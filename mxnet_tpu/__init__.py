@@ -1,0 +1,45 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new implementation (not a port): the compute path is JAX/XLA/Pallas,
+scheduling and memory are XLA's, and distribution is ``jax.sharding`` over
+device meshes. See SURVEY.md for the capability map against the reference
+(Apache MXNet ~1.2, rahul003 fork).
+
+Usage mirrors MXNet::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    net = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=10)
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, AttrScope
+from . import executor
+from .executor import Executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from .io import DataBatch, DataIter
+from . import kvstore
+from . import kvstore as kv
+from .kvstore import KVStore
+from . import model
+from . import module
+from . import module as mod
+from .module import Module
+from . import parallel
+from . import test_utils

@@ -1,0 +1,280 @@
+"""Autograd: imperative differentiation over recorded op tapes.
+
+Reference parity: python/mxnet/autograd.py + src/imperative/imperative.cc
+(RecordOp :183, Backward :270). The reference tags NDArrays with nnvm graph
+nodes and runs nnvm::pass::Gradient; here the tape of eager ops is replayed
+as a pure JAX function and differentiated with ``jax.vjp`` — one XLA
+computation for the whole backward, rather than per-op backward kernels.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops import registry as _reg
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "get_symbol"]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_state = _AGState()
+
+
+def is_recording():
+    return _state.recording
+
+
+def is_training():
+    return _state.training
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        self._saved = (_state.recording, _state.training)
+        if self._rec is not None:
+            _state.recording = self._rec
+        if self._train is not None:
+            _state.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        _state.recording, _state.training = self._saved
+
+
+def record(train_mode=True):
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+class _TapeRecord:
+    __slots__ = ("opdef", "attrs", "is_train", "rng", "inputs", "outputs",
+                 "custom")
+
+    def __init__(self, opdef, attrs, is_train, rng, inputs, outputs,
+                 custom=None):
+        self.opdef = opdef
+        self.attrs = attrs
+        self.is_train = is_train
+        self.rng = rng
+        self.inputs = inputs     # list of NDArray or None
+        self.outputs = outputs   # list of NDArray (visible outputs)
+        self.custom = custom     # optional callable(*arrays)->arrays (Function)
+
+
+def _record_op(opdef, attrs, is_train, rng, inputs, outputs, custom=None):
+    rec = _TapeRecord(opdef, attrs, is_train, rng, inputs, outputs, custom)
+    idx = len(_state.tape)
+    _state.tape.append(rec)
+    for o in outputs:
+        o._autograd_entry = idx
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def _collect_subgraph(outputs):
+    """Topo-ordered tape records reachable from outputs + leaf variables."""
+    tape = _state.tape
+    needed = set()
+    stack = [o._autograd_entry for o in outputs if o._autograd_entry is not None]
+    while stack:
+        idx = stack.pop()
+        if idx in needed:
+            continue
+        needed.add(idx)
+        for inp in tape[idx].inputs:
+            if inp is not None and inp._autograd_entry is not None:
+                stack.append(inp._autograd_entry)
+    order = sorted(needed)
+    leaves = []
+    seen = set()
+    for idx in order:
+        for inp in tape[idx].inputs:
+            if (inp is not None and inp._grad_req != "null"
+                    and id(inp) not in seen):
+                seen.add(id(inp))
+                leaves.append(inp)
+    # marked outputs themselves can be leaves (x.attach_grad(); y=f(x))
+    return [tape[i] for i in order], leaves
+
+
+def backward(outputs, out_grads=None, retain_graph=False, train_mode=True,
+             variables=None):
+    """Compute gradients of outputs w.r.t. marked variables and write them
+    into ``var.grad`` honoring grad_req (write/add)."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    nodes, leaves = _collect_subgraph(outputs)
+    explicit = variables is not None
+    if explicit:
+        leaves = list(variables)
+    if not leaves:
+        raise MXNetError("backward: no variables with grad attached "
+                         "(call attach_grad/mark_variables first)")
+
+    leaf_ids = [id(v) for v in leaves]
+    leaf_id_set = set(leaf_ids)
+
+    def replay(leaf_vals):
+        env = dict(zip(leaf_ids, leaf_vals))
+
+        def val(nd):
+            if nd is None:
+                return None
+            got = env.get(id(nd))
+            return got if got is not None else jax.lax.stop_gradient(nd._data)
+
+        for rec in nodes:
+            ins = [val(x) for x in rec.inputs]
+            if rec.custom is not None:
+                raw = rec.custom(*ins)
+            else:
+                with _reg._OpCtxScope(rec.is_train, rec.rng):
+                    raw = rec.opdef.fn(*ins, **rec.attrs)
+            outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+            for o_nd, v in zip(rec.outputs, outs):
+                # a marked variable that is itself a record output stays a
+                # leaf: keep the vjp input value so its gradient flows
+                if id(o_nd) not in leaf_id_set:
+                    env[id(o_nd)] = v
+        res = []
+        for o in outputs:
+            got = env.get(id(o))
+            res.append(got if got is not None else o._data)
+        return res
+
+    leaf_vals = [v._data for v in leaves]
+    with _Scope(recording=False, training=train_mode):
+        out_vals, vjp_fn = jax.vjp(replay, leaf_vals)
+    if out_grads is None:
+        cts = [jnp.ones_like(v) for v in out_vals]
+    else:
+        cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+               for g in out_grads]
+    (grads,) = vjp_fn(cts)
+
+    if not retain_graph:
+        _clear_tape()
+
+    result = []
+    for v, g in zip(leaves, grads):
+        g = g.astype(v._data.dtype)
+        if explicit:
+            result.append(NDArray(g, v._ctx))
+        elif v._grad_req == "add" and v._grad is not None:
+            v._grad._set_data(v._grad._data + g)
+        elif v._grad is not None:
+            v._grad._set_data(g)
+    return result if explicit else None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients instead of writing .grad (parity: autograd.grad)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if create_graph:
+        raise NotImplementedError("higher-order autograd.grad lands with the "
+                                  "symbolic higher-order pass")
+    retain = retain_graph if retain_graph is not None else create_graph
+    return backward(heads, out_grads=head_grads, retain_graph=retain,
+                    train_mode=train_mode, variables=variables)
+
+
+def _clear_tape():
+    for rec in _state.tape:
+        for o in rec.outputs:
+            o._autograd_entry = None
+    _state.tape.clear()
+
+
+def get_symbol(x):
+    raise NotImplementedError("autograd.get_symbol: use symbolic API instead")
+
+
+class Function:
+    """User-defined differentiable function (parity: autograd.Function,
+    python/mxnet/autograd.py:363). Subclass and implement forward/backward
+    with NDArray semantics; internally wrapped as a jax.custom_vjp."""
+
+    def __init__(self):
+        self._used = False
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        from .ndarray import dispatch as _dispatch
+        ctx = inputs[0]._ctx if inputs else None
+        self_ref = self
+
+        @jax.custom_vjp
+        def _f(*arrs):
+            nds = [NDArray(a, ctx) for a in arrs]
+            with _Scope(recording=False):
+                outs = self_ref.forward(*nds)
+            if isinstance(outs, NDArray):
+                return outs._data
+            return tuple(o._data for o in outs)
+
+        def _fwd(*arrs):
+            return _f(*arrs), None
+
+        def _bwd(res, g):
+            gs = (g,) if not isinstance(g, (tuple, list)) else tuple(g)
+            gnds = [NDArray(x, ctx) for x in gs]
+            with _Scope(recording=False):
+                igrads = self_ref.backward(*gnds)
+            if isinstance(igrads, NDArray):
+                igrads = (igrads,)
+            return tuple(x._data for x in igrads)
+
+        _f.defvjp(_fwd, _bwd)
+        arrs = [x._data for x in inputs]
+        raw = _f(*arrs)
+        outs_raw = list(raw) if isinstance(raw, tuple) else [raw]
+        outputs = [NDArray(o, ctx) for o in outs_raw]
+        if is_recording():
+            _record_op(None, {}, is_training(), None, list(inputs), outputs,
+                       custom=_f)
+        return outputs[0] if len(outputs) == 1 else outputs

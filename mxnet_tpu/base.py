@@ -1,0 +1,99 @@
+"""Foundation utilities for mxnet_tpu.
+
+TPU-native rebuild of MXNet's base layer. The reference funnels everything
+through a ctypes FFI boundary (reference: python/mxnet/base.py:711,
+include/mxnet/c_api.h); here the "backend" is JAX/XLA, so the base layer only
+carries the error type, name management, and small shared helpers.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "NameManager", "Prefix", "current_name_manager", "classproperty"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by mxnet_tpu (parity with reference dmlc error surface)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+class _NameManagerTLS(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+
+_name_tls = _NameManagerTLS()
+
+
+class NameManager:
+    """Automatic unique-name generation for symbols/blocks.
+
+    Mirrors reference python/mxnet/name.py: each anonymous symbol gets
+    ``{op_name_lower}{counter}``.
+    """
+
+    _current_global = None
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    def __enter__(self):
+        _name_tls.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        _name_tls.stack.pop()
+
+
+class Prefix(NameManager):
+    """NameManager that attaches a constant prefix to every name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current_name_manager() -> NameManager:
+    if _name_tls.stack:
+        return _name_tls.stack[-1]
+    if NameManager._current_global is None:
+        NameManager._current_global = NameManager()
+    return NameManager._current_global
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+_SNAKE_RE1 = re.compile(r"(.)([A-Z][a-z]+)")
+_SNAKE_RE2 = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    name = _SNAKE_RE1.sub(r"\1_\2", name)
+    return _SNAKE_RE2.sub(r"\1_\2", name).lower()

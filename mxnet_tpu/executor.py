@@ -1,0 +1,425 @@
+"""Executor: a bound symbol compiled to whole-graph XLA computations.
+
+Reference parity: src/executor/graph_executor.cc + include/mxnet/executor.h.
+The reference builds a full fwd+bwd nnvm graph, plans memory, and pushes one
+engine op per node; here ``simple_bind`` traces the DAG once into
+
+* ``_fwd``      — one XLA computation for forward (+ aux-state updates),
+* ``_fwd_bwd``  — one XLA computation for forward+backward via ``jax.vjp``,
+
+so the whole step is a single fused HLO (the BASELINE.json north-star:
+"one XLA computation per forward/backward subgraph"). Memory planning,
+op fusion, scheduling = XLA. grad_req add/write follows the reference's
+OpReqType semantics (include/mxnet/op_attr_types.h:46).
+
+Training forward is lazily fused: ``forward(is_train=True)`` defers
+execution; ``backward()`` then runs the fused fwd+bwd program, so a
+Module-style fit step costs exactly one compiled program launch.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray, zeros as nd_zeros
+from .ops import registry as _reg
+
+__all__ = ["Executor"]
+
+
+def _build_graph_fn(symbol):
+    """Build a pure function (args, auxs, seed, is_train) ->
+    (outputs, new_auxs) interpreting the DAG with registered op impls."""
+    topo = symbol._topo()
+    entries = list(symbol._entries)
+    aux_names = set(symbol.list_auxiliary_states())
+
+    def graph_fn(args, auxs, seed, is_train):
+        rng = jax.random.key(seed)
+        new_auxs = {}
+        with _reg._OpCtxScope(is_train, rng):
+            env = {}
+            for node in topo:
+                if node.is_var:
+                    if node.name in args:
+                        env[(id(node), 0)] = args[node.name]
+                    elif node.name in auxs:
+                        env[(id(node), 0)] = jax.lax.stop_gradient(auxs[node.name])
+                    else:
+                        raise MXNetError("unbound variable '%s'" % node.name)
+                    continue
+                ins = [env[(id(inp), oi)] for inp, oi in node.inputs]
+                raw = node.op.fn(*ins, **node.attrs)
+                outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+                for i, v in enumerate(outs):
+                    env[(id(node), i)] = v
+                # aux-state updates (reference FMutateInputs)
+                if node.op.mutate_inputs and is_train:
+                    in_names = node.op.input_names
+                    for mut_name, out_idx in node.op.mutate_inputs:
+                        for (inp, _), nm in zip(node.inputs, in_names):
+                            if nm == mut_name and inp.is_var and inp.name in aux_names:
+                                new_auxs[inp.name] = outs[out_idx]
+            outputs = [env[(id(n), oi)] for n, oi in entries]
+        for name in auxs:
+            new_auxs.setdefault(name, auxs[name])
+        return outputs, new_auxs
+
+    return graph_fn
+
+
+def _compiled_cache(symbol):
+    """Per-symbol compiled-callable cache: executors bound to the same
+    Symbol (rebinds, numeric-grad perturbations, BucketingModule buckets)
+    share XLA executables — the analog of the reference's shared memory
+    pool across executors (graph_executor.cc InitDataEntryMemory)."""
+    cache = getattr(symbol, "_exec_cache", None)
+    if cache is None:
+        graph_fn = _build_graph_fn(symbol)
+
+        @jax.jit
+        def _fwd_train(args, auxs, seed):
+            return graph_fn(args, auxs, seed, True)
+
+        @jax.jit
+        def _fwd_eval(args, auxs, seed):
+            outs, _ = graph_fn(args, auxs, seed, False)
+            return outs
+
+        cache = {"graph_fn": graph_fn, "fwd_train": _fwd_train,
+                 "fwd_eval": _fwd_eval, "fwd_bwd": {}}
+        symbol._exec_cache = cache
+    return cache
+
+
+def _make_fwd_bwd(graph_fn, diff_names):
+    @jax.jit
+    def _fwd_bwd(args, auxs, seed, ograds):
+        diff = {n: args[n] for n in diff_names}
+        rest = {n: v for n, v in args.items() if n not in diff}
+
+        def f(d):
+            outs, new_auxs = graph_fn({**rest, **d}, auxs, seed, True)
+            return outs, new_auxs
+
+        outs, vjp_fn, new_auxs = jax.vjp(f, diff, has_aux=True)
+        cts = [g if g is not None else jnp.ones_like(o)
+               for g, o in zip(ograds, outs)]
+        (grads,) = vjp_fn(cts)
+        return outs, new_auxs, grads
+    return _fwd_bwd
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
+                 grad_req_dict, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else current_context()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req_dict
+        self._group2ctx = group2ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._diff_names = [n for n in self._arg_names
+                            if grad_req_dict.get(n, "null") != "null"]
+        self._monitor_callback = None
+        self._outputs = None
+        self._pending_train_fwd = False
+        self._train_seed = None
+        self._train_auxs = None
+        self._step = 0
+        self._base_seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
+
+        cache = _compiled_cache(symbol)
+        self._graph_fn = cache["graph_fn"]
+        self._jit_fwd_train = cache["fwd_train"]
+        self._jit_fwd_eval = cache["fwd_eval"]
+        key = tuple(sorted(self._diff_names))
+        if key not in cache["fwd_bwd"]:
+            cache["fwd_bwd"][key] = _make_fwd_bwd(cache["graph_fn"], key)
+        self._jit_fwd_bwd = cache["fwd_bwd"][key]
+
+    # ------------------------------------------------------------------
+    @property
+    def outputs(self):
+        if self._pending_train_fwd:
+            self._run_fwd(True)
+        return self._outputs
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    # ------------------------------------------------------------------
+    def _args_values(self):
+        return {n: self.arg_dict[n]._data for n in self._arg_names}
+
+    def _auxs_values(self):
+        return {n: self.aux_dict[n]._data for n in self._aux_names}
+
+    def _next_seed(self):
+        self._step += 1
+        return _np.uint32((int(self._base_seed) + self._step * 2654435761)
+                          & 0x7FFFFFFF)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument '%s'" % k)
+            dst = self.arg_dict[k]
+            if isinstance(v, NDArray):
+                dst._set_data(v._data)
+            else:
+                dst._sync_copyfrom(v)
+        if is_train:
+            # defer: backward() will run the fused fwd+bwd program. The seed
+            # and pre-update aux snapshot are fixed NOW so that a forced
+            # .outputs read and the later backward() see the exact same
+            # computation (same dropout masks, single aux-momentum update).
+            self._pending_train_fwd = True
+            self._outputs = None
+            self._train_seed = self._next_seed()
+            self._train_auxs = self._auxs_values()
+        else:
+            self._train_seed = None
+            self._train_auxs = None
+            self._run_fwd(False)
+        return self.outputs if not is_train else _LazyOutputs(self)
+
+    def _run_fwd(self, is_train):
+        if is_train:
+            seed = self._train_seed if self._train_seed is not None \
+                else self._next_seed()
+            auxs = self._train_auxs if self._train_auxs is not None \
+                else self._auxs_values()
+            outs, new_auxs = self._jit_fwd_train(self._args_values(), auxs, seed)
+            self._write_auxs(new_auxs)
+        else:
+            seed = self._next_seed()
+            outs = self._jit_fwd_eval(self._args_values(),
+                                      self._auxs_values(), seed)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._pending_train_fwd = False
+        return self._outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self._diff_names:
+            self._pending_train_fwd = False
+            return
+        n_out = len(self._output_names)
+        if out_grads is None:
+            ograds = [None] * n_out
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        # reuse the seed/aux snapshot fixed at forward(is_train=True) so the
+        # recomputed forward inside the fused program matches what the user
+        # observed (and aux momentum updates apply exactly once per step)
+        seed = self._train_seed if self._train_seed is not None \
+            else self._next_seed()
+        auxs = self._train_auxs if self._train_auxs is not None \
+            else self._auxs_values()
+        self._train_seed = None
+        self._train_auxs = None
+        outs, new_auxs, grads = self._jit_fwd_bwd(
+            self._args_values(), auxs, seed, ograds)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._pending_train_fwd = False
+        self._write_auxs(new_auxs)
+        for name, g in grads.items():
+            req = self._grad_req.get(name, "null")
+            dst = self.grad_dict.get(name)
+            if dst is None or req == "null":
+                continue
+            g = g.astype(dst._data.dtype)
+            if req == "add":
+                dst._set_data(dst._data + g)
+            else:
+                dst._set_data(g)
+
+    def _write_auxs(self, new_auxs):
+        for name, v in new_auxs.items():
+            self.aux_dict[name]._set_data(v)
+
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    jax.device_put(arr._data, self._ctx.jax_device))
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg '%s'" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        jax.device_put(arr._data, self._ctx.jax_device))
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux '%s'" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound with new data shapes; weights are
+        shared (reference: GraphExecutor::Reshape, graph_executor.h:110).
+        The jit cache keys on shape, so recompilation is automatic."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**kwargs)
+        new_args = {}
+        for name, shp in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if shp is not None and tuple(shp) != cur.shape:
+                new_args[name] = nd_zeros(shp, self._ctx, cur.dtype)
+            else:
+                new_args[name] = cur
+        grad_dict = {}
+        for name, arr in new_args.items():
+            if self._grad_req.get(name, "null") != "null":
+                prev = self.grad_dict.get(name)
+                if prev is not None and prev.shape == arr.shape:
+                    grad_dict[name] = prev
+                else:
+                    grad_dict[name] = nd_zeros(arr.shape, self._ctx, arr.dtype)
+        return Executor(self._symbol, self._ctx, new_args, grad_dict,
+                        dict(self.aux_dict), dict(self._grad_req),
+                        self._group2ctx)
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self._output_names)]
+        for node in self._symbol._topo():
+            kind = "var" if node.is_var else node.op.name
+            lines.append("  %s %s <- %s" % (kind, node.name,
+                                            [n.name for n, _ in node.inputs]))
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
+    # binding entry points (invoked from Symbol)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize_grad_req(grad_req, arg_names):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(arg_names, grad_req))
+        if isinstance(grad_req, dict):
+            return {n: grad_req.get(n, "null") for n in arg_names}
+        raise MXNetError("invalid grad_req %r" % (grad_req,))
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx,
+                     shared_exec, shared_buffer, shape_kwargs):
+        ctx = ctx if ctx is not None else current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        type_dict = type_dict or {}
+        arg_types, _, aux_types = symbol.infer_type(**{
+            k: v for k, v in type_dict.items()})
+
+        grad_req_dict = Executor._normalize_grad_req(grad_req, arg_names)
+        # data/label inputs default to grad null under 'write' like the
+        # reference Module behavior is handled by the caller; here we follow
+        # the grad_req given.
+        arg_dict = {}
+        for name, shp, dt in zip(arg_names, arg_shapes, arg_types):
+            shared = shared_exec.arg_dict.get(name) if shared_exec else None
+            if shared is not None and shared.shape == tuple(shp):
+                arg_dict[name] = shared
+            else:
+                arg_dict[name] = nd_zeros(shp, ctx, type_dict.get(name, dt))
+        grad_dict = {}
+        for name in arg_names:
+            if grad_req_dict.get(name, "null") != "null":
+                arr = arg_dict[name]
+                grad_dict[name] = nd_zeros(arr.shape, ctx, arr.dtype)
+        aux_dict = {}
+        for name, shp, dt in zip(aux_names, aux_shapes, aux_types):
+            shared = shared_exec.aux_dict.get(name) if shared_exec else None
+            if shared is not None and shared.shape == tuple(shp):
+                aux_dict[name] = shared
+            else:
+                aux_dict[name] = nd_zeros(shp, ctx, dt)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict,
+                        grad_req_dict, group2ctx)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states, group2ctx,
+              shared_exec):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args)
+        missing = [n for n in arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        if isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, args_grad))
+        elif args_grad is None:
+            grad_dict = {}
+        else:
+            grad_dict = dict(args_grad)
+        if isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        elif aux_states is None:
+            aux_dict = {}
+        else:
+            aux_dict = dict(aux_states)
+        for n in aux_names:
+            if n not in aux_dict:
+                raise MXNetError("bind: missing aux state %s" % n)
+        grad_req_dict = Executor._normalize_grad_req(grad_req, arg_names)
+        for n in arg_names:
+            if n not in grad_dict:
+                grad_req_dict[n] = "null"
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict,
+                        grad_req_dict, group2ctx)
+
+
+class _LazyOutputs(list):
+    """Returned by forward(is_train=True); materializes on first access so
+    Module's fwd+bwd fuses into one program when outputs aren't read early."""
+
+    def __init__(self, executor):
+        super().__init__()
+        self._ex = executor
+
+    def _force(self):
+        outs = self._ex.outputs
+        if not list.__len__(self):
+            self.extend(outs)
+        return outs
+
+    def __getitem__(self, i):
+        self._force()
+        return super().__getitem__(i)
+
+    def __iter__(self):
+        self._force()
+        return super().__iter__()
+
+    def __len__(self):
+        self._force()
+        return super().__len__()

@@ -1,0 +1,312 @@
+"""Weight initializers (reference parity: python/mxnet/initializer.py).
+
+Same registry + ``InitDesc``-style name-pattern dispatch as the reference:
+names ending in _weight/_bias/_gamma/_beta/_moving_* get the matching rule.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Load", "Mixed", "InitDesc",
+           "register", "Bilinear", "LSTMBias", "FusedRNN"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def get(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if name is None:
+        return Uniform(0.07)
+    key = str(name).lower()
+    if key not in _INIT_REGISTRY:
+        raise MXNetError("unknown initializer '%s'" % name)
+    return _INIT_REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init_attr = desc.attrs.get("__init__", "")
+        if init_attr:
+            klass, kwargs = json.loads(init_attr)
+            get(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("_weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("_bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("_gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("_beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("_moving_mean") or name.endswith("_running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("_moving_var") or name.endswith("_running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("_moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("_min") or name.endswith("_max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- rules ----------------------------------------------------------
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.random.normal(0, self.sigma, arr.shape)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference initializer.py Xavier; default for vision)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError("Xavier requires >=2D weight, got %s for %s"
+                             % (shape, name))
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = _np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = _np.random.uniform(-scale, scale, shape)
+        else:
+            arr[:] = _np.random.normal(0, scale, shape)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Initializer.__init__(self, factor_type=factor_type, slope=slope)
+        self.rnd_type = "gaussian"
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.size, dtype="float32")
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(arr.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class Load(Initializer):
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = param
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        name = str(name)
+        for key in (name, "arg:" + name, "aux:" + name):
+            if key in self.param:
+                src = self.param[key]
+                if src.shape != arr.shape:
+                    raise MXNetError("shape mismatch loading %s" % name)
+                arr[:] = src.asnumpy() if hasattr(src, "asnumpy") else src
+                return
+        if self.default_init is None:
+            raise MXNetError("no init value for %s" % name)
+        self.default_init(InitDesc(name), arr)
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("no initializer matches %s" % name)
+
+
+@register
+class LSTMBias(Initializer):
+    """Init forget-gate bias to a constant (cuDNN gate order i,f,g,o)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = arr.shape[0] // 4
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        a[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = a
+
+    _init_default = _init_bias
+    _init_weight = _init_bias
+
+
+class FusedRNN(Initializer):
+    """Initialize the flat fused-RNN parameter vector by delegating to a
+    base initializer per sub-matrix (reference initializer.py FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        super().__init__()
+        self._init = get(init) if not isinstance(init, Initializer) else init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn import _NGATES
+        ngates = _NGATES[self._mode]
+        H = self._num_hidden
+        flat = arr.asnumpy().ravel()
+        # weights: uniform; biases: zero (+forget bias for lstm)
+        total = flat.size
+        nbias_per = ngates * H
+        ndir = 2 if self._bidirectional else 1
+        n_bias = self._num_layers * ndir * 2 * nbias_per
+        wpart = _np.random.uniform(-0.07, 0.07, total - n_bias)
+        bpart = _np.zeros(n_bias, dtype="float32")
+        if self._mode == "lstm":
+            for blk in range(self._num_layers * ndir * 2):
+                bpart[blk * nbias_per + H: blk * nbias_per + 2 * H] = \
+                    self._forget_bias
+        arr[:] = _np.concatenate([wpart, bpart]).reshape(arr.shape)
+
+    _init_default = _init_weight

@@ -1,0 +1,15 @@
+"""Data IO (reference parity: python/mxnet/io.py + src/io/).
+
+The reference's C++ iterator chain (record reader → OMP decode → augment →
+batch → prefetch, src/io/iter_image_recordio_2.cc) maps to Python iterators
+with a background-thread prefetcher; device transfer is asynchronous via JAX
+so the `PrefetcherIter` role (overlap host decode with device compute) is
+preserved.
+"""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, MXDataIter, CSVIter, MNISTIter,
+                 ImageRecordIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MXDataIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter"]

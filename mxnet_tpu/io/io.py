@@ -1,0 +1,468 @@
+"""Data iterators.
+
+Reference parity: python/mxnet/io.py (DataIter protocol :182, NDArrayIter
+:546, PrefetchingIter :349, MXDataIter :766) and src/io/ C++ iterators
+(MNISTIter, CSVIter, ImageRecordIter). All iterators yield ``DataBatch``
+with ``data``/``label`` NDArray lists and ``pad`` for final-batch handling.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array as nd_array
+from ..ndarray.ndarray import concatenate
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MXDataIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter"]
+
+
+class DataDesc:
+    """Name+shape(+dtype+layout) of one input (reference io.py DataDesc)."""
+
+    def __init__(self, name, shape, dtype=_np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    def __iter__(self):  # tuple-compat: name, shape
+        yield self.name
+        yield self.shape
+
+    def __getitem__(self, i):
+        return (self.name, self.shape)[i]
+
+    def __len__(self):
+        return 2
+
+    def __eq__(self, other):
+        if isinstance(other, DataDesc):
+            return self.name == other.name and self.shape == other.shape
+        if isinstance(other, tuple):
+            return tuple(self) == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.name, self.shape))
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types=None):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict.get(x[0], _np.float32))
+                    for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        return "DataBatch: data shapes %s label shapes %s" % (
+            [d.shape for d in self.data] if self.data else None,
+            [l.shape for l in self.label] if self.label else None)
+
+
+class DataIter:
+    """Iterator protocol (reference io.py:182)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = nd_array(_np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference io.py:546): shuffle, pad/discard/
+    roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.idx = _np.arange(self.num_data)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self.num_pad = 0
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self._cache_np = {k: v.asnumpy() for k, v in self.data + self.label}
+        if shuffle:
+            self._shuffle_data()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def _shuffle_data(self):
+        _np.random.shuffle(self.idx)
+
+    def reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, kv_list):
+        out = []
+        for k, _ in kv_list:
+            src = self._cache_np[k]
+            start = self.cursor
+            end = self.cursor + self.batch_size
+            if end <= self.num_data:
+                part = src[self.idx[start:end]]
+                self.num_pad = 0
+            else:
+                pad = end - self.num_data
+                sel = _np.concatenate([self.idx[start:], self.idx[:pad]])
+                part = src[sel]
+                self.num_pad = pad
+            out.append(nd_array(part))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[self.cursor:end]
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (reference io.py:288)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference io.py:349 + C++
+    iter_prefetcher.h): overlaps host-side batch prep with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._depth = prefetch_depth
+        self._queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [i.next() for i in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        batch = batches[0]
+        if len(batches) > 1:
+            data = sum([b.data for b in batches], [])
+            label = sum([b.label for b in batches], [])
+            return DataBatch(data, label, batch.pad, batch.index)
+        return batch
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        else:
+            label = _np.zeros((data.shape[0],), dtype=dtype)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad" if round_batch else "discard",
+                                  data_name="data", label_name="label")
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def next(self):
+        return self._inner.next()
+
+    def reset(self):
+        self._inner.reset()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference src/io/iter_mnist.cc)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=None, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        imgs = self._read_idx(image)
+        labels = self._read_idx(label)
+        imgs = imgs.astype("float32") / 255.0
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1], imgs.shape[2])
+        if input_shape is not None:
+            imgs = imgs.reshape((imgs.shape[0],) + tuple(input_shape))
+        self._inner = NDArrayIter(imgs, labels.astype("float32"), batch_size,
+                                  shuffle=shuffle, last_batch_handle="discard")
+
+    @staticmethod
+    def _read_idx(path):
+        if not os.path.exists(path):
+            raise MXNetError("MNIST file not found: %s" % path)
+        import gzip
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            return data.reshape(dims)
+
+    def next(self):
+        return self._inner.next()
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+def MXDataIter(handle, **kwargs):  # pragma: no cover - compat shim
+    raise MXNetError("MXDataIter wraps C++ iterators in the reference; use "
+                     "the Python-native iterators (NDArrayIter, "
+                     "ImageRecordIter, CSVIter, MNISTIter) instead")
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO image iterator — implemented in image/record_iter.py over the
+    native recordio reader (reference src/io/iter_image_recordio_2.cc)."""
+    from ..image.record_iter import ImageRecordIter as _Impl
+    return _Impl(**kwargs)

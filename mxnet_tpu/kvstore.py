@@ -1,0 +1,186 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference parity: include/mxnet/kvstore.h + src/kvstore/ (SURVEY.md §2.3).
+TPU-native mapping:
+
+* ``local``/``device`` — single-process aggregation. The reference reduces
+  gradient lists on CPU (CommCPU) or via GPU P2P (CommDevice); here the
+  per-device gradients are jnp adds that XLA schedules — and when the arrays
+  are sharded over a mesh the same add lowers to an ICI all-reduce.
+* ``tpu`` (alias ``nccl``) — same API; values that live sharded on a
+  ``jax.sharding.Mesh`` reduce over ICI (replaces KVStoreNCCL).
+* ``dist_sync``/``dist_async`` — multi-process over ``jax.distributed``
+  (kvstore_dist.py), replacing ps-lite ZPush/ZPull. The optimizer-on-server
+  mode maps to running the updater on the reduced value (sync by
+  construction).
+
+2-bit gradient compression (rahul003's signature feature,
+src/kvstore/gradient_compression.h) is preserved as an optional transform
+applied on push (parallel/compression.py).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import ndarray as nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local"):
+    """Create a KVStore (reference kvstore.cc:40 string dispatch)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be str")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl", "tpu"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        from .kvstore_dist import KVStoreDist
+        return KVStoreDist(name)
+    raise MXNetError("unknown kvstore type '%s'" % name)
+
+
+class KVStore:
+    """Single-process kvstore (reference kvstore_local.h:53)."""
+
+    def __init__(self, name="local"):
+        self._type = name
+        self._store = {}
+        self._updater = None
+        self._compression = None
+        self._compression_residuals = {}
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values per key (reference KVStoreLocal::PushImpl
+        kvstore_local.h:168 → Comm::Reduce). When a compression config is
+        set, each device gradient goes through quantize→dequantize with
+        per-key error-feedback residual, matching gradient_compression.h."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if self._compression is not None:
+                vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
+            reduced = vlist[0]
+            if len(vlist) > 1:
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + v._data
+                reduced = NDArray(acc, vlist[0].context)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % k)
+                self._updater(_updater_key(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense fallback: row_sparse storage maps to dense on TPU (SURVEY §7)
+        self.pull(key, out=out, priority=priority)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        self.set_updater(opt.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression (reference kvstore.py:392)."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype not in ("2bit",):
+            raise MXNetError("unsupported compression type %s" % ctype)
+        from .parallel.compression import TwoBitCompressor
+        self._compression = TwoBitCompressor(
+            threshold=float(compression_params.get("threshold", 0.5)))
+
+    def _compress(self, key, dev_idx, grad):
+        res_key = (key, dev_idx)
+        residual = self._compression_residuals.get(res_key)
+        if residual is None:
+            residual = zeros(grad.shape, grad.context, str(grad.dtype))
+            self._compression_residuals[res_key] = residual
+        out, new_residual = self._compression.compress_decompress(
+            grad._data, residual._data)
+        residual._set_data(new_residual)
+        return NDArray(out, grad.context)
+
+    def barrier(self):
+        pass
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Liveness query (reference kvstore.h:341); single-process → 0."""
+        return 0
+
+    @property
+    def is_recovery(self):
+        return False
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value):
+    """Normalize (key, value) into (list_of_keys, list_of_value_lists)."""
+    single = isinstance(key, (str, int))
+    if single:
+        key = [key]
+        value = [value]
+    else:
+        key = list(key)
+        if value is None:
+            value = [None] * len(key)
+    out_vals = []
+    for k, v in zip(key, value):
+        if v is None:
+            out_vals.append(None)
+        elif isinstance(v, NDArray):
+            out_vals.append([v])
+        else:
+            out_vals.append(list(v))
+    return key, out_vals

@@ -1,0 +1,178 @@
+"""DataParallelExecutorGroup: multi-device data parallelism.
+
+Reference parity: python/mxnet/module/executor_group.py:143. The reference
+slices each batch across per-device executors (decide_slices :281) and
+gathers gradients through kvstore. TPU-native (SURVEY.md §7): ONE executor
+over a ``jax.sharding.Mesh`` with the batch sharded on the 'dp' axis and
+parameters replicated — XLA partitions the compiled step SPMD and inserts
+ICI all-reduces for the gradients, replacing per-device executors + Comm.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..context import cpu
+from ..ndarray import NDArray
+from ..ndarray.ndarray import array as nd_array
+from ..io.io import DataDesc
+from ..parallel.mesh import data_parallel_mesh
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=None, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = state_names or []
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.data_names = [d.name if isinstance(d, DataDesc) else d[0]
+                           for d in data_shapes]
+        self.label_names = [l.name if isinstance(l, DataDesc) else l[0]
+                            for l in (label_shapes or [])]
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self._grad_req_arg = grad_req
+
+        self._n_dev = len(contexts)
+        self._mesh = data_parallel_mesh(contexts) if self._n_dev > 1 else None
+
+        req = {}
+        for name in self.arg_names:
+            if name in self.data_names:
+                req[name] = "null"
+            elif name in self.label_names:
+                req[name] = "null"
+            elif name in self.fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if isinstance(grad_req, str) \
+                    else grad_req.get(name, "write")
+        if inputs_need_grad:
+            for name in self.data_names:
+                req[name] = "write"
+        shapes = {}
+        for d in list(data_shapes) + list(label_shapes or []):
+            name, shp = (d.name, d.shape) if isinstance(d, DataDesc) \
+                else (d[0], d[1])
+            shapes[name] = shp
+        shared_exec = shared_group.execs[0] if shared_group else None
+        self.execs = [symbol.simple_bind(contexts[0], req,
+                                         shared_exec=shared_exec, **shapes)]
+        self._exec = self.execs[0]
+        if self._mesh is not None:
+            self._install_shardings()
+
+        # Module-facing views: param_arrays[i] is the list of per-device
+        # arrays for param i — with one sharded executor that list has one
+        # entry (the global array).
+        self.param_arrays = [[self._exec.arg_dict[n]] for n in param_names
+                             if n in self._exec.arg_dict]
+        self.grad_arrays = [[self._exec.grad_dict[n]]
+                            if self._exec.grad_dict.get(n) is not None else [None]
+                            for n in param_names if n in self._exec.arg_dict]
+        self.aux_arrays = [[self._exec.aux_dict[n]] for n in self.aux_names]
+
+    # ------------------------------------------------------------------
+    def _batch_sharding(self):
+        return NamedSharding(self._mesh, P("dp"))
+
+    def _repl_sharding(self):
+        return NamedSharding(self._mesh, P())
+
+    def _install_shardings(self):
+        repl = self._repl_sharding()
+        bsh = self._batch_sharding()
+        for name, arr in self._exec.arg_dict.items():
+            sh = bsh if (name in self.data_names or name in self.label_names) \
+                else repl
+            arr._set_data(jax.device_put(arr._data, sh))
+        for arr in self._exec.aux_dict.values():
+            arr._set_data(jax.device_put(arr._data, repl))
+        for arr in self._exec.grad_dict.values():
+            if arr is not None:
+                arr._set_data(jax.device_put(arr._data, repl))
+
+    def _place_input(self, name, value):
+        data = value._data if isinstance(value, NDArray) else \
+            nd_array(_np.asarray(value))._data
+        if self._mesh is not None:
+            data = jax.device_put(data, self._batch_sharding())
+        dst = self._exec.arg_dict[name]
+        if data.shape != dst.shape:
+            raise MXNetError("input '%s' shape %s != bound shape %s (use "
+                             "module.reshape)" % (name, data.shape, dst.shape))
+        dst._set_data(data.astype(dst._data.dtype))
+
+    # ------------------------------------------------------------------
+    def load_data_batch(self, data_batch):
+        data = data_batch.data
+        for name, value in zip(self.data_names, data):
+            self._place_input(name, value)
+        if self.label_names and data_batch.label:
+            for name, value in zip(self.label_names, data_batch.label):
+                self._place_input(name, value)
+
+    def forward(self, data_batch, is_train=None):
+        self.load_data_batch(data_batch)
+        if is_train is None:
+            is_train = self.for_training
+        self._exec.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to call backward")
+        self._exec.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True, begin=0, end=None):
+        outs = list(self._exec.outputs)
+        if end is None:
+            end = len(outs)
+        outs = outs[begin:end]
+        return outs if merge_multi_context else [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = [self._exec.grad_dict.get(n) for n in self.data_names]
+        return grads if merge_multi_context else [[g] for g in grads]
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self._exec.copy_params_from(arg_params, aux_params, allow_extra)
+        if self._mesh is not None:
+            self._install_shardings()
+
+    def get_params(self, arg_params, aux_params):
+        for name in self.param_names:
+            if name in self._exec.arg_dict:
+                arg_params[name] = nd_array(
+                    self._exec.arg_dict[name].asnumpy(), ctx=cpu())
+        for name in self.aux_names:
+            aux_params[name] = nd_array(
+                self._exec.aux_dict[name].asnumpy(), ctx=cpu())
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self.label_names, labels or [])),
+            dict(zip(self.symbol.list_outputs(), list(self._exec.outputs))))
+
+    def reshape(self, data_shapes, label_shapes):
+        return DataParallelExecutorGroup(
+            self.symbol, self.contexts, None, data_shapes, label_shapes,
+            self.param_names, self.for_training, self.inputs_need_grad,
+            shared_group=self, fixed_param_names=self.fixed_param_names,
+            grad_req=self._grad_req_arg, state_names=self.state_names)
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            exe.set_monitor_callback(mon.stat_helper if hasattr(mon, "stat_helper")
+                                     else mon)

@@ -1,0 +1,110 @@
+"""NDArray package: imperative tensors + generated op namespace.
+
+Parity surface: python/mxnet/ndarray/ — ``mx.nd.<op>`` for every registered
+operator, plus creation/converters. ``mx.nd.random`` mirrors the random
+sampling namespace.
+"""
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
+                      concatenate, waitall, moveaxis, onehot_encode, imm)
+from . import register as _register
+from .. import ops as _ops  # ensure all ops are registered
+
+_register.populate(globals())
+
+# `power` etc. convenience aliases matching mx.nd module functions
+power = globals().get("broadcast_power")
+equal = globals().get("broadcast_equal")
+not_equal = globals().get("broadcast_not_equal")
+greater = globals().get("broadcast_greater")
+lesser = globals().get("broadcast_lesser")
+add = globals().get("broadcast_add")
+subtract = globals().get("broadcast_sub")
+multiply = globals().get("broadcast_mul")
+divide = globals().get("broadcast_div")
+
+
+class _RandomNS:
+    """mx.nd.random namespace (parity: python/mxnet/ndarray/random.py)."""
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_uniform", [],
+            {"low": low, "high": high, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_normal", [],
+            {"loc": loc, "scale": scale, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+    @staticmethod
+    def randint(low, high, shape=(), dtype="int32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_randint", [],
+            {"low": low, "high": high, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+    @staticmethod
+    def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_sample_multinomial", [data],
+            {"shape": _as_shape(shape), "get_prob": get_prob, "dtype": dtype})
+
+    @staticmethod
+    def shuffle(data, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name("_shuffle", [data], {})
+
+    @staticmethod
+    def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_exponential", [],
+            {"lam": 1.0 / scale, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+    @staticmethod
+    def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_gamma", [],
+            {"alpha": alpha, "beta": beta, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+    @staticmethod
+    def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+        from . import dispatch
+        return dispatch.invoke_by_name(
+            "_random_poisson", [],
+            {"lam": lam, "shape": _as_shape(shape), "dtype": dtype}, out=out)
+
+
+def _as_shape(s):
+    return tuple(s) if isinstance(s, (tuple, list)) else (int(s),)
+
+
+random = _RandomNS()
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return random.uniform(low, high, shape, dtype, ctx, out, **kw)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return random.normal(loc, scale, shape, dtype, ctx, out, **kw)
+
+
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    return random.multinomial(data, shape, get_prob, dtype, **kw)
+
+
+def load(fname):
+    from ..serialization import load_ndarray_file
+    return load_ndarray_file(fname)
+
+
+def save(fname, data):
+    from ..serialization import save_ndarray_file
+    save_ndarray_file(fname, data)

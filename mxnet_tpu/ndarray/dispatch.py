@@ -1,0 +1,132 @@
+"""Eager operator dispatch (the imperative runtime).
+
+Reference parity: src/imperative/imperative.cc Invoke/InvokeOp +
+python/mxnet/_ctypes/ndarray.py:65 _imperative_invoke. TPU-native: each
+(op, attrs, is_train) triple gets one ``jax.jit``-compiled callable, cached;
+XLA's async dispatch replaces the dependency engine. Autograd taping happens
+here (reference: Imperative::RecordOp, src/imperative/imperative.cc:183).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+
+_JIT_CACHE = {}
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _get_jitted(opdef, attrs, is_train, needs_rng, n_inputs):
+    key = (opdef.name, _freeze(tuple(sorted(attrs.items()))), is_train,
+           needs_rng, n_inputs)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        if needs_rng:
+            def run(rng, *arrs):
+                with _reg._OpCtxScope(is_train, rng):
+                    return opdef.fn(*arrs, **attrs)
+        else:
+            def run(*arrs):
+                with _reg._OpCtxScope(is_train, None):
+                    return opdef.fn(*arrs, **attrs)
+        fn = jax.jit(run)
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def _op_needs_rng(opdef):
+    return getattr(opdef.fn, "_needs_rng", False)
+
+
+def invoke(opdef, args, kwargs, out=None, name=None):
+    """Run an op eagerly on NDArray inputs; returns NDArray or list."""
+    from .ndarray import NDArray
+
+    kw_inputs, attrs = opdef.split_kwargs(kwargs)
+    attrs = opdef.normalize_attrs(attrs)
+
+    # assemble positional tensor inputs
+    if opdef.variadic:
+        inputs = list(args)
+        input_names = [str(i) for i in range(len(inputs))]
+    else:
+        inputs = list(args)
+        if len(inputs) > len(opdef.input_names):
+            raise MXNetError("%s takes %d tensor inputs, got %d" %
+                             (opdef.name, len(opdef.input_names), len(inputs)))
+        for nm in opdef.input_names[len(inputs):]:
+            inputs.append(kw_inputs.pop(nm, None))
+        if kw_inputs:
+            raise MXNetError("%s: unexpected inputs %s" % (opdef.name, list(kw_inputs)))
+        input_names = opdef.input_names
+
+    ctx = None
+    arrs = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            if ctx is None:
+                ctx = x._ctx
+            arrs.append(x._data)
+        elif x is None:
+            arrs.append(None)
+        else:
+            import jax.numpy as jnp
+            arrs.append(jnp.asarray(x))
+    from ..context import current_context
+    if ctx is None:
+        ctx = current_context()
+
+    from .. import autograd
+    is_train = autograd.is_training()
+    needs_rng = _op_needs_rng(opdef)
+
+    fn = _get_jitted(opdef, attrs, is_train, needs_rng, len(arrs))
+    rng = None
+    if needs_rng:
+        from .. import random as _random
+        rng = _random.next_key()
+        raw = fn(rng, *arrs)
+    else:
+        raw = fn(*arrs)
+
+    n_out = opdef.out_count(attrs)
+    outs_raw = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+    if len(outs_raw) != n_out:
+        raise MXNetError("%s returned %d outputs, declared %d" %
+                         (opdef.name, len(outs_raw), n_out))
+
+    # write mutated values back into their input NDArrays (aux states,
+    # optimizer update ops) — reference FMutateInputs semantics.
+    for in_name, out_idx in opdef.mutate_inputs:
+        idx = input_names.index(in_name) if in_name in input_names else -1
+        if idx >= 0 and isinstance(inputs[idx], NDArray):
+            inputs[idx]._set_data(outs_raw[out_idx])
+
+    n_vis = opdef.visible_out_count(attrs)
+    outputs = [NDArray(o, ctx) for o in outs_raw[:n_vis]]
+
+    if autograd.is_recording():
+        autograd._record_op(opdef, attrs, is_train, rng,
+                            [x if isinstance(x, NDArray) else None for x in inputs],
+                            outputs)
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, outputs):
+            dst._set_data(src._data)
+        return out
+    if n_vis == 1:
+        return outputs[0]
+    return outputs
+
+
+def invoke_by_name(name, args, kwargs, out=None):
+    return invoke(_reg.get_op(name), args, kwargs, out=out)

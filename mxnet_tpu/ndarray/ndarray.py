@@ -1,0 +1,478 @@
+"""NDArray: MXNet's imperative tensor, backed by ``jax.Array``.
+
+Reference parity: include/mxnet/ndarray.h + python/mxnet/ndarray/ndarray.py.
+TPU-native mapping (SURVEY.md §7): the reference's dependency-engine variable
+per array (src/engine/threaded_engine.h:115) is replaced by JAX's own async
+dispatch — ``wait_to_read`` maps to ``block_until_ready``. Storage handles
+(src/storage/) are replaced by XLA's HBM allocator; ``Context`` decides the
+``jax.Device`` an array is committed to.
+
+Mutability: MXNet NDArrays are mutable buffers. Here mutation rebinds the
+wrapped immutable ``jax.Array`` (``_set_data``), and sliced writes lower to
+XLA scatter (``.at[]``) — in-place semantics are preserved at the NDArray
+level while the compiled world stays functional.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, integer_types, numeric_types
+from ..context import Context, current_context
+from . import dispatch as _dispatch
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concatenate", "waitall", "moveaxis", "onehot_encode", "imm"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_autograd_entry",
+                 "_deferred_init", "__weakref__")
+
+    # make numpy defer to NDArray.__r<op>__
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_entry = None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):  # parity shim: some user code checks identity via handle
+        return id(self)
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            _np.asarray(self._data), "x".join(str(s) for s in self.shape), self._ctx)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------------
+    # host/device movement & sync
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host (the reference's implicit sync point)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype, copy=True):
+        out = jnp.asarray(self._data, dtype=dtype)
+        if not copy and out.dtype == self.dtype:
+            return self
+        return NDArray(out, self._ctx)
+
+    def copy(self):
+        return NDArray(self._data, self._ctx)
+
+    def copyto(self, other):
+        """Copy to another NDArray or Context (reference: CopyFromTo,
+        src/ndarray/ndarray.cc:1147)."""
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            other._set_data(jax.device_put(self._data, other._ctx.jax_device))
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), other)
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+
+    # ------------------------------------------------------------------
+    # mutation
+    # ------------------------------------------------------------------
+    def _set_data(self, new_data):
+        if tuple(new_data.shape) != self.shape:
+            raise MXNetError("in-place assignment shape mismatch %s vs %s"
+                             % (tuple(new_data.shape), self.shape))
+        if new_data.dtype != self._data.dtype:
+            new_data = jnp.asarray(new_data, dtype=self._data.dtype)
+        self._data = new_data
+
+    def _sync_copyfrom(self, source):
+        arr = _np.asarray(source, dtype=self.dtype)
+        if arr.shape != self.shape:
+            raise MXNetError("shape mismatch in _sync_copyfrom")
+        self._data = jax.device_put(jnp.asarray(arr), self._ctx.jax_device)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, (_np.ndarray, _np.generic, list)):
+            value = jnp.asarray(value, dtype=self.dtype)
+        if isinstance(key, tuple) and len(key) == 0:
+            key = slice(None)
+        if key is None or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(value, numeric_types):
+                self._data = jnp.full(self.shape, value, dtype=self.dtype)
+            else:
+                v = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+                self._data = v
+            return
+        self._data = self._data.at[key].set(value)
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        out = self._data[key]
+        return NDArray(out, self._ctx)
+
+    # ------------------------------------------------------------------
+    # shape ops (view-free: XLA reshapes are free inside jit)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape", ())
+        shape = _infer_reshape(self.shape, tuple(shape))
+        return NDArray(jnp.reshape(self._data, shape), self._ctx)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
+
+    def squeeze(self, axis=None):
+        return NDArray(jnp.squeeze(self._data, axis), self._ctx)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return NDArray(jnp.transpose(self._data, axes), self._ctx)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1))
+
+    def broadcast_to(self, shape):
+        cur, tgt = self.shape, tuple(shape)
+        if len(cur) < len(tgt):
+            cur = (1,) * (len(tgt) - len(cur)) + cur
+        return NDArray(jnp.broadcast_to(self._data.reshape(cur), tgt), self._ctx)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def swapaxes(self, dim1, dim2):
+        return NDArray(jnp.swapaxes(self._data, dim1, dim2), self._ctx)
+
+    def tile(self, reps):
+        return NDArray(jnp.tile(self._data, reps), self._ctx)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage not supported on this array type")
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd hooks (implemented in mxnet_tpu.autograd)
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+        autograd.mark_variables([self], [zeros(self.shape, self._ctx, self.dtype)],
+                                grad_reqs=grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], out_grads=None if out_grad is None else [out_grad],
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # arithmetic — routed through the op registry so autograd records them
+    # ------------------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _dispatch.invoke_by_name(op, [a, b], {})
+        if isinstance(other, numeric_types):
+            return _dispatch.invoke_by_name(
+                scalar_op, [self], {"scalar": float(other), "reverse": reverse})
+        if isinstance(other, _np.ndarray):
+            return self._binop(array(other, self._ctx), op, scalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o): return self._binop(o, "broadcast_add", "_plus_scalar")
+    def __radd__(self, o): return self._binop(o, "broadcast_add", "_plus_scalar", True)
+    def __sub__(self, o): return self._binop(o, "broadcast_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binop(o, "broadcast_sub", "_minus_scalar", True)
+    def __mul__(self, o): return self._binop(o, "broadcast_mul", "_mul_scalar")
+    def __rmul__(self, o): return self._binop(o, "broadcast_mul", "_mul_scalar", True)
+    def __truediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binop(o, "broadcast_div", "_div_scalar", True)
+    def __mod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar")
+    def __rmod__(self, o): return self._binop(o, "broadcast_mod", "_mod_scalar", True)
+    def __pow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar")
+    def __rpow__(self, o): return self._binop(o, "broadcast_power", "_power_scalar", True)
+    def __neg__(self): return self._binop(-1.0, None, "_mul_scalar")
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o): return self._binop(o, "broadcast_greater", "_greater_scalar")
+    def __ge__(self, o): return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+    def __lt__(self, o): return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+    def __le__(self, o): return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._set_data(out._data)
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._set_data(out._data)
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._set_data(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._set_data(out._data)
+        return self
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx": str(self._ctx)}
+
+    def __setstate__(self, state):
+        dev, idx = state["ctx"].split("(")
+        ctx = Context(dev, int(idx[:-1]))
+        self._ctx = ctx
+        self._data = jax.device_put(jnp.asarray(state["data"]), ctx.jax_device)
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_entry = None
+
+    # reductions / misc used pervasively in user code -------------------
+    def sum(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("sum", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("mean", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("max", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("min", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("argmax", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _dispatch.invoke_by_name("argmin", [self],
+                                        {"axis": axis, "keepdims": keepdims})
+
+    def abs(self):
+        return _dispatch.invoke_by_name("abs", [self], {})
+
+    def clip(self, a_min, a_max):
+        return _dispatch.invoke_by_name("clip", [self],
+                                        {"a_min": a_min, "a_max": a_max})
+
+    def slice_axis(self, axis, begin, end):
+        return _dispatch.invoke_by_name("slice_axis", [self],
+                                        {"axis": axis, "begin": begin, "end": end})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return _dispatch.invoke_by_name(
+            "one_hot", [self],
+            {"depth": depth, "on_value": on_value, "off_value": off_value})
+
+
+def _infer_reshape(cur_shape, shape):
+    """Support MXNet reshape magic values 0 (copy dim) and -1 (infer)."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(cur_shape[i])
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# creation functions
+# ----------------------------------------------------------------------
+def _ctx_or_default(ctx):
+    return ctx if ctx is not None else current_context()
+
+
+def imm(jarr, ctx=None):
+    """Wrap an existing jax array without copy."""
+    return NDArray(jarr, _ctx_or_default(ctx))
+
+
+def array(source_array, ctx=None, dtype=None):
+    ctx = _ctx_or_default(ctx)
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = jnp.asarray(src, dtype=dtype)
+        return NDArray(jax.device_put(src, ctx.jax_device), ctx)
+    arr = _np.asarray(source_array, dtype=dtype)
+    if dtype is None and arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    if dtype is None and arr.dtype == _np.int64:
+        arr = arr.astype(_np.int32)
+    return NDArray(jax.device_put(jnp.asarray(arr), ctx.jax_device), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    ctx = _ctx_or_default(ctx)
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        return NDArray(jnp.zeros(shape, dtype=dtype or "float32"), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    ctx = _ctx_or_default(ctx)
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        return NDArray(jnp.ones(shape, dtype=dtype or "float32"), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    ctx = _ctx_or_default(ctx)
+    if isinstance(shape, integer_types):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        return NDArray(jnp.full(shape, val, dtype=dtype or "float32"), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    ctx = _ctx_or_default(ctx)
+    with jax.default_device(ctx.jax_device):
+        out = jnp.arange(start, stop, step, dtype=dtype)
+        if repeat > 1:
+            out = jnp.repeat(out, repeat)
+        return NDArray(out, ctx)
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    if not arrays:
+        raise ValueError("concatenate needs at least one array")
+    out = jnp.concatenate([a._data for a in arrays], axis=axis)
+    return NDArray(out, arrays[0]._ctx)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = jax.nn.one_hot(indices._data.astype("int32"), depth, dtype=out.dtype)
+    out._set_data(res)
+    return out
+
+
+def waitall():
+    """Reference: Engine WaitForAll — block until all async work completes."""
+    (jnp.zeros(()) + 0).block_until_ready()

@@ -1,0 +1,26 @@
+"""Generate ``nd.<op>`` wrappers from the registry at import time.
+
+Reference parity: python/mxnet/ndarray/register.py:156 _make_ndarray_function
+(code-gen'd ctypes wrappers); here wrappers close over OpDefs directly.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..ops import registry as _reg
+from . import dispatch as _dispatch
+
+
+def _make_op_func(opdef, name):
+    def fn(*args, out=None, name=None, **kwargs):
+        return _dispatch.invoke(opdef, args, kwargs, out=out)
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = opdef.__doc__
+    return fn
+
+
+def populate(namespace_dict):
+    for name in _reg.list_ops():
+        opdef = _reg.get_op(name)
+        namespace_dict[name] = _make_op_func(opdef, name)

@@ -1,0 +1,16 @@
+"""Operator corpus — pure-JAX implementations behind the registry.
+
+Importing this package registers all ops (the analog of the reference's
+static NNVM_REGISTER_OP initializers across src/operator/)."""
+from . import registry
+from .registry import register, get_op, list_ops, OpDef
+
+from . import elemwise      # noqa: F401
+from . import tensor        # noqa: F401
+from . import nn            # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops    # noqa: F401
+from . import rnn           # noqa: F401
+from . import shape_rules   # noqa: F401
+
+__all__ = ["registry", "register", "get_op", "list_ops", "OpDef"]

@@ -1,0 +1,299 @@
+"""Elementwise, broadcast, and reduction operators.
+
+Reference parity: src/operator/tensor/elemwise_binary_broadcast_op_basic.cc,
+elemwise_unary_op_basic.cc, broadcast_reduce_op_value.cc. All impls are pure
+jnp — XLA fuses chains of these into single kernels, which replaces the
+reference's mshadow expression templates and manual kernel fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_EPS = 1e-12
+
+
+# ----------------------------------------------------------------------
+# broadcast binary
+# ----------------------------------------------------------------------
+def _defbinary(name, fn, aliases=()):
+    def impl(lhs, rhs):
+        return fn(lhs, rhs)
+    impl.__name__ = name
+    impl.__doc__ = "Broadcast binary op %s (ref src/operator/tensor/)" % name
+    register(name, aliases=aliases)(impl)
+
+
+_defbinary("broadcast_add", jnp.add, aliases=("broadcast_plus", "elemwise_add", "_add", "_plus", "_Plus"))
+_defbinary("broadcast_sub", jnp.subtract, aliases=("broadcast_minus", "elemwise_sub", "_sub", "_minus", "_Minus"))
+_defbinary("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "_mul", "_Mul"))
+_defbinary("broadcast_div", jnp.divide, aliases=("elemwise_div", "_div", "_Div"))
+_defbinary("broadcast_mod", jnp.mod, aliases=("_mod",))
+_defbinary("broadcast_power", lambda a, b: jnp.power(a, b), aliases=("_power", "_Power", "pow"))
+_defbinary("broadcast_maximum", jnp.maximum, aliases=("_maximum", "maximum"))
+_defbinary("broadcast_minimum", jnp.minimum, aliases=("_minimum", "minimum"))
+_defbinary("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+
+
+def _cmp(fn):
+    def impl(a, b):
+        return fn(a, b).astype(jnp.result_type(a))
+    return impl
+
+
+_defbinary("broadcast_equal", _cmp(jnp.equal), aliases=("_equal",))
+_defbinary("broadcast_not_equal", _cmp(jnp.not_equal), aliases=("_not_equal",))
+_defbinary("broadcast_greater", _cmp(jnp.greater), aliases=("_greater",))
+_defbinary("broadcast_greater_equal", _cmp(jnp.greater_equal), aliases=("_greater_equal",))
+_defbinary("broadcast_lesser", _cmp(jnp.less), aliases=("_lesser",))
+_defbinary("broadcast_lesser_equal", _cmp(jnp.less_equal), aliases=("_lesser_equal",))
+_defbinary("broadcast_logical_and", _cmp(jnp.logical_and), aliases=("_logical_and",))
+_defbinary("broadcast_logical_or", _cmp(jnp.logical_or), aliases=("_logical_or",))
+_defbinary("broadcast_logical_xor", _cmp(jnp.logical_xor), aliases=("_logical_xor",))
+
+
+# ----------------------------------------------------------------------
+# scalar binary (reference: *_scalar ops with `scalar` attr)
+# ----------------------------------------------------------------------
+def _defscalar(name, fwd, rev=None, aliases=()):
+    rev = rev or fwd
+
+    def impl(data, *, scalar=1.0, reverse=False):
+        s = jnp.asarray(scalar, dtype=data.dtype)
+        return rev(s, data) if reverse else fwd(data, s)
+    impl.__name__ = name
+    register(name, aliases=aliases)(impl)
+
+
+_defscalar("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
+_defscalar("_minus_scalar", jnp.subtract, jnp.subtract, aliases=("_rminus_scalar", "_MinusScalar"))
+_defscalar("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
+_defscalar("_div_scalar", jnp.divide, jnp.divide, aliases=("_rdiv_scalar", "_DivScalar"))
+_defscalar("_mod_scalar", jnp.mod, jnp.mod, aliases=("_rmod_scalar",))
+_defscalar("_power_scalar", jnp.power, jnp.power, aliases=("_rpower_scalar", "_PowerScalar"))
+_defscalar("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_defscalar("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+
+
+def _defscalar_cmp(name, fn):
+    def impl(data, *, scalar=0.0, reverse=False):
+        s = jnp.asarray(scalar, dtype=data.dtype)
+        out = fn(s, data) if reverse else fn(data, s)
+        return out.astype(data.dtype)
+    impl.__name__ = name
+    register(name)(impl)
+
+
+_defscalar_cmp("_equal_scalar", jnp.equal)
+_defscalar_cmp("_not_equal_scalar", jnp.not_equal)
+_defscalar_cmp("_greater_scalar", jnp.greater)
+_defscalar_cmp("_greater_equal_scalar", jnp.greater_equal)
+_defscalar_cmp("_lesser_scalar", jnp.less)
+_defscalar_cmp("_lesser_equal_scalar", jnp.less_equal)
+
+
+# ----------------------------------------------------------------------
+# unary math
+# ----------------------------------------------------------------------
+def _defunary(name, fn, aliases=()):
+    def impl(data):
+        return fn(data)
+    impl.__name__ = name
+    impl.__doc__ = "Elementwise %s (ref src/operator/tensor/elemwise_unary_op)" % name
+    register(name, aliases=aliases)(impl)
+
+
+_defunary("abs", jnp.abs, aliases=("_abs",))
+_defunary("sign", jnp.sign)
+_defunary("negative", jnp.negative)
+_defunary("reciprocal", jnp.reciprocal)
+_defunary("square", jnp.square)
+_defunary("sqrt", jnp.sqrt)
+_defunary("rsqrt", jax.lax.rsqrt)
+_defunary("cbrt", jnp.cbrt)
+_defunary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_defunary("exp", jnp.exp)
+_defunary("log", jnp.log)
+_defunary("log10", jnp.log10)
+_defunary("log2", jnp.log2)
+_defunary("log1p", jnp.log1p)
+_defunary("expm1", jnp.expm1)
+_defunary("sin", jnp.sin)
+_defunary("cos", jnp.cos)
+_defunary("tan", jnp.tan)
+_defunary("arcsin", jnp.arcsin)
+_defunary("arccos", jnp.arccos)
+_defunary("arctan", jnp.arctan)
+_defunary("sinh", jnp.sinh)
+_defunary("cosh", jnp.cosh)
+_defunary("tanh", jnp.tanh)
+_defunary("arcsinh", jnp.arcsinh)
+_defunary("arccosh", jnp.arccosh)
+_defunary("arctanh", jnp.arctanh)
+_defunary("degrees", jnp.degrees)
+_defunary("radians", jnp.radians)
+_defunary("floor", jnp.floor)
+_defunary("ceil", jnp.ceil)
+_defunary("trunc", jnp.trunc)
+_defunary("rint", jnp.rint)
+_defunary("round", jnp.round)
+_defunary("fix", jnp.trunc)
+_defunary("sigmoid", jax.nn.sigmoid)
+_defunary("softsign", jax.nn.soft_sign)
+_defunary("relu", jax.nn.relu)
+_defunary("erf", jax.scipy.special.erf)
+_defunary("erfinv", jax.scipy.special.erfinv)
+_defunary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_defunary("gammaln", jax.scipy.special.gammaln)
+_defunary("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype))
+_defunary("identity", lambda x: x, aliases=("_copy", "stop_gradient_off"))
+_defunary("make_loss", lambda x: x, aliases=("MakeLoss",))
+_defunary("zeros_like", jnp.zeros_like)
+_defunary("ones_like", jnp.ones_like)
+_defunary("isnan", lambda x: jnp.isnan(x).astype("float32"))
+_defunary("isinf", lambda x: jnp.isinf(x).astype("float32"))
+_defunary("isfinite", lambda x: jnp.isfinite(x).astype("float32"))
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    """Stop gradient (ref src/operator/tensor/elemwise_unary_op_basic.cc)."""
+    return jax.lax.stop_gradient(data)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    """Sum of N arrays (ref src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("clip")
+def clip(data, *, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, *, shape=None):
+    return lhs.at[tuple(indices)].set(rhs)
+
+
+# ----------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ----------------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _defreduce(name, fn, aliases=(), exclude_support=True):
+    def impl(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            all_ax = set(range(data.ndim))
+            sel = {a % data.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(sorted(all_ax - sel))
+        return fn(data, axis=ax, keepdims=bool(keepdims))
+    impl.__name__ = name
+    register(name, aliases=aliases)(impl)
+
+
+_defreduce("sum", jnp.sum, aliases=("sum_axis",))
+_defreduce("mean", jnp.mean)
+_defreduce("prod", jnp.prod)
+_defreduce("max", jnp.max, aliases=("max_axis",))
+_defreduce("min", jnp.min, aliases=("min_axis",))
+_defreduce("nansum", jnp.nansum)
+_defreduce("nanprod", jnp.nanprod)
+
+
+@register("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("argmax")
+def argmax(data, *, axis=None, keepdims=False):
+    ax = None if axis is None else int(axis)
+    out = jnp.argmax(data, axis=ax, keepdims=bool(keepdims))
+    return out.astype("float32")
+
+
+@register("argmin")
+def argmin(data, *, axis=None, keepdims=False):
+    ax = None if axis is None else int(axis)
+    out = jnp.argmin(data, axis=ax, keepdims=bool(keepdims))
+    return out.astype("float32")
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype("float32")
+
+
+@register("log_softmax")
+def log_softmax(data, *, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register("softmax")
+def softmax_op(data, *, axis=-1, temperature=None):
+    """Softmax along axis (ref src/operator/nn/softmax.cc)."""
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register("softmin")
+def softmin(data, *, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.softmax(-x, axis=int(axis))
+
+
+@register("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """Matrix product (ref src/operator/tensor/dot.cc). MXNet semantics:
+    reduce over the last axis of lhs and the first axis of rhs."""
+    a = lhs.T if transpose_a and lhs.ndim == 2 else lhs
+    b = rhs.T if transpose_b and rhs.ndim == 2 else rhs
+    if transpose_a and lhs.ndim > 2:
+        a = jnp.moveaxis(lhs, 0, -1)
+    if transpose_b and rhs.ndim > 2:
+        b = jnp.moveaxis(rhs, -1, 0)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * jnp.square(data), absx - 0.5 / s2)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)

@@ -1,0 +1,533 @@
+"""Neural-network operators lowering to XLA.
+
+Reference parity: src/operator/nn/ (fully_connected.cc:231, convolution.cc,
+batch_norm.cc, pooling.cc, activation.cc, dropout-inl.h, layer_norm.cc,
+softmax_output.cc, lrn.cc) and src/operator/tensor/indexing_op.cc(Embedding).
+
+TPU-first notes: matmuls/convs map onto the MXU via lax.dot_general /
+lax.conv_general_dilated; XLA layout assignment picks the TPU-internal
+layout so the NCHW API surface carries no transpose cost. Ops that the
+reference implements with cuDNN become single XLA HLOs here. Gradients come
+from JAX autodiff except where MXNet semantics differ (SoftmaxOutput's
+fused softmax-CE gradient → jax.custom_vjp).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, current_op_context
+
+
+def needs_rng(fn):
+    fn._needs_rng = True
+    return fn
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        t = tuple(int(x) for x in v)
+        return t if t else (1,) * n
+    return (int(v),) * n
+
+
+# ----------------------------------------------------------------------
+# FullyConnected
+# ----------------------------------------------------------------------
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False,
+                    flatten=True):
+    """y = x W^T + b (ref src/operator/nn/fully_connected-inl.h:85-166).
+    weight layout (num_hidden, in_dim) matches the reference."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32
+                        if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if not no_bias and bias is not None:
+        y = y + bias
+    return y
+
+
+# ----------------------------------------------------------------------
+# Convolution / Deconvolution
+# ----------------------------------------------------------------------
+def _conv_dnums(ndim):
+    if ndim == 3:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
+                dilate=(), pad=(), num_group=1, no_bias=False, cudnn_tune=None,
+                cudnn_off=False, workspace=1024, layout=None):
+    """N-D convolution (ref src/operator/nn/convolution.cc). Lowers to a
+    single conv HLO on the MXU; groups via feature_group_count."""
+    nd = len(kernel)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    dilate = _pair(dilate, nd) if dilate else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dnums(data.ndim))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * (data.ndim - 2))
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
+                  dilate=(), pad=(), adj=(), target_shape=(), num_group=1,
+                  no_bias=True, cudnn_tune=None, cudnn_off=False,
+                  workspace=512, layout=None):
+    """Transposed convolution (ref src/operator/nn/deconvolution.cc).
+    weight layout (in_ch, out_ch/g, kh, kw); implemented as the gradient of
+    conv = conv with lhs_dilation."""
+    nd = len(kernel)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    dilate = _pair(dilate, nd) if dilate else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    adj = _pair(adj, nd) if adj else (0,) * nd
+    kernel = tuple(int(k) for k in kernel)
+    # flip spatial dims; swap in/out channel axes → standard conv weight
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if int(num_group) > 1:
+        g = int(num_group)
+        w = w.reshape((g, w.shape[0] // g) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dnums(data.ndim))
+    eff_k = tuple((kernel[i] - 1) * dilate[i] + 1 for i in range(nd))
+    padding = [(eff_k[i] - 1 - pad[i], eff_k[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+    ).astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * (data.ndim - 2))
+    return out
+
+
+# ----------------------------------------------------------------------
+# BatchNorm
+# ----------------------------------------------------------------------
+@register("BatchNorm", aliases=("batch_norm", "CuDNNBatchNorm"), num_outputs=5,
+          num_visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+          mutate_inputs=(("moving_mean", 3), ("moving_var", 4)))
+def batch_norm(data, gamma, beta, moving_mean=None, moving_var=None, *,
+               eps=1e-3, momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False):
+    """Batch normalization (ref src/operator/nn/batch_norm.cc).
+    Returns (out, save_mean, save_inv_var, new_moving_mean, new_moving_var);
+    the last two update the aux states (reference mutates them in place)."""
+    ctx = current_op_context()
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+
+    if moving_mean is None:
+        moving_mean = jnp.zeros(data.shape[ax], dtype=jnp.float32)
+    if moving_var is None:
+        moving_var = jnp.ones(data.shape[ax], dtype=jnp.float32)
+
+    use_batch_stats = ctx.is_train and not use_global_stats
+    xf = data.astype(jnp.float32)
+    if use_batch_stats:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean = lax.stop_gradient(moving_mean)
+        var = lax.stop_gradient(moving_var)
+        new_mm, new_mv = moving_mean, moving_var
+
+    inv_std = lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(bshape)) * inv_std.reshape(bshape)
+    out = out * g.reshape(bshape) + beta.reshape(bshape)
+    return (out.astype(data.dtype), mean, inv_std,
+            lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))
+
+
+@register("LayerNorm", aliases=("layer_norm",), num_outputs=3,
+          num_visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1)
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalization (ref src/operator/nn/layer_norm.cc)."""
+    ax = int(axis) % data.ndim
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    var = jnp.var(xf, axis=ax, keepdims=True)
+    inv_std = lax.rsqrt(var + eps)
+    out = (xf - mean) * inv_std
+    bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return (out.astype(data.dtype), jnp.squeeze(mean, ax), jnp.squeeze(inv_std, ax))
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def lrn(data, *, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Local response norm across channels (ref src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = int(nsize) // 2
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add, (1, int(nsize), 1, 1), (1, 1, 1, 1),
+        [(0, 0), (half, half), (0, 0), (0, 0)])
+    return data * jnp.power(knorm + alpha * summed / nsize, -beta)
+
+
+# ----------------------------------------------------------------------
+# Pooling
+# ----------------------------------------------------------------------
+@register("Pooling", aliases=("pooling",))
+def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", cudnn_off=False,
+            count_include_pad=True, p_value=2):
+    """Max/avg/sum/lp pooling (ref src/operator/nn/pooling.cc)."""
+    nd = data.ndim - 2
+    if global_pool:
+        red = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=red, keepdims=True)
+        elif pool_type == "sum":
+            out = jnp.sum(data, axis=red, keepdims=True)
+        else:
+            out = jnp.mean(data, axis=red, keepdims=True)
+        return out
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pooling_convention == "full":
+        # ceil semantics: add extra right-pad so the last window fits
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(size - kernel[i]) // stride[i]) + 1  # ceil
+            need = (out_sz - 1) * stride[i] + kernel[i] - size
+            base_pad[2 + i] = (pad[i], pad[i] + max(0, need))
+    if pool_type == "max":
+        init = (-jnp.inf if jnp.issubdtype(data.dtype, jnp.floating)
+                else jnp.iinfo(data.dtype).min)
+        return lax.reduce_window(data, init, lax.max, window, strides, base_pad)
+    summed = lax.reduce_window(data, 0.0, lax.add, window, strides, base_pad)
+    if pool_type == "sum":
+        return summed
+    if pool_type == "avg":
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+                                   window, strides, base_pad)
+        return summed / counts
+    raise ValueError("unsupported pool_type %s" % pool_type)
+
+
+@register("UpSampling", key_var_num_args="num_args")
+def upsampling(*args, scale=2, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    """Nearest/bilinear upsampling (ref src/operator/upsampling.cc)."""
+    data = args[0]
+    s = int(scale)
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+        return out
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * s, w * s), method="bilinear")
+
+
+# ----------------------------------------------------------------------
+# Activations
+# ----------------------------------------------------------------------
+@register("Activation", aliases=("activation",))
+def activation(data, *, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+@register("LeakyReLU")
+@needs_rng
+def leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    """leaky/prelu/elu/selu/gelu/rrelu (ref src/operator/leaky_relu.cc)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        ctx = current_op_context()
+        if ctx.is_train:
+            key = ctx.next_rng_key()
+            slope_s = jax.random.uniform(key, data.shape, dtype=data.dtype,
+                                         minval=lower_bound, maxval=upper_bound)
+        else:
+            slope_s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, slope_s * data)
+    raise ValueError("unknown act_type %s" % act_type)
+
+
+@register("softmax_cross_entropy", aliases=("SoftmaxCrossEntropy",))
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    oh = jax.nn.one_hot(label.astype("int32"), data.shape[-1], dtype=logp.dtype)
+    return -jnp.sum(oh * logp)
+
+
+# ----------------------------------------------------------------------
+# Dropout
+# ----------------------------------------------------------------------
+@register("Dropout", aliases=("dropout",), num_outputs=2, num_visible_outputs=1)
+@needs_rng
+def dropout_op(data, *, p=0.5, mode="training", axes=(), cudnn_off=False):
+    """Dropout (ref src/operator/nn/dropout-inl.h). mask is the 2nd output."""
+    ctx = current_op_context()
+    if (not ctx.is_train and mode != "always") or p <= 0.0:
+        return data, jnp.ones_like(data)
+    key = ctx.next_rng_key()
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in tuple(axes) else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask, jnp.broadcast_to(mask, data.shape)
+
+
+# ----------------------------------------------------------------------
+# SoftmaxOutput — custom gradient identical to the reference's fused
+# softmax + cross-entropy backward (src/operator/softmax_output-inl.h).
+# ----------------------------------------------------------------------
+def _softmax_fwd(data, label, attrs):
+    if attrs["multi_output"]:
+        # data (n, c, d1...): softmax over axis 1
+        prob = jax.nn.softmax(data, axis=1)
+    else:
+        prob = jax.nn.softmax(data, axis=-1)
+    return prob
+
+
+def _softmax_grad(prob, label, attrs):
+    grad_scale = attrs["grad_scale"]
+    ignore_label = attrs["ignore_label"]
+    use_ignore = attrs["use_ignore"]
+    normalization = attrs["normalization"]
+    smooth_alpha = attrs["smooth_alpha"]
+    if attrs["multi_output"]:
+        caxis, nclass = 1, prob.shape[1]
+        lab = label.astype("int32")
+        oh = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=prob.dtype), -1, 1)
+    else:
+        caxis, nclass = prob.ndim - 1, prob.shape[-1]
+        lab = label.astype("int32")
+        oh = jax.nn.one_hot(lab, nclass, dtype=prob.dtype)
+    if smooth_alpha:
+        oh = oh * (1.0 - smooth_alpha) + smooth_alpha / (nclass - 1) * (1.0 - oh)
+    grad = prob - oh
+    valid = jnp.ones(lab.shape, dtype=prob.dtype)
+    if use_ignore:
+        valid = (lab != int(ignore_label)).astype(prob.dtype)
+        grad = grad * jnp.expand_dims(valid, caxis)
+    if normalization == "valid":
+        grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+    elif normalization == "batch":
+        grad = grad / prob.shape[0]
+    return grad * grad_scale
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    attrs = dict(grad_scale=grad_scale, ignore_label=ignore_label,
+                 multi_output=multi_output, use_ignore=use_ignore,
+                 normalization=normalization, smooth_alpha=smooth_alpha)
+
+    @jax.custom_vjp
+    def _f(d, l):
+        return _softmax_fwd(d, l, attrs)
+
+    def _f_fwd(d, l):
+        prob = _softmax_fwd(d, l, attrs)
+        return prob, (prob, l)
+
+    def _f_bwd(res, g):
+        prob, l = res
+        # reference ignores upstream out_grad unless out_grad=True
+        return _softmax_grad(prob, l, attrs).astype(prob.dtype), jnp.zeros_like(l)
+
+    _f.defvjp(_f_fwd, _f_bwd)
+    return _f(data, label)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, *, grad_scale=1.0):
+    """Identity fwd; grad = (pred - label)/batch (ref src/operator/regression_output-inl.h)."""
+    @jax.custom_vjp
+    def _f(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        grad = (d - l.reshape(d.shape)) * grad_scale / d.shape[0]
+        return grad, jnp.zeros_like(l)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, *, grad_scale=1.0):
+    @jax.custom_vjp
+    def _f(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        grad = jnp.sign(d - l.reshape(d.shape)) * grad_scale / d.shape[0]
+        return grad, jnp.zeros_like(l)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, *, grad_scale=1.0):
+    @jax.custom_vjp
+    def _f(d, l):
+        return jax.nn.sigmoid(d)
+
+    def _fwd(d, l):
+        out = jax.nn.sigmoid(d)
+        return out, (out, l)
+
+    def _bwd(res, g):
+        out, l = res
+        grad = (out - l.reshape(out.shape)) * grad_scale / out.shape[0]
+        return grad, jnp.zeros_like(l)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+# ----------------------------------------------------------------------
+# Embedding
+# ----------------------------------------------------------------------
+@register("Embedding")
+def embedding(data, weight, *, input_dim, output_dim, dtype="float32",
+              sparse_grad=False):
+    """Row gather (ref src/operator/tensor/indexing_op.cc Embedding).
+    TPU: lowers to a gather HLO; one-hot matmul would also hit the MXU but
+    gather wins at vocab scale."""
+    idx = data.astype("int32")
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+@register("Correlation")
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    raise NotImplementedError("Correlation is not yet implemented")
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """Bilinear sampling (ref src/operator/bilinear_sampler.cc). grid in
+    [-1,1] with shape (n, 2, h, w)."""
+    n, c, hin, win = data.shape
+    gx = (grid[:, 0] + 1.0) * (win - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (hin - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi.astype("int32"), 0, hin - 1)
+        xi = jnp.clip(xi.astype("int32"), 0, win - 1)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        return data[bidx, :, yi, xi].transpose(0, 3, 1, 2)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)

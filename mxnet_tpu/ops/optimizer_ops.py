@@ -1,0 +1,148 @@
+"""Fused optimizer update operators.
+
+Reference parity: src/operator/optimizer_op.cc (sgd_update, sgd_mom_update,
+adam_update, signsgd_update, signum_update, ftrl_update, rmsprop_update,
+mp_sgd_* multi-precision variants). Each is one fused XLA computation; state
+tensors (mom, mean, var) are declared as mutated inputs so the eager path
+updates them in place like the reference's FMutateInputs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_common(grad, weight, rescale_grad, clip_gradient, wd=0.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd:
+        g = g + wd * weight.astype(jnp.float32)
+    return g
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(("mom", 1),))
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom.astype(jnp.float32) - lr * g
+    new_w = weight.astype(jnp.float32) + new_mom
+    return new_w.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+@register("mp_sgd_update", num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(("weight32", 1),))
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: fp32 master weights, low-precision model weights
+    (ref src/operator/optimizer_op.cc MP_SGD)."""
+    g = _apply_common(grad, weight32, rescale_grad, clip_gradient, wd)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(("mom", 1), ("weight32", 2)))
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _apply_common(grad, weight32, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(("mean", 1), ("var", 2)))
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = (weight.astype(jnp.float32)
+             - lr * new_mean / (jnp.sqrt(new_var) + epsilon))
+    return new_w.astype(weight.dtype), new_mean, new_var
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, 0.0)
+    return (weight.astype(jnp.float32)
+            - lr * (jnp.sign(g) + wd * weight)).astype(weight.dtype)
+
+
+@register("signum_update", num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(("mom", 1),))
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum: momentum SGD taking the sign of the momentum
+    (rahul003's Signum optimizer; ref src/operator/optimizer_op.cc)."""
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom - (1 - momentum) * g
+    new_w = (weight.astype(jnp.float32)
+             + lr * (jnp.sign(new_mom) - wd_lh * weight))
+    return new_w.astype(weight.dtype), new_mom
+
+
+@register("rmsprop_update", num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(("n", 1),))
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w.astype(weight.dtype), new_n
+
+
+@register("rmspropalex_update", num_outputs=4, num_visible_outputs=1,
+          mutate_inputs=(("n", 1), ("g", 2), ("delta", 3)))
+def rmspropalex_update(weight, grad, n, g, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _apply_common(grad, weight, rescale_grad, clip_gradient, wd)
+    new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1 - gamma1) * gr + gamma1 * g
+    new_delta = (gamma2 * delta
+                 - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon))
+    new_w = weight.astype(jnp.float32) + new_delta
+    return new_w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(("z", 1), ("n", 2)))
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, 0.0)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("adagrad_update", num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(("history", 1),))
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_common(grad, weight, rescale_grad, clip_gradient, 0.0)
+    new_h = history + jnp.square(g)
+    new_w = (weight.astype(jnp.float32)
+             - lr * (g / jnp.sqrt(new_h + epsilon) + wd * weight))
+    return new_w.astype(weight.dtype), new_h

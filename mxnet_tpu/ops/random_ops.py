@@ -1,0 +1,107 @@
+"""Random sampling operators.
+
+Reference parity: src/operator/random/sample_op.cc (_random_uniform,
+_random_normal, _random_gamma, …) and multinomial sampling. TPU-native:
+counter-based JAX PRNG keys threaded through OpContext (replaces the
+reference's per-device cuRAND resource, src/resource.cc:87).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, current_op_context
+from .nn import needs_rng
+
+
+@register("_random_uniform", aliases=("random_uniform", "uniform"))
+@needs_rng
+def random_uniform(*, low=0.0, high=1.0, shape=(), dtype="float32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return jax.random.uniform(key, tuple(shape), minval=low, maxval=high,
+                              dtype=dtype or "float32")
+
+
+@register("_random_normal", aliases=("random_normal", "normal"))
+@needs_rng
+def random_normal(*, loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return (loc + scale * jax.random.normal(key, tuple(shape))).astype(dtype or "float32")
+
+
+@register("_random_gamma", aliases=("random_gamma",))
+@needs_rng
+def random_gamma(*, alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return (jax.random.gamma(key, alpha, tuple(shape)) * beta).astype(dtype or "float32")
+
+
+@register("_random_exponential", aliases=("random_exponential",))
+@needs_rng
+def random_exponential(*, lam=1.0, shape=(), dtype="float32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return (jax.random.exponential(key, tuple(shape)) / lam).astype(dtype or "float32")
+
+
+@register("_random_poisson", aliases=("random_poisson",))
+@needs_rng
+def random_poisson(*, lam=1.0, shape=(), dtype="float32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return jax.random.poisson(key, lam, tuple(shape)).astype(dtype or "float32")
+
+
+@register("_random_randint", aliases=("random_randint",))
+@needs_rng
+def random_randint(*, low=0, high=1, shape=(), dtype="int32", ctx=None):
+    key = current_op_context().next_rng_key()
+    return jax.random.randint(key, tuple(shape), int(low), int(high),
+                              dtype=dtype or "int32")
+
+
+@register("_sample_uniform", aliases=("sample_uniform",))
+@needs_rng
+def sample_uniform(low, high, *, shape=(), dtype="float32"):
+    key = current_op_context().next_rng_key()
+    sshape = tuple(shape) if shape else ()
+    u = jax.random.uniform(key, low.shape + sshape)
+    ex = low.reshape(low.shape + (1,) * len(sshape))
+    return (ex + u * (high - low).reshape(ex.shape)).astype(dtype or "float32")
+
+
+@register("_sample_normal", aliases=("sample_normal",))
+@needs_rng
+def sample_normal(mu, sigma, *, shape=(), dtype="float32"):
+    key = current_op_context().next_rng_key()
+    sshape = tuple(shape) if shape else ()
+    z = jax.random.normal(key, mu.shape + sshape)
+    ex = mu.reshape(mu.shape + (1,) * len(sshape))
+    return (ex + z * sigma.reshape(ex.shape)).astype(dtype or "float32")
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",))
+@needs_rng
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32"):
+    """Categorical sampling from probability rows
+    (ref src/operator/random/sample_multinomial_op.cc)."""
+    key = current_op_context().next_rng_key()
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)) if shape else ():
+        n *= int(s)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    sshape = tuple(shape) if isinstance(shape, tuple) else ((shape,) if shape else ())
+    out_shape = data.shape[:-1] + sshape
+    samples = jax.random.categorical(
+        key, logits, axis=-1,
+        shape=(sshape + data.shape[:-1]) if sshape else data.shape[:-1])
+    if sshape:
+        samples = jnp.moveaxis(samples.reshape(sshape + data.shape[:-1]),
+                               tuple(range(len(sshape))),
+                               tuple(range(-len(sshape), 0)))
+    return samples.astype(dtype)
+
+
+@register("_shuffle", aliases=("shuffle",))
+@needs_rng
+def shuffle(data):
+    key = current_op_context().next_rng_key()
+    return jax.random.permutation(key, data, axis=0)

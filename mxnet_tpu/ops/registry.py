@@ -1,0 +1,240 @@
+"""Operator registry — the TPU-native replacement for NNVM op registration.
+
+Reference: src/operator/ registers ops via NNVM_REGISTER_OP with separate
+FCompute / FInferShape / FInferType / FGradient attributes
+(include/mxnet/op_attr_types.h:197-282). On TPU none of those need to be
+hand-written: each op here is a single pure JAX function, so
+
+* shape/dtype inference  = ``jax.eval_shape`` over the same function,
+* gradients              = JAX autodiff (or ``jax.custom_vjp`` where MXNet
+                           semantics differ, e.g. SoftmaxOutput),
+* kernel fusion/placement = XLA, with Pallas kernels for ops XLA can't fuse.
+
+The registered function's signature declares its interface:
+positional-or-keyword parameters are tensor inputs (``=None`` marks them
+optional), keyword-only parameters are op attributes (the analog of
+DMLC_REGISTER_PARAMETER structs, auto-documented through Python signatures).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OpContext",
+           "op_context", "current_op_context"]
+
+_OP_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpContext(threading.local):
+    """Execution context threaded through op impls (trace-safe).
+
+    Replaces the reference's OpContext (include/mxnet/op_attr_types.h:64:
+    is_train, RunContext, requested resources). Random ops draw keys from
+    here — the analog of ResourceRequest::kRandom (src/resource.cc:87).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.is_train = False
+        self._rng_key = None
+        self._rng_counter = 0
+
+    def set(self, is_train, rng_key):
+        self.is_train = is_train
+        self._rng_key = rng_key
+        self._rng_counter = 0
+
+    def next_rng_key(self):
+        if self._rng_key is None:
+            # Eager fallback: draw from the global seed state lazily to avoid
+            # an import cycle (mxnet_tpu.random imports the op registry).
+            from .. import random as _random
+            return _random.next_key()
+        key = jax.random.fold_in(self._rng_key, self._rng_counter)
+        self._rng_counter += 1
+        return key
+
+
+op_context = OpContext()
+
+
+def current_op_context() -> OpContext:
+    return op_context
+
+
+class _OpCtxScope:
+    """Context manager installing (is_train, rng_key) for a traced region."""
+
+    def __init__(self, is_train, rng_key):
+        self._new = (is_train, rng_key)
+
+    def __enter__(self):
+        self._saved = (op_context.is_train, op_context._rng_key,
+                       op_context._rng_counter)
+        op_context.set(*self._new)
+        return op_context
+
+    def __exit__(self, *a):
+        (op_context.is_train, op_context._rng_key,
+         op_context._rng_counter) = self._saved
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (MXNet CamelCase or snake_case as registered)
+    fn : pure function (*tensor_inputs, **attrs) -> array or tuple of arrays
+    input_names : declared tensor input names
+    optional_inputs : subset of input_names that may be None
+    attr_names : attribute (param) names
+    num_outputs : static output count, or a callable(attrs)->int
+    num_visible_outputs : outputs returned to the user in eager mode
+    variadic : accepts *args tensor inputs (e.g. Concat, add_n)
+    mutate_inputs : indices of inputs updated in place in eager mode
+        (aux states like BatchNorm moving stats; optimizer update ops)
+    """
+
+    def __init__(self, name, fn, *, aliases=(), num_outputs=1,
+                 num_visible_outputs=None, mutate_inputs=(), key_var_num_args=None):
+        self.name = name
+        self.fn = fn
+        self.aliases = tuple(aliases)
+        self.num_outputs = num_outputs
+        self.num_visible_outputs = (num_visible_outputs
+                                    if num_visible_outputs is not None
+                                    else num_outputs)
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.key_var_num_args = key_var_num_args
+        # param_shapes(known_shapes: dict, attrs) -> dict of inferred input
+        # shapes — the analog of the backward direction of FInferShape.
+        self.param_shapes = None
+        # unused_inputs(attrs) -> set of input names absent given these attrs
+        # (e.g. FullyConnected bias when no_bias=True).
+        self.unused_inputs = None
+
+        sig = inspect.signature(fn)
+        self.input_names = []
+        self.optional_inputs = set()
+        self.attr_names = []
+        self.attr_defaults = {}
+        self.variadic = False
+        for pname, p in sig.parameters.items():
+            if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                          inspect.Parameter.POSITIONAL_OR_KEYWORD):
+                self.input_names.append(pname)
+                if p.default is None:
+                    self.optional_inputs.add(pname)
+            elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+                self.variadic = True
+                self.varname = pname
+            elif p.kind == inspect.Parameter.KEYWORD_ONLY:
+                self.attr_names.append(pname)
+                if p.default is not inspect.Parameter.empty:
+                    self.attr_defaults[pname] = p.default
+        self.__doc__ = fn.__doc__
+
+    # ------------------------------------------------------------------
+    def split_kwargs(self, kwargs):
+        """Split user kwargs into (tensor_inputs_by_name, attrs)."""
+        inputs, attrs = {}, {}
+        for k, v in kwargs.items():
+            if k in self.attr_names:
+                attrs[k] = v
+            elif k in self.input_names or self.variadic:
+                inputs[k] = v
+            else:
+                raise MXNetError("%s got unknown argument '%s'" % (self.name, k))
+        return inputs, attrs
+
+    def normalize_attrs(self, attrs):
+        """Fill defaults + coerce MXNet-style string attrs (from JSON)."""
+        out = dict(self.attr_defaults)
+        for k, v in attrs.items():
+            if k not in self.attr_names:
+                raise MXNetError("%s: unknown attr '%s'" % (self.name, k))
+            if isinstance(v, str):
+                v = _parse_attr_string(v, self.attr_defaults.get(k))
+            out[k] = v
+        return out
+
+    def out_count(self, attrs):
+        n = self.num_outputs
+        return n(attrs) if callable(n) else n
+
+    def visible_out_count(self, attrs):
+        n = self.num_visible_outputs
+        return n(attrs) if callable(n) else n
+
+    def __repr__(self):
+        return "<OpDef %s>" % self.name
+
+
+def _parse_attr_string(v, default):
+    """Parse MXNet JSON attr strings: 'True', '(2, 2)', '1e-3', 'relu'."""
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low == "none":
+        return None
+    if s.startswith("(") or s.startswith("["):
+        inner = s[1:-1].strip()
+        if not inner:
+            return ()
+        return tuple(_parse_attr_string(t, None) for t in inner.split(","))
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return v
+
+
+def register(name=None, **opts):
+    """Decorator registering an op. See OpDef for ``opts``."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        opdef = OpDef(opname, fn, **opts)
+        if opname in _OP_REGISTRY:
+            raise MXNetError("op '%s' registered twice" % opname)
+        _OP_REGISTRY[opname] = opdef
+        for alias in opdef.aliases:
+            _OP_REGISTRY[alias] = opdef
+        return fn
+
+    return deco
+
+
+def get_op(name) -> OpDef:
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator '%s' is not registered" % name) from None
+
+
+def has_op(name) -> bool:
+    return name in _OP_REGISTRY
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def canonical_ops():
+    """Unique OpDefs (aliases deduplicated)."""
+    seen = {}
+    for opdef in _OP_REGISTRY.values():
+        seen.setdefault(id(opdef), opdef)
+    return list(seen.values())

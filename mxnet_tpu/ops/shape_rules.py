@@ -1,0 +1,161 @@
+"""Backward shape-inference rules for parameterized ops.
+
+The reference implements full bidirectional shape inference per op
+(FInferShape, e.g. src/operator/nn/fully_connected.cc:55-95) so that
+``simple_bind`` can size weights from data shapes alone. On TPU the forward
+direction is free (``jax.eval_shape``); only the backward direction —
+"given data shape + attrs, what are the parameter shapes" — needs rules,
+and only for ops that own parameters. Also declares which optional inputs
+are absent for given attrs (nnvm's FListInputNames dependence on params).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import get_op
+from .rnn import rnn_param_size
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _set(opname, param_shapes=None, unused_inputs=None):
+    op = get_op(opname)
+    if param_shapes is not None:
+        op.param_shapes = param_shapes
+    if unused_inputs is not None:
+        op.unused_inputs = unused_inputs
+
+
+def _fc_shapes(known, attrs):
+    out = {}
+    data = known.get("data")
+    nh = int(attrs["num_hidden"])
+    if data is not None:
+        in_dim = _prod(data[1:]) if attrs.get("flatten", True) else data[-1]
+        out["weight"] = (nh, in_dim)
+    out["bias"] = (nh,)
+    return out
+
+
+_set("FullyConnected", _fc_shapes,
+     lambda attrs: {"bias"} if attrs.get("no_bias") else set())
+
+
+def _conv_shapes(known, attrs):
+    out = {}
+    data = known.get("data")
+    nf = int(attrs["num_filter"])
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    g = int(attrs.get("num_group", 1))
+    if data is not None:
+        out["weight"] = (nf, data[1] // g) + kernel
+    out["bias"] = (nf,)
+    return out
+
+
+_set("Convolution", _conv_shapes,
+     lambda attrs: {"bias"} if attrs.get("no_bias") else set())
+
+
+def _deconv_shapes(known, attrs):
+    out = {}
+    data = known.get("data")
+    nf = int(attrs["num_filter"])
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    g = int(attrs.get("num_group", 1))
+    if data is not None:
+        out["weight"] = (data[1], nf // g) + kernel
+    out["bias"] = (nf,)
+    return out
+
+
+_set("Deconvolution", _deconv_shapes,
+     lambda attrs: {"bias"} if attrs.get("no_bias", True) else set())
+
+
+def _channel_shapes(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return {}
+    ax = int(attrs.get("axis", 1)) % len(data)
+    c = (data[ax],)
+    return {"gamma": c, "beta": c, "moving_mean": c, "moving_var": c}
+
+
+_set("BatchNorm", _channel_shapes)
+
+
+def _ln_shapes(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return {}
+    ax = int(attrs.get("axis", -1)) % len(data)
+    return {"gamma": (data[ax],), "beta": (data[ax],)}
+
+
+_set("LayerNorm", _ln_shapes)
+_set("InstanceNorm", lambda known, attrs: (
+    {"gamma": (known["data"][1],), "beta": (known["data"][1],)}
+    if known.get("data") is not None else {}))
+
+
+_set("Embedding", lambda known, attrs: {
+    "weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))})
+
+
+def _leaky_shapes(known, attrs):
+    data = known.get("data")
+    if attrs.get("act_type") == "prelu" and data is not None:
+        return {"gamma": (data[1],)}
+    return {}
+
+
+_set("LeakyReLU", _leaky_shapes,
+     lambda attrs: set() if attrs.get("act_type") == "prelu" else {"gamma"})
+
+
+def _rnn_shapes(known, attrs):
+    data = known.get("data")
+    out = {}
+    mode = attrs.get("mode", "lstm")
+    L = int(attrs["num_layers"])
+    H = int(attrs["state_size"])
+    bi = bool(attrs.get("bidirectional", False))
+    ndir = 2 if bi else 1
+    if data is not None:
+        out["parameters"] = (rnn_param_size(L, int(data[2]), H, bi, mode),)
+        out["state"] = (L * ndir, int(data[1]), H)
+        if mode == "lstm":
+            out["state_cell"] = (L * ndir, int(data[1]), H)
+    return out
+
+
+_set("RNN", _rnn_shapes,
+     lambda attrs: set() if attrs.get("mode", "lstm") == "lstm" else {"state_cell"})
+
+def _softmax_output_shapes(known, attrs):
+    d = known.get("data")
+    if d is None:
+        return {}
+    if attrs.get("multi_output"):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+_set("SoftmaxOutput", _softmax_output_shapes)
+for _nm in ("LinearRegressionOutput", "MAERegressionOutput",
+            "LogisticRegressionOutput"):
+    _set(_nm, lambda known, attrs: (
+        {"label": known["data"]} if known.get("data") is not None else {}))
+
+_set("SequenceMask",
+     unused_inputs=lambda attrs: set() if attrs.get("use_sequence_length") else {"sequence_length"})
+_set("SequenceLast",
+     unused_inputs=lambda attrs: set() if attrs.get("use_sequence_length") else {"sequence_length"})
+_set("SequenceReverse",
+     unused_inputs=lambda attrs: set() if attrs.get("use_sequence_length") else {"sequence_length"})

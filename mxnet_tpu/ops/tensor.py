@@ -1,0 +1,379 @@
+"""Tensor shape/index manipulation + init operators.
+
+Reference parity: src/operator/tensor/{matrix_op.cc,indexing_op.cc,
+init_op.cc,ordering_op.cc}. Reshapes/transposes are free inside XLA; index
+ops lower to gather/scatter HLOs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, *, shape=(), reverse=False, target_shape=None, keep_highest=False):
+    """MXNet reshape with magic values 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split) — ref src/operator/tensor/matrix_op-inl.h:95."""
+    ishape = data.shape
+    if target_shape:  # legacy attr
+        shape = target_shape
+    shape = tuple(int(s) for s in shape)
+    if reverse:
+        rev = _infer_magic(tuple(reversed(ishape)), tuple(reversed(shape)))
+        return jnp.reshape(data, tuple(reversed(rev)))
+    return jnp.reshape(data, _infer_magic(ishape, shape))
+
+
+def _infer_magic(ishape, shape):
+    out = []
+    i = 0  # index into ishape
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(ishape[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(ishape[i:]); i = len(ishape)
+        elif s == -3:
+            out.append(ishape[i] * ishape[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = ishape[i] // b
+            if b == -1:
+                b = ishape[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    return tuple(out)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten_op(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, *, axes=()):
+    return jnp.transpose(data, tuple(axes) if axes else None)
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis):
+    return jnp.expand_dims(data, int(axis))
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis if axis is None else tuple(
+        [axis] if isinstance(axis, int) else axis))
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def swapaxes(data, *, dim1=0, dim2=0):
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, *, dtype):
+    return data.astype(dtype)
+
+
+@register("Concat", aliases=("concat",), key_var_num_args="num_args")
+def concat(*args, num_args=None, dim=1):
+    return jnp.concatenate(args, axis=int(dim))
+
+
+@register("stack", key_var_num_args="num_args")
+def stack(*args, num_args=None, axis=0):
+    return jnp.stack(args, axis=int(axis))
+
+
+def _split_outputs(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_outputs,
+          num_visible_outputs=_split_outputs)
+def slice_channel(data, *, num_outputs, axis=1, squeeze_axis=False):
+    """Split along axis into equal parts (ref src/operator/slice_channel.cc)."""
+    parts = jnp.split(data, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, *, begin, end, step=()):
+    idx = []
+    step = tuple(step) if step else (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis, begin, end):
+    axis = int(axis) % data.ndim
+    if end is None:
+        end = data.shape[axis]
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(int(begin), int(end))
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, *, axes=()):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    idx = [slice(None)] * data.ndim
+    for ax in axes:
+        idx[ax] = slice(0, shape_like.shape[ax])
+    return data[tuple(idx)]
+
+
+@register("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    """Gather along axis (ref src/operator/tensor/indexing_op.cc)."""
+    idx = indices.astype("int32")
+    return jnp.take(a, idx, axis=int(axis), mode="clip" if mode == "clip" else "wrap")
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = indices.astype("int32")
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("pick")
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = index.astype("int32")
+    ax = int(axis)
+    idxe = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(data, idxe, axis=ax)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+@register("one_hot")
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype("int32"), int(depth), dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype("int32"))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    idx = tuple(indices.astype("int32"))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("tile")
+def tile(data, *, reps):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def repeat(data, *, repeats, axis=None):
+    return jnp.repeat(data, int(repeats), axis=None if axis is None else int(axis))
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, *, mode="constant", pad_width=(), constant_value=0.0):
+    """N-D padding (ref src/operator/pad.cc). pad_width is the MXNet flat
+    (before, after) per-axis tuple."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    return jnp.pad(data, pw, mode="reflect")
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, *, axis):
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else (int(axis),)
+    return jnp.flip(data, axis=ax)
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape):
+    tgt = tuple(int(t) if int(t) != 0 else data.shape[i]
+                for i, t in enumerate(shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, *, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype="int64")
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype="int64")
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size):
+    b = int(block_size)
+    n, c, h, w = data.shape
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size):
+    b = int(block_size)
+    n, c, h, w = data.shape
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ----------------------------------------------------------------------
+# ordering (ref src/operator/tensor/ordering_op.cc)
+# ----------------------------------------------------------------------
+@register("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=None if axis is None else int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=-1 if axis is None else int(axis))
+    return out
+
+
+@register("argsort")
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    x = data if is_ascend else -data
+    out = jnp.argsort(x, axis=None if axis is None else int(axis), stable=True)
+    return out.astype(dtype)
+
+
+def _topk_nout(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout, num_visible_outputs=_topk_nout)
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = int(axis) % data.ndim
+    k = int(k) if int(k) > 0 else data.shape[ax]
+    x = jnp.moveaxis(data, ax, -1)
+    vals, idxs = jax.lax.top_k(-x if is_ascend else x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(dtype)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ=mask")
+    return idxs
+
+
+# ----------------------------------------------------------------------
+# sequence ops (ref src/operator/sequence_*.cc)
+# ----------------------------------------------------------------------
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    ax = int(axis)  # sequence axis: 0 or 1; batch is the other of (0,1)
+    seq = data.shape[ax]
+    steps = jnp.arange(seq)
+    lens = sequence_length.astype(steps.dtype)
+    mask = steps[:, None] < lens[None, :]  # (seq, batch)
+    if ax == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    ax = int(axis)
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    idx = (sequence_length.astype("int32") - 1)
+    x = jnp.moveaxis(data, ax, 0)  # (seq, batch, ...)
+    batch = jnp.arange(x.shape[1])
+    return x[idx, batch]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    seq = data.shape[0]
+    steps = jnp.arange(seq)[:, None]
+    lens = sequence_length.astype("int32")[None, :]
+    rev_idx = jnp.where(steps < lens, lens - 1 - steps, steps)  # (seq,batch)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[rev_idx, batch]
+
+
+# ----------------------------------------------------------------------
+# init ops (ref src/operator/tensor/init_op.cc)
+# ----------------------------------------------------------------------
+@register("_zeros", aliases=("zeros_op",))
+def _zeros(*, shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(tuple(shape), dtype=dtype or "float32")
+
+
+@register("_ones")
+def _ones(*, shape=(), dtype="float32", ctx=None):
+    return jnp.ones(tuple(shape), dtype=dtype or "float32")
+
+
+@register("_full")
+def _full(*, shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(tuple(shape), value, dtype=dtype or "float32")
+
+
+@register("_arange")
+def _arange(*, start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None):
+    out = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat and int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("_eye", aliases=("eye",))
+def _eye(*, N, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype)
+
+
+@register("diag")
+def diag(data, *, k=0):
+    return jnp.diag(data, k=int(k)) if data.ndim <= 2 else jnp.diagonal(data, offset=int(k))

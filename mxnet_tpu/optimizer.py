@@ -1,0 +1,616 @@
+"""Optimizers (reference parity: python/mxnet/optimizer.py, 17 optimizers).
+
+Each optimizer drives a *fused update op* (ops/optimizer_ops.py) so the whole
+update is one XLA computation per parameter — mirroring the reference where
+optimizers call sgd_update/adam_update kernels (src/operator/optimizer_op.cc).
+Multi-precision (fp32 master weights for fp16/bf16 params) follows
+reference optimizer.py:445-545.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import (NDArray, zeros, ones, array, sgd_update, sgd_mom_update,
+                      mp_sgd_update, mp_sgd_mom_update, adam_update,
+                      signsgd_update, signum_update, rmsprop_update,
+                      rmspropalex_update, ftrl_update, adagrad_update)
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "NAG", "SGLD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+           "FTML", "DCASGD", "LBSGD", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    key = str(name).lower()
+    if key not in _OPT_REGISTRY:
+        raise MXNetError("unknown optimizer '%s'" % name)
+    return _OPT_REGISTRY[key](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:33). Tracks per-parameter
+    lr/wd multipliers, update counts, and optional fp32 master copies."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
+            if sym is not None else ()
+        self.lr_mult = {}
+        self.set_lr_mult({})
+        self.wd_mult = {}
+        self.set_wd_mult({})
+
+    # -- serialization for kvstore servers (reference set_optimizer) ----
+    def dumps(self):
+        return pickle.dumps(self)
+
+    @staticmethod
+    def loads(data):
+        return pickle.loads(data)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
+            weight_master_copy = array(weight.asnumpy().astype("float32"),
+                                       ctx=weight.context)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
+            inner_state, weight32 = state
+            g32 = grad.astype("float32")
+            self.update(index, weight32, g32, inner_state)
+            weight._set_data(weight32._data.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- schedules ------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        """(reference optimizer.py:296) __lr_mult__ attrs then overrides."""
+        self.lr_mult = {}
+        if self.sym_info:
+            attrs, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attrs and "__lr_mult__" in attrs[name]:
+                    self.lr_mult[name] = float(attrs[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """(reference optimizer.py:330) wd defaults to 0 for params whose
+        name doesn't end in _weight/_gamma (bias, beta, moving stats)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attrs, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attrs and "__wd_mult__" in attrs[name]:
+                    self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        else:
+            lr *= self.lr_mult.get(name, 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        else:
+            wd *= self.wd_mult.get(name, 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + multi-precision (reference optimizer.py:445)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                           momentum=self.momentum, **kw)
+        else:
+            sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype in (
+            _np.float16, _np.dtype("bfloat16"))
+        if not use_mp:
+            return self.update(index, weight, grad, state)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        mom, weight32 = state
+        if mom is not None:
+            mp_sgd_mom_update(weight, grad, mom, weight32, out=weight, lr=lr,
+                              wd=wd, momentum=self.momentum, **kw)
+        else:
+            mp_sgd_update(weight, grad, weight32, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class Signum(Optimizer):
+    """rahul003's Signum (reference optimizer.py Signum + signum_update op)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            signum_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                          momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+        else:
+            signsgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=weight.dtype)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                    **self._common_kwargs(index))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        adagrad_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                       epsilon=self.float_stable_eps,
+                       **self._common_kwargs(index))
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context, dtype="float32"),
+                    zeros(weight.shape, weight.context, dtype="float32"),
+                    zeros(weight.shape, weight.context, dtype="float32"))
+        return zeros(weight.shape, weight.context, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta, out=weight, lr=lr,
+                               wd=wd, gamma1=self.gamma1, gamma2=self.gamma2,
+                               epsilon=self.epsilon, **kw)
+        else:
+            rmsprop_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                           gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        grad += wd * weight
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1 - self.rho) * grad * grad
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta[:] = (self.rho * acc_delta
+                        + (1 - self.rho) * current_delta * current_delta)
+        weight -= current_delta
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        ftrl_update(weight, grad, z, n, out=weight, lr=lr, wd=wd,
+                    lamda1=self.lamda1, beta=self.beta,
+                    **self._common_kwargs(index))
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        m_t, u_t = state
+        m_t[:] = self.beta1 * m_t + (1.0 - self.beta1) * grad
+        u_t[:] = nd.maximum(self.beta2 * u_t, nd.abs(grad))
+        weight -= lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t[:] = self.beta1 * m_t + (1.0 - self.beta1) * grad
+        v_t[:] = self.beta2 * v_t + (1.0 - self.beta2) * grad * grad
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_t_prime = m_t / (1.0 - m_schedule_next)
+        v_t_prime = v_t / (1.0 - self.beta2 ** t)
+        m_t_bar = ((1.0 - momentum_t) * grad_prime
+                   + momentum_t_1 * m_t_prime)
+        weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        d_t, v_t, z_t = state
+        v_t[:] = self.beta2 * v_t + (1.0 - self.beta2) * grad * grad
+        d_new = ((1.0 - self.beta1 ** t) / lr
+                 * (nd.sqrt(v_t / (1.0 - self.beta2 ** t)) + self.epsilon))
+        sigma_t = d_new - self.beta1 * d_t
+        z_t[:] = self.beta1 * z_t + (1.0 - self.beta1) * grad - sigma_t * weight
+        weight[:] = -z_t / d_new
+        d_t[:] = d_new
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = (zeros(weight.shape, weight.context)
+               if self.momentum != 0.0 else None)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        mom, previous_weight = state
+        comp = grad + wd * weight + self.lamda * grad * grad * (
+            weight - previous_weight)
+        if mom is not None:
+            mom[:] = self.momentum * mom - lr * comp
+            update = mom
+        else:
+            update = -lr * comp
+        previous_weight._set_data(weight._data)
+        weight += update
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling
+    (reference optimizer.py LBSGD, simplified warmup handling)."""
+
+    def __init__(self, momentum=0.9, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, multi_precision=multi_precision,
+                         **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+
+    def _get_lars(self, weight, g, wd):
+        w_norm = float(nd.norm(weight).asscalar())
+        g_norm = float(nd.norm(g).asscalar())
+        if w_norm > 0 and g_norm > 0:
+            return w_norm / (g_norm + wd * w_norm + 1e-9) * 0.001
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr = lr * self._get_lars(weight, grad, wd)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                           momentum=self.momentum, **kw)
+        else:
+            sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """Applies an optimizer with per-key state (reference optimizer.py:1464);
+    picklable so dist kvstore servers can run it."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        data = pickle.loads(states) if isinstance(states, bytes) else states
+        if isinstance(data, tuple) and len(data) == 2:
+            self.states, self.optimizer = data
+        else:
+            self.states = data
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

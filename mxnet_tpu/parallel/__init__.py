@@ -1,0 +1,12 @@
+"""Parallelism primitives: device meshes, shardings, collectives, and
+gradient compression.
+
+This package is the TPU-native replacement for src/kvstore's Comm hierarchy
+and ps-lite transport (SURVEY.md §2.3): a ``jax.sharding.Mesh`` over
+ICI/DCN with XLA collectives instead of NCCL/ZMQ.
+"""
+from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
+                   replicated_sharding, shard_batch, current_mesh)
+
+__all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
+           "replicated_sharding", "shard_batch", "current_mesh"]

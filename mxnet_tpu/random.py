@@ -1,0 +1,26 @@
+"""Global PRNG state (reference: python/mxnet/random.py, src/resource.cc kRandom).
+
+TPU-native: a single JAX PRNG key chain. Eager random ops split off this
+chain; jitted executors instead thread a per-step key through OpContext so
+compiled computations stay pure.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["seed", "next_key"]
+
+_STATE = {"key": None, "seed": 0}
+
+
+def seed(seed_state):
+    """Seed the global RNG (parity with mx.random.seed)."""
+    _STATE["seed"] = int(seed_state)
+    _STATE["key"] = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    if _STATE["key"] is None:
+        _STATE["key"] = jax.random.PRNGKey(_STATE["seed"])
+    _STATE["key"], sub = jax.random.split(_STATE["key"])
+    return sub

@@ -1,0 +1,41 @@
+"""NDArray file serialization.
+
+Reference parity: NDArray::Save/Load (src/ndarray/ndarray.cc) used by
+mx.nd.save/load and checkpointing. The container here is NumPy ``.npz``
+(self-describing, portable) rather than the reference's dmlc binary stream;
+the API-level semantics (list or str-keyed dict of NDArrays, ``arg:``/
+``aux:`` prefixes for checkpoints) are identical.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["save_ndarray_file", "load_ndarray_file"]
+
+_LIST_KEY = "__mx_list_%d"
+
+
+def save_ndarray_file(fname, data):
+    from .ndarray.ndarray import NDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        arrays = {_LIST_KEY % i: d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list, or dict")
+    with open(fname, "wb") as f:
+        _np.savez(f, **arrays)
+
+
+def load_ndarray_file(fname):
+    from .ndarray.ndarray import array
+    with _np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith("__mx_list_") for k in keys):
+            out = [None] * len(keys)
+            for k in keys:
+                out[int(k[len("__mx_list_"):])] = array(npz[k])
+            return out
+        return {k: array(npz[k]) for k in keys}

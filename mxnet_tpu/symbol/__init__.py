@@ -1,0 +1,101 @@
+"""Symbol package: declarative graph API (mx.sym.*).
+
+Parity surface: python/mxnet/symbol/ — one generated function per registered
+operator that composes Symbols, auto-creating parameter variables named
+``{node}_{input}`` exactly like the reference (symbol compose semantics in
+python/mxnet/symbol/register.py).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, current_name_manager
+from ..ops import registry as _reg
+from .symbol import (Symbol, Variable, var, Group, load, load_json, AttrScope,
+                     _Node)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "AttrScope"]
+
+
+def _entry_of(s):
+    if len(s._entries) != 1:
+        raise MXNetError("cannot use a multi-output Symbol as an op input "
+                         "directly; index it first")
+    return s._entries[0]
+
+
+def _invoke_op(opname, sym_inputs, attrs=None, name=None):
+    opdef = _reg.get_op(opname)
+    attrs = opdef.normalize_attrs(attrs or {})
+    nm = current_name_manager().get(name, opdef.name.replace("_", ""))
+    inputs = [_entry_of(s) for s in sym_inputs]
+    node = _Node(opdef, nm, attrs, inputs, AttrScope.current_attrs())
+    vis = opdef.visible_out_count(attrs)
+    return Symbol([(node, i) for i in range(vis)]) if vis > 1 else Symbol([(node, 0)])
+
+
+def _invoke_scalar(opname, s, scalar, reverse):
+    return _invoke_op(opname, [s], {"scalar": scalar, "reverse": reverse})
+
+
+def _make_sym_func(opdef, fname):
+    def fn(*args, name=None, attr=None, **kwargs):
+        kw_inputs, attrs = opdef.split_kwargs(kwargs)
+        attrs = opdef.normalize_attrs(attrs)
+        hint = opdef.name.lower().replace("_", "")
+        nm = current_name_manager().get(name, hint)
+
+        if opdef.variadic:
+            inputs = [_entry_of(s) for s in args]
+        else:
+            unused = (opdef.unused_inputs(attrs)
+                      if opdef.unused_inputs is not None else set())
+            provided = list(args)
+            inputs = []
+            for i, in_name in enumerate(opdef.input_names):
+                if i < len(provided):
+                    s = provided[i]
+                elif in_name in kw_inputs:
+                    s = kw_inputs[in_name]
+                elif in_name in unused:
+                    continue
+                else:
+                    # auto-create the parameter variable (ref: nnvm compose)
+                    s = Variable("%s_%s" % (nm, in_name))
+                inputs.append(_entry_of(s))
+        node = _Node(opdef, nm, attrs, inputs, AttrScope.current_attrs())
+        if attr:
+            node.str_attrs.update({k: str(v) for k, v in attr.items()})
+        vis = opdef.visible_out_count(attrs)
+        if vis > 1:
+            return Symbol([(node, i) for i in range(vis)])
+        return Symbol([(node, 0)])
+
+    fn.__name__ = fname
+    fn.__qualname__ = fname
+    fn.__doc__ = opdef.__doc__
+    return fn
+
+
+for _name in _reg.list_ops():
+    globals()[_name] = _make_sym_func(_reg.get_op(_name), _name)
+
+zeros = globals()["_zeros"]
+ones = globals()["_ones"]
+pow = globals().get("broadcast_power")
+
+
+class _SymRandom:
+    @staticmethod
+    def uniform(low=0.0, high=1.0, shape=(), dtype="float32", **kw):
+        return _invoke_op("_random_uniform",
+                          [], {"low": low, "high": high, "shape": tuple(shape),
+                               "dtype": dtype}, name=kw.get("name"))
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", **kw):
+        return _invoke_op("_random_normal",
+                          [], {"loc": loc, "scale": scale, "shape": tuple(shape),
+                               "dtype": dtype}, name=kw.get("name"))
+
+
+random = _SymRandom()

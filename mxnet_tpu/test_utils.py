@@ -1,0 +1,190 @@
+"""Testing utilities.
+
+Reference parity: python/mxnet/test_utils.py — the op-correctness harness
+(SURVEY.md §4): ``check_numeric_gradient`` (central differences vs autodiff,
+ref :792), ``check_symbolic_forward/backward`` (:925,:999), and
+``check_consistency`` (:1207 — same op across backend contexts; here
+CPU-XLA vs TPU-XLA replaces cpu-vs-gpu-vs-cudnn).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from .ndarray import array as nd_array
+from .base import MXNetError
+
+__all__ = ["default_context", "assert_almost_equal", "rand_ndarray",
+           "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "almost_equal", "same", "simple_forward"]
+
+
+def default_context():
+    return current_context()
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+    a = a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+    b = b.asnumpy() if hasattr(b, "asnumpy") else np.asarray(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+        raise AssertionError(
+            "%s and %s differ: max |diff|=%g at %s (%s vs %s), rtol=%g atol=%g"
+            % (names[0], names[1], float(np.max(np.abs(a - b))), idx,
+               a[idx] if a.shape else a, b[idx] if b.shape else b, rtol, atol))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None):
+    if stype != "default":
+        raise MXNetError("sparse rand_ndarray not yet supported")
+    return nd_array(np.random.uniform(-1, 1, size=shape).astype(dtype), ctx=ctx)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    shapes = {k: v.shape for k, v in inputs.items()}
+    ex = sym.simple_bind(ctx or default_context(), "null", **shapes)
+    outs = ex.forward(is_train=is_train,
+                      **{k: nd_array(v) for k, v in inputs.items()})
+    outs = [o.asnumpy() for o in outs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _exec_for(sym, location, aux_states, grad_req, ctx):
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    args = {k: nd_array(np.asarray(v), ctx=ctx) for k, v in location.items()}
+    arg_shapes = {k: v.shape for k, v in args.items()}
+    ex = sym.simple_bind(ctx, grad_req, **arg_shapes)
+    for k, v in args.items():
+        ex.arg_dict[k]._set_data(v._data)
+    if aux_states:
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
+        for k, v in aux_states.items():
+            ex.aux_dict[k]._set_data(nd_array(np.asarray(v), ctx=ctx)._data)
+    return ex, location
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None, is_train=False):
+    ctx = ctx or default_context()
+    ex, _ = _exec_for(sym, location, aux_states, "null", ctx)
+    outputs = ex.forward(is_train=is_train)
+    for out, exp in zip(list(outputs), expected):
+        assert_almost_equal(out, exp, rtol, atol)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write",
+                            ctx=None):
+    ctx = ctx or default_context()
+    ex, loc = _exec_for(sym, location, aux_states, grad_req, ctx)
+    ex.forward(is_train=True)
+    ex.backward([nd_array(np.asarray(g), ctx=ctx) for g in out_grads])
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    for name, exp in expected.items():
+        if exp is None:
+            continue
+        assert_almost_equal(ex.grad_dict[name], exp, rtol, atol,
+                            names=("grad(%s)" % name, "expected"))
+    return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None,
+                           dtype="float64"):
+    """Central-difference gradient check against the executor's autodiff
+    (reference test_utils.py:792). The symbol's scalar loss is
+    sum(outputs * fixed_random_projection) so multi-output syms work."""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    location = {k: np.asarray(v, dtype="float32") for k, v in location.items()}
+    grad_nodes = grad_nodes or [n for n in arg_names
+                                if np.issubdtype(location[n].dtype, np.floating)]
+
+    grad_req = {n: ("write" if n in grad_nodes else "null") for n in arg_names}
+    ex, _ = _exec_for(sym, location, aux_states, grad_req, ctx)
+    outputs = list(ex.forward(is_train=True))
+    projs = [np.random.normal(0, 1, size=o.shape).astype("float32")
+             for o in outputs]
+    ex.backward([nd_array(p, ctx=ctx) for p in projs])
+    sym_grads = {n: ex.grad_dict[n].asnumpy() for n in grad_nodes}
+
+    ex_probe, _ = _exec_for(sym, location, aux_states, "null", ctx)
+
+    def loss_at(loc):
+        outs = ex_probe.forward(is_train=True,
+                                **{k: nd_array(v, ctx=ctx) for k, v in loc.items()})
+        return sum(float(np.sum(o.asnumpy() * p)) for o, p in zip(list(outs), projs))
+
+    for name in grad_nodes:
+        base = location[name]
+        num_grad = np.zeros_like(base, dtype="float64")
+        flat = base.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            loc_p = {k: v.copy() for k, v in location.items()}
+            loc_p[name].reshape(-1)[i] = orig + numeric_eps
+            loss_p = loss_at(loc_p)
+            loc_m = {k: v.copy() for k, v in location.items()}
+            loc_m[name].reshape(-1)[i] = orig - numeric_eps
+            loss_m = loss_at(loc_m)
+            num_grad.reshape(-1)[i] = (loss_p - loss_m) / (2 * numeric_eps)
+        assert_almost_equal(sym_grads[name], num_grad, rtol,
+                            atol if atol is not None else 1e-2,
+                            names=("autodiff(%s)" % name, "numeric(%s)" % name))
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, rtol=1e-4, atol=1e-5):
+    """Run the same symbol on multiple contexts and compare outputs+grads
+    (reference test_utils.py:1207 cpu/gpu/cudnn cross-check)."""
+    results = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        type_dict = spec.pop("type_dict", {})
+        shapes = spec
+        ex = sym.simple_bind(ctx, grad_req, type_dict=type_dict, **shapes)
+        if not results:
+            # seed shared random params from the first context
+            arg_vals = {n: np.random.normal(0, scale, size=a.shape).astype("float32")
+                        for n, a in ex.arg_dict.items()}
+            if arg_params:
+                arg_vals.update({k: np.asarray(v) for k, v in arg_params.items()})
+        for n, a in ex.arg_dict.items():
+            a._set_data(nd_array(arg_vals[n].astype(a.dtype), ctx=ctx)._data)
+        outs = ex.forward(is_train=(grad_req != "null"))
+        if grad_req != "null":
+            ex.backward([nd_array(np.ones(o.shape, dtype="float32"), ctx=ctx)
+                         for o in list(outs)])
+            grads = {n: g.asnumpy() for n, g in ex.grad_dict.items() if g is not None}
+        else:
+            grads = {}
+        results.append(([o.asnumpy() for o in list(outs)], grads))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for a, b in zip(ref_outs, outs):
+            assert_almost_equal(a, b, rtol, atol)
+        for n in ref_grads:
+            assert_almost_equal(ref_grads[n], grads[n], rtol, atol,
+                                names=("grad_%s" % n, "grad_%s'" % n))
+    return results

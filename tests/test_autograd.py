@@ -1,0 +1,131 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2.0 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * np.array([1, 2, 3]) + 2)
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = y * y
+    z.backward()
+    assert_almost_equal(x.grad, 2 * np.exp(2 * np.array([[1.0, 2.0]])), rtol=1e-4)
+
+
+def test_out_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3.0 * x
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30.0, 300.0])
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2.0 * x
+        y.backward()
+    assert float(x.grad.asscalar()) == 6.0
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    assert float(x.grad.asscalar()) == 1.0
+
+
+def test_is_training_scopes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_mark_variables_explicit():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert float(g.asscalar()) == 10.0
+    assert x.grad is g
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (dx,) = autograd.grad(y, [x])
+    assert abs(float(dx.asscalar()) - 27.0) < 1e-4
+
+
+def test_autograd_with_nn_ops():
+    wv = np.random.randn(4, 3).astype("float32")
+    xv = np.random.randn(2, 3).astype("float32")
+    w = nd.array(wv)
+    x = nd.array(xv)
+    w.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, None, no_bias=True, num_hidden=4)
+        loss = nd.sum(y * y)
+    loss.backward()
+    expect = 2 * (xv @ wv.T).T @ xv
+    assert_almost_equal(w.grad, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save = y
+            return y
+
+        def backward(self, dy):
+            y = self.save
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), 1.0)
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()

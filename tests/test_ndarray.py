@@ -1,0 +1,176 @@
+"""NDArray surface tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4) and a.dtype == np.float32
+    b = nd.ones((2,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1]
+    c = nd.full((2, 2), 3.5)
+    assert float(c.asnumpy()[0, 0]) == 3.5
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert e.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert_almost_equal(a + b, [[11, 22], [33, 44]])
+    assert_almost_equal(b - a, [[9, 18], [27, 36]])
+    assert_almost_equal(a * b, [[10, 40], [90, 160]])
+    assert_almost_equal(b / a, [[10, 10], [10, 10]])
+    assert_almost_equal(a + 1, [[2, 3], [4, 5]])
+    assert_almost_equal(1 - a, [[0, -1], [-2, -3]])
+    assert_almost_equal(2 / a, [[2, 1], [2.0 / 3, 0.5]])
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, [[2, 2], [2, 2]])
+    a *= 3
+    assert_almost_equal(a, [[6, 6], [6, 6]])
+    a /= 2
+    assert_almost_equal(a, [[3, 3], [3, 3]])
+    a -= 1
+    assert_almost_equal(a, [[2, 2], [2, 2]])
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+    assert (a >= b).asnumpy().tolist() == [0, 1, 1]
+    assert (a < 2).asnumpy().tolist() == [1, 0, 0]
+    assert (a == 2).asnumpy().tolist() == [0, 1, 0]
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a[1].asnumpy().tolist() == [4, 5, 6, 7]
+    assert a[1:3].shape == (2, 4)
+    assert float(a[2, 3].asscalar()) == 11
+    a[0] = 100.0
+    assert a[0].asnumpy().tolist() == [100] * 4
+    a[1, 2] = -1
+    assert float(a.asnumpy()[1, 2]) == -1
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+
+
+def test_broadcast():
+    a = nd.array([[1.0], [2.0]])
+    b = a.broadcast_to((2, 3))
+    assert b.shape == (2, 3)
+    assert b.asnumpy().tolist() == [[1, 1, 1], [2, 2, 2]]
+
+
+def test_reductions():
+    a = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    assert float(a.sum().asscalar()) == 15
+    assert a.sum(axis=0).asnumpy().tolist() == [3, 5, 7]
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+    assert float(a.mean().asscalar()) == 2.5
+    assert float(a.max().asscalar()) == 5
+    assert float(a.min().asscalar()) == 0
+    assert a.argmax(axis=1).asnumpy().tolist() == [2, 2]
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = nd.array([1.5, 2.7]).astype("int32")
+    assert c.asnumpy().tolist() == [1, 2]
+
+
+def test_copy_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b[:] = 5
+    assert a.asnumpy().sum() == 4  # copy is independent
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type in ("cpu", "tpu")
+    d = nd.zeros((2, 2))
+    a.copyto(d)
+    assert d.asnumpy().sum() == 4
+
+
+def test_wait_sync():
+    a = nd.ones((8, 8))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 8
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "nd.npz")
+    a = nd.array([[1.0, 2.0]])
+    b = nd.array([3.0])
+    nd.save(f, {"a": a, "b": b})
+    loaded = nd.load(f)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"], a)
+    nd.save(f, [a, b])
+    lst = nd.load(f)
+    assert len(lst) == 2 and lst[1].asnumpy().tolist() == [3.0]
+
+
+def test_random():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, shape=(100,))
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+    c = nd.random.normal(0, 1, shape=(500,))
+    assert abs(float(c.mean().asscalar())) < 0.2
+    d = nd.random.randint(0, 10, shape=(50,))
+    assert d.asnumpy().min() >= 0 and d.asnumpy().max() < 10
+
+
+def test_pickle():
+    import pickle
+    a = nd.array([[1.0, 2.0]])
+    b = pickle.loads(pickle.dumps(a))
+    assert_almost_equal(a, b)
+
+
+def test_iter_len():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    assert len(a) == 3
+    rows = [r.asnumpy().tolist() for r in a]
+    assert rows == [[0, 1], [2, 3], [4, 5]]
+
+
+def test_concat_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    d = nd.Concat(a, b, dim=1)
+    assert d.shape == (2, 6)
+    e = nd.stack(a, b, axis=0)
+    assert e.shape == (2, 2, 3)

@@ -1,0 +1,133 @@
+"""Symbol graph API tests (parity model: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    args = dict(zip(net.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (16, 100)
+    assert args["fc1_bias"] == (16,)
+    assert args["fc2_weight"] == (10, 16)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    assert out_shapes == [None]
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    data2 = sym.Variable("data2")
+    net2 = sym.FullyConnected(data2, num_hidden=5, name="fc2")
+    composed = net2(data2=net1)
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc2_weight" in args and "data" in args
+
+
+def test_group_and_index():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_json_roundtrip(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(8, 20))
+    a2, o2, _ = net2.infer_shape(data=(8, 20))
+    assert o1 == o2 and a1 == a2
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    net3 = sym.load(f)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_var_shape_attr():
+    data = sym.Variable("data", shape=(4, 7))
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    arg_shapes, out_shapes, _ = net.infer_shape()
+    assert out_shapes == [(4, 3)]
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = sym.FullyConnected(a, num_hidden=2, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+
+
+def test_symbol_arith_and_infer():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2.0) / 3.0
+    ex = c.bind(mx.cpu(), {"a": mx.nd.ones((2, 2)), "b": mx.nd.ones((2, 2)) * 4})
+    out = ex.forward()
+    assert np.allclose(out[0].asnumpy(), 3.0)
+
+
+def test_multi_output_split():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=3, axis=1, name="split")
+    assert len(parts.list_outputs()) == 3
+    ex = parts.bind(mx.cpu(), {"data": mx.nd.array(np.arange(12).reshape(2, 6))})
+    outs = ex.forward()
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 2)
+
+
+def test_infer_type():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_types, out_types, _ = net.infer_type(data="float32")
+    assert all(t == np.float32 for t in arg_types)
+
+
+def test_bucketing_shared_shapes():
+    # same symbol bound at two shapes — jit cache handles both
+    net = _mlp()
+    ex1 = net.simple_bind(mx.cpu(), "null", data=(4, 12), softmax_label=(4,))
+    ex2 = ex1.reshape(data=(8, 12), softmax_label=(8,))
+    o1 = ex1.forward(is_train=False, data=np.zeros((4, 12), "float32"))
+    o2 = ex2.forward(is_train=False, data=np.zeros((8, 12), "float32"))
+    assert o1[0].shape == (4, 10) and o2[0].shape == (8, 10)
