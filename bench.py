@@ -1,0 +1,87 @@
+"""Headline benchmark: ResNet-50 ImageNet training throughput.
+
+Reference baseline (BASELINE.md / docs/faq/perf.md:205-215): MXNet 1.2
+ResNet-50 training, batch 32, fp32, 1x V100 = 298.51 img/s.
+
+Here the whole training step — forward, backward, gradient scale, SGD
+momentum update — is ONE XLA computation (parallel/trainer.py TrainStep)
+running bf16 on the MXU with fp32 master weights (the multi-precision
+configuration the reference exposes as optimizer.py SGD multi_precision).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_IMG_PER_SEC = 298.51
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image-shape", type=str, default="3,224,224")
+    ap.add_argument("--dtype", type=str, default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import TrainStep
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    sym = models.get_symbol("resnet", num_classes=1000,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape, dtype=args.dtype)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                           multi_precision=(args.dtype != "float32"),
+                           rescale_grad=1.0 / args.batch)
+    ts = TrainStep(sym, opt,
+                   data_shapes={"data": (args.batch,) + image_shape},
+                   label_shapes={"softmax_label": (args.batch,)})
+    ts.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                  magnitude=2))
+
+    # Synthetic device-resident batches (the reference's perf.md numbers are
+    # synthetic-data benchmarks of the training step; input-pipeline overlap
+    # is the data iterator's job, not the step's). Two batches alternate to
+    # avoid any single-buffer artifacts.
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(2):
+        data = jnp.asarray(rng.uniform(
+            -1, 1, (args.batch,) + image_shape).astype(np.float32))
+        label = jnp.asarray(rng.randint(0, 1000, (args.batch,))
+                            .astype(np.float32))
+        batches.append({"data": data, "softmax_label": label})
+    jax.block_until_ready(batches)
+
+    for i in range(args.warmup):
+        outs = ts.step(batches[i % 2])
+    jax.block_until_ready(ts.params)
+
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        outs = ts.step(batches[i % 2])
+    jax.block_until_ready(ts.params)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = args.batch * args.iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

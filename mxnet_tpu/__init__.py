@@ -42,4 +42,5 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import parallel
+from . import models
 from . import test_utils

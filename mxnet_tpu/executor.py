@@ -332,10 +332,12 @@ class Executor:
         ctx = ctx if ctx is not None else current_context()
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
         type_dict = type_dict or {}
-        arg_types, _, aux_types = symbol.infer_type(**{
-            k: v for k, v in type_dict.items()})
+        arg_shapes, arg_types, aux_shapes, aux_types = \
+            symbol.infer_shape_type(shape_kwargs, type_dict)
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("simple_bind: cannot infer shapes of %s" % missing)
 
         grad_req_dict = Executor._normalize_grad_req(grad_req, arg_names)
         # data/label inputs default to grad null under 'write' like the

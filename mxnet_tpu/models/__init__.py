@@ -1,0 +1,48 @@
+"""Symbol-based model zoo.
+
+Reference parity: example/image-classification/symbols/ (mlp, lenet,
+alexnet, vgg, resnet, resnext, mobilenet, inception-bn, googlenet,
+squeezenet, densenet). Each module exposes ``get_symbol(num_classes, ...)``
+returning a Symbol ending in SoftmaxOutput, so any of them drops into
+``Module.fit`` / ``bench.py`` unchanged.
+
+These are fresh TPU-first definitions (bf16-friendly: ``dtype`` casts the
+trunk while the final classifier/softmax stays fp32), not translations of
+the reference scripts.
+"""
+from . import mlp
+from . import lenet
+from . import alexnet
+from . import vgg
+from . import resnet
+from . import resnext
+from . import mobilenet
+from . import inception_bn
+from . import googlenet
+from . import squeezenet
+from . import densenet
+
+_NETWORKS = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "alexnet": alexnet,
+    "vgg": vgg,
+    "resnet": resnet,
+    "resnext": resnext,
+    "mobilenet": mobilenet,
+    "inception-bn": inception_bn,
+    "inception_bn": inception_bn,
+    "googlenet": googlenet,
+    "squeezenet": squeezenet,
+    "densenet": densenet,
+}
+
+
+def get_symbol(network, **kwargs):
+    """Factory mirroring example/image-classification/common/fit.py usage:
+    ``models.get_symbol('resnet', num_classes=1000, num_layers=50,
+    image_shape=(3,224,224))``."""
+    if network not in _NETWORKS:
+        raise ValueError("unknown network '%s'; available: %s"
+                         % (network, sorted(set(_NETWORKS))))
+    return _NETWORKS[network].get_symbol(**kwargs)

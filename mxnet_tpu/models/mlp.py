@@ -1,0 +1,13 @@
+"""3-layer perceptron (reference example/image-classification/symbols/mlp.py)."""
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=10, **kwargs):
+    data = sym.Variable("data")
+    net = sym.Flatten(data=data)
+    net = sym.FullyConnected(data=net, name="fc1", num_hidden=128)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=64)
+    net = sym.Activation(data=net, name="relu2", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc3", num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=net, name="softmax")

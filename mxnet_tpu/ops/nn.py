@@ -43,10 +43,9 @@ def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False,
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32
-                        if x.dtype == jnp.bfloat16 else None)
-    y = y.astype(x.dtype)
+    # bf16 inputs accumulate in fp32 on the MXU by default; no explicit
+    # preferred_element_type (its transpose rule breaks mixed-dtype vjp)
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if not no_bias and bias is not None:
         y = y + bias
     return y
@@ -82,7 +81,6 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     ).astype(data.dtype)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * (data.ndim - 2))

@@ -7,6 +7,8 @@ ICI/DCN with XLA collectives instead of NCCL/ZMQ.
 """
 from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
                    replicated_sharding, shard_batch, current_mesh)
+from .trainer import TrainStep, default_tp_rule
 
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
-           "replicated_sharding", "shard_batch", "current_mesh"]
+           "replicated_sharding", "shard_batch", "current_mesh",
+           "TrainStep", "default_tp_rule"]

@@ -234,6 +234,22 @@ class Symbol:
                       for node, idx in self._entries]
         return arg_shapes, out_shapes, aux_shapes
 
+    def infer_shape_type(self, shape_kwargs, type_kwargs=None):
+        """Joint shape+dtype inference — needed because dtype propagation
+        (bf16 data ⇒ bf16 weights) rides the same eval_shape pass. Returns
+        (arg_shapes, arg_types, aux_shapes, aux_types)."""
+        known_shapes = {k: tuple(v) for k, v in shape_kwargs.items()
+                        if v is not None}
+        known_dtypes = {k: _np.dtype(v) for k, v in (type_kwargs or {}).items()}
+        shapes, dtypes = self._infer(known_shapes, known_dtypes)
+        args = self.list_arguments()
+        auxs = self.list_auxiliary_states()
+        f32 = _np.dtype("float32")
+        return ([shapes.get(n) for n in args],
+                [dtypes.get(n, f32) for n in args],
+                [shapes.get(n) for n in auxs],
+                [dtypes.get(n, f32) for n in auxs])
+
     def infer_type(self, *args, **kwargs):
         known = {}
         arg_names = self.list_arguments()
@@ -262,6 +278,10 @@ class Symbol:
         shapes = dict(known_shapes)
         dtypes = dict(known_dtypes)
         env = {}  # (id(node), out_idx) -> jax.ShapeDtypeStruct | None
+        # vars whose dtype wasn't given: provisionally fp32, upgraded to the
+        # dtype of a sibling input on first use (the reference's same-type
+        # FInferType default, e.g. bf16 data ⇒ bf16 conv weights)
+        pending_dtype_vars = {}
 
         for node in self._topo():
             if node.is_var:
@@ -272,10 +292,35 @@ class Symbol:
                 dt = dtypes.get(node.name)
                 if dt is None and "__dtype__" in node.str_attrs:
                     dt = _np.dtype(node.str_attrs["__dtype__"])
+                if dt is None:
+                    pending_dtype_vars[id(node)] = node
                 env[(id(node), 0)] = (
                     jax.ShapeDtypeStruct(tuple(shp), dt or _np.dtype("float32"))
                     if shp is not None else None)
                 continue
+            # same-dtype rule: resolve pending param-var dtypes from the
+            # first input whose dtype is definitively known. Integer inputs
+            # (Embedding/take indices, labels) never anchor — the reference's
+            # FInferType same-type rule is a float-dtype rule; Embedding
+            # weights take their dtype from the op's dtype attr, not the
+            # index input.
+            anchor = None
+            for inp, oi in node.inputs:
+                if not (inp.is_var and id(inp) in pending_dtype_vars):
+                    sds = env.get((id(inp), oi))
+                    if sds is not None and jax.numpy.issubdtype(
+                            sds.dtype, _np.floating):  # bf16-aware check
+                        anchor = sds.dtype
+                        break
+            if anchor is not None:
+                for inp, oi in node.inputs:
+                    if inp.is_var and id(inp) in pending_dtype_vars:
+                        sds = env.get((id(inp), 0))
+                        if sds is not None:
+                            env[(id(inp), 0)] = jax.ShapeDtypeStruct(
+                                sds.shape, anchor)
+                        dtypes[inp.name] = _np.dtype(anchor)
+                        del pending_dtype_vars[id(inp)]
 
             in_names = (node.op.input_names if not node.op.variadic
                         else [str(i) for i in range(len(node.inputs))])

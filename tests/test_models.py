@@ -1,0 +1,70 @@
+"""Model-zoo construction + tiny forward/train smoke tests.
+
+Mirrors the reference's symbol tests (tests/python/unittest/test_symbol.py)
+and the train-integration tier (tests/python/train/) at toy scale.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+ALL_NETS = [
+    ("mlp", {"num_classes": 10}),
+    ("lenet", {"num_classes": 10}),
+    ("alexnet", {"num_classes": 17}),
+    ("vgg", {"num_classes": 17, "num_layers": 11}),
+    ("resnet", {"num_classes": 17, "num_layers": 18}),
+    ("resnet", {"num_classes": 17, "num_layers": 50}),
+    ("resnext", {"num_classes": 17, "num_layers": 50}),
+    ("mobilenet", {"num_classes": 17}),
+    ("inception-bn", {"num_classes": 17}),
+    ("googlenet", {"num_classes": 17}),
+    ("squeezenet", {"num_classes": 17}),
+    ("densenet", {"num_classes": 17, "num_layers": 121}),
+]
+
+
+@pytest.mark.parametrize("net,kw", ALL_NETS,
+                         ids=["%s-%s" % (n, k.get("num_layers", "")) for n, k in ALL_NETS])
+def test_build_and_infer(net, kw):
+    s = models.get_symbol(net, **kw)
+    if net in ("mlp",):
+        dshape = (2, 784)
+    elif net == "lenet":
+        dshape = (2, 1, 28, 28)
+    else:
+        dshape = (2, 3, 224, 224)
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(data=dshape)
+    assert out_shapes[0] == (2, kw["num_classes"])
+    assert all(sh is not None for sh in arg_shapes)
+
+
+def test_resnet50_forward():
+    s = models.get_symbol("resnet", num_classes=10, num_layers=50,
+                          image_shape=(3, 32, 32))
+    ex = s.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32),
+                       softmax_label=(2,), grad_req="null")
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.uniform(-0.05, 0.05, arr.shape)
+    out = ex.forward(is_train=False, data=np.random.uniform(
+        0, 1, (2, 3, 32, 32)).astype(np.float32))
+    p = out[0].asnumpy()
+    assert p.shape == (2, 10)
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(2), rtol=1e-4)
+
+
+def test_cifar_resnet_depth():
+    s = models.get_symbol("resnet", num_classes=10, num_layers=20,
+                          image_shape=(3, 28, 28))
+    args, outs, _ = s.infer_shape(data=(4, 3, 28, 28))
+    assert outs[0] == (4, 10)
+
+
+def test_json_roundtrip_resnet():
+    s = models.get_symbol("resnet", num_classes=10, num_layers=18)
+    s2 = mx.sym.load_json(s.tojson())
+    assert s2.list_arguments() == s.list_arguments()
+    assert s2.list_outputs() == s.list_outputs()

@@ -131,3 +131,19 @@ def test_bucketing_shared_shapes():
     o1 = ex1.forward(is_train=False, data=np.zeros((4, 12), "float32"))
     o2 = ex2.forward(is_train=False, data=np.zeros((8, 12), "float32"))
     assert o1[0].shape == (4, 10) and o2[0].shape == (8, 10)
+
+
+def test_int_inputs_dont_poison_param_dtypes():
+    """Integer index inputs (Embedding) must not anchor sibling/downstream
+    parameter dtypes to int32 via the same-dtype rule."""
+    import numpy as np
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, name="emb", input_dim=20, output_dim=8)
+    fc = mx.sym.FullyConnected(emb, name="fc", num_hidden=4, flatten=True)
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 5), softmax_label=(2,),
+                         type_dict={"data": "int32"}, grad_req="null")
+    assert ex.arg_dict["emb_weight"].dtype == np.float32
+    assert ex.arg_dict["fc_weight"].dtype == np.float32
+    assert ex.arg_dict["fc_bias"].dtype == np.float32
