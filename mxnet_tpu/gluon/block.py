@@ -1,0 +1,584 @@
+"""Block / HybridBlock / SymbolBlock.
+
+Reference parity: python/mxnet/gluon/block.py (Block :126, HybridBlock
+:669, ``hybridize`` → ``_build_cache`` → CachedOp :746-783, SymbolBlock).
+
+TPU-native hybridization: instead of building an nnvm CachedOp, the block's
+``hybrid_forward`` is traced under ``jax.jit`` with its NDArrays wrapping
+tracers — the whole block becomes ONE XLA computation, cached per
+(input shapes/dtypes, train-mode). Mutated non-differentiable parameters
+(BatchNorm running stats) are threaded out of the traced function and
+written back eagerly, keeping jit purity while preserving MXNet's in-place
+aux-update semantics (FMutateInputs).
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .. import autograd
+from ..ops import registry as _reg
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(threading.local):
+    """Name manager for Block prefixes (reference block.py _BlockScope)."""
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..base import current_name_manager
+                prefix = current_name_manager().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (reference gluon/block.py:126)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(
+                    value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this block and children, optionally filtered by
+        a regex over names (reference block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({n: p for n, p in self.params.items()
+                        if pattern.match(n)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            from ..initializer import Uniform
+            init = Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    # ------------------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from ..serialization import save_ndarray_file
+        save_ndarray_file(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..serialization import load_ndarray_file
+        loaded = load_ndarray_file(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise IOError("Parameter '%s' missing in '%s'"
+                                  % (name, filename))
+        for name, v in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise IOError("Parameter '%s' from '%s' not found in "
+                                  "Block" % (name, filename))
+                continue
+            p = params[name]
+            if p._data is None:
+                p.shape = v.shape
+                p.initialize(ctx=ctx)
+            p.set_data(v)
+
+    # legacy names (reference keeps both)
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        summary = []
+
+        def _hook(block, inp, out):
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            n_params = sum(int(_np.prod(p.shape))
+                           for p in block._reg_params.values()
+                           if p.shape is not None)
+            summary.append((block.name, type(block).__name__,
+                            [tuple(o.shape) for o in outs
+                             if isinstance(o, NDArray)], n_params))
+
+        handles = []
+        def _register(b):
+            b._forward_hooks.append(_hook)
+            handles.append(b)
+        self.apply(_register)
+        try:
+            self(*inputs)
+        finally:
+            for b in handles:
+                b._forward_hooks.remove(_hook)
+        lines = ["%-30s %-20s %-25s %10s" % ("Layer", "Type", "Output Shape",
+                                             "Params")]
+        for name, typ, shapes, n in summary:
+            lines.append("%-30s %-20s %-25s %10d"
+                         % (name, typ, ",".join(map(str, shapes)), n))
+        print("\n".join(lines))
+
+
+def _indent(s, num):
+    lines = s.split("\n")
+    return ("\n" + " " * num).join(lines)
+
+
+class HybridBlock(Block):
+    """Block that can be compiled to one XLA computation
+    (reference gluon/block.py:669)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_fns = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_fns = {}
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_fns = {}
+        super().cast(dtype)
+
+    def _infer_param_shapes(self, *args):
+        """Per-layer rule completing unknown (0) parameter dims from the
+        concrete inputs — the deferred-init analog of the reference's
+        infer_shape pass (gluon/block.py _deferred_infer_shape)."""
+
+    # ------------------------------------------------------------------
+    def _collect_all_params(self):
+        """(grad_params, aux_params) dicts keyed by parameter NAME, over
+        this block and all children (what the traced fn takes as inputs)."""
+        grad_p, aux_p = {}, {}
+
+        def visit(block):
+            for p in block._reg_params.values():
+                (aux_p if p.grad_req == "null" else grad_p)[p.name] = p
+            for c in block._children.values():
+                visit(c)
+        visit(self)
+        return grad_p, aux_p
+
+    def forward(self, *args):
+        if self._active:
+            # deferred params must exist before tracing; resolve them with
+            # one eager pass (only happens on the very first call)
+            if any(p._data is None for p in self.collect_params().values()):
+                return self._eager_forward(*args)
+            return self._call_cached(*args)
+        return self._eager_forward(*args)
+
+    def _eager_forward(self, *args):
+        """Eager path. Deferred-init resolution happens leaf-locally: when a
+        parameter read raises, the layer's _infer_param_shapes completes the
+        unknown dims from the inputs and init finishes (the reference's
+        _deferred_infer_shape flow, gluon/block.py)."""
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._infer_param_shapes(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        from .. import ndarray as F
+        return self.hybrid_forward(F, *args, **params)
+
+    # ------------------------------------------------------------------
+    def _call_cached(self, *args):
+        grad_p, aux_p = self._collect_all_params()
+        grad_names = sorted(grad_p)
+        aux_names = sorted(aux_p)
+        in_arrs = [a._data for a in args]
+        is_train = autograd.is_training()
+        key = (tuple((a.shape, str(a.dtype)) for a in in_arrs), is_train,
+               tuple(grad_names), tuple(aux_names))
+        cached = self._cached_fns.get(key)
+        if cached is None:
+            cached = self._build_cache(args, grad_names, aux_names, is_train)
+            self._cached_fns[key] = cached
+        fn = cached
+
+        grad_vals = [grad_p[n].data()._data for n in grad_names]
+        aux_vals = [aux_p[n].data()._data for n in aux_names]
+        seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
+        outs, new_aux = fn(grad_vals, aux_vals, in_arrs, seed)
+        # write mutated aux (BatchNorm running stats) back eagerly
+        if is_train:
+            for n, v in zip(aux_names, new_aux):
+                aux_p[n].data()._set_data(v)
+        ctx = args[0]._ctx if args else current_context()
+        out_nds = [NDArray(o, ctx) for o in outs]
+
+        if autograd.is_recording():
+            # tape entry: pure fn of (inputs + grad params); aux and seed
+            # closed over so replay reproduces the same computation
+            aux_c = list(aux_vals)
+            n_in = len(in_arrs)
+
+            def custom(*arrs):
+                outs2, _ = fn(list(arrs[n_in:]), aux_c, list(arrs[:n_in]),
+                              seed)
+                return tuple(outs2)
+
+            inputs = list(args) + [grad_p[n].data() for n in grad_names]
+            autograd._record_op(None, {}, is_train, None, inputs, out_nds,
+                                custom=custom)
+        return out_nds[0] if len(out_nds) == 1 else out_nds
+
+    def _build_cache(self, args, grad_names, aux_names, is_train):
+        """jit the whole hybrid_forward; one XLA computation per shape/mode
+        (the CachedOp analog, reference cached_op.cc)."""
+        self_ref = self
+
+        def run(grad_vals, aux_vals, in_vals, seed):
+            rng = jax.random.key(seed)
+            grad_nd = dict(zip(grad_names, (NDArray(v) for v in grad_vals)))
+            aux_nd = dict(zip(aux_names, (NDArray(v) for v in aux_vals)))
+            in_nd = [NDArray(v) for v in in_vals]
+            with _reg._OpCtxScope(is_train, rng), \
+                    autograd._Scope(recording=False, training=is_train):
+                out = self_ref._hybrid_call(in_nd, grad_nd, aux_nd)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            new_aux = [aux_nd[n]._data for n in aux_names]
+            return tuple(o._data for o in outs), new_aux
+
+        return jax.jit(run)
+
+    def _hybrid_call(self, in_nd, grad_nd, aux_nd):
+        """Run hybrid_forward recursively with param NDArrays drawn from the
+        traced pools (children share the same pools via name lookup)."""
+        pools = (grad_nd, aux_nd)
+        return _run_with_pools(self, in_nd, pools)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def export(self, path, epoch=0):
+        """Write symbol.json + params for the symbolic/Module/C-predict
+        world (reference block.py export)."""
+        from .. import symbol as sym_mod
+        from ..serialization import save_ndarray_file
+        grad_p, aux_p = self._collect_all_params()
+        data_var = sym_mod.var("data")
+        with _SymbolTraceScope():
+            out = _run_symbolic(self, [data_var])
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        arrs = {}
+        for n, p in grad_p.items():
+            arrs["arg:" + n] = p.data()
+        for n, p in aux_p.items():
+            arrs["aux:" + n] = p.data()
+        save_ndarray_file("%s-%04d.params" % (path, epoch), arrs)
+        return out
+
+
+class _SymbolTraceScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+def _run_symbolic(block, sym_inputs):
+    """Recursively evaluate hybrid_forward with F=symbol and parameter
+    variables, producing the exported graph."""
+    from .. import symbol as F
+    params = {k: p.var() for k, p in block._reg_params.items()}
+    orig_calls = {}
+
+    # children must also run symbolically: monkey-free approach — call
+    # hybrid_forward directly with symbolic children wrappers
+    class _SymChild:
+        def __init__(self, child):
+            self._child = child
+
+        def __call__(self, *xs):
+            return _run_symbolic(self._child, list(xs))
+
+    saved = {}
+    for name, child in block._children.items():
+        for attr, val in list(vars(block).items()):
+            if val is child:
+                saved[attr] = val
+                object.__setattr__(block, attr, _SymChild(child))
+    try:
+        out = block.hybrid_forward(F, *sym_inputs, **params)
+    finally:
+        for attr, val in saved.items():
+            object.__setattr__(block, attr, val)
+    return out
+
+
+def _run_with_pools(block, in_nd, pools):
+    """Evaluate block.hybrid_forward eagerly-on-tracers, drawing every
+    parameter value from the shared traced pools by name."""
+    grad_nd, aux_nd = pools
+    params = {}
+    for attr, p in block._reg_params.items():
+        pool = aux_nd if p.grad_req == "null" else grad_nd
+        params[attr] = pool[p.name]
+
+    saved = {}
+
+    class _TracedChild:
+        def __init__(self, child):
+            self._child = child
+
+        def __call__(self, *xs):
+            return _run_with_pools(self._child, list(xs), pools)
+
+        def __getattr__(self, item):
+            return getattr(self._child, item)
+
+    for name, child in list(block._children.items()):
+        for attr, val in list(vars(block).items()):
+            if val is child:
+                saved[attr] = val
+                object.__setattr__(block, attr, _TracedChild(child))
+    # Sequential-style children stored only in _children
+    saved_children = block._children
+    block._children = {k: _TracedChild(v) if isinstance(v, (Block,))
+                       else v for k, v in saved_children.items()}
+    from .. import ndarray as F
+    try:
+        out = block.hybrid_forward(F, *in_nd, **params)
+    finally:
+        block._children = saved_children
+        for attr, val in saved.items():
+            object.__setattr__(block, attr, val)
+    return out
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params into a Block (reference block.py SymbolBlock);
+    the import path for `export`ed models."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=None)
+        from ..symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        existing = dict(params.items()) if params is not None else {}
+        for name in arg_names + list(aux_names):
+            if name in self._input_names:
+                continue
+            if name in existing:
+                self._params._params[name] = existing[name]
+            else:
+                self._params._params[name] = Parameter(
+                    name, allow_deferred_init=True,
+                    grad_req="null" if name in aux_names else "write")
+        self._graph_cache = {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        from ..serialization import load_ndarray_file
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        from ..symbol import var
+        inputs = [var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            loaded = load_ndarray_file(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[-1]
+                if name in block._params._params:
+                    p = block._params._params[name]
+                    p.shape = v.shape
+                    p.initialize(ctx=ctx)
+                    p.set_data(v)
+        return block
+
+    def forward(self, *args):
+        from ..executor import _build_graph_fn
+        is_train = autograd.is_training()
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in args), is_train)
+        fn = self._graph_cache.get(key)
+        if fn is None:
+            graph_fn = _build_graph_fn(self._symbol)
+
+            def run(arg_vals, aux_vals, in_vals, seed):
+                all_args = dict(arg_vals)
+                all_args.update(dict(zip(self._input_names, in_vals)))
+                outs, _ = graph_fn(all_args, aux_vals, seed, is_train)
+                return tuple(outs)
+
+            fn = jax.jit(run)
+            self._graph_cache[key] = fn
+        aux_names = set(self._symbol.list_auxiliary_states())
+        arg_vals = {n: p.data()._data for n, p in self._params.items()
+                    if n not in aux_names and n not in self._input_names}
+        aux_vals = {n: p.data()._data for n, p in self._params.items()
+                    if n in aux_names}
+        seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
+        outs = fn(arg_vals, aux_vals, [a._data for a in args], seed)
+        ctx = args[0]._ctx if args else current_context()
+        out_nds = [NDArray(o, ctx) for o in outs]
+        return out_nds[0] if len(out_nds) == 1 else out_nds

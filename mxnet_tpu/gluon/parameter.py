@@ -1,0 +1,368 @@
+"""Parameter / ParameterDict.
+
+Reference parity: python/mxnet/gluon/parameter.py (Parameter with deferred
+initialization, grad_req, lr_mult/wd_mult; ParameterDict with prefix
+scoping and sharing). TPU-native: data lives as a jax.Array-backed NDArray;
+"per-context copies" (list_data/list_grad) collapse to the single sharded
+array — a mesh sharding replaces per-device replication.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from ..initializer import InitDesc, get as init_create
+from .. import autograd
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self._stype = stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
+                                                      self.dtype)
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._grad = None
+                self._data._grad_req = "null"
+        elif self._data is not None:
+            self._init_grad()
+
+    def _check_shape_dtype_known(self):
+        if self.shape is None or any(s == 0 for s in self.shape):
+            raise DeferredInitializationError(
+                "Parameter '%s' has unknown shape %s. Either pass shapes or "
+                "run a forward pass to trigger shape inference." %
+                (self.name, self.shape))
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            from ..initializer import Uniform
+            default_init = Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape %s." % (self.name, self.shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        self._deferred_init = ()
+        nd = nd_zeros(self.shape, ctx[0], self.dtype)
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_create(initializer)
+        initializer(InitDesc(self.name, {"__init__": ""}), nd)
+        self._data = nd
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd_zeros(self._data.shape, self._data.context,
+                              self._data.dtype)
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=self._grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init:
+            init, ctx, default_init = self._deferred_init
+            self._check_shape_dtype_known()
+            self._finish_init(init, ctx, default_init)
+
+    def _shape_from_data(self, data_shape):
+        """Complete unknown (0) dims from a concrete forward input."""
+        if self.shape is None:
+            self.shape = tuple(data_shape)
+            return
+        new = tuple(d if s == 0 else s
+                    for s, d in zip(self.shape, data_shape))
+        if len(self.shape) != len(data_shape) or any(
+                s != 0 and s != d for s, d in zip(self.shape, data_shape)):
+            raise MXNetError(
+                "Parameter %s: inferred shape %s incompatible with declared "
+                "%s" % (self.name, data_shape, self.shape))
+        self.shape = new
+
+    # ------------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter '%s' has not been initialized yet because "
+                    "initialization was deferred. Run a forward pass first." %
+                    self.name)
+            raise RuntimeError(
+                "Parameter '%s' has not been initialized. You should "
+                "initialize parameters with Block.initialize()." % self.name)
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self.data().context] if self._data is not None else []
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad[:] = 0
+
+    def set_data(self, data):
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if self._deferred_init:
+                self._finish_deferred_init()
+            else:
+                raise RuntimeError("set_data on uninitialized Parameter '%s'"
+                                   % self.name)
+        if isinstance(data, NDArray):
+            self._data._set_data(data.astype(self.dtype)._data)
+        else:
+            import jax.numpy as jnp
+            self._data._set_data(jnp.asarray(data, self.dtype))
+
+    def reset_ctx(self, ctx):
+        pass  # single logical device; shardings govern placement
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._set_data(self._data.astype(dtype)._data)
+            if self._grad is not None:
+                self._init_grad()
+
+    def var(self):
+        """Symbol variable for this parameter (used by export/SymbolBlock)."""
+        from .. import symbol as sym
+        return sym.var(self.name, shape=self.shape, dtype=self.dtype,
+                       lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+
+class Constant(Parameter):
+    """Constant parameter: never updated (reference gluon/parameter.py
+    Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            import jax.numpy as jnp
+            value = NDArray(jnp.asarray(value, "float32"))
+        self.value = value
+
+        class _CInit:
+            def __call__(self, desc, arr):
+                arr[:] = value.asnumpy()
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix scoping + sharing
+    (reference gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return "ParameterDict '%s' (%s)" % (
+            self._prefix, ", ".join(sorted(self._params)))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs):
+        """Retrieve-or-create ``prefix+name`` (the Block layer API)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if getattr(param, k, None) is not None and k in ("shape", "dtype"):
+                    if k == "shape" and v is not None:
+                        v = tuple(v)
+                        cur = tuple(param.shape)
+                        if len(cur) == len(v) and all(
+                                a in (0, b) or b == 0
+                                for a, b in zip(cur, v)):
+                            param.shape = tuple(
+                                b if a == 0 else a for a, b in zip(cur, v))
+                            continue
+                        if cur != v:
+                            raise AssertionError(
+                                "Parameter '%s' shape mismatch: %s vs %s"
+                                % (name, cur, v))
+                    elif v != getattr(param, k):
+                        raise AssertionError(
+                            "Parameter '%s' %s mismatch: %s vs %s"
+                            % (name, k, getattr(param, k), v))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '%s'" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("Cannot update self with other because they "
+                                 "have different Parameters with the same "
+                                 "name '%s'" % k)
+            self._params[k] = v
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        for _, v in sorted(self._params.items()):
+            v.initialize(None, ctx, init or Uniform(), force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..serialization import save_ndarray_file
+        arg = {}
+        for p in self._params.values():
+            weight = p.data()
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = weight
+        save_ndarray_file(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..serialization import load_ndarray_file
+        loaded = load_ndarray_file(filename)
+        params = {restore_prefix + k.split(":", 1)[-1]: v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in params:
+                    raise IOError("Parameter '%s' is missing in file '%s'"
+                                  % (name, filename))
+        for name, v in params.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError("Parameter '%s' loaded from file '%s' is "
+                                  "not present in ParameterDict"
+                                  % (name, filename))
+                continue
+            p = self._params[name]
+            if p.shape is None or p._data is None:
+                p.shape = v.shape
+                p.initialize(ctx=ctx)
+            p.set_data(v)

@@ -91,8 +91,14 @@ def invoke(opdef, args, kwargs, out=None, name=None):
     fn = _get_jitted(opdef, attrs, is_train, needs_rng, len(arrs))
     rng = None
     if needs_rng:
-        from .. import random as _random
-        rng = _random.next_key()
+        # inside an enclosing trace (hybridized block, executor graph) the
+        # scope installed a traced key — drawing the global concrete key
+        # there would bake the randomness into the compiled graph
+        if _reg.op_context._rng_key is not None:
+            rng = _reg.op_context.next_rng_key()
+        else:
+            from .. import random as _random
+            rng = _random.next_key()
         raw = fn(rng, *arrs)
     else:
         raw = fn(*arrs)
