@@ -43,4 +43,5 @@ from . import module as mod
 from .module import Module
 from . import parallel
 from . import models
+from . import gluon
 from . import test_utils

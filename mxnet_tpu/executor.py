@@ -134,7 +134,8 @@ class Executor:
         self._train_seed = None
         self._train_auxs = None
         self._step = 0
-        self._base_seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
+        from . import random as _rand
+        self._base_seed = _rand.next_seed()
 
         cache = _compiled_cache(symbol)
         self._graph_fn = cache["graph_fn"]

@@ -355,7 +355,8 @@ class HybridBlock(Block):
 
         grad_vals = [grad_p[n].data()._data for n in grad_names]
         aux_vals = [aux_p[n].data()._data for n in aux_names]
-        seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
+        from .. import random as _rand
+        seed = _rand.next_seed()
         outs, new_aux = fn(grad_vals, aux_vals, in_arrs, seed)
         # write mutated aux (BatchNorm running stats) back eagerly
         if is_train:
@@ -460,9 +461,14 @@ def _run_symbolic(block, sym_inputs):
             if val is child:
                 saved[attr] = val
                 object.__setattr__(block, attr, _SymChild(child))
+    # Sequential-style children stored only in _children
+    saved_children = block._children
+    block._children = {k: _SymChild(v) if isinstance(v, Block) else v
+                       for k, v in saved_children.items()}
     try:
         out = block.hybrid_forward(F, *sym_inputs, **params)
     finally:
+        block._children = saved_children
         for attr, val in saved.items():
             object.__setattr__(block, attr, val)
     return out
@@ -573,12 +579,31 @@ class SymbolBlock(HybridBlock):
             fn = jax.jit(run)
             self._graph_cache[key] = fn
         aux_names = set(self._symbol.list_auxiliary_states())
-        arg_vals = {n: p.data()._data for n, p in self._params.items()
-                    if n not in aux_names and n not in self._input_names}
+        arg_param_names = sorted(
+            n for n in self._params.keys()
+            if n not in aux_names and n not in self._input_names)
+        arg_vals = {n: self._params[n].data()._data for n in arg_param_names}
         aux_vals = {n: p.data()._data for n, p in self._params.items()
                     if n in aux_names}
-        seed = _np.uint32(_np.random.randint(0, 2**31 - 1))
-        outs = fn(arg_vals, aux_vals, [a._data for a in args], seed)
+        from .. import random as _rand
+        seed = _rand.next_seed()
+        in_arrs = [a._data for a in args]
+        outs = fn(arg_vals, aux_vals, in_arrs, seed)
         ctx = args[0]._ctx if args else current_context()
         out_nds = [NDArray(o, ctx) for o in outs]
+
+        if autograd.is_recording():
+            # tape entry mirroring HybridBlock._call_cached: replay is a pure
+            # fn of (inputs + arg params); aux and seed closed over
+            aux_c = dict(aux_vals)
+            n_in = len(in_arrs)
+
+            def custom(*arrs):
+                return tuple(fn(dict(zip(arg_param_names, arrs[n_in:])),
+                                aux_c, list(arrs[:n_in]), seed))
+
+            inputs = list(args) + [self._params[n].data()
+                                   for n in arg_param_names]
+            autograd._record_op(None, {}, is_train, None, inputs, out_nds,
+                                custom=custom)
         return out_nds[0] if len(out_nds) == 1 else out_nds

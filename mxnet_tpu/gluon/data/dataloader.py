@@ -1,0 +1,90 @@
+"""DataLoader (reference python/mxnet/gluon/data/dataloader.py:26-96).
+
+TPU-native worker model: the reference forks worker *processes* and ships
+batches through CPU shared memory because Python-side decode contends with
+the GIL-bound training loop. Here decode/augment is numpy (releases the
+GIL in practice) and device transfer is jax's async host→HBM copy, so
+``num_workers`` maps to a thread pool prefetching whole batches — no
+pickle/shared-memory round-trip, same overlap.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
+    from ... import ndarray as nd
+    from ...ndarray import NDArray
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return nd.array(arr)
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference dataloader.py:26)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, int(num_workers))
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # thread-pool prefetch: keep num_workers batches in flight
+        from concurrent.futures import ThreadPoolExecutor
+        import collections
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            pending = collections.deque()
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._num_workers * 2):
+                    pending.append(pool.submit(self._make_batch, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                yield pending.popleft().result()
+                try:
+                    pending.append(pool.submit(self._make_batch, next(it)))
+                except StopIteration:
+                    pass
+
+    def __len__(self):
+        return len(self._batch_sampler)

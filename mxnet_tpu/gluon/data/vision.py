@@ -1,0 +1,201 @@
+"""Vision datasets (reference python/mxnet/gluon/data/vision.py).
+
+No network egress in this environment: datasets read standard local files
+(idx/pickle/folder formats) from ``root`` and raise with guidance when the
+files are absent, instead of downloading.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...base import MXNetError
+from .dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _require(path):
+    if not os.path.exists(path):
+        raise MXNetError(
+            "Dataset file %s not found. This environment has no network "
+            "access; place the file there manually." % path)
+    return path
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        from ... import ndarray as nd
+        data = nd.array(self._data[idx])
+        if self._transform is not None:
+            return self._transform(data, self._label[idx])
+        return data, self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference data/vision.py MNIST); reads idx files from root."""
+
+    _files = {True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+              False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")}
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        magic, = struct.unpack(">i", data[:4])
+        ndim = magic % 256
+        dims = struct.unpack(">" + "i" * ndim, data[4:4 + 4 * ndim])
+        return np.frombuffer(data, dtype=np.uint8,
+                             offset=4 + 4 * ndim).reshape(dims)
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        img_path = os.path.join(self._root, img_name)
+        if not os.path.exists(img_path) and os.path.exists(img_path + ".gz"):
+            img_path += ".gz"
+        lbl_path = os.path.join(self._root, lbl_name)
+        if not os.path.exists(lbl_path) and os.path.exists(lbl_path + ".gz"):
+            lbl_path += ".gz"
+        imgs = self._read_idx(_require(img_path))
+        self._data = imgs.reshape(imgs.shape[0], imgs.shape[1],
+                                  imgs.shape[2], 1)
+        self._label = self._read_idx(_require(lbl_path)).astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST: same idx format, different files
+    (reference data/vision.py FashionMNIST)."""
+
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches
+    (reference data/vision.py CIFAR10)."""
+
+    _train_files = ["data_batch_%d" % i for i in range(1, 6)]
+    _test_files = ["test_batch"]
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, path):
+        import pickle
+        with open(_require(path), "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        data = np.asarray(batch["data"], dtype=np.uint8)
+        data = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        labels = np.asarray(
+            batch.get("labels", batch.get("fine_labels")), dtype=np.int32)
+        return data, labels
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        data, labels = [], []
+        for fname in files:
+            d, l = self._read_batch(os.path.join(base, fname))
+            data.append(d)
+            labels.append(l)
+        self._data = np.concatenate(data, axis=0)
+        self._label = np.concatenate(labels, axis=0)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference data/vision.py CIFAR100)."""
+
+    _train_files = ["train"]
+    _test_files = ["test"]
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 transform=None):
+        sub = os.path.join(os.path.expanduser(root), "cifar-100-python")
+        if os.path.isdir(sub):
+            root = sub
+        super().__init__(root, train, transform)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a .rec file (reference data/vision.py
+    ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ...recordio import unpack_img
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, iscolor=self._flag)
+        from ... import ndarray as nd
+        img = nd.array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/category/image.jpg layout (reference data/vision.py
+    ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ...image import imread
+        img = imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -40,11 +40,11 @@ class Parameter:
         self._differentiable = differentiable
         if not differentiable:
             grad_req = "null"
-        self.grad_req = grad_req
         self._data = None
         self._grad = None
         self._deferred_init = ()
         self._stype = stype
+        self.grad_req = grad_req
 
     def __repr__(self):
         return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
@@ -265,26 +265,29 @@ class ParameterDict:
             param = Parameter(name, **kwargs)
             self._params[name] = param
         else:
+            # Keep any attribute already set on a shared/existing Parameter
+            # and assert consistency, instead of clobbering it with layer
+            # defaults (reference gluon/parameter.py ParameterDict.get).
             for k, v in kwargs.items():
-                if getattr(param, k, None) is not None and k in ("shape", "dtype"):
+                existing = getattr(param, k, None)
+                if existing is not None:
                     if k == "shape" and v is not None:
                         v = tuple(v)
-                        cur = tuple(param.shape)
+                        cur = tuple(existing)
                         if len(cur) == len(v) and all(
-                                a in (0, b) or b == 0
+                                a == b or a == 0 or b == 0
                                 for a, b in zip(cur, v)):
                             param.shape = tuple(
                                 b if a == 0 else a for a, b in zip(cur, v))
                             continue
-                        if cur != v:
-                            raise AssertionError(
-                                "Parameter '%s' shape mismatch: %s vs %s"
-                                % (name, cur, v))
-                    elif v != getattr(param, k):
+                        raise AssertionError(
+                            "Parameter '%s' shape mismatch: %s vs %s"
+                            % (name, cur, v))
+                    if v is not None and v != existing:
                         raise AssertionError(
                             "Parameter '%s' %s mismatch: %s vs %s"
-                            % (name, k, getattr(param, k), v))
-                else:
+                            % (name, k, existing, v))
+                elif v is not None:
                     setattr(param, k, v)
         return param
 

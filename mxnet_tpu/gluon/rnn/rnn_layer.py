@@ -1,0 +1,222 @@
+"""Fused recurrent layers RNN / LSTM / GRU.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer packing
+per-layer i2h/h2h Parameters into the fused RNN op's flat weight vector,
+cuDNN layout). TPU-native: the fused op (ops/rnn.py) is one ``lax.scan``
+XLA while-loop per layer/direction with the input matmul hoisted onto the
+MXU — the packed-layout parity means checkpoints interoperate with the
+reference's cuDNN weights.
+"""
+from __future__ import annotations
+
+from ..block import Block
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(Block):
+    """Eager-only like the reference's 1.x ``_RNNLayer`` (a ``Block``): the
+    fused op is itself one jitted ``lax.scan``, so hybridization adds
+    nothing."""
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, prefix=None, params=None):
+        self._mode = mode  # before super(): _alias() runs in Block.__init__
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC', 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=i2h_bias_initializer)
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "%s -> %s" % (shape[1] if shape[1] else None,
+                                shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (reference rnn_layer.py begin_state)."""
+        from ... import ndarray as nd
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            if func is None:
+                states.append(nd.zeros(shape, **kwargs))
+            else:
+                states.append(func(shape=shape, **kwargs))
+        return states
+
+    def _infer_param_shapes(self, inputs):
+        ni = inputs.shape[2]  # called with TNC inputs
+        ng, nh = self._gates, self._hidden_size
+        for j in ["l", "r"][:self._dir]:
+            getattr(self, "%s0_i2h_weight" % j).shape = (ng * nh, ni)
+
+    def forward(self, inputs, states=None):
+        """Accepts layout ``self._layout``; states optional
+        (reference rnn_layer.py forward_kernel/forward)."""
+        from ... import ndarray as nd
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.context,
+                                      dtype=str(inputs.dtype))
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for info, state in zip(self.state_info(batch_size), states):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." %
+                    (str(info["shape"]), str(state.shape)))
+        out = self._forward_kernel(inputs, states)
+        # out: (output, states); skip states in return if not given
+        return out[0] if skip_states else out
+
+    def _forward_kernel(self, inputs, states):
+        from ... import ndarray as F
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        # pack flat params in the fused op's cuDNN layout: all weights
+        # (per layer, per dir: i2h then h2h) then all biases
+        if any(p._data is None for p in self._reg_params.values()):
+            self._infer_param_shapes(inputs)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+        wbits, bbits = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                wbits.append(getattr(self, "%s%d_i2h_weight" % (j, i))
+                             .data().reshape((-1,)))
+                wbits.append(getattr(self, "%s%d_h2h_weight" % (j, i))
+                             .data().reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bbits.append(getattr(self, "%s%d_i2h_bias" % (j, i))
+                             .data().reshape((-1,)))
+                bbits.append(getattr(self, "%s%d_h2h_bias" % (j, i))
+                             .data().reshape((-1,)))
+        params = F.concat(*(wbits + bbits), dim=0)
+
+        rnn_args = [inputs, params] + list(states)
+        if self._mode != "lstm":
+            rnn_args = rnn_args[:3]
+        rnn = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, bidirectional=self._dir == 2,
+                    p=self._dropout, state_outputs=True, mode=self._mode)
+        if self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu or tanh), fused
+    (reference rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM, fused (reference rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU, fused (reference rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -138,6 +138,11 @@ class One(Initializer):
         arr[:] = 1.0
 
 
+# reference aliases (python/mxnet/initializer.py: @register(alias) usage)
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+
+
 @register
 class Constant(Initializer):
     def __init__(self, value=0.0):

@@ -188,7 +188,9 @@ class NDArray:
         return NDArray(out, self._ctx)
 
     # ------------------------------------------------------------------
-    # shape ops (view-free: XLA reshapes are free inside jit)
+    # shape ops (view-free: XLA reshapes are free inside jit). Routed
+    # through the op dispatch so they land on the autograd tape when
+    # recording — a raw jnp call here would silently sever the grad chain.
     # ------------------------------------------------------------------
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
@@ -196,22 +198,22 @@ class NDArray:
         if not shape:
             shape = kwargs.get("shape", ())
         shape = _infer_reshape(self.shape, tuple(shape))
-        return NDArray(jnp.reshape(self._data, shape), self._ctx)
+        return _dispatch.invoke_by_name("reshape", [self], {"shape": shape})
 
     def reshape_like(self, other):
         return self.reshape(other.shape)
 
     def expand_dims(self, axis):
-        return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
+        return _dispatch.invoke_by_name("expand_dims", [self], {"axis": axis})
 
     def squeeze(self, axis=None):
-        return NDArray(jnp.squeeze(self._data, axis), self._ctx)
+        return _dispatch.invoke_by_name("squeeze", [self], {"axis": axis})
 
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
             axes = tuple(axes[0])
         axes = axes if axes else None
-        return NDArray(jnp.transpose(self._data, axes), self._ctx)
+        return _dispatch.invoke_by_name("transpose", [self], {"axes": axes})
 
     @property
     def T(self):
@@ -221,19 +223,18 @@ class NDArray:
         return self.reshape((self.shape[0], -1))
 
     def broadcast_to(self, shape):
-        cur, tgt = self.shape, tuple(shape)
-        if len(cur) < len(tgt):
-            cur = (1,) * (len(tgt) - len(cur)) + cur
-        return NDArray(jnp.broadcast_to(self._data.reshape(cur), tgt), self._ctx)
+        return _dispatch.invoke_by_name("broadcast_to", [self],
+                                        {"shape": tuple(shape)})
 
     def broadcast_like(self, other):
         return self.broadcast_to(other.shape)
 
     def swapaxes(self, dim1, dim2):
-        return NDArray(jnp.swapaxes(self._data, dim1, dim2), self._ctx)
+        return _dispatch.invoke_by_name("swapaxes", [self],
+                                        {"dim1": dim1, "dim2": dim2})
 
     def tile(self, reps):
-        return NDArray(jnp.tile(self._data, reps), self._ctx)
+        return _dispatch.invoke_by_name("tile", [self], {"reps": reps})
 
     def as_nd_ndarray(self):
         return self

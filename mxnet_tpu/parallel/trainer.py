@@ -201,7 +201,8 @@ class TrainStep:
         self.auxs = None         # name -> jax.Array
         self._step_fn = None
         self._nstep = 0
-        self._base_seed = int(_np.random.randint(0, 2**31 - 1))
+        from .. import random as _rand
+        self._base_seed = int(_rand.next_seed())
 
     # ------------------------------------------------------------------
     def _param_sharding(self, name):

@@ -7,16 +7,18 @@ compiled computations stay pure.
 from __future__ import annotations
 
 import jax
+import numpy as _np
 
-__all__ = ["seed", "next_key"]
+__all__ = ["seed", "next_key", "next_seed"]
 
-_STATE = {"key": None, "seed": 0}
+_STATE = {"key": None, "seed": 0, "host_rng": None}
 
 
 def seed(seed_state):
     """Seed the global RNG (parity with mx.random.seed)."""
     _STATE["seed"] = int(seed_state)
     _STATE["key"] = jax.random.PRNGKey(int(seed_state))
+    _STATE["host_rng"] = _np.random.RandomState(int(seed_state) & 0xFFFFFFFF)
 
 
 def next_key():
@@ -24,3 +26,17 @@ def next_key():
         _STATE["key"] = jax.random.PRNGKey(_STATE["seed"])
     _STATE["key"], sub = jax.random.split(_STATE["key"])
     return sub
+
+
+def next_seed():
+    """A uint32 seed drawn from the framework's seeded host stream.
+
+    Used by jitted paths (hybridized blocks, executors) that pass a scalar
+    seed into the compiled computation — keeps their dropout reproducible
+    via :func:`seed` without touching numpy's global RNG. If :func:`seed`
+    was never called the stream is entropy-seeded (distinct per process),
+    matching the reference's unseeded behavior.
+    """
+    if _STATE["host_rng"] is None:
+        _STATE["host_rng"] = _np.random.RandomState()  # OS entropy
+    return _np.uint32(_STATE["host_rng"].randint(0, 2 ** 31 - 1))
