@@ -122,6 +122,21 @@ class Executor:
         self.grad_dict = grad_dict
         self.aux_dict = aux_dict
         self._grad_req = grad_req_dict
+        # group2ctx is the reference's manual model-parallel placement
+        # (graph_executor.cc PlaceDevice). On TPU, cross-device placement
+        # inside one XLA program is expressed with mesh shardings, which
+        # TrainStep's tp axis provides; a per-group device map cannot be
+        # honored here, so reject it loudly rather than silently ignore.
+        if group2ctx:
+            base = ctx if ctx is not None else current_context()
+            for grp, gctx in group2ctx.items():
+                if gctx != base:
+                    raise NotImplementedError(
+                        "group2ctx[%r]=%s differs from the bind context %s: "
+                        "per-group device placement is not supported in one "
+                        "XLA program. Use parallel.TrainStep's tensor-"
+                        "parallel mesh axis for model parallelism instead."
+                        % (grp, gctx, base))
         self._group2ctx = group2ctx
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()

@@ -99,11 +99,20 @@ class RecurrentCell(Block):
         states = begin_state if begin_state is not None else \
             self.begin_state(batch_size)
         outputs = []
+        all_states = []
         for i in range(length):
             output, states = self(inputs[i], states)
             outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
         if valid_length is not None:
             from ... import ndarray as nd
+            # per-sequence last *valid* states, not the states after padding
+            # (reference unroll applies F.SequenceLast on stacked states)
+            states = [nd.SequenceLast(nd.stack(*ss, axis=0),
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True)
+                      for ss in zip(*all_states)]
             stacked = nd.stack(*outputs, axis=0)  # (T, N, C)
             masked = nd.SequenceMask(stacked, sequence_length=valid_length,
                                      use_sequence_length=True)
@@ -448,15 +457,29 @@ class BidirectionalCell(RecurrentCell):
             self.begin_state(batch_size)
         l_cell, r_cell = self._children.values()
         l_n = len(l_cell.state_info())
+        def _rev(seq):
+            # reverse each sequence over its valid steps only (reference
+            # uses F.SequenceReverse(sequence_length=valid_length)) so the
+            # backward cell starts at the last valid token, not at padding
+            if valid_length is None:
+                return list(reversed(seq))
+            rev = F.SequenceReverse(F.stack(*seq, axis=0),
+                                    sequence_length=valid_length,
+                                    use_sequence_length=True)
+            if length == 1:
+                return [F.reshape(rev, rev.shape[1:])]
+            return list(F.split(rev, num_outputs=length, axis=0,
+                                squeeze_axis=True))
+
         l_outputs, l_states = l_cell.unroll(
             length, inputs=inputs, begin_state=states[:l_n], layout=layout,
             merge_outputs=False, valid_length=valid_length)
         r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
+            length, inputs=_rev(inputs),
             begin_state=states[l_n:], layout=layout, merge_outputs=False,
-            valid_length=None)
+            valid_length=valid_length)
         outputs = [F.concat(l_o, r_o, dim=1)
-                   for l_o, r_o in zip(l_outputs, reversed(r_outputs))]
+                   for l_o, r_o in zip(l_outputs, _rev(r_outputs))]
         if merge_outputs:
             outputs = F.stack(*outputs, axis=axis)
         return outputs, l_states + r_states

@@ -185,6 +185,10 @@ class Trainer:
                 states = f.read()
             for upd in self._updaters:
                 upd.set_states(states)
+            # adopt the deserialized optimizer (num_update, hyperparams) —
+            # reference trainer.py load_states does the same
+            self._optimizer = self._updaters[0].optimizer
+            for upd in self._updaters:
                 upd.optimizer = self._optimizer
         self._optimizer.param_dict = {
             i: param for i, param in enumerate(self._params)}

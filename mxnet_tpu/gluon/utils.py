@@ -61,11 +61,11 @@ def clip_global_norm(arrays, max_norm):
     import math
     if not arrays:
         raise ValueError("arrays must not be empty")
-    total = 0.0
-    for arr in arrays:
-        n = float((arr * arr).sum().asnumpy())
-        total += n
-    total_norm = math.sqrt(total)
+    # reduce on device, one host sync at the end (reference asscalar's once)
+    total = (arrays[0] * arrays[0]).sum()
+    for arr in arrays[1:]:
+        total = total + (arr * arr).sum()
+    total_norm = math.sqrt(float(total.asnumpy()))
     scale = max_norm / (total_norm + 1e-8)
     if scale < 1.0:
         for arr in arrays:

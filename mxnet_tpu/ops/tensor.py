@@ -182,6 +182,8 @@ def scatter_nd(data, indices, *, shape):
 
 @register("tile")
 def tile(data, *, reps):
+    if isinstance(reps, int):
+        reps = (reps,)
     return jnp.tile(data, tuple(reps))
 
 

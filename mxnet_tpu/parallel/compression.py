@@ -10,8 +10,10 @@ gradient_compression-inl.h (rahul003's contribution). Semantics:
   residual -= q          (error feedback)
 
 The reference packs 16 2-bit codes per float for the wire; on TPU the
-compress→decompress pair fuses into one XLA kernel, and a Pallas packing
-kernel is provided for the DCN path where actual bit-packing pays off.
+compress→decompress pair fuses into one XLA kernel. For the cross-host
+(DCN) path, ``compress``/``decompress`` pack 4 2-bit codes per byte with
+plain jnp bit ops — XLA fuses the shift/or chain into one kernel, so a
+hand-written Pallas kernel buys nothing here.
 """
 from __future__ import annotations
 
