@@ -44,4 +44,7 @@ from .module import Module
 from . import parallel
 from . import models
 from . import gluon
+from . import profiler
+from . import monitor
+from .monitor import Monitor
 from . import test_utils

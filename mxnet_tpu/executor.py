@@ -30,9 +30,13 @@ from .ops import registry as _reg
 __all__ = ["Executor"]
 
 
-def _build_graph_fn(symbol):
+def _build_graph_fn(symbol, collect_taps=False, monitor_all=False):
     """Build a pure function (args, auxs, seed, is_train) ->
-    (outputs, new_auxs) interpreting the DAG with registered op impls."""
+    (outputs, new_auxs) interpreting the DAG with registered op impls.
+    With ``collect_taps`` the function also returns {tap_name: value} for
+    every op output (and every variable when ``monitor_all``) — the debug
+    program behind executor monitor callbacks (reference
+    graph_executor.cc SetMonitorCallback)."""
     topo = symbol._topo()
     entries = list(symbol._entries)
     aux_names = set(symbol.list_auxiliary_states())
@@ -40,6 +44,7 @@ def _build_graph_fn(symbol):
     def graph_fn(args, auxs, seed, is_train):
         rng = jax.random.key(seed)
         new_auxs = {}
+        taps = {}
         with _reg._OpCtxScope(is_train, rng):
             env = {}
             for node in topo:
@@ -50,12 +55,16 @@ def _build_graph_fn(symbol):
                         env[(id(node), 0)] = jax.lax.stop_gradient(auxs[node.name])
                     else:
                         raise MXNetError("unbound variable '%s'" % node.name)
+                    if collect_taps and monitor_all:
+                        taps[node.name] = env[(id(node), 0)]
                     continue
                 ins = [env[(id(inp), oi)] for inp, oi in node.inputs]
                 raw = node.op.fn(*ins, **node.attrs)
                 outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
                 for i, v in enumerate(outs):
                     env[(id(node), i)] = v
+                    if collect_taps:
+                        taps[node.output_name(i)] = v
                 # aux-state updates (reference FMutateInputs)
                 if node.op.mutate_inputs and is_train:
                     in_names = node.op.input_names
@@ -66,6 +75,8 @@ def _build_graph_fn(symbol):
             outputs = [env[(id(n), oi)] for n, oi in entries]
         for name in auxs:
             new_auxs.setdefault(name, auxs[name])
+        if collect_taps:
+            return outputs, new_auxs, taps
         return outputs, new_auxs
 
     return graph_fn
@@ -90,9 +101,26 @@ def _compiled_cache(symbol):
             return outs
 
         cache = {"graph_fn": graph_fn, "fwd_train": _fwd_train,
-                 "fwd_eval": _fwd_eval, "fwd_bwd": {}}
+                 "fwd_eval": _fwd_eval, "fwd_bwd": {}, "fwd_monitor": {}}
         symbol._exec_cache = cache
     return cache
+
+
+def _monitor_fn(symbol, is_train, monitor_all):
+    """Jitted tapped-forward program, cached per (is_train, monitor_all)."""
+    cache = _compiled_cache(symbol)
+    key = (bool(is_train), bool(monitor_all))
+    fn = cache["fwd_monitor"].get(key)
+    if fn is None:
+        tapped = _build_graph_fn(symbol, collect_taps=True,
+                                 monitor_all=monitor_all)
+
+        @jax.jit
+        def fn(args, auxs, seed):
+            return tapped(args, auxs, seed, is_train)
+
+        cache["fwd_monitor"][key] = fn
+    return fn
 
 
 def _make_fwd_bwd(graph_fn, diff_names):
@@ -144,6 +172,7 @@ class Executor:
         self._diff_names = [n for n in self._arg_names
                             if grad_req_dict.get(n, "null") != "null"]
         self._monitor_callback = None
+        self._monitor_all = False
         self._outputs = None
         self._pending_train_fwd = False
         self._train_seed = None
@@ -185,7 +214,17 @@ class Executor:
         return [self.aux_dict[n] for n in self._aux_names]
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a (name, NDArray) callback fired with every node output
+        (and every variable when ``monitor_all``) after each forward
+        (reference graph_executor.cc SetMonitorCallback)."""
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
+
+    def _fire_monitor(self, is_train, seed, auxs):
+        fn = _monitor_fn(self._symbol, is_train, self._monitor_all)
+        _, _, taps = fn(self._args_values(), auxs, seed)
+        for name, val in taps.items():
+            self._monitor_callback(name, NDArray(val, self._ctx))
 
     # ------------------------------------------------------------------
     def _args_values(self):
@@ -223,18 +262,33 @@ class Executor:
             self._run_fwd(False)
         return self.outputs if not is_train else _LazyOutputs(self)
 
+    @staticmethod
+    def _prof_scope(name):
+        from . import profiler as _prof
+        if _prof.SYMBOLIC_ON:
+            return _prof.scope(name, "symbolic")
+        import contextlib
+        return contextlib.nullcontext()
+
     def _run_fwd(self, is_train):
         if is_train:
             seed = self._train_seed if self._train_seed is not None \
                 else self._next_seed()
             auxs = self._train_auxs if self._train_auxs is not None \
                 else self._auxs_values()
-            outs, new_auxs = self._jit_fwd_train(self._args_values(), auxs, seed)
+            if self._monitor_callback is not None:
+                self._fire_monitor(True, seed, auxs)
+            with self._prof_scope("Executor::forward"):
+                outs, new_auxs = self._jit_fwd_train(
+                    self._args_values(), auxs, seed)
             self._write_auxs(new_auxs)
         else:
             seed = self._next_seed()
-            outs = self._jit_fwd_eval(self._args_values(),
-                                      self._auxs_values(), seed)
+            if self._monitor_callback is not None:
+                self._fire_monitor(False, seed, self._auxs_values())
+            with self._prof_scope("Executor::forward"):
+                outs = self._jit_fwd_eval(self._args_values(),
+                                          self._auxs_values(), seed)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False
         return self._outputs
@@ -260,8 +314,13 @@ class Executor:
             else self._auxs_values()
         self._train_seed = None
         self._train_auxs = None
-        outs, new_auxs, grads = self._jit_fwd_bwd(
-            self._args_values(), auxs, seed, ograds)
+        if self._monitor_callback is not None and self._pending_train_fwd:
+            # fire taps with the same seed/aux snapshot the fused program
+            # will consume, so the monitored values match what executes
+            self._fire_monitor(True, seed, auxs)
+        with self._prof_scope("Executor::forward_backward"):
+            outs, new_auxs, grads = self._jit_fwd_bwd(
+                self._args_values(), auxs, seed, ograds)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False
         self._write_auxs(new_auxs)

@@ -467,7 +467,7 @@ class BidirectionalCell(RecurrentCell):
                                     sequence_length=valid_length,
                                     use_sequence_length=True)
             if length == 1:
-                return [F.reshape(rev, rev.shape[1:])]
+                return [F.reshape(rev, shape=rev.shape[1:])]
             return list(F.split(rev, num_outputs=length, axis=0,
                                 squeeze_axis=True))
 

@@ -173,6 +173,7 @@ class DataParallelExecutorGroup:
             grad_req=self._grad_req_arg, state_names=self.state_names)
 
     def install_monitor(self, mon):
+        monitor_all = getattr(mon, "monitor_all", False)
         for exe in self.execs:
             exe.set_monitor_callback(mon.stat_helper if hasattr(mon, "stat_helper")
-                                     else mon)
+                                     else mon, monitor_all)

@@ -1,0 +1,104 @@
+"""Monitor — tap intermediate outputs of bound executors for debugging.
+
+Reference parity: python/mxnet/monitor.py:33 (Monitor installs a callback
+via executor.set_monitor_callback; graph_executor.cc SetMonitorCallback
+fires it with each op's output). TPU-native: the executor compiles the
+whole graph into one XLA program, so intermediates normally never
+materialize; when a monitor callback is installed the executor runs a
+separate jitted "tapped" program that also returns every node output
+(executor.py _build_monitor_fn) and fires the callback per tap. This is a
+debug path — it costs one extra program launch per monitored forward.
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect statistics of intermediate outputs every ``interval``
+    batches (reference monitor.py Monitor).
+
+    Parameters
+    ----------
+    interval : int
+        Sample every ``interval`` calls to ``tic()``.
+    stat_func : callable(NDArray) -> NDArray, optional
+        Statistic to compute per tapped array; default mean(|x|)
+        (the reference's asum/size).
+    pattern : str
+        Regex on tap names; only matches are collected.
+    sort : bool
+        Sort the toc() result by name.
+    monitor_all : bool
+        Also tap op *inputs* (weights, data), not just op outputs.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_pattern.match(name):
+                return
+            if not isinstance(array, NDArray):
+                array = NDArray(array)
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach this monitor to an executor."""
+        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collection; returns [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """End collection and print the collected stats."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))

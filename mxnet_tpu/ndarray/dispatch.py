@@ -99,9 +99,13 @@ def invoke(opdef, args, kwargs, out=None, name=None):
         else:
             from .. import random as _random
             rng = _random.next_key()
-        raw = fn(rng, *arrs)
+
+    from .. import profiler as _prof
+    if _prof.IMPERATIVE_ON:
+        with _prof.scope(opdef.name, "operator"):
+            raw = fn(rng, *arrs) if needs_rng else fn(*arrs)
     else:
-        raw = fn(*arrs)
+        raw = fn(rng, *arrs) if needs_rng else fn(*arrs)
 
     n_out = opdef.out_count(attrs)
     outs_raw = list(raw) if isinstance(raw, (tuple, list)) else [raw]

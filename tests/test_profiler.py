@@ -1,0 +1,166 @@
+"""Profiler + Monitor tests.
+
+Mirrors tests/python/unittest/test_profiler.py (chrome-trace dump,
+start/stop) and the reference Monitor semantics (monitor.py:33).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, sym
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname, aggregate_stats=True)
+    profiler.set_state("run")
+    a = mx.nd.array(np.ones((8, 8)))
+    b = mx.nd.array(np.ones((8, 8)))
+    for _ in range(3):
+        c = mx.nd.dot(a, b)
+    c.wait_to_read()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        doc = json.load(f)
+    names = [ev["name"] for ev in doc["traceEvents"]]
+    assert "dot" in names
+    table = profiler.dumps(reset=True)
+    assert "dot" in table
+    # events cleared after dump(finished=True)
+    profiler.dump()
+    with open(fname) as f:
+        assert json.load(f)["traceEvents"] == []
+
+
+def test_profiler_pause_resume():
+    profiler.set_config(filename="unused.json")
+    profiler.set_state("run")
+    profiler.pause()
+    a = mx.nd.array(np.ones((4,)))
+    (a + a).wait_to_read()
+    assert not profiler.IMPERATIVE_ON
+    profiler.resume()
+    assert profiler.IMPERATIVE_ON
+    profiler.set_state("stop")
+
+
+def test_profiler_task_counter_marker(tmp_path):
+    fname = str(tmp_path / "user.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    domain = profiler.Domain("mydomain")
+    with domain.new_task("mytask"):
+        pass
+    cnt = domain.new_counter("mycounter", 5)
+    cnt.increment(2)
+    domain.new_marker("mymarker").mark()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        evs = json.load(f)["traceEvents"]
+    by_name = {e["name"]: e for e in evs}
+    assert "mytask" in by_name and by_name["mytask"]["ph"] == "X"
+    assert by_name["mycounter"]["ph"] == "C"
+    assert by_name["mymarker"]["ph"] == "i"
+
+
+def test_profiler_symbolic_span(tmp_path):
+    fname = str(tmp_path / "sym.json")
+    profiler.set_config(filename=fname)
+    x = sym.Variable("x")
+    y = sym.FullyConnected(x, num_hidden=3, name="fc")
+    exe = y.simple_bind(ctx=mx.cpu(), x=(2, 4))
+    exe.arg_dict["x"][:] = np.ones((2, 4), dtype=np.float32)
+    exe.arg_dict["fc_weight"][:] = np.ones((3, 4), dtype=np.float32)
+    exe.arg_dict["fc_bias"][:] = np.zeros((3,), dtype=np.float32)
+    profiler.set_state("run")
+    exe.forward()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert "Executor::forward" in names
+
+
+def test_monitor_taps_intermediates():
+    x = sym.Variable("x")
+    h = sym.FullyConnected(x, num_hidden=3, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    out = sym.FullyConnected(h, num_hidden=2, name="fc2")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 4))
+    for name, arr in exe.arg_dict.items():
+        arr[:] = np.ones(arr.shape, dtype=np.float32)
+
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert "fc1_output" in names
+    assert "relu1_output" in names
+    assert "fc2_output" in names
+    # stat value is mean(|x|) of the tap: fc1 out = 4*1+1 = 5
+    stat = dict((k, v) for _, k, v in res)
+    assert abs(float(stat["fc1_output"].strip()) - 5.0) < 1e-5
+
+
+def test_monitor_monitor_all_taps_inputs():
+    x = sym.Variable("x")
+    out = sym.FullyConnected(x, num_hidden=2, name="fc")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 4))
+    for name, arr in exe.arg_dict.items():
+        arr[:] = np.ones(arr.shape, dtype=np.float32)
+    mon = mx.Monitor(interval=1, monitor_all=True)
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    names = [k for _, k, _ in mon.toc()]
+    assert "fc_weight" in names and "x" in names
+
+
+def test_monitor_interval_and_backward_path():
+    x = sym.Variable("x")
+    out = sym.FullyConnected(x, num_hidden=2, name="fc")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 4))
+    for name, arr in exe.arg_dict.items():
+        arr[:] = np.ones(arr.shape, dtype=np.float32)
+    mon = mx.Monitor(interval=2)
+    mon.install(exe)
+    seen = []
+    for i in range(4):
+        mon.tic()
+        exe.forward(is_train=True)
+        exe.backward(out_grads=mx.nd.ones((2, 2)))
+        seen.append(len(mon.toc()))
+    # fires on steps 0 and 2 only
+    assert [s > 0 for s in seen] == [True, False, True, False]
+
+
+def test_monitor_through_module_fit():
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=2, name="fc2"),
+                            name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(net, context=mx.cpu())
+    tapped = []
+    mon = mx.Monitor(interval=1, stat_func=lambda a: a.abs().mean(),
+                     pattern="fc.*")
+    orig_helper = mon.stat_helper
+
+    def helper(name, arr):
+        tapped.append(name)
+        orig_helper(name, arr)
+    mon.stat_helper = helper
+    mod.fit(it, num_epoch=1, optimizer="sgd", monitor=mon,
+            initializer=mx.initializer.Xavier())
+    assert any(n.startswith("fc1") for n in tapped)
