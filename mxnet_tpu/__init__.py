@@ -44,6 +44,8 @@ from .module import Module
 from . import parallel
 from . import models
 from . import gluon
+from . import recordio
+from . import image
 from . import profiler
 from . import monitor
 from .monitor import Monitor

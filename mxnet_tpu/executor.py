@@ -220,6 +220,14 @@ class Executor:
         self._monitor_callback = callback
         self._monitor_all = bool(monitor_all)
 
+    def _monitor_active(self):
+        if self._monitor_callback is None:
+            return False
+        # Monitor attaches itself to its stat_helper; skip the extra tapped
+        # program launch entirely on batches its interval gate would drop
+        mon = getattr(self._monitor_callback, "_monitor", None)
+        return mon is None or getattr(mon, "activated", True)
+
     def _fire_monitor(self, is_train, seed, auxs):
         fn = _monitor_fn(self._symbol, is_train, self._monitor_all)
         _, _, taps = fn(self._args_values(), auxs, seed)
@@ -276,7 +284,7 @@ class Executor:
                 else self._next_seed()
             auxs = self._train_auxs if self._train_auxs is not None \
                 else self._auxs_values()
-            if self._monitor_callback is not None:
+            if self._monitor_active():
                 self._fire_monitor(True, seed, auxs)
             with self._prof_scope("Executor::forward"):
                 outs, new_auxs = self._jit_fwd_train(
@@ -284,7 +292,7 @@ class Executor:
             self._write_auxs(new_auxs)
         else:
             seed = self._next_seed()
-            if self._monitor_callback is not None:
+            if self._monitor_active():
                 self._fire_monitor(False, seed, self._auxs_values())
             with self._prof_scope("Executor::forward"):
                 outs = self._jit_fwd_eval(self._args_values(),
@@ -314,7 +322,7 @@ class Executor:
             else self._auxs_values()
         self._train_seed = None
         self._train_auxs = None
-        if self._monitor_callback is not None and self._pending_train_fwd:
+        if self._monitor_active() and self._pending_train_fwd:
             # fire taps with the same seed/aux snapshot the fused program
             # will consume, so the monitored values match what executes
             self._fire_monitor(True, seed, auxs)

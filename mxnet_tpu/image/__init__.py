@@ -1,0 +1,14 @@
+"""mx.image — image IO, augmentation, and iterators (reference
+python/mxnet/image/ + src/io/image_aug_default.cc, rebuilt host-side in
+numpy/PIL; the decode/augment pipeline is host work by design — TPU time
+is for the training step, and the iterators overlap the two)."""
+from .image import (imread, imdecode, imresize, scale_down, resize_short,
+                    fixed_crop, random_crop, center_crop, color_normalize,
+                    random_size_crop,
+                    Augmenter, SequentialAug, RandomOrderAug, ResizeAug,
+                    ForceResizeAug, RandomCropAug, RandomSizedCropAug,
+                    CenterCropAug, BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, HueJitterAug, ColorJitterAug,
+                    LightingAug, ColorNormalizeAug, RandomGrayAug,
+                    HorizontalFlipAug, CastAug, CreateAugmenter, ImageIter)
+from .record_iter import ImageRecordIter

@@ -1,0 +1,640 @@
+"""Image decode / resize / crop / augment, and the Python ImageIter.
+
+Reference parity: python/mxnet/image/image.py. The reference decodes and
+augments through OpenCV NDArray ops on the engine; here everything is
+host-side numpy + PIL (the TPU is busy running the training step — the
+data pipeline's job is to hide under it). Channel order is RGB
+everywhere. Augmenter classes keep the reference API: they take and
+return ``NDArray`` (numpy also accepted); the hot RecordIO path calls
+their ``_apply_np`` directly to stay off-device.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import logging
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+try:
+    from PIL import Image
+except ImportError:  # pragma: no cover
+    Image = None
+
+
+def _require_pil():
+    if Image is None:  # pragma: no cover
+        raise MXNetError("mx.image requires PIL (Pillow)")
+
+
+# interp codes follow cv2 / the reference (_get_interp_method,
+# image.py:175): 0 nearest, 1 bilinear, 2 area, 3 bicubic, 4 lanczos,
+# 9 auto (cubic enlarge / area shrink), 10 random
+_PIL_INTERP = {}
+
+
+def _interp(interp, src_size=None, dst_size=None):
+    _require_pil()
+    if not _PIL_INTERP:
+        _PIL_INTERP.update({0: Image.NEAREST, 1: Image.BILINEAR,
+                            2: Image.BOX, 3: Image.BICUBIC,
+                            4: Image.LANCZOS})
+    if interp == 9:
+        if src_size and dst_size:
+            oh, ow = src_size
+            nh, nw = dst_size
+            return _PIL_INTERP[3 if nh > oh and nw > ow else 2]
+        return _PIL_INTERP[2]
+    if interp == 10:
+        return _PIL_INTERP[_pyrandom.randint(0, 4)]
+    if interp not in _PIL_INTERP:
+        raise ValueError("unknown interp method %s" % interp)
+    return _PIL_INTERP[interp]
+
+
+def _to_np(src):
+    if isinstance(src, NDArray):
+        return src.asnumpy()
+    return np.asarray(src)
+
+
+def _wrap(out, like):
+    if isinstance(like, NDArray) or not isinstance(like, np.ndarray):
+        return NDArray(np.ascontiguousarray(out))
+    return out
+
+
+# ----------------------------------------------------------------------
+# decode / resize / crop primitives
+# ----------------------------------------------------------------------
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image buffer to an HWC uint8 NDArray (reference
+    image.py:86; PIL backend, output is RGB regardless of to_rgb — the
+    reference flag exists to flip cv2's BGR, which PIL never produces)."""
+    _require_pil()
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    nd = NDArray(arr)
+    if out is not None:
+        out._set_data(nd._data)
+        return out
+    return nd
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file into an HWC uint8 NDArray (reference
+    image.py:45)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (h, w) (reference mx.image cv2 imresize op)."""
+    arr = _to_np(src)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = Image.fromarray(arr[:, :, 0] if squeeze else arr)
+    pil = pil.resize((int(w), int(h)),
+                     _interp(interp, arr.shape[:2], (h, w)))
+    out = np.asarray(pil)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _wrap(out, src)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit within src (w, h), keeping aspect
+    (reference image.py:140)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals ``size`` (reference
+    image.py:230)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop [y0:y0+h, x0:x0+w], optionally resize to ``size`` (w, h)
+    (reference image.py:292)."""
+    arr = _to_np(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(_wrap(out, src), size[0], size[1], interp)
+    return _wrap(out, src)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of ``size`` (w, h), scaled down if src is smaller;
+    returns (img, (x0, y0, w, h)) (reference image.py:324)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop of ``size`` (w, h); returns (img, (x0, y0, w, h))
+    (reference image.py:363)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std in float32 (reference image.py:412)."""
+    arr = _to_np(src).astype(np.float32)
+    if mean is not None:
+        arr = arr - _to_np(mean).astype(np.float32)
+    if std is not None:
+        arr = arr / _to_np(std).astype(np.float32)
+    return _wrap(arr, src)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random crop with area in ``area`` (fraction) and aspect in
+    ``ratio``; returns (img, (x0, y0, w, h)) (reference image.py:436)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if "min_area" in kwargs:
+        area = kwargs.pop("min_area")
+    assert not kwargs, "unexpected keyword arguments %s" % list(kwargs)
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ----------------------------------------------------------------------
+# augmenters (reference image.py:493+); each works on numpy HWC float32
+# via _apply_np, the NDArray __call__ is the API-parity wrapper
+# ----------------------------------------------------------------------
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy()
+
+    def dumps(self):
+        """Name + params as a json-ish string (reference Augmenter.dumps)."""
+        import json
+        return json.dumps([self.__class__.__name__.lower(),
+                           {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                            for k, v in self._kwargs.items()}])
+
+    def _apply_np(self, src):
+        raise NotImplementedError
+
+    def __call__(self, src):
+        return _wrap(self._apply_np(_to_np(src)), src)
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def _apply_np(self, src):
+        for t in self.ts:
+            src = t._apply_np(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def _apply_np(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t._apply_np(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply_np(self, src):
+        return _to_np(resize_short(src, self.size, self.interp))
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply_np(self, src):
+        return _to_np(imresize(src, self.size[0], self.size[1], self.interp))
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply_np(self, src):
+        return _to_np(random_crop(src, self.size, self.interp)[0])
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def _apply_np(self, src):
+        return _to_np(random_size_crop(src, self.size, self.area,
+                                       self.ratio, self.interp)[0])
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def _apply_np(self, src):
+        return _to_np(center_crop(src, self.size, self.interp)[0])
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def _apply_np(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return src.astype(np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def _apply_np(self, src):
+        src = src.astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * self._coef[..., :src.shape[2]]).sum()
+        gray = (3.0 * (1.0 - alpha) / src.size) * gray
+        return src * alpha + gray
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def _apply_np(self, src):
+        src = src.astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * self._coef[..., :src.shape[2]]).sum(
+            axis=2, keepdims=True) * (1.0 - alpha)
+        return src * alpha + gray
+
+
+class HueJitterAug(Augmenter):
+    # yiq rotation matrices (reference image.py:747)
+    _tyiq = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], dtype=np.float32)
+    _ityiq = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def _apply_np(self, src):
+        src = src.astype(np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], dtype=np.float32)
+        t = self._ityiq @ bt @ self._tyiq
+        return src @ t.T
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA noise (reference image.py:804)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def _apply_np(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return src.astype(np.float32) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = None if mean is None else np.asarray(_to_np(mean), np.float32)
+        self.std = None if std is None else np.asarray(_to_np(std), np.float32)
+
+    def _apply_np(self, src):
+        src = src.astype(np.float32)
+        if self.mean is not None:
+            src = src - self.mean
+        if self.std is not None:
+            src = src / self.std
+        return src
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def _apply_np(self, src):
+        if _pyrandom.random() < self.p:
+            return src.astype(np.float32) @ self._mat
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def _apply_np(self, src):
+        if _pyrandom.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def _apply_np(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Create the standard augmenter list (reference image.py:903)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ----------------------------------------------------------------------
+# ImageIter — python-side image iterator (reference image.py:1017)
+# ----------------------------------------------------------------------
+class ImageIter:
+    """Iterator over images from a .rec file, a .lst file, or an in-memory
+    list, with augmenters (reference image.py ImageIter). Yields
+    DataBatch(data=[NCHW float32], label=[(N, label_width)]).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        from ..io import DataDesc
+        assert path_imgrec or path_imglist or isinstance(imglist, list), \
+            "must provide path_imgrec, path_imglist, or imglist"
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self.last_batch_handle = last_batch_handle
+        self._data_name, self._label_name = data_name, label_name
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            from ..recordio import MXRecordIO, MXIndexedRecordIO
+            if path_imgidx:
+                self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = MXRecordIO(path_imgrec, "r")
+                if shuffle:
+                    raise MXNetError(
+                        "shuffle requires path_imgidx alongside path_imgrec")
+        elif path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = np.array(
+                        [float(x) for x in parts[1:-1]], np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = sorted(self.imglist.keys())
+            self.path_root = path_root or "."
+        else:
+            self.imglist = {}
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (np.array(np.atleast_1d(label), np.float32),
+                                   fname)
+            self.seq = list(range(len(imglist)))
+            self.path_root = path_root or "."
+
+        if self.seq is not None and num_parts > 1:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape, dtype)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, label_width), dtype)]
+        self.cur = 0
+        self._allow_read = True
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._allow_read = True
+
+    def next_sample(self):
+        """Next (label, decoded HWC uint8 image)."""
+        from ..recordio import unpack
+        if not self._allow_read:
+            raise StopIteration
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            self._allow_read = False
+            raise StopIteration
+        header, img = unpack(s)
+        return header.label, img
+
+    def _aug(self, raw):
+        img = _to_np(imdecode(raw, flag=1 if self.data_shape[0] == 3 else 0))
+        for aug in self.auglist:
+            img = aug._apply_np(img)
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            raise MXNetError("augmented image shape %s does not match "
+                             "data_shape %s (add a crop/resize augmenter)"
+                             % (img.shape, self.data_shape))
+        return np.ascontiguousarray(
+            img.astype(self.dtype).transpose(2, 0, 1))
+
+    def next(self):
+        from ..io import DataBatch
+        from .. import ndarray as nd
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), self.dtype)
+        label = np.zeros((self.batch_size, self.label_width), self.dtype)
+        i = 0
+        try:
+            while i < self.batch_size:
+                lab, raw = self.next_sample()
+                data[i] = self._aug(raw)
+                label[i] = np.atleast_1d(np.asarray(lab, np.float32))[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            if self.last_batch_handle == "discard":
+                raise
+        pad = self.batch_size - i
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False

@@ -59,6 +59,9 @@ class Monitor:
                 array = NDArray(array)
             self.queue.append((self.step, name, self.stat_func(array)))
 
+        # the executor consults this backref to skip the tapped-program
+        # launch on batches the interval gate would drop anyway
+        stat_helper._monitor = self
         self.stat_helper = stat_helper
 
     def install(self, exe):

@@ -70,9 +70,14 @@ def _refresh_flags():
 def set_config(**kwargs):
     """Configure the profiler (reference profiler.py set_config). Accepts
     the reference kwargs plus ``trace_dir`` for the device xplane trace."""
+    import logging
     for k, v in kwargs.items():
         if k not in _config:
-            raise ValueError("profiler.set_config: unknown option '%s'" % k)
+            # reference-valid options we don't distinguish (e.g.
+            # profile_process='worker'|'server') are accepted with a note
+            logging.warning("profiler.set_config: option '%s' is accepted "
+                            "but has no effect here", k)
+            continue
         _config[k] = v
     _refresh_flags()
 
@@ -94,10 +99,13 @@ def set_state(new_state="stop"):
         import jax
         jax.profiler.start_trace(_config["trace_dir"])
         _device_trace_on = True
-    elif new_state == "stop" and _device_trace_on:
-        import jax
-        jax.profiler.stop_trace()
-        _device_trace_on = False
+    elif new_state == "stop":
+        if _device_trace_on:
+            import jax
+            jax.profiler.stop_trace()
+            _device_trace_on = False
+        if _config["continuous_dump"]:
+            dump(finished=False)
 
 
 def start():
@@ -124,6 +132,10 @@ def resume():
 
 
 def add_event(name, cat, ts_us, dur_us, tid=None, args=None, ph="X"):
+    if _state != "run":
+        # nothing is recorded while stopped/paused — user Counter/Task
+        # objects may outlive the profiled window without leaking events
+        return
     ev = {"name": name, "cat": cat, "ph": ph, "ts": ts_us,
           "pid": os.getpid(),
           "tid": tid if tid is not None else threading.get_ident() & 0xFFFF}

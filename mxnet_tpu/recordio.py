@@ -1,0 +1,263 @@
+"""Read/write the RecordIO data format (.rec/.idx) — pure Python.
+
+Reference parity: python/mxnet/recordio.py (MXRecordIO, MXIndexedRecordIO,
+IRHeader, pack/unpack, pack_img/unpack_img) over the dmlc-core recordio
+wire format (3rdparty/dmlc-core recordio: per-chunk ``[magic u32][lrec
+u32][data][pad to 4]`` where ``lrec >> 29`` is the continue-flag and
+``lrec & 0x1FFFFFFF`` the chunk length; records larger than 2^29-1 bytes
+are split into chunks flagged 1/2/3 = first/middle/last). Files written
+here are byte-compatible with the reference's .rec files.
+
+One deliberate divergence: image decode/encode uses PIL, not OpenCV, so
+``unpack_img``/``imdecode`` return **RGB** channel order (the reference's
+cv2 path returns BGR and flips to RGB later in mx.image). All of
+mxnet_tpu handles images as RGB end to end.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+_MAX_CHUNK = _LEN_MASK
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+class MXRecordIO:
+    """Sequential reader/writer for RecordIO files (reference
+    recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior: a reader re-opens at the same
+        position in the worker (DataLoader multiprocessing parity)."""
+        if self.writable:
+            raise RuntimeError("cannot pickle a writable MXRecordIO")
+        d = dict(self.__dict__)
+        d.pop("_fp", None)
+        d["_pos"] = self._fp.tell() if self.is_open else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        self._fp.seek(pos)
+
+    def close(self):
+        if not self.is_open:
+            return
+        self._fp.close()
+        self.is_open = False
+
+    def reset(self):
+        """Reset to the first record ('w' truncates the file)."""
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Append one record (bytes or str)."""
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        n = len(buf)
+        if n <= _MAX_CHUNK:
+            chunks = [(0, buf)]
+        else:
+            chunks = []
+            off = 0
+            while off < n:
+                piece = buf[off:off + _MAX_CHUNK]
+                off += len(piece)
+                if not chunks:
+                    cflag = 1
+                elif off >= n:
+                    cflag = 3
+                else:
+                    cflag = 2
+                chunks.append((cflag, piece))
+        for cflag, piece in chunks:
+            self._fp.write(struct.pack("<II", _MAGIC,
+                                       _encode_lrec(cflag, len(piece))))
+            self._fp.write(piece)
+            pad = (-len(piece)) % 4
+            if pad:
+                self._fp.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record; returns bytes or None at EOF."""
+        assert not self.writable
+        parts = []
+        while True:
+            head = self._fp.read(8)
+            if len(head) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise IOError("invalid RecordIO magic at offset %d"
+                              % (self._fp.tell() - 8))
+            cflag, length = lrec >> 29, lrec & _LEN_MASK
+            data = self._fp.read(length)
+            pad = (-length) % 4
+            if pad:
+                self._fp.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with an index file for random access (reference
+    recordio.py:160)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if not self.writable:
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop("fidx", None)
+        return d
+
+    def seek(self, idx):
+        """Position the reader at record ``idx``."""
+        assert not self.writable
+        self._fp.seek(self.idx[idx])
+
+    def tell(self):
+        """Current write position (byte offset of the next record)."""
+        assert self.writable
+        return self._fp.tell()
+
+    def read_idx(self, idx):
+        """Read the record stored under key ``idx``."""
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """Append a record under key ``idx``."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes into an MXImageRecord payload
+    (reference recordio.py pack; format 'IfQQ' + optional label array)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + (s if isinstance(s, bytes) else s.encode())
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Inverse of :func:`pack`; returns (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=np.frombuffer(s, np.float32, header.flag))
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack an MXImageRecord into (header, HWC uint8 ndarray).
+    ``iscolor``: 1 forces RGB, 0 forces grayscale, -1 keeps as stored
+    (cv2.imdecode flag parity; channel order is RGB, see module doc)."""
+    from PIL import Image
+    header, s = unpack(s)
+    img = Image.open(_pyio.BytesIO(s))
+    if iscolor == 1:
+        img = img.convert("RGB")
+    elif iscolor == 0:
+        img = img.convert("L")
+    return header, np.asarray(img)
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 image and pack it (reference pack_img).
+    ``quality``: JPEG quality 1-100 or PNG compression 1-9."""
+    from PIL import Image
+    img = np.asarray(img)
+    if img.ndim == 2:
+        pil = Image.fromarray(img, mode="L")
+    else:
+        pil = Image.fromarray(img[:, :, :3].astype(np.uint8), mode="RGB")
+    buf = _pyio.BytesIO()
+    fmt = img_fmt.lower().lstrip(".")
+    if fmt in ("jpg", "jpeg"):
+        pil.save(buf, format="JPEG", quality=quality)
+    elif fmt == "png":
+        pil.save(buf, format="PNG", compress_level=min(quality, 9))
+    else:
+        raise ValueError("unsupported img_fmt %s" % img_fmt)
+    return pack(header, buf.getvalue())

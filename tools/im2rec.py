@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""im2rec — build .lst/.rec/.idx image databases.
+
+Port of the reference tools/im2rec.py CLI over mxnet_tpu.recordio (pure
+Python, PIL backend). Two modes:
+
+  python tools/im2rec.py PREFIX ROOT --list [--recursive] [--train-ratio R]
+      scan ROOT for images, write PREFIX.lst (index \t label \t relpath)
+  python tools/im2rec.py PREFIX ROOT [--resize N] [--quality Q] [--num-thread T]
+      read PREFIX.lst (or PREFIX*.lst), write PREFIX.rec + PREFIX.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def list_image(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda x: x[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if args.chunks > 1:
+            str_chunk = "_%d" % i
+        else:
+            str_chunk = ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line_i, line in enumerate(fin):
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                print("lst should have at least 3 columns, skipping line %d"
+                      % line_i)
+                continue
+            yield (int(line[0]), line[-1]) + tuple(float(i)
+                                                   for i in line[1:-1])
+
+
+def image_encode(args, item):
+    """Return the packed record bytes for one .lst row, or None."""
+    from mxnet_tpu import recordio
+    fullpath = os.path.join(args.root, item[1])
+
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, np.array(item[2:], np.float32),
+                                   item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+
+    if args.pass_through:
+        with open(fullpath, "rb") as fin:
+            return recordio.pack(header, fin.read())
+
+    from PIL import Image
+    try:
+        img = Image.open(fullpath)
+        img = img.convert("RGB" if args.color else "L")
+    except Exception as e:
+        print("imread error %s: %s" % (fullpath, e))
+        return None
+    if args.center_crop:
+        w, h = img.size
+        m = min(w, h)
+        img = img.crop(((w - m) // 2, (h - m) // 2,
+                        (w - m) // 2 + m, (h - m) // 2 + m))
+    if args.resize:
+        w, h = img.size
+        if min(w, h) != args.resize:
+            if w > h:
+                nw, nh = args.resize * w // h, args.resize
+            else:
+                nw, nh = args.resize, args.resize * h // w
+            img = img.resize((nw, nh), Image.BILINEAR)
+    return recordio.pack_img(header, np.asarray(img), quality=args.quality,
+                             img_fmt=args.encoding)
+
+
+def make_rec(args, path_lst):
+    from mxnet_tpu import recordio
+    fname = os.path.basename(path_lst)
+    prefix = os.path.splitext(path_lst)[0]
+    print("Creating .rec file from", path_lst)
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    items = list(read_list(path_lst))
+    tic = time.time()
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for cnt, (item, buf) in enumerate(
+                zip(items, pool.map(lambda it: image_encode(args, it),
+                                    items))):
+            if buf is None:
+                continue
+            record.write_idx(item[0], buf)
+            if cnt % 1000 == 0 and cnt > 0:
+                print("time:", time.time() - tic, "count:", cnt)
+                tic = time.time()
+    record.close()
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Create an image list or RecordIO database "
+                    "(reference tools/im2rec.py)")
+    parser.add_argument("prefix",
+                        help="prefix of input/output lst and rec files")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list instead of a record database")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true",
+                        help="label images by sub-directory")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true",
+                        help="pack multi-dimensional labels")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    if args.list:
+        make_list(args)
+        return
+    working_dir = os.path.dirname(args.prefix)
+    files = [os.path.join(working_dir, f) for f in os.listdir(working_dir)
+             if os.path.isfile(os.path.join(working_dir, f))]
+    for f in files:
+        if f.startswith(args.prefix) and f.endswith(".lst"):
+            make_rec(args, f)
+
+
+if __name__ == "__main__":
+    main()
