@@ -13,6 +13,41 @@ Usage mirrors MXNet::
 """
 __version__ = "0.1.0"
 
+
+def _maybe_init_distributed():
+    """When spawned by tools/launch.py, join the collective world BEFORE
+    anything touches the XLA backend (jax.distributed.initialize must run
+    first). The reference does the analogous bootstrap on import: a
+    DMLC_ROLE=server process enters the ps-lite server loop from
+    python/mxnet/kvstore_server.py."""
+    import os
+    if os.environ.get("DMLC_ROLE") != "worker":
+        return
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    uri = os.environ.get("MXTPU_COORDINATOR")
+    if uri is None:
+        root = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT")
+        uri = "%s:%s" % (root, port) if root and port else None
+    if n <= 1 or uri is None:
+        return
+    rank = os.environ.get("MXTPU_WORKER_RANK")
+    if rank is None:
+        raise ImportError(
+            "distributed worker env found (DMLC_ROLE=worker, "
+            "DMLC_NUM_WORKER=%d) but MXTPU_WORKER_RANK is unset. Launch "
+            "workers via tools/launch.py — a collective world needs ranks "
+            "pinned at spawn (ps-lite assigned them dynamically)." % n)
+    import jax
+    jax.distributed.initialize(uri, num_processes=n, process_id=int(rank))
+    # keep this process' eager/jit results on its own devices: without a
+    # default device, multi-controller jit replicates outputs across the
+    # whole world and host reads (asnumpy) of them fail
+    jax.config.update("jax_default_device", jax.local_devices()[0])
+
+
+_maybe_init_distributed()
+
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)

@@ -77,15 +77,18 @@ class Context:
 
 
 def _accelerators():
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices()
+    # local_devices: in a multi-process (jax.distributed) world a Context
+    # must name a device THIS process owns; identical to jax.devices()
+    # when single-process
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else jax.local_devices()
 
 
 def _resolve_device(ctx: Context) -> jax.Device:
     if ctx.device_type == "cpu" or ctx.device_type == "cpu_pinned":
-        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
         if not cpus:  # running with a TPU-only backend: fall back to default
-            cpus = jax.devices()
+            cpus = jax.local_devices()
         return cpus[min(ctx.device_id, len(cpus) - 1)]
     devs = _accelerators()
     if ctx.device_id >= len(devs):
@@ -112,7 +115,7 @@ def tpu(device_id=0):
 
 
 def num_gpus():
-    return len([d for d in jax.devices() if d.platform != "cpu"])
+    return len([d for d in jax.local_devices() if d.platform != "cpu"])
 
 
 def num_tpus():
@@ -127,6 +130,6 @@ def current_context() -> Context:
 
 def default_context() -> Context:
     """Default = first accelerator if present else cpu (TPU-first stance)."""
-    if any(d.platform != "cpu" for d in jax.devices()):
+    if any(d.platform != "cpu" for d in jax.local_devices()):
         return Context("tpu", 0)
     return Context("cpu", 0)

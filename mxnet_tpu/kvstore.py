@@ -82,12 +82,7 @@ class KVStore:
         for k, vlist in zip(keys, values):
             if self._compression is not None:
                 vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
-            reduced = vlist[0]
-            if len(vlist) > 1:
-                acc = vlist[0]._data
-                for v in vlist[1:]:
-                    acc = acc + v._data
-                reduced = NDArray(acc, vlist[0].context)
+            reduced = self._local_reduce(vlist)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %s not initialized" % k)
@@ -123,12 +118,25 @@ class KVStore:
         self._compression = TwoBitCompressor(
             threshold=float(compression_params.get("threshold", 0.5)))
 
-    def _compress(self, key, dev_idx, grad):
-        res_key = (key, dev_idx)
+    @staticmethod
+    def _local_reduce(vlist):
+        """Sum a per-device value list (the Comm::Reduce analog)."""
+        if len(vlist) == 1:
+            return vlist[0]
+        acc = vlist[0]._data
+        for v in vlist[1:]:
+            acc = acc + v._data
+        return NDArray(acc, vlist[0].context)
+
+    def _get_residual(self, res_key, like):
         residual = self._compression_residuals.get(res_key)
         if residual is None:
-            residual = zeros(grad.shape, grad.context, str(grad.dtype))
+            residual = zeros(like.shape, like.context, str(like.dtype))
             self._compression_residuals[res_key] = residual
+        return residual
+
+    def _compress(self, key, dev_idx, grad):
+        residual = self._get_residual((key, dev_idx), grad)
         out, new_residual = self._compression.compress_decompress(
             grad._data, residual._data)
         residual._set_data(new_residual)

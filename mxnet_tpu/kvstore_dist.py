@@ -1,0 +1,162 @@
+"""Distributed KVStore over jax.distributed collectives.
+
+Reference parity: src/kvstore/kvstore_dist.h:44-500 (worker: ZPush/ZPull
+to parameter servers over ps-lite/ZMQ) and kvstore_dist_server.h:152-300
+(server: per-key aggregation with a sync barrier counting pushes from all
+workers; optimizer-on-server via set_optimizer). TPU-native mapping
+(SURVEY.md §2.3/§5.8): there are **no server processes** — ps-lite is
+replaced by ``jax.distributed`` + XLA collectives (ICI within a slice,
+DCN across slices / Gloo on CPU). Each push is a collective all-gather +
+sum across workers, which gives the reference's ``dist_sync`` semantics
+by construction: every worker's push participates before any pull
+observes the value. The "server state" (weights + optimizer state) is
+replicated deterministically on every worker — same reduced gradient,
+same updater, same result — so pull never needs a wire transfer at all.
+
+``dist_async`` is accepted but runs with sync semantics: Hogwild-style
+async applies make no sense when the transport is a collective (and sync
+is strictly more reproducible). ``get_num_dead_node``/``is_recovery``
+map to the jax coordination service's own failure model: a dead process
+fails the job, so the live view is always "0 dead".
+
+Process topology comes from the launcher (tools/launch.py) via env vars,
+reference names honored: DMLC_NUM_WORKER, DMLC_PS_ROOT_URI/PORT, and
+MXTPU_WORKER_RANK for the rank (ps-lite assigned ranks dynamically; a
+collective world needs them pinned at spawn).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+from .kvstore import KVStore, _key_value, _updater_key
+from .ndarray import NDArray
+
+__all__ = ["KVStoreDist"]
+
+_initialized = False
+
+
+def _ensure_dist():
+    """Verify the collective world is up. The actual
+    jax.distributed.initialize happens at package import
+    (mxnet_tpu._maybe_init_distributed) because it must precede any XLA
+    backend touch; by kvstore-creation time the backend is long live."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if n > 1 and jax.process_count() != n:
+        raise MXNetError(
+            "dist kvstore: DMLC_NUM_WORKER=%d but jax.process_count()=%d — "
+            "the collective world was not initialized at import. Launch "
+            "workers via tools/launch.py (it sets DMLC_ROLE=worker and the "
+            "coordinator env before Python starts)." % (n, jax.process_count()))
+    _initialized = True
+
+
+class KVStoreDist(KVStore):
+    """Multi-process synchronous kvstore (see module docstring)."""
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        _ensure_dist()
+        import jax
+        self._rank = jax.process_index()
+        self._nworkers = jax.process_count()
+        self._barrier_count = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nworkers
+
+    def init(self, key, value):
+        """Initialize keys from rank 0's values (reference
+        kvstore_dist.h:181-197: only worker 0 pushes init, others
+        barrier)."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                continue
+            v = vlist[0]
+            if self._nworkers > 1:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+                arr = multihost_utils.broadcast_one_to_all(v._data)
+                self._store[k] = NDArray(jnp.asarray(_np.asarray(arr)),
+                                         v.context)
+            else:
+                self._store[k] = v.copy()
+
+    def _allreduce(self, k, value):
+        """Sum a per-worker value across all workers (the ZPush/server-
+        aggregate/ZPull round of the reference, as one collective). With
+        compression on, the packed 2-bit buffer is what crosses the wire;
+        a single-worker world still quantizes (semantics must not depend
+        on world size)."""
+        if self._compression is not None:
+            packed, shape, dtype = self._compress_wire(k, value)
+            if self._nworkers == 1:
+                return NDArray(
+                    self._compression.decompress(packed, shape, dtype),
+                    value.context)
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(packed)
+            total = None
+            for w in range(gathered.shape[0]):
+                part = self._compression.decompress(gathered[w], shape, dtype)
+                total = part if total is None else total + part
+            return NDArray(total, value.context)
+        if self._nworkers == 1:
+            return value.copy()
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(value._data)
+        return NDArray(gathered.sum(axis=0), value.context)
+
+    def _compress_wire(self, k, grad):
+        """Quantize to the packed 2-bit wire format with per-key error
+        feedback (reference gradient_compression-inl.h quantize_2bit;
+        the packed uint8 buffer is what crosses DCN)."""
+        residual = self._get_residual((k, "wire"), grad)
+        packed, new_residual = self._compression.compress(
+            grad._data, residual._data)
+        residual._set_data(new_residual)
+        return packed, grad.shape, grad._data.dtype
+
+    def push(self, key, value, priority=0):
+        """Reduce local device list, then all-reduce across workers; with
+        an updater set, apply it to the globally reduced value (the
+        reference's optimizer-on-server mode, kvstore_dist_server.h:262-300
+        ApplyUpdates). Collective: every worker must push every key."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            reduced = self._allreduce(k, self._local_reduce(vlist))
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % k)
+                self._updater(_updater_key(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced
+
+    def barrier(self):
+        """Global barrier across workers (reference ps::Postoffice
+        Barrier)."""
+        if self._nworkers > 1:
+            from jax.experimental import multihost_utils
+            self._barrier_count += 1
+            multihost_utils.sync_global_devices(
+                "mxtpu_kv_barrier_%d" % self._barrier_count)
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        return 0
+
+    @property
+    def is_recovery(self):
+        return False

@@ -1,0 +1,103 @@
+"""Worker script for the distributed kvstore test.
+
+Port of tests/nightly/dist_sync_kvstore.py:30-80 (analytic rank-sum
+assertions). Run via:  python tools/launch.py -n 4 python tests/dist_sync_kvstore.py
+Each worker asserts the collective results; exit code 0 means pass.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+SHAPE = (4, 5)
+
+
+def check(name, got, expect):
+    got = got.asnumpy() if hasattr(got, "asnumpy") else np.asarray(got)
+    if not np.allclose(got, expect, rtol=1e-5, atol=1e-6):
+        raise AssertionError("%s: got %s expected %s" % (name, got, expect))
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    n = kv.num_workers
+    rank = kv.rank
+    assert n == int(os.environ["DMLC_NUM_WORKER"])
+    assert kv.type == "dist_sync"
+
+    # --- init comes from rank 0 (kvstore_dist.h:181-197) ---
+    kv.init("a", nd.full(SHAPE, rank + 10.0))
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    check("init-from-rank0", out, 10.0)
+
+    # --- push sums across workers: sum(rank+1) = n(n+1)/2 ---
+    kv.push("a", nd.full(SHAPE, rank + 1.0))
+    kv.pull("a", out=out)
+    check("push-sum", out, n * (n + 1) / 2.0)
+
+    # --- multi-device list push: local reduce then global ---
+    kv.push("a", [nd.ones(SHAPE), nd.ones(SHAPE)])
+    kv.pull("a", out=out)
+    check("multidev-push", out, 2.0 * n)
+
+    # --- int keys + list API ---
+    kv.init([3, 5], [nd.zeros(SHAPE), nd.zeros(SHAPE)])
+    kv.push([3, 5], [nd.full(SHAPE, 1.0), nd.full(SHAPE, 2.0)])
+    o3, o5 = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    kv.pull([3, 5], out=[o3, o5])
+    check("list-keys-3", o3, 1.0 * n)
+    check("list-keys-5", o5, 2.0 * n)
+
+    # --- updater on "server": stored += reduced (dist_sync_kvstore.py) ---
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_updater(lambda key, recv, stored: stored.__iadd__(recv))
+    kv2.init("w", nd.zeros(SHAPE))
+    for step in range(3):
+        kv2.push("w", nd.full(SHAPE, rank + 1.0))
+    kv2.pull("w", out=out)
+    check("updater-accumulate", out, 3 * n * (n + 1) / 2.0)
+
+    # --- optimizer-on-server mode (kvstore_dist_server.h ApplyUpdates) ---
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0,
+                                       rescale_grad=1.0 / n))
+    kv3.init("p", nd.ones(SHAPE))
+    kv3.push("p", nd.full(SHAPE, float(n)))  # reduced grad = n*n, rescaled = n
+    kv3.pull("p", out=out)
+    check("optimizer-on-server", out, 1.0 - 0.1 * n)
+
+    # --- gradient compression key (2-bit, error feedback) ---
+    kv4 = mx.kv.create("dist_sync")
+    kv4.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kv4.init("c", nd.zeros(SHAPE))
+    # every worker replays the compressor logic locally to compute the
+    # analytic expectation (deterministic error-feedback recurrences)
+    residuals = np.zeros((n,) + SHAPE, np.float32)
+    expect = None
+    for step in range(3):
+        grads = np.stack([np.full(SHAPE, r + 1.0, np.float32)
+                          for r in range(n)])
+        acc = residuals + grads
+        q = np.where(acc > 2.0, 2.0, np.where(acc < -2.0, -2.0, 0.0))
+        residuals = acc - q
+        expect = q.sum(axis=0)
+        kv4.push("c", nd.full(SHAPE, rank + 1.0))
+    kv4.pull("c", out=out)
+    check("2bit-compressed-push", out, expect)
+
+    # --- barrier + liveness surface ---
+    kv.barrier()
+    assert kv.get_num_dead_node() == 0
+    assert kv.is_recovery is False
+
+    print("worker %d/%d: all dist_sync checks passed" % (rank, n))
+
+
+if __name__ == "__main__":
+    main()

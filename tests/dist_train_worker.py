@@ -1,0 +1,59 @@
+"""Worker script: data-parallel Module.fit over dist_sync kvstore.
+
+Analog of tests/nightly/dist_lenet.py: each worker trains on its own
+shard, gradients sync through the dist kvstore, and at the end every
+worker must hold bit-identical parameters and solve the task.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+
+    rng = np.random.RandomState(0)  # same dataset everywhere
+    N = 256
+    X = rng.rand(N, 8).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) > X[:, 4:].sum(axis=1)).astype(np.float32)
+    # shard by worker (the reference slices via part_index/num_parts)
+    Xs, ys = X[rank::n], y[rank::n]
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=2, name="fc2"),
+                            name="softmax")
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02}, kvstore=kv,
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              magnitude=1.0))
+
+    # all workers must agree bit-for-bit on the parameters
+    arg_params, _ = mod.get_params()
+    from jax.experimental import multihost_utils
+    for name, arr in sorted(arg_params.items()):
+        gathered = np.asarray(
+            multihost_utils.process_allgather(arr._data))
+        for w in range(1, n):
+            if not np.array_equal(gathered[0], gathered[w]):
+                raise AssertionError("param %s differs between workers"
+                                     % name)
+
+    full_it = mx.io.NDArrayIter(X, y, batch_size=16)
+    acc = mod.score(full_it, "acc")[0][1]
+    assert acc > 0.9, "accuracy %f too low" % acc
+    print("worker %d/%d: dist training converged, acc=%.3f" % (rank, n, acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""launch.py — spawn a distributed training job.
+
+Port of the reference tools/launch.py:21-120 (dmlc-tracker). The
+reference launches W worker + S server + 1 scheduler processes and lets
+ps-lite wire them up; the TPU-native stack has no servers or scheduler —
+workers form a collective world via jax.distributed (kvstore_dist.py), so
+``launch.py -n W`` spawns exactly W worker processes. ``-s`` is accepted
+for CLI parity and ignored with a note. Only the ``local`` launcher
+(all processes on this host, the mode the reference's distributed tests
+use) is implemented; cluster launch is one process per TPU host with the
+same env vars, driven by your scheduler (GKE/xmanager/…).
+
+Env passed to each worker (reference DMLC names kept for parity):
+  DMLC_ROLE=worker  DMLC_NUM_WORKER=W  MXTPU_WORKER_RANK=i
+  DMLC_PS_ROOT_URI=127.0.0.1  DMLC_PS_ROOT_PORT=<free port>
+
+Usage:  python tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored: servers are replaced by collectives")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local"],
+                        help="only 'local' (single host) is implemented")
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra NAME=VALUE env for workers")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the worker command")
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.num_servers:
+        print("launch.py: -s/--num-servers ignored (no server processes; "
+              "kvstore_dist uses collectives)", file=sys.stderr)
+
+    port = _free_port()
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(port),
+                "MXTPU_WORKER_RANK": str(rank),
+                # worker collectives run on CPU devices locally
+                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+                "PALLAS_AXON_POOL_IPS": "",
+            })
+            for kv in args.env:
+                name, _, value = kv.partition("=")
+                env[name] = value
+            procs.append(subprocess.Popen(args.command, env=env))
+        # one dead worker leaves the rest blocked in collectives: kill the
+        # job on first failure (dmlc-tracker does the same)
+        import time
+        rc = None
+        while rc is None:
+            time.sleep(0.2)
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                rc = next(c for c in codes if c not in (None, 0))
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+            elif all(c == 0 for c in codes):
+                rc = 0
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        sys.exit(rc)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
