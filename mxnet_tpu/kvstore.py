@@ -23,6 +23,9 @@ from __future__ import annotations
 import os
 import pickle
 
+import numpy as _np
+import jax.numpy as jnp
+
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import ndarray as nd
@@ -100,8 +103,48 @@ class KVStore:
                 o._set_data(src._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense fallback: row_sparse storage maps to dense on TPU (SURVEY §7)
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows as row_sparse arrays (reference
+        KVStore::PullRowSparse, kvstore_local.h PullRowSparseImpl).
+        ``row_ids`` pairs with the flattened ``out`` list (one NDArray of
+        ids per output, or a single NDArray shared by all outputs — the
+        reference's semantics). With no ``row_ids`` (or a dense ``out``)
+        this is a full dense pull."""
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        from .ndarray.sparse import RowSparseNDArray
+        keys, outs = _key_value(key, out)
+        n_out = sum(len(olist) for olist in outs)
+        if isinstance(row_ids, NDArray):
+            rid_list = [row_ids] * n_out
+        elif isinstance(row_ids, (list, tuple)):
+            if not all(isinstance(r, NDArray) for r in row_ids):
+                raise TypeError("row_ids must be an NDArray or a list of "
+                                "NDArrays (one per out array)")
+            if len(row_ids) != n_out:
+                raise MXNetError(
+                    "row_sparse_pull: %d row_ids for %d out arrays"
+                    % (len(row_ids), n_out))
+            rid_list = list(row_ids)
+        else:
+            raise TypeError("row_ids must be an NDArray or a list of "
+                            "NDArrays")
+        i = 0
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                rids = rid_list[i]
+                i += 1
+                if isinstance(o, RowSparseNDArray):
+                    rows = jnp.asarray(_np.unique(
+                        rids.asnumpy().astype(_np.int64)))
+                    o._sp_data = src._data[rows]
+                    o._sp_indices = rows
+                    o._dense_cache = None
+                else:
+                    o._set_data(src._data)
 
     def set_updater(self, updater):
         self._updater = updater

@@ -11,6 +11,10 @@ from .. import ops as _ops  # ensure all ops are registered
 
 _register.populate(globals())
 
+from . import sparse
+from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
+                     cast_storage)
+
 # `power` etc. convenience aliases matching mx.nd module functions
 power = globals().get("broadcast_power")
 equal = globals().get("broadcast_equal")

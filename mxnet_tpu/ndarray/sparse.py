@@ -1,0 +1,459 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Reference parity: include/mxnet/ndarray.h:61-66 (kDefaultStorage /
+kRowSparseStorage / kCSRStorage), python/mxnet/ndarray/sparse.py, and the
+sparse kernels in src/operator/tensor/ (dot-inl.h, cast_storage-inl.h).
+
+TPU-native stance (SURVEY.md §7 "hard parts" #3): XLA has no native
+sparse tensors, so the *storage* is real — compressed component arrays
+(``data``/``indices``/``indptr``) held on device — while *compute*
+picks per-op between targeted sparse kernels (CSR matmul lowers to
+gather + segment-sum, which XLA turns into efficient scatter/gather on
+the MXU-adjacent VPU) and documented dense fallback (any op without a
+sparse rule densifies transparently through the lazy ``_data``
+property). stype semantics — what the reference's FInferStorageType
+decides — are preserved: add(rsp, rsp)→rsp, scalar*rsp→rsp,
+mixed→dense, cast_storage/retain/slice behave like the reference.
+Indices are int32 on device (reference: int64) — JAX's default int width;
+2^31 rows per array is far beyond any practical vocab.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "zeros",
+           "empty", "array", "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base: compressed components + lazy densification."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr", "_sp_shape",
+                 "_dense_cache")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        # deliberately NOT calling NDArray.__init__: _data is a property here
+        self._sp_data = data
+        self._sp_indices = indices
+        self._sp_indptr = indptr
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+        self._autograd_entry = None
+
+    # -- dense bridge ---------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # writing a dense value into a sparse array re-compresses it
+        # (reference CopyFromTo dense→sparse does a cast_storage)
+        self._set_from_dense(jnp.asarray(value))
+
+    def _set_data(self, value):
+        self._data = value
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._sp_data.dtype)
+
+    @property
+    def data(self):
+        """The non-zero values (reference sparse.py .data)."""
+        return NDArray(self._sp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices, self._ctx)
+
+    def astype(self, dtype, copy=True):
+        """stype-preserving cast (reference sparse arrays keep storage)."""
+        return self._with_data(self._sp_data.astype(dtype))
+
+    def copy(self):
+        return self.tostype(self.stype)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(self._data)
+            return other
+        raise TypeError("copyto expects NDArray or sparse NDArray")
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._sp_data)
+
+    def __repr__(self):
+        return "\n%s\n<%s %s @%s>" % (
+            _np.asarray(self._data), type(self).__name__,
+            "x".join(str(s) for s in self.shape), self._ctx)
+
+    # stype-preserving arithmetic (FInferStorageType rules)
+    def __mul__(self, other):
+        from ..base import numeric_types
+        if isinstance(other, numeric_types):
+            return self._with_data(self._sp_data * other)
+        return NDArray.__mul__(self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        from ..base import numeric_types
+        if isinstance(other, numeric_types):
+            return self._with_data(self._sp_data / other)
+        return NDArray.__truediv__(self, other)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse array: ``data[k] = dense[indices[k]]`` for the
+    stored rows, all other rows zero (reference ndarray.h kRowSparse;
+    the storage behind embeddings and their gradients)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        indices = jnp.asarray(indices, jnp.int32)
+        super().__init__(jnp.asarray(data), indices, None, shape, ctx)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def _to_dense(self):
+        dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
+        if self._sp_data.shape[0] == 0:
+            return dense
+        return dense.at[self._sp_indices].set(self._sp_data)
+
+    def _set_from_dense(self, dense):
+        if tuple(dense.shape) != self._sp_shape:
+            raise MXNetError("shape mismatch writing into RowSparseNDArray")
+        rsp = _dense_to_rsp(dense)
+        self._sp_data, self._sp_indices = rsp
+        self._dense_cache = dense
+
+    def _with_data(self, new_data):
+        return RowSparseNDArray(new_data, self._sp_indices, self._sp_shape,
+                                self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return RowSparseNDArray(self._sp_data, self._sp_indices,
+                                    self._sp_shape, self._ctx)
+        if stype == "default":
+            return NDArray(self._to_dense(), self._ctx)
+        if stype == "csr":
+            raise MXNetError("row_sparse -> csr cast is not defined "
+                             "(reference cast_storage supports "
+                             "default<->rsp and default<->csr)")
+        raise MXNetError("unknown stype %s" % stype)
+
+    def retain(self, row_ids):
+        """Keep only the given rows (reference sparse_retain op)."""
+        row_ids = jnp.asarray(
+            row_ids._data if isinstance(row_ids, NDArray) else row_ids,
+            jnp.int32)
+        # membership of each stored index in row_ids
+        keep = jnp.isin(self._sp_indices, row_ids)
+        kept_idx = _np.asarray(self._sp_indices)[_np.asarray(keep)]
+        kept_data = _np.asarray(self._sp_data)[_np.asarray(keep)]
+        return RowSparseNDArray(jnp.asarray(kept_data),
+                                jnp.asarray(kept_idx),
+                                self._sp_shape, self._ctx)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            idx = jnp.concatenate([self._sp_indices, other._sp_indices])
+            dat = jnp.concatenate([self._sp_data, other._sp_data])
+            return _coalesce_rsp(dat, idx, self._sp_shape, self._ctx)
+        return NDArray.__add__(self, other)
+
+    def __sub__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self + (other * -1.0)
+        return NDArray.__sub__(self, other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed-sparse-row 2-D array (reference ndarray.h kCSRStorage)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(jnp.asarray(data),
+                         jnp.asarray(indices, jnp.int32),
+                         jnp.asarray(indptr, jnp.int32), shape, ctx)
+        if len(self._sp_shape) != 2:
+            raise MXNetError("csr arrays are 2-D")
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        return NDArray(self._sp_indptr, self._ctx)
+
+    def _to_dense(self):
+        n, m = self._sp_shape
+        dense = jnp.zeros((n, m), self._sp_data.dtype)
+        if self._sp_data.shape[0] == 0:
+            return dense
+        row_ids = _csr_row_ids(self._sp_indptr, self._sp_data.shape[0])
+        return dense.at[row_ids, self._sp_indices].set(self._sp_data)
+
+    def _set_from_dense(self, dense):
+        if tuple(dense.shape) != self._sp_shape:
+            raise MXNetError("shape mismatch writing into CSRNDArray")
+        self._sp_data, self._sp_indices, self._sp_indptr = \
+            _dense_to_csr(dense)
+        self._dense_cache = dense
+
+    def _with_data(self, new_data):
+        return CSRNDArray(new_data, self._sp_indices, self._sp_indptr,
+                          self._sp_shape, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return CSRNDArray(self._sp_data, self._sp_indices,
+                              self._sp_indptr, self._sp_shape, self._ctx)
+        if stype == "default":
+            return NDArray(self._to_dense(), self._ctx)
+        if stype == "row_sparse":
+            raise MXNetError("csr -> row_sparse cast is not defined")
+        raise MXNetError("unknown stype %s" % stype)
+
+    def __getitem__(self, key):
+        """Row slicing keeps csr storage (reference sparse.py
+        CSRNDArray.__getitem__)."""
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sp_shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing requires step 1")
+            iptr = self._sp_indptr[start:stop + 1]
+            lo, hi = int(iptr[0]), int(iptr[-1])
+            return CSRNDArray(self._sp_data[lo:hi],
+                              self._sp_indices[lo:hi],
+                              iptr - lo,
+                              (stop - start, self._sp_shape[1]), self._ctx)
+        raise MXNetError("csr supports only row slicing")
+
+
+# ----------------------------------------------------------------------
+# conversion helpers (cast_storage-inl.h)
+# ----------------------------------------------------------------------
+def _csr_row_ids(indptr, nnz):
+    counts = jnp.diff(indptr)
+    return jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                      total_repeat_length=int(nnz))
+
+
+def _dense_to_rsp(dense):
+    host = _np.asarray(dense)
+    nz_rows = _np.nonzero(host.reshape(host.shape[0], -1).any(axis=1))[0]
+    return (jnp.asarray(host[nz_rows]), jnp.asarray(nz_rows, jnp.int32))
+
+
+def _dense_to_csr(dense):
+    host = _np.asarray(dense)
+    rows, cols = _np.nonzero(host)
+    data = host[rows, cols]
+    indptr = _np.zeros(host.shape[0] + 1, _np.int64)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr)
+    return (jnp.asarray(data), jnp.asarray(cols, jnp.int32),
+            jnp.asarray(indptr))
+
+
+def _coalesce_rsp(data, indices, shape, ctx):
+    """Merge duplicate row indices by summing (sorted, like the
+    reference's rsp aggregation in kvstore comm)."""
+    host_idx = _np.asarray(indices)
+    uniq, inv = _np.unique(host_idx, return_inverse=True)
+    summed = jax.ops.segment_sum(data, jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    return RowSparseNDArray(summed, jnp.asarray(uniq, jnp.int32), shape, ctx)
+
+
+def cast_storage(arr, stype):
+    """Cast between storage types (reference op cast_storage)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return NDArray(arr._data, arr.context)
+    if stype == "row_sparse":
+        data, idx = _dense_to_rsp(arr._data)
+        return RowSparseNDArray(data, idx, arr.shape, arr.context)
+    if stype == "csr":
+        data, indices, indptr = _dense_to_csr(arr._data)
+        return CSRNDArray(data, indices, indptr, arr.shape, arr.context)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def retain(arr, row_ids):
+    """sparse_retain op (reference sparse_retain-inl.h)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return arr.retain(row_ids)
+
+
+# ----------------------------------------------------------------------
+# creation (reference sparse.py csr_matrix / row_sparse_array / zeros)
+# ----------------------------------------------------------------------
+def csr_matrix(arg1, shape=None, ctx=None, dtype="float32"):
+    """Create a CSRNDArray from (data, indices, indptr), a dense
+    array-like, or another sparse array."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(_unwrap(data), dtype)
+        return CSRNDArray(data, _unwrap(indices), _unwrap(indptr),
+                          shape, ctx)
+    if isinstance(arg1, CSRNDArray):
+        return arg1.tostype("csr")
+    dense = jnp.asarray(_unwrap(arg1), dtype)
+    return cast_storage(NDArray(dense, ctx), "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
+    """Create a RowSparseNDArray from (data, indices), a dense
+    array-like, or another sparse array."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(_unwrap(data), dtype)
+        return RowSparseNDArray(data, _unwrap(indices), shape, ctx)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.tostype("row_sparse")
+    dense = jnp.asarray(_unwrap(arg1), dtype)
+    return cast_storage(NDArray(dense, ctx), "row_sparse")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        trailing = tuple(shape[1:])
+        return RowSparseNDArray(jnp.zeros((0,) + trailing, dtype),
+                                jnp.zeros((0,), jnp.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros(shape[0] + 1, jnp.int32), shape, ctx)
+    if stype == "default":
+        from . import ndarray as _nd
+        return _nd.zeros(shape, ctx, dtype)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-preserving array(): sparse in → same stype out."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.copy()
+    from . import ndarray as _nd
+    return _nd.array(source_array, ctx=ctx, dtype=dtype)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else _np.asarray(x)
+
+
+# ----------------------------------------------------------------------
+# sparse dot (reference src/operator/tensor/dot-inl.h DotCsrDnsDns /
+# DotCsrTDnsDns) — gather + segment-sum, the XLA-friendly formulation
+# ----------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b=True) unsupported "
+                             "(matches reference)")
+        n, m = lhs.shape
+        dense = rhs._data
+        nnz = lhs._sp_data.shape[0]
+        if nnz == 0:
+            out_rows = m if transpose_a else n
+            return NDArray(jnp.zeros((out_rows,) + tuple(dense.shape[1:]),
+                                     dense.dtype), lhs.context)
+        row_ids = _csr_row_ids(lhs._sp_indptr, nnz)
+        if transpose_a:
+            # out[col[k]] += data[k] * dense[row[k]]
+            contrib = lhs._sp_data[:, None] * dense[row_ids]
+            out = jax.ops.segment_sum(contrib, lhs._sp_indices,
+                                      num_segments=m)
+        else:
+            # out[row[k]] += data[k] * dense[col[k]]
+            contrib = lhs._sp_data[:, None] * dense[lhs._sp_indices]
+            out = jax.ops.segment_sum(contrib, row_ids, num_segments=n)
+        return NDArray(out, lhs.context)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        # documented dense fallback for remaining sparse dot combinations
+        from . import ndarray as _nd
+        return _nd.dot(NDArray(lhs._data), NDArray(rhs._data),
+                       transpose_a=transpose_a, transpose_b=transpose_b)
+    from . import ndarray as _nd
+    return _nd.dot(lhs, rhs, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
+
+
+# ----------------------------------------------------------------------
+# lazy (row-sparse) optimizer updates — only rows present in the gradient
+# are touched (reference optimizer_op.cc SGDUpdateRspImpl "lazy update",
+# adam_update FComputeEx); XLA lowers the row gather/scatter to efficient
+# dynamic-slice updates
+# ----------------------------------------------------------------------
+def _prep_sparse_grad(grad, rescale_grad, clip_gradient):
+    g = grad._sp_data.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return grad._sp_indices, g
+
+
+def sparse_sgd_update(weight, grad, state, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    """SGD(+momentum) on the gradient's rows only."""
+    rows, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows].astype(jnp.float32)
+    if wd:
+        g = g + wd * wr
+    if state is not None:
+        m = state._data
+        new_mr = momentum * m[rows].astype(jnp.float32) - lr * g
+        state._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
+        new_wr = wr + new_mr
+    else:
+        new_wr = wr - lr * g
+    weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
+
+
+def sparse_adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    """Adam on the gradient's rows only (lazy_update=True semantics)."""
+    rows, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows].astype(jnp.float32)
+    if wd:
+        g = g + wd * wr
+    m, v = mean._data, var._data
+    new_mr = beta1 * m[rows] + (1 - beta1) * g
+    new_vr = beta2 * v[rows] + (1 - beta2) * jnp.square(g)
+    mean._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
+    var._set_data(v.at[rows].set(new_vr.astype(v.dtype)))
+    new_wr = wr - lr * new_mr / (jnp.sqrt(new_vr) + epsilon)
+    weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))

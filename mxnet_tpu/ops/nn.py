@@ -492,7 +492,11 @@ def embedding(data, weight, *, input_dim, output_dim, dtype="float32",
               sparse_grad=False):
     """Row gather (ref src/operator/tensor/indexing_op.cc Embedding).
     TPU: lowers to a gather HLO; one-hot matmul would also hit the MXU but
-    gather wins at vocab scale."""
+    gather wins at vocab scale. ``sparse_grad=True`` is accepted for API
+    parity; inside a compiled graph the weight gradient is a dense
+    scatter-add (XLA's own efficient form) — to get a row_sparse gradient
+    for lazy optimizer updates, use ``nd.sparse.cast_storage(grad,
+    'row_sparse')`` or Parameter(grad_stype='row_sparse') in gluon."""
     idx = data.astype("int32")
     return jnp.take(weight, idx, axis=0, mode="clip")
 

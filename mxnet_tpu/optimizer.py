@@ -42,6 +42,13 @@ def create(name, **kwargs):
     return _OPT_REGISTRY[key](**kwargs)
 
 
+def _lazy_sparse(opt, grad):
+    """True when the grad is row_sparse and the optimizer opts into the
+    reference's lazy (touched-rows-only) update."""
+    return (getattr(grad, "stype", "default") == "row_sparse"
+            and getattr(opt, "lazy_update", False))
+
+
 class Optimizer:
     """Base optimizer (reference optimizer.py:33). Tracks per-parameter
     lr/wd multipliers, update counts, and optional fp32 master copies."""
@@ -184,7 +191,11 @@ class SGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs(index)
-        if state is not None:
+        if _lazy_sparse(self, grad):
+            from .ndarray import sparse as _sp
+            _sp.sparse_sgd_update(weight, grad, state, lr,
+                                  momentum=self.momentum, wd=wd, **kw)
+        elif state is not None:
             sgd_mom_update(weight, grad, state, out=weight, lr=lr, wd=wd,
                            momentum=self.momentum, **kw)
         else:
@@ -199,7 +210,13 @@ class SGD(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs(index)
         mom, weight32 = state
-        if mom is not None:
+        if _lazy_sparse(self, grad):
+            # lazy rows on the fp32 master, then refresh the model copy
+            from .ndarray import sparse as _sp
+            _sp.sparse_sgd_update(weight32, grad.astype("float32"), mom, lr,
+                                  momentum=self.momentum, wd=wd, **kw)
+            weight._set_data(weight32._data.astype(weight.dtype))
+        elif mom is not None:
             mp_sgd_mom_update(weight, grad, mom, weight32, out=weight, lr=lr,
                               wd=wd, momentum=self.momentum, **kw)
         else:
@@ -293,6 +310,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context, dtype="float32"),
@@ -304,9 +322,17 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
-        adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
-                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-                    **self._common_kwargs(index))
+        if _lazy_sparse(self, grad):
+            from .ndarray import sparse as _sp
+            _sp.sparse_adam_update(weight, grad, mean, var, lr,
+                                   beta1=self.beta1, beta2=self.beta2,
+                                   epsilon=self.epsilon, wd=wd,
+                                   **self._common_kwargs(index))
+        else:
+            adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon,
+                        **self._common_kwargs(index))
 
 
 @register

@@ -1,0 +1,220 @@
+"""Sparse NDArray tests — ported subset of
+tests/python/unittest/test_sparse_ndarray.py + test_sparse_operator.py
+(creation, cast_storage round trips, retain, csr slicing, stype
+arithmetic rules, sparse dot, lazy optimizer updates, kvstore
+row_sparse_pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_sparse_np(shape, density, rng):
+    arr = rng.rand(*shape).astype(np.float32)
+    arr[rng.rand(*shape) > density] = 0.0
+    return arr
+
+
+def test_rsp_creation_and_roundtrip():
+    rng = np.random.RandomState(0)
+    dense = _rand_sparse_np((8, 4), 0.3, rng)
+    rsp = sp.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    # components are the nonzero rows
+    nz_rows = np.nonzero(dense.any(axis=1))[0]
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), nz_rows)
+    np.testing.assert_array_equal(rsp.data.asnumpy(), dense[nz_rows])
+    # from components
+    rsp2 = sp.row_sparse_array((dense[nz_rows], nz_rows), shape=(8, 4))
+    np.testing.assert_array_equal(rsp2.asnumpy(), dense)
+    # round trip through dense
+    back = sp.cast_storage(rsp.tostype("default"), "row_sparse")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+
+
+def test_csr_creation_and_roundtrip():
+    rng = np.random.RandomState(1)
+    dense = _rand_sparse_np((6, 9), 0.25, rng)
+    csr = sp.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+
+
+def test_csr_components_and_slice():
+    dense = np.array([[0, 2, 0], [1, 0, 3], [0, 0, 0], [4, 0, 0]],
+                     np.float32)
+    csr = sp.csr_matrix(dense)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3, 4])
+    np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2, 0])
+    np.testing.assert_array_equal(csr.data.asnumpy(), [2, 1, 3, 4])
+    sl = csr[1:3]
+    assert sl.stype == "csr"
+    np.testing.assert_array_equal(sl.asnumpy(), dense[1:3])
+    one = csr[3]
+    np.testing.assert_array_equal(one.asnumpy(), dense[3:4])
+
+
+def test_cast_storage_invalid():
+    rsp = sp.zeros("row_sparse", (3, 2))
+    with pytest.raises(mx.MXNetError):
+        rsp.tostype("csr")
+
+
+def test_sparse_zeros():
+    rsp = sp.zeros("row_sparse", (4, 3))
+    assert rsp.shape == (4, 3) and rsp.stype == "row_sparse"
+    assert rsp.data.shape[0] == 0
+    np.testing.assert_array_equal(rsp.asnumpy(), np.zeros((4, 3)))
+    csr = sp.zeros("csr", (4, 3))
+    np.testing.assert_array_equal(csr.asnumpy(), np.zeros((4, 3)))
+
+
+def test_retain():
+    dense = np.diag(np.arange(1.0, 6.0)).astype(np.float32)
+    rsp = sp.row_sparse_array(dense)
+    kept = sp.retain(rsp, nd.array([1.0, 3.0]))
+    exp = np.zeros_like(dense)
+    exp[1], exp[3] = dense[1], dense[3]
+    np.testing.assert_array_equal(kept.asnumpy(), exp)
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [1, 3])
+
+
+def test_stype_arithmetic_rules():
+    rng = np.random.RandomState(2)
+    a = _rand_sparse_np((5, 4), 0.4, rng)
+    b = _rand_sparse_np((5, 4), 0.4, rng)
+    ra, rb = sp.row_sparse_array(a), sp.row_sparse_array(b)
+    s = ra + rb
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    d = ra - rb
+    assert d.stype == "row_sparse"
+    np.testing.assert_allclose(d.asnumpy(), a - b, rtol=1e-6)
+    m = ra * 2.5
+    assert m.stype == "row_sparse"
+    np.testing.assert_allclose(m.asnumpy(), a * 2.5, rtol=1e-6)
+    dv = ra / 2.0
+    assert dv.stype == "row_sparse"
+    # mixed sparse+dense falls back to dense
+    mixed = ra + nd.array(b)
+    assert mixed.stype == "default"
+    np.testing.assert_allclose(mixed.asnumpy(), a + b, rtol=1e-6)
+
+
+def test_sparse_dot_csr_dense():
+    rng = np.random.RandomState(3)
+    lhs = _rand_sparse_np((7, 5), 0.3, rng)
+    rhs = rng.rand(5, 6).astype(np.float32)
+    csr = sp.csr_matrix(lhs)
+    out = sp.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-5)
+    rhsT = rng.rand(7, 3).astype(np.float32)
+    outT = sp.dot(csr, nd.array(rhsT), transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(), lhs.T @ rhsT, rtol=1e-5)
+    # empty csr
+    empty = sp.zeros("csr", (4, 5))
+    np.testing.assert_array_equal(sp.dot(empty, nd.array(rhs)).asnumpy(),
+                                  np.zeros((4, 6)))
+
+
+def test_sparse_sgd_lazy_update():
+    """Rows absent from the gradient must NOT be touched (no wd decay on
+    untouched rows) — the reference's lazy_update=True semantics."""
+    w0 = np.ones((6, 3), np.float32)
+    weight = nd.array(w0.copy())
+    grad = sp.row_sparse_array((np.full((2, 3), 2.0, np.float32), [1, 4]),
+                               shape=(6, 3))
+    opt = mx.optimizer.SGD(learning_rate=0.5, wd=0.1, momentum=0.0,
+                           rescale_grad=1.0)
+    opt.update(0, weight, grad, opt.create_state(0, weight))
+    got = weight.asnumpy()
+    exp = w0.copy()
+    exp[[1, 4]] = w0[[1, 4]] - 0.5 * (2.0 + 0.1 * w0[[1, 4]])
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    # untouched rows identical
+    np.testing.assert_array_equal(got[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+
+
+def test_sparse_sgd_momentum_rows_only():
+    weight = nd.array(np.zeros((4, 2), np.float32))
+    opt = mx.optimizer.SGD(learning_rate=1.0, momentum=0.9, wd=0.0)
+    state = opt.create_state(0, weight)
+    g = sp.row_sparse_array((np.ones((1, 2), np.float32), [2]), shape=(4, 2))
+    opt.update(0, weight, g, state)
+    opt.update(0, weight, g, state)
+    # row 2: mom = -1 then -1.9 => w = -1 - 1.9 = -2.9
+    exp = np.zeros((4, 2), np.float32)
+    exp[2] = -2.9
+    np.testing.assert_allclose(weight.asnumpy(), exp, rtol=1e-6)
+    # state rows untouched elsewhere
+    np.testing.assert_array_equal(state.asnumpy()[[0, 1, 3]],
+                                  np.zeros((3, 2)))
+
+
+def test_sparse_adam_lazy_update():
+    w0 = np.ones((5, 2), np.float32)
+    weight = nd.array(w0.copy())
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    state = opt.create_state(0, weight)
+    g = sp.row_sparse_array((np.full((2, 2), 0.5, np.float32), [0, 3]),
+                            shape=(5, 2))
+    opt.update(0, weight, g, state)
+    got = weight.asnumpy()
+    assert not np.allclose(got[[0, 3]], 1.0)
+    np.testing.assert_array_equal(got[[1, 2, 4]], w0[[1, 2, 4]])
+    # dense-equivalent check on touched rows: adam with bias correction
+    # t=1 reduces to w - lr*g/(|g|+eps) = 1 - 0.1
+    np.testing.assert_allclose(got[[0, 3]], 0.9, rtol=1e-4)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("emb", nd.array(w))
+    out = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([4.0, 1.0, 4.0]))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 4])
+    exp = np.zeros((6, 2), np.float32)
+    exp[[1, 4]] = w[[1, 4]]
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+    # dense out falls back to full pull
+    dout = nd.zeros((6, 2))
+    kv.row_sparse_pull("emb", out=dout, row_ids=nd.array([0.0]))
+    np.testing.assert_array_equal(dout.asnumpy(), w)
+
+
+def test_kvstore_push_row_sparse_grads():
+    """Pushing rsp gradients aggregates correctly (dense-equivalent)."""
+    kv = mx.kv.create("local")
+    kv.init("g", nd.zeros((4, 2)))
+    g1 = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]), shape=(4, 2))
+    g2 = sp.row_sparse_array((np.ones((1, 2), np.float32) * 2, [3]),
+                             shape=(4, 2))
+    kv.push("g", [g1, g2])
+    out = nd.zeros((4, 2))
+    kv.pull("g", out=out)
+    exp = np.zeros((4, 2), np.float32)
+    exp[1], exp[3] = 1.0, 2.0
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_sparse_write_dense_into_sparse():
+    rsp = sp.zeros("row_sparse", (3, 2))
+    dense = np.array([[0, 0], [1, 2], [0, 0]], np.float32)
+    nd.array(dense).copyto(rsp)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1])
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+
+
+def test_embedding_sparse_grad_param_accepted():
+    data = nd.array(np.array([1.0, 3.0]))
+    weight = nd.array(np.arange(10, dtype=np.float32).reshape(5, 2))
+    out = nd.Embedding(data, weight, input_dim=5, output_dim=2,
+                       sparse_grad=True)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  weight.asnumpy()[[1, 3]])
