@@ -81,6 +81,7 @@ from . import models
 from . import gluon
 from . import recordio
 from . import image
+from . import operator
 from . import profiler
 from . import monitor
 from .monitor import Monitor

@@ -163,9 +163,17 @@ class KVStore:
 
     @staticmethod
     def _local_reduce(vlist):
-        """Sum a per-device value list (the Comm::Reduce analog)."""
+        """Sum a per-device value list (the Comm::Reduce analog). An
+        all-row_sparse list reduces row-sparse (coalescing indices) so
+        lazy optimizer semantics don't depend on device count."""
         if len(vlist) == 1:
             return vlist[0]
+        from .ndarray.sparse import RowSparseNDArray
+        if all(isinstance(v, RowSparseNDArray) for v in vlist):
+            acc = vlist[0]
+            for v in vlist[1:]:
+                acc = acc + v
+            return acc
         acc = vlist[0]._data
         for v in vlist[1:]:
             acc = acc + v._data

@@ -11,6 +11,7 @@ from .. import ops as _ops  # ensure all ops are registered
 
 _register.populate(globals())
 
+from . import contrib
 from . import sparse
 from .sparse import (BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
                      cast_storage)

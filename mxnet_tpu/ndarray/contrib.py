@@ -1,0 +1,84 @@
+"""Eager control flow: foreach / while_loop / cond on NDArrays.
+
+Reference parity: python/mxnet/ndarray/contrib.py — the imperative
+twins of symbol/contrib.py. Eager mode runs plain Python loops (each op
+dispatches asynchronously anyway); the compiled/fused form is the
+symbol version or hybridized blocks.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if isinstance(x, NDArray):
+        return [x], True
+    return list(x), False
+
+
+def foreach(body, data, init_states):
+    """Iterate ``body`` over axis 0 of ``data``
+    (reference ndarray/contrib.py foreach)."""
+    from . import stack
+
+    datas, single_data = _as_list(data)
+    length = datas[0].shape[0]
+    outputs = []
+    st = init_states
+    for i in range(length):
+        sl = [d[i] for d in datas]
+        out, st = body(sl[0] if single_data else sl, st)
+        outputs.append(out)
+    if outputs and isinstance(outputs[0], (list, tuple)):
+        stacked = [stack(*[o[j] for o in outputs], axis=0)
+                   for j in range(len(outputs[0]))]
+    else:
+        stacked = stack(*outputs, axis=0)
+    return stacked, st
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run ``func`` while ``cond`` holds (reference ndarray/contrib.py
+    while_loop). Outputs are stacked and zero-padded to
+    ``max_iterations`` like the symbolic version."""
+    from . import stack, zeros
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    lvars, single_var = _as_list(loop_vars)
+    steps = []
+    i = 0
+    while i < max_iterations and bool(cond(*lvars).asscalar()):
+        out, new_vars = func(*lvars)
+        outs, single_out = _as_list(out) if out is not None else ([], True)
+        steps.append(outs)
+        lvars, _ = _as_list(new_vars)
+        i += 1
+    if steps and steps[0]:
+        n_out = len(steps[0])
+        stacked = []
+        for j in range(n_out):
+            cols = [s[j] for s in steps]
+            pad = max_iterations - len(cols)
+            col = stack(*cols, axis=0)
+            if pad:
+                z = zeros((pad,) + cols[0].shape, cols[0].context,
+                          str(cols[0].dtype))
+                from . import concat
+                col = concat(col, z, dim=0)
+            stacked.append(col)
+        out = stacked[0] if single_out else stacked
+    else:
+        out = []
+    return out, (lvars[0] if single_var else lvars)
+
+
+def cond(pred, then_func, else_func):
+    """Branch eagerly on a boolean scalar (reference ndarray/contrib.py
+    cond)."""
+    if bool(pred.asscalar()):
+        return then_func()
+    return else_func()

@@ -56,6 +56,8 @@ def invoke(opdef, args, kwargs, out=None, name=None):
     # assemble positional tensor inputs
     if opdef.variadic:
         inputs = list(args)
+        if kw_inputs:
+            inputs += opdef.ordered_kw_inputs(kw_inputs, attrs)
         input_names = [str(i) for i in range(len(inputs))]
     else:
         inputs = list(args)

@@ -164,13 +164,15 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def retain(self, row_ids):
         """Keep only the given rows (reference sparse_retain op)."""
-        row_ids = jnp.asarray(
-            row_ids._data if isinstance(row_ids, NDArray) else row_ids,
-            jnp.int32)
-        # membership of each stored index in row_ids
-        keep = jnp.isin(self._sp_indices, row_ids)
-        kept_idx = _np.asarray(self._sp_indices)[_np.asarray(keep)]
-        kept_data = _np.asarray(self._sp_data)[_np.asarray(keep)]
+        rid_host = _np.asarray(
+            row_ids._data if isinstance(row_ids, NDArray) else row_ids
+        ).astype(_np.int64)
+        # membership on host: the components come to host anyway, so one
+        # fetch + numpy isin beats a device kernel + three syncs
+        idx_host = _np.asarray(self._sp_indices)
+        keep = _np.isin(idx_host, rid_host)
+        kept_idx = idx_host[keep]
+        kept_data = _np.asarray(self._sp_data)[keep]
         return RowSparseNDArray(jnp.asarray(kept_data),
                                 jnp.asarray(kept_idx),
                                 self._sp_shape, self._ctx)
@@ -239,11 +241,17 @@ class CSRNDArray(BaseSparseNDArray):
         """Row slicing keeps csr storage (reference sparse.py
         CSRNDArray.__getitem__)."""
         if isinstance(key, int):
+            n = self._sp_shape[0]
+            if key < 0:
+                key += n
+            if not 0 <= key < n:
+                raise IndexError("index %d out of bounds for axis 0" % key)
             key = slice(key, key + 1)
         if isinstance(key, slice):
             start, stop, step = key.indices(self._sp_shape[0])
             if step != 1:
                 raise MXNetError("csr slicing requires step 1")
+            stop = max(stop, start)
             iptr = self._sp_indptr[start:stop + 1]
             lo, hi = int(iptr[0]), int(iptr[-1])
             return CSRNDArray(self._sp_data[lo:hi],

@@ -1,0 +1,135 @@
+"""CustomOp: user-defined operators in Python.
+
+Reference parity: python/mxnet/operator.py:426-472 (CustomOp /
+CustomOpProp / register) + src/operator/custom/custom.cc. The reference
+trampolines through C callbacks into Python from the engine; the
+TPU-native realization is ``jax.pure_callback`` (host callback embedded
+in the XLA program) wrapped in ``jax.custom_vjp`` so the user's
+``backward`` drives autodiff (see ops/custom.py for the op itself). A
+Custom op therefore works everywhere an ordinary op does — eager,
+autograd.record, hybridized blocks, and bound executors — at the cost of
+a host round-trip per call (the same cost the reference pays crossing
+the C/Python boundary).
+
+Usage (identical to the reference)::
+
+    class Sigmoid(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+            self.assign(out_data[0], req[0], y)
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0]
+            self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+        def list_arguments(self): return ['data']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes): return Sigmoid()
+
+    y = mx.nd.Custom(x, op_type='sigmoid')
+    s = mx.sym.Custom(data=mx.sym.Variable('d'), op_type='sigmoid')
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register",
+           "get_all_registered_operators"]
+
+_PROP_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom operator implementations (reference
+    operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad_req (reference
+        CustomOp.assign: null/write/inplace/add)."""
+        if req in ("null", None):
+            return
+        import jax.numpy as jnp
+        from .ndarray.ndarray import NDArray
+        val = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        if req == "add":
+            dst._set_data(dst._data + val)
+        else:  # write / inplace
+            dst._set_data(val)
+
+
+class CustomOpProp:
+    """Operator properties: shapes, types, and operator creation
+    (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type=reg_name``
+    (reference operator.py register :1101)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators():
+    return list(_PROP_REGISTRY)
+
+
+def _make_prop(attrs):
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires op_type=")
+    if op_type not in _PROP_REGISTRY:
+        raise MXNetError("custom op '%s' is not registered "
+                         "(mx.operator.register)" % op_type)
+    kwargs = {k: str(v) for k, v in attrs.items() if k != "op_type"}
+    return _PROP_REGISTRY[op_type](**kwargs)

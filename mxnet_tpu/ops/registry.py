@@ -119,6 +119,10 @@ class OpDef:
         # unused_inputs(attrs) -> set of input names absent given these attrs
         # (e.g. FullyConnected bias when no_bias=True).
         self.unused_inputs = None
+        # kw_input_order(attrs) -> ordered input names, for variadic ops
+        # whose tensor inputs may be passed by keyword (Custom: the prop's
+        # list_arguments order)
+        self.kw_input_order = None
 
         sig = inspect.signature(fn)
         self.input_names = []
@@ -126,6 +130,7 @@ class OpDef:
         self.attr_names = []
         self.attr_defaults = {}
         self.variadic = False
+        self.var_keyword = False  # op takes **kwargs attrs (Custom)
         for pname, p in sig.parameters.items():
             if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
                           inspect.Parameter.POSITIONAL_OR_KEYWORD):
@@ -139,6 +144,8 @@ class OpDef:
                 self.attr_names.append(pname)
                 if p.default is not inspect.Parameter.empty:
                     self.attr_defaults[pname] = p.default
+            elif p.kind == inspect.Parameter.VAR_KEYWORD:
+                self.var_keyword = True
         self.__doc__ = fn.__doc__
 
     # ------------------------------------------------------------------
@@ -148,7 +155,16 @@ class OpDef:
         for k, v in kwargs.items():
             if k in self.attr_names:
                 attrs[k] = v
-            elif k in self.input_names or self.variadic:
+            elif k in self.input_names:
+                inputs[k] = v
+            elif self.var_keyword:
+                # free-form op (Custom): tensors go to inputs, the rest
+                # are prop attrs — classify by value type
+                if _is_tensor_like(v):
+                    inputs[k] = v
+                else:
+                    attrs[k] = v
+            elif self.variadic:
                 inputs[k] = v
             else:
                 raise MXNetError("%s got unknown argument '%s'" % (self.name, k))
@@ -159,11 +175,27 @@ class OpDef:
         out = dict(self.attr_defaults)
         for k, v in attrs.items():
             if k not in self.attr_names:
+                if self.var_keyword:
+                    # free-form attrs (Custom op params) stay as given;
+                    # the prop receives them as strings like the reference
+                    out[k] = v
+                    continue
                 raise MXNetError("%s: unknown attr '%s'" % (self.name, k))
             if isinstance(v, str):
                 v = _parse_attr_string(v, self.attr_defaults.get(k))
             out[k] = v
         return out
+
+    def ordered_kw_inputs(self, kw_inputs, attrs):
+        """Order keyword tensor inputs of a variadic op; unknown names are
+        an error (a typo'd input must not be silently dropped)."""
+        order = (self.kw_input_order(attrs) if self.kw_input_order
+                 else sorted(kw_inputs))
+        unknown = set(kw_inputs) - set(order)
+        if unknown:
+            raise MXNetError("%s: unexpected tensor input(s) %s (expected "
+                             "from %s)" % (self.name, sorted(unknown), order))
+        return [kw_inputs[n] for n in order if n in kw_inputs]
 
     def out_count(self, attrs):
         n = self.num_outputs
@@ -175,6 +207,14 @@ class OpDef:
 
     def __repr__(self):
         return "<OpDef %s>" % self.name
+
+
+def _is_tensor_like(v):
+    import numpy as _np
+    if isinstance(v, (jax.Array, _np.ndarray)):
+        return True
+    cls = type(v).__mro__
+    return any(c.__name__ in ("NDArray", "Symbol") for c in cls)
 
 
 def _parse_attr_string(v, default):

@@ -46,6 +46,9 @@ def _make_sym_func(opdef, fname):
 
         if opdef.variadic:
             inputs = [_entry_of(s) for s in args]
+            if kw_inputs:
+                inputs += [_entry_of(s) for s in
+                           opdef.ordered_kw_inputs(kw_inputs, attrs)]
         else:
             unused = (opdef.unused_inputs(attrs)
                       if opdef.unused_inputs is not None else set())
@@ -99,3 +102,5 @@ class _SymRandom:
 
 
 random = _SymRandom()
+
+from . import contrib  # noqa: E402,F401

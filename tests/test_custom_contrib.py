@@ -1,0 +1,256 @@
+"""CustomOp + contrib control flow tests.
+
+Ports tests/python/unittest/test_operator.py::test_custom_op and the
+control-flow tests over symbol/contrib.py foreach/while_loop/cond.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sym
+
+
+# ----------------------------------------------------------------------
+# CustomOp
+# ----------------------------------------------------------------------
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("t_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _AddN(mx.operator.CustomOp):
+    """Two inputs, two outputs, a scalar param — exercises multi-io."""
+
+    def __init__(self, alpha):
+        self.alpha = alpha
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+        self.assign(out_data[1], req[1], in_data[0] * self.alpha)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0] + out_grad[1] * self.alpha)
+        self.assign(in_grad[1], req[1], out_grad[0])
+
+
+@mx.operator.register("t_addn")
+class _AddNProp(mx.operator.CustomOpProp):
+    def __init__(self, alpha="2.0"):
+        super().__init__(need_top_grad=True)
+        self.alpha = float(alpha)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "scaled"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _AddN(self.alpha)
+
+
+def test_custom_op_eager_forward_backward():
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    exp = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    y = nd.Custom(x, op_type="t_sigmoid")
+    np.testing.assert_allclose(y.asnumpy(), exp, rtol=1e-6)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="t_sigmoid")
+    y.backward(nd.ones((1, 3)))
+    np.testing.assert_allclose(x.grad.asnumpy(), exp * (1 - exp), rtol=1e-5)
+
+
+def test_custom_op_symbol_executor():
+    data = sym.Variable("data")
+    s = sym.Custom(data=data, op_type="t_sigmoid", name="sig")
+    exe = s.simple_bind(ctx=mx.cpu(), data=(2, 3), grad_req="write")
+    x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exp = 1.0 / (1.0 + np.exp(-x))
+    exe.forward(is_train=True)
+    exe.backward(out_grads=nd.ones((2, 3)))
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), exp, rtol=1e-6)
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               exp * (1 - exp), rtol=1e-5)
+
+
+def test_custom_op_multi_io_and_params():
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.full((2, 2), 3.0, np.float32))
+    s, scaled = nd.Custom(a, b, op_type="t_addn", alpha=4.0)
+    np.testing.assert_array_equal(s.asnumpy(), 4.0 * np.ones((2, 2)))
+    np.testing.assert_array_equal(scaled.asnumpy(), 4.0 * np.ones((2, 2)))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s, scaled = nd.Custom(a, b, op_type="t_addn", alpha=4.0)
+        loss = s.sum() + scaled.sum()
+    loss.backward()
+    np.testing.assert_array_equal(a.grad.asnumpy(), 5.0 * np.ones((2, 2)))
+    np.testing.assert_array_equal(b.grad.asnumpy(), np.ones((2, 2)))
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="no_such_op")
+
+
+def test_custom_op_in_module_fit():
+    """A Custom op inside a Module training loop learns (the reference's
+    canonical CustomOp use: custom loss/activation in a fit)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(64, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 2).astype(np.float32)
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = sym.Custom(data=h, op_type="t_sigmoid", name="act")
+    out = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=2, name="fc2"),
+                            name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=20, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Xavier())
+    assert mod.score(it, "acc")[0][1] > 0.9
+
+
+# ----------------------------------------------------------------------
+# contrib control flow
+# ----------------------------------------------------------------------
+def test_eager_foreach_cumsum():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+
+    def step(x, states):
+        (s,) = states
+        return x + s, [x + s]
+
+    outs, st = nd.contrib.foreach(step, data, [nd.zeros((2,))])
+    exp = np.cumsum(data.asnumpy(), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), exp)
+    np.testing.assert_allclose(st[0].asnumpy(), exp[-1])
+
+
+def test_eager_while_loop_and_cond():
+    i = nd.array(np.array([0.0]))
+    s = nd.array(np.array([0.0]))
+    outs, (fi, fs) = nd.contrib.while_loop(
+        lambda i, s: i < 4, lambda i, s: (i * 2, [i + 1, s + i]),
+        [i, s], max_iterations=8)
+    assert float(fi.asscalar()) == 4 and float(fs.asscalar()) == 6
+    # padded to max_iterations
+    assert outs.shape[0] == 8
+    np.testing.assert_allclose(outs.asnumpy()[:4, 0], [0, 2, 4, 6])
+    np.testing.assert_allclose(outs.asnumpy()[4:], 0.0)
+    c = nd.contrib.cond(nd.array(np.array([0.0])),
+                        lambda: nd.ones((2,)), lambda: nd.zeros((2,)))
+    np.testing.assert_array_equal(c.asnumpy(), np.zeros(2))
+
+
+def test_symbol_foreach_forward_backward():
+    data_s = sym.Variable("data")
+    init_s = sym.Variable("init")
+
+    def body(x, states):
+        (s,) = states
+        return x + s, [x + s]
+
+    outs_s, states_s = sym.contrib.foreach(body, data_s, [init_s])
+    data = np.arange(6, dtype=np.float32).reshape(3, 2)
+    exe = outs_s.simple_bind(ctx=mx.cpu(), data=(3, 2), init=(2,),
+                             grad_req="write")
+    exe.arg_dict["data"][:] = data
+    exe.arg_dict["init"][:] = np.zeros(2, np.float32)
+    exe.forward(is_train=True)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               np.cumsum(data, axis=0), rtol=1e-6)
+    exe.backward(out_grads=nd.ones((3, 2)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               [[3, 3], [2, 2], [1, 1]])
+
+
+def test_symbol_foreach_with_params():
+    """Body uses a weight: it becomes a loop-invariant node input and
+    receives gradients through the scan."""
+    data_s = sym.Variable("data")
+    init_s = sym.Variable("init")
+
+    def body(x, states):
+        (s,) = states
+        h = sym.FullyConnected(x + s, num_hidden=2, no_bias=True, name="fc")
+        return h, [h]
+
+    outs_s, _ = sym.contrib.foreach(body, data_s, [init_s])
+    exe = outs_s.simple_bind(ctx=mx.cpu(), data=(3, 1, 2), init=(1, 2),
+                             fc_weight=(2, 2), grad_req="write")
+    rng = np.random.RandomState(1)
+    W = rng.randn(2, 2).astype(np.float32) * 0.5
+    data = rng.randn(3, 1, 2).astype(np.float32)
+    exe.arg_dict["data"][:] = data
+    exe.arg_dict["init"][:] = np.zeros((1, 2), np.float32)
+    exe.arg_dict["fc_weight"][:] = W
+    exe.forward(is_train=True)
+    # numpy reference
+    s = np.zeros((1, 2), np.float32)
+    exp = []
+    for t in range(3):
+        s = (data[t] + s) @ W.T
+        exp.append(s)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), np.stack(exp),
+                               rtol=1e-5)
+    exe.backward(out_grads=nd.ones((3, 1, 2)))
+    assert np.abs(exe.grad_dict["fc_weight"].asnumpy()).sum() > 0
+
+
+def test_symbol_while_loop():
+    iv, sv = sym.Variable("i"), sym.Variable("s")
+    outs_w, fvars = sym.contrib.while_loop(
+        lambda i, s: i < 4, lambda i, s: (i * 2, [i + 1, s + i]),
+        [iv, sv], max_iterations=8)
+    grp = sym.Group([outs_w] + list(fvars))
+    exe = grp.simple_bind(ctx=mx.cpu(), i=(1,), s=(1,))
+    exe.arg_dict["i"][:] = 0.0
+    exe.arg_dict["s"][:] = 0.0
+    res = exe.forward()
+    np.testing.assert_allclose(res[0].asnumpy()[:4, 0], [0, 2, 4, 6])
+    np.testing.assert_allclose(res[0].asnumpy()[4:], 0.0)
+    assert float(res[1].asnumpy()[0]) == 4
+    assert float(res[2].asnumpy()[0]) == 6
+
+
+def test_symbol_cond():
+    pv, av = sym.Variable("p"), sym.Variable("a")
+    c_s = sym.contrib.cond(pv, lambda: av * 2, lambda: av - 1)
+    exe = c_s.simple_bind(ctx=mx.cpu(), p=(1,), a=(3,))
+    exe.arg_dict["a"][:] = np.array([1.0, 2.0, 3.0], np.float32)
+    exe.arg_dict["p"][:] = 1.0
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [2, 4, 6])
+    exe.arg_dict["p"][:] = 0.0
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [0, 1, 2])
