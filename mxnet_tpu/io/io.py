@@ -190,7 +190,9 @@ class NDArrayIter(DataIter):
         if self.shuffle:
             self._shuffle_data()
         if self.last_batch_handle == "roll_over" and \
-                0 < self.cursor < self.num_data:
+                self.cursor > self.num_data:
+            # leftover of the wrapped last batch starts the next epoch
+            # (reference io.py:700 reset)
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
                 self.batch_size
         else:
@@ -212,10 +214,11 @@ class NDArrayIter(DataIter):
                 part = src[self.idx[start:end]]
                 self.num_pad = 0
             else:
-                pad = end - self.num_data
-                sel = _np.concatenate([self.idx[start:], self.idx[:pad]])
+                # wrap modulo num_data so the batch is always full even
+                # when batch_size exceeds the dataset size
+                sel = self.idx[_np.arange(start, end) % self.num_data]
                 part = src[sel]
-                self.num_pad = pad
+                self.num_pad = end - self.num_data
             out.append(nd_array(part))
         return out
 

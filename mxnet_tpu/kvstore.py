@@ -168,12 +168,13 @@ class KVStore:
         lazy optimizer semantics don't depend on device count."""
         if len(vlist) == 1:
             return vlist[0]
-        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray.sparse import RowSparseNDArray, _coalesce_rsp
         if all(isinstance(v, RowSparseNDArray) for v in vlist):
-            acc = vlist[0]
-            for v in vlist[1:]:
-                acc = acc + v
-            return acc
+            # concatenate all device components, coalesce once (one host
+            # sync per push, not one per device pair)
+            dat = jnp.concatenate([v._sp_data for v in vlist])
+            idx = jnp.concatenate([v._sp_indices for v in vlist])
+            return _coalesce_rsp(dat, idx, vlist[0].shape, vlist[0].context)
         acc = vlist[0]._data
         for v in vlist[1:]:
             acc = acc + v._data

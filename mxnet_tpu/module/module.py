@@ -116,8 +116,15 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        outs = self._exec_group.get_outputs()
-        return list(zip(self._output_names, [o.shape for o in outs]))
+        # infer from the bound input shapes — must work before any
+        # forward has run (SequentialModule wires layers at bind time)
+        known = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            known.update({l.name: l.shape for l in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape_partial(**known)
+        return list(zip(self._output_names,
+                        [tuple(s) if s is not None else None
+                         for s in out_shapes]))
 
     # ------------------------------------------------------------------
     def get_params(self):
@@ -149,6 +156,18 @@ class Module(BaseModule):
 
         attrs = self._symbol.attr_dict()
 
+        if not allow_extra:
+            # reference module.py set_params: unknown names are an error
+            # unless allow_extra_params is set
+            extra = [n for n in (arg_params or {})
+                     if n not in self._arg_params]
+            extra += [n for n in (aux_params or {})
+                      if n not in self._aux_params]
+            if extra:
+                raise MXNetError(
+                    "set_params/init_params got extra parameter(s) %s "
+                    "(pass allow_extra=True to ignore)" % sorted(extra))
+
         def _impl(name, arr, cache):
             if cache is not None and name in cache:
                 cache_arr = cache[name]
@@ -164,13 +183,12 @@ class Module(BaseModule):
                     initializer(InitDesc(name, attrs.get(name)), arr)
 
         for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name))
-            if arg_params is not None and name in arg_params:
+            if arg_params is not None:
                 _impl(name, arr, arg_params)
             elif initializer is not None:
-                initializer(desc, arr)
+                initializer(InitDesc(name, attrs.get(name)), arr)
         for name, arr in sorted(self._aux_params.items()):
-            if aux_params is not None and name in aux_params:
+            if aux_params is not None:
                 _impl(name, arr, aux_params)
             elif initializer is not None:
                 initializer(InitDesc(name, attrs.get(name)), arr)
@@ -238,6 +256,10 @@ class Module(BaseModule):
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params,
                                         allow_extra=True)
+        if shared_module is not None and shared_module.optimizer_initialized:
+            # a bucket created mid-training adopts the live optimizer
+            # (reference module.py:455)
+            self.borrow_optimizer(shared_module)
 
     def _reset_bind(self):
         self.binded = False

@@ -57,7 +57,8 @@ def invoke(opdef, args, kwargs, out=None, name=None):
     if opdef.variadic:
         inputs = list(args)
         if kw_inputs:
-            inputs += opdef.ordered_kw_inputs(kw_inputs, attrs)
+            inputs += opdef.ordered_kw_inputs(kw_inputs, attrs,
+                                              n_positional=len(args))
         input_names = [str(i) for i in range(len(inputs))]
     else:
         inputs = list(args)

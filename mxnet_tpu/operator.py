@@ -124,7 +124,13 @@ def get_all_registered_operators():
     return list(_PROP_REGISTRY)
 
 
+_PROP_CACHE = {}
+
+
 def _make_prop(attrs):
+    """Instantiate (with memoization — each nd.Custom call consults this
+    from out_count, kw ordering, and the op body) the prop registered
+    under attrs['op_type']."""
     op_type = attrs.get("op_type")
     if op_type is None:
         raise MXNetError("Custom op requires op_type=")
@@ -132,4 +138,9 @@ def _make_prop(attrs):
         raise MXNetError("custom op '%s' is not registered "
                          "(mx.operator.register)" % op_type)
     kwargs = {k: str(v) for k, v in attrs.items() if k != "op_type"}
-    return _PROP_REGISTRY[op_type](**kwargs)
+    key = (op_type, tuple(sorted(kwargs.items())))
+    prop = _PROP_CACHE.get(key)
+    if prop is None:
+        prop = _PROP_REGISTRY[op_type](**kwargs)
+        _PROP_CACHE[key] = prop
+    return prop

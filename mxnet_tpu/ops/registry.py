@@ -186,16 +186,32 @@ class OpDef:
             out[k] = v
         return out
 
-    def ordered_kw_inputs(self, kw_inputs, attrs):
-        """Order keyword tensor inputs of a variadic op; unknown names are
-        an error (a typo'd input must not be silently dropped)."""
+    def ordered_kw_inputs(self, kw_inputs, attrs, n_positional=0):
+        """Order keyword tensor inputs of a variadic op. Positional args
+        fill the first ``n_positional`` slots of the declared order;
+        keyword names may not collide with them, may not be unknown, and
+        must fill the remaining slots contiguously — anything else would
+        silently bind tensors to the wrong arguments."""
         order = (self.kw_input_order(attrs) if self.kw_input_order
                  else sorted(kw_inputs))
         unknown = set(kw_inputs) - set(order)
         if unknown:
             raise MXNetError("%s: unexpected tensor input(s) %s (expected "
                              "from %s)" % (self.name, sorted(unknown), order))
-        return [kw_inputs[n] for n in order if n in kw_inputs]
+        dup = set(kw_inputs) & set(order[:n_positional])
+        if dup:
+            raise MXNetError("%s: input(s) %s given both positionally and "
+                             "by keyword" % (self.name, sorted(dup)))
+        remaining = order[n_positional:]
+        out = []
+        for i, name in enumerate(remaining):
+            if name in kw_inputs:
+                if len(out) != i:
+                    raise MXNetError(
+                        "%s: keyword input '%s' given but earlier input "
+                        "'%s' missing" % (self.name, name, remaining[i - 1]))
+                out.append(kw_inputs[name])
+        return out
 
     def out_count(self, attrs):
         n = self.num_outputs

@@ -48,7 +48,8 @@ def _make_sym_func(opdef, fname):
             inputs = [_entry_of(s) for s in args]
             if kw_inputs:
                 inputs += [_entry_of(s) for s in
-                           opdef.ordered_kw_inputs(kw_inputs, attrs)]
+                           opdef.ordered_kw_inputs(kw_inputs, attrs,
+                                                   n_positional=len(args))]
         else:
             unused = (opdef.unused_inputs(attrs)
                       if opdef.unused_inputs is not None else set())

@@ -1,0 +1,112 @@
+"""End-to-end convergence tests asserting final-accuracy thresholds.
+
+Analog of tests/python/train/test_mlp.py and test_conv.py: the reference
+trains MLP/LeNet on MNIST and asserts accuracy > 0.96/0.93. No dataset
+download is possible here, so a synthetic MNIST-like task stands in:
+10 random digit prototypes + per-sample noise + random shifts — linearly
+non-separable enough that the conv net must actually learn.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _synthetic_digits(n=600, size=14, noise=0.35, seed=42):
+    rng = np.random.RandomState(seed)
+    protos = (rng.rand(10, size, size) > 0.6).astype(np.float32)
+    X = np.zeros((n, 1, size, size), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = rng.randint(0, 10)
+        img = protos[c].copy()
+        # small translation
+        dx, dy = rng.randint(-1, 2, 2)
+        img = np.roll(np.roll(img, dx, axis=0), dy, axis=1)
+        img += rng.randn(size, size).astype(np.float32) * noise
+        X[i, 0] = img
+        y[i] = c
+    return X, y
+
+
+def test_mlp_convergence():
+    """reference tests/python/train/test_mlp.py: MLP reaches >0.96 on
+    its training distribution."""
+    X, y = _synthetic_digits()
+    data = sym.Variable("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=10,
+                                               name="fc3"), name="softmax")
+    train = mx.io.NDArrayIter(X[:480], y[:480], batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X[480:], y[480:], batch_size=32)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=15, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            initializer=mx.initializer.Xavier())
+    val.reset()
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.93, "MLP val accuracy %f below threshold" % acc
+
+
+def test_lenet_convergence():
+    """reference tests/python/train/test_conv.py: LeNet-style conv net
+    above threshold; exercises Conv/Pool/BN through full fit."""
+    X, y = _synthetic_digits(n=480)
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=16, name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=10,
+                                               name="fc2"), name="softmax")
+    train = mx.io.NDArrayIter(X[:400], y[:400], batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X[400:], y[400:], batch_size=32)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.initializer.Xavier())
+    val.reset()
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.9, "LeNet val accuracy %f below threshold" % acc
+
+
+def test_gluon_imperative_convergence():
+    """Gluon Trainer imperative loop converges (reference straight-dope
+    style smoke; complements the hybridized tests in test_gluon.py)."""
+    from mxnet_tpu import autograd, gluon
+    X, y = _synthetic_digits(n=320)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=32, shuffle=True)
+    for epoch in range(10):
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+    correct = 0
+    for xb, yb in gluon.data.DataLoader(ds, batch_size=64):
+        correct += int((net(xb).asnumpy().argmax(1) ==
+                        yb.asnumpy()).sum())
+    acc = correct / len(ds)
+    assert acc > 0.9, "gluon accuracy %f below threshold" % acc

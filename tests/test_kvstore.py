@@ -1,0 +1,143 @@
+"""Local kvstore tests — ported subset of
+tests/python/unittest/test_kvstore.py (init/push/pull, list aggregation,
+updater, optimizer, compression, state save/load).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+SHAPE = (4, 4)
+
+
+def _check(nd_arr, expect):
+    np.testing.assert_allclose(nd_arr.asnumpy(), expect, rtol=1e-5)
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check(out, 1.0)
+    kv.push(3, nd.ones(SHAPE) * 4)
+    kv.pull(3, out=out)
+    _check(out, 4.0)
+
+
+def test_init_is_idempotent():
+    kv = mx.kv.create("local")
+    kv.init("a", nd.ones(SHAPE))
+    kv.init("a", nd.ones(SHAPE) * 7)  # second init ignored (reference)
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    _check(out, 1.0)
+
+
+def test_list_kv_pairs():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [nd.ones(SHAPE)] * 3)
+    kv.push(keys, [nd.ones(SHAPE) * (i + 1) for i in range(3)])
+    outs = [nd.zeros(SHAPE) for _ in range(3)]
+    kv.pull(keys, out=outs)
+    for i, o in enumerate(outs):
+        _check(o, i + 1.0)
+
+
+def test_aggregation_over_device_list():
+    """Per-key list push sums over 'devices' (reference
+    test_kvstore.py test_aggregator)."""
+    kv = mx.kv.create("device")
+    kv.init(3, nd.ones(SHAPE))
+    devs_vals = [nd.ones(SHAPE) for _ in range(4)]
+    kv.push(3, devs_vals)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check(out, 4.0)
+
+
+def test_updater_runs_on_push():
+    kv = mx.kv.create("local")
+    kv.set_updater(lambda key, recv, stored: stored.__iadd__(recv * 2))
+    kv.init("w", nd.zeros(SHAPE))
+    for _ in range(3):
+        kv.push("w", nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    _check(out, 6.0)
+
+
+def test_set_optimizer_sgd():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0,
+                                      rescale_grad=1.0))
+    kv.init(0, nd.ones(SHAPE))
+    kv.push(0, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    _check(out, 0.9)
+
+
+def test_gradient_compression_error_feedback():
+    """threshold=2: sub-threshold grads accumulate in the residual until
+    they cross it (reference test_kvstore.py compression tests)."""
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kv.init("g", nd.zeros(SHAPE))
+    kv.push("g", nd.ones(SHAPE) * 1.5)   # acc 1.5 -> q 0, residual 1.5
+    out = nd.zeros(SHAPE)
+    kv.pull("g", out=out)
+    _check(out, 0.0)
+    kv.push("g", nd.ones(SHAPE) * 1.0)   # acc 2.5 -> q +2, residual 0.5
+    kv.pull("g", out=out)
+    _check(out, 2.0)
+
+
+def test_optimizer_state_save_load(tmp_path):
+    """Updater state (Adam moments + counts) round-trips through
+    save/load_optimizer_states; the restored store continues the update
+    sequence identically (reference kvstore.py:save_optimizer_states)."""
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    kv.init("p", nd.ones(SHAPE))
+    for _ in range(3):
+        kv.push("p", nd.ones(SHAPE) * 0.5)
+    fname = str(tmp_path / "opt.states")
+    # dump_optimizer carries the per-index update counts (bias correction)
+    kv.save_optimizer_states(fname, dump_optimizer=True)
+    snapshot = kv._store["p"].asnumpy().copy()
+
+    kv2 = mx.kv.create("local")
+    kv2.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    kv2.init("p", nd.array(snapshot))
+    kv2.load_optimizer_states(fname)
+
+    # both apply the same 4th update from the same weight + state
+    kv.push("p", nd.ones(SHAPE) * 0.5)
+    kv2.push("p", nd.ones(SHAPE) * 0.5)
+    p1, p2 = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    kv.pull("p", out=p1)
+    kv2.pull("p", out=p2)
+    np.testing.assert_allclose(p1.asnumpy(), p2.asnumpy(), rtol=1e-6)
+
+
+def test_kvstore_type_and_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0 and kv.num_workers == 1
+    assert kv.get_num_dead_node() == 0
+    assert kv.is_recovery is False
+    kv.barrier()  # no-op single process
+
+
+def test_unknown_kvstore_type():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("bogus_store")
+
+
+def test_pull_uninitialized_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.pull("missing", out=nd.zeros(SHAPE))

@@ -1,0 +1,109 @@
+"""Metric tests — ported subset of tests/python/unittest/test_metric.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]],
+                             np.float32))
+    label = nd.array(np.array([1.0, 0.0, 0.0]))
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    np.testing.assert_allclose(value, 2.0 / 3.0)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_top_k_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array(np.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]],
+                             np.float32))
+    label = nd.array(np.array([2.0, 2.0]))
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], 0.5)
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = nd.array(np.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]],
+                             np.float32))
+    label = nd.array(np.array([1.0, 0.0, 0.0]))
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> precision .5 recall 1 -> f1 = 2/3
+    np.testing.assert_allclose(m.get()[1], 2.0 / 3.0, rtol=1e-6)
+
+
+def test_mae_mse_rmse():
+    pred = nd.array(np.array([[1.0], [3.0]], np.float32))
+    label = nd.array(np.array([[2.0], [1.0]], np.float32))
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    np.testing.assert_allclose(mae.get()[1], 1.5)
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    np.testing.assert_allclose(mse.get()[1], 2.5)
+    rmse = mx.metric.RMSE()
+    rmse.update([label], [pred])
+    np.testing.assert_allclose(rmse.get()[1], np.sqrt(2.5))
+
+
+def test_cross_entropy_and_perplexity():
+    pred = nd.array(np.array([[0.25, 0.75], [0.5, 0.5]], np.float32))
+    label = nd.array(np.array([1.0, 0.0]))
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    exp = -(np.log(0.75) + np.log(0.5)) / 2
+    np.testing.assert_allclose(ce.get()[1], exp, rtol=1e-6)
+    pp = mx.metric.Perplexity(ignore_label=None)
+    pp.update([label], [pred])
+    np.testing.assert_allclose(pp.get()[1], np.exp(exp), rtol=1e-6)
+
+
+def test_composite_metric():
+    m = mx.metric.CompositeEvalMetric()
+    m.add(mx.metric.Accuracy())
+    m.add(mx.metric.MAE())
+    pred = nd.array(np.array([[0.3, 0.7]], np.float32))
+    label = nd.array(np.array([1.0]))
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names[0]
+
+
+def test_custom_metric():
+    def my_metric(label, pred):
+        return float(np.abs(label - pred.argmax(axis=1)).sum())
+
+    m = mx.metric.CustomMetric(my_metric, name="mymetric")
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1]], np.float32))
+    label = nd.array(np.array([1.0, 1.0]))
+    m.update([label], [pred])
+    assert "mymetric" in m.get()[0]
+    # feval's scalar return counts as one instance (reference CustomMetric)
+    np.testing.assert_allclose(m.get()[1], 1.0)
+
+
+def test_metric_create_by_name():
+    assert isinstance(mx.metric.create("acc"), mx.metric.Accuracy)
+    assert isinstance(mx.metric.create("mse"), mx.metric.MSE)
+    comp = mx.metric.create(["acc", "mae"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def test_pearson_correlation():
+    m = mx.metric.PearsonCorrelation()
+    pred = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    label = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], 1.0, rtol=1e-6)
+
+
+def test_loss_metric():
+    m = mx.metric.Loss()
+    m.update(None, [nd.array(np.array([2.0, 4.0], np.float32))])
+    np.testing.assert_allclose(m.get()[1], 3.0)

@@ -1,0 +1,231 @@
+"""Module API tests — ported subset of
+tests/python/unittest/test_module.py: bind/rebind, set/get params,
+forward/backward, checkpoint round trips incl. optimizer state,
+BucketingModule, SequentialModule, input grads.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4, name="fc2"),
+                            name="softmax")
+    return net
+
+
+def _fit_data(n=96, d=6, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32) * 0.1
+    y = rng.randint(0, classes, n)
+    for i in range(n):
+        X[i, y[i]] += 1.0
+    return X, y.astype(np.float32)
+
+
+def test_module_bind_forward_backward():
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((4, 6))],
+                            label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+    mod.backward()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.update()
+    after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_module_input_grads():
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((4, 6))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (4, 6)
+    assert np.abs(ig.asnumpy()).sum() > 0
+
+
+def test_module_reshape():
+    """reference test_module.py test_module_reshape."""
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    w0 = mod.get_params()[0]["fc1_weight"].asnumpy()
+    mod.reshape(data_shapes=[("data", (10, 6))],
+                label_shapes=[("softmax_label", (10,))])
+    batch = mx.io.DataBatch(data=[nd.ones((10, 6))], label=[nd.zeros((10,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (10, 4)
+    # params survive the reshape
+    np.testing.assert_array_equal(
+        mod.get_params()[0]["fc1_weight"].asnumpy(), w0)
+
+
+def test_module_set_params_missing_and_extra():
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    arg0, aux0 = mod.get_params()
+    arg = dict(arg0)
+    aux = dict(aux0)
+    arg["bogus"] = nd.ones((1,))
+    with pytest.raises(mx.MXNetError):
+        mod.set_params(arg, aux, allow_extra=False)
+    mod.set_params(arg, aux, allow_extra=True)
+    del arg["bogus"], arg["fc1_bias"]
+    with pytest.raises(RuntimeError):
+        mod.set_params(arg, aux, allow_missing=False)
+    mod.set_params(arg, aux, allow_missing=True)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    """fit → save_checkpoint(+optimizer states) → Module.load → identical
+    predictions and resumable optimizer (reference test_module.py
+    test_module_save_load / model.py save_checkpoint)."""
+    X, y = _fit_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 4, save_optimizer_states=True)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0004.params")
+    assert os.path.exists(prefix + "-0004.states")
+
+    mod2 = mx.Module.load(prefix, 4, load_optimizer_states=True,
+                          context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (16, 6))],
+              label_shapes=[("softmax_label", (16,))])
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.2,
+                                          "momentum": 0.9})
+    eval_it = mx.io.NDArrayIter(X, y, batch_size=16)
+    p1 = mod.predict(eval_it).asnumpy()
+    eval_it.reset()
+    p2 = mod2.predict(eval_it).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+    # params byte-identical through the reference arg:/aux: format
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_array_equal(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_resume_training(tmp_path):
+    """fit(begin_epoch=N) resumes from a checkpoint (reference
+    base_module.py:461-469)."""
+    X, y = _fit_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    prefix = str(tmp_path / "res")
+    mod.fit(it, num_epoch=2, optimizer="adam",
+            initializer=mx.initializer.Xavier(),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    _, arg_params, aux_params = mx.model.load_checkpoint(prefix, 2)
+    mod2 = mx.Module(_mlp(), context=mx.cpu())
+    it.reset()
+    mod2.fit(it, num_epoch=6, begin_epoch=2, optimizer="adam",
+             arg_params=arg_params, aux_params=aux_params)
+    it.reset()
+    assert mod2.score(it, "acc")[0][1] > 0.9
+
+
+def test_module_score_predict_consistency():
+    X, y = _fit_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            initializer=mx.initializer.Xavier())
+    it.reset()
+    acc = mod.score(it, "acc")[0][1]
+    it.reset()
+    preds = mod.predict(it).asnumpy()
+    manual = (preds.argmax(axis=1) == y).mean()
+    np.testing.assert_allclose(acc, manual, rtol=1e-6)
+
+
+def test_bucketing_module_shared_params():
+    """Buckets share parameters; training one bucket moves the others
+    (reference test_module.py test_bucket_module + bucketing_module.py)."""
+    # shared fc over a bucket-length sum so the param shapes are
+    # identical across buckets (the BucketingModule invariant)
+    def gen_fixed(seq_len):
+        data = sym.Variable("data")
+        net = sym.sum(sym.Reshape(data, shape=(-1, seq_len, 2)), axis=1)
+        net = sym.FullyConnected(net, num_hidden=6, name="fc_shared")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(gen_fixed, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for key, width in ((8, 16), (4, 8), (8, 16)):
+        batch = mx.io.DataBatch(
+            data=[nd.array(rng.rand(4, width).astype(np.float32))],
+            label=[nd.array(np.zeros(4, np.float32))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (4, width))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    params = mod.get_params()[0]
+    assert "fc_shared_weight" in params
+
+
+def test_sequential_module():
+    net1 = sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                              name="fc1")
+    net2 = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc2"),
+        name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.Module(net1, label_names=[], context=mx.cpu()))
+    seq.add(mx.Module(net2, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((4, 6))], label=[nd.zeros((4,))])
+    seq.forward(batch, is_train=False)
+    assert seq.get_outputs()[0].shape == (4, 4)
+
+
+def test_module_multi_device_data_parallel():
+    """Module over several (virtual) devices slices the batch and syncs
+    grads — the DataParallelExecutorGroup path (executor_group.py)."""
+    X, y = _fit_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            initializer=mx.initializer.Xavier())
+    it.reset()
+    assert mod.score(it, "acc")[0][1] > 0.9
