@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Measure inference throughput of the model zoo (reference
+example/image-classification/benchmark_score.py).
+
+  python benchmark_score.py [--network resnet-50] [--batch-sizes 1,32]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def score(network, num_layers, dev, batch_size, image_shape=(3, 224, 224),
+          num_batches=10, dtype="float32"):
+    sym = models.get_symbol(network, num_classes=1000,
+                            num_layers=num_layers,
+                            image_shape=image_shape, dtype=dtype)
+    mod = mx.Module(sym, label_names=["softmax_label"], context=dev)
+    data_shape = (batch_size,) + image_shape
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=False)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    data = mx.nd.array(np.random.uniform(-1, 1, data_shape)
+                       .astype(np.float32))
+    batch = mx.io.DataBatch(data=[data], label=None)
+    for _ in range(3):  # warmup/compile
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+        mod.get_outputs()[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,resnet-50,vgg-16")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--dtype", type=str, default="float32")
+    parser.add_argument("--num-batches", type=int, default=10)
+    args = parser.parse_args()
+
+    dev = mx.tpu(0) if mx.num_tpus() else mx.cpu()
+    for net_spec in args.networks.split(","):
+        name, _, layers = net_spec.partition("-")
+        num_layers = int(layers) if layers else 0
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(name, num_layers, dev, b,
+                          num_batches=args.num_batches, dtype=args.dtype)
+            print("network: %s batch: %d  %.1f img/s" % (net_spec, b, speed))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,10 +78,25 @@ class SequentialModule(BaseModule):
             return
         assert self.binded
         for module in self._modules:
+            # each sub-module consumes only its own subset of the combined
+            # dict, so the others' names are always "extra" from its view
             module.init_params(initializer=initializer, arg_params=arg_params,
                                aux_params=aux_params,
                                allow_missing=allow_missing,
-                               force_init=force_init, allow_extra=allow_extra)
+                               force_init=force_init, allow_extra=True)
+        if not allow_extra and arg_params:
+            known = set()
+            for module in self._modules:
+                known.update(module._arg_params or {})
+                known.update(module._aux_params or {})
+            extra = [n for n in arg_params if n not in known]
+            extra += [n for n in (aux_params or {}) if n not in known]
+            if extra:
+                from ..base import MXNetError
+                raise MXNetError(
+                    "init_params got parameter(s) %s unknown to every "
+                    "sub-module (pass allow_extra=True to ignore)"
+                    % sorted(extra))
         self.params_initialized = True
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,

@@ -115,6 +115,17 @@ def register(reg_name):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("register expects a CustomOpProp subclass")
         _PROP_REGISTRY[reg_name] = prop_cls
+        # drop cached instances of any previous registration under this
+        # name (notebook/test re-registration must take effect) — both the
+        # prop instances and the jitted Custom callables that close over
+        # them
+        for key in [k for k in _PROP_CACHE if k[0] == reg_name]:
+            del _PROP_CACHE[key]
+        from .ndarray import dispatch as _dispatch
+        stale = [k for k in _dispatch._JIT_CACHE
+                 if k[0] == "Custom" and ("op_type", reg_name) in k[1]]
+        for key in stale:
+            del _dispatch._JIT_CACHE[key]
         return prop_cls
 
     return deco
@@ -125,12 +136,16 @@ def get_all_registered_operators():
 
 
 _PROP_CACHE = {}
+_PROP_CACHE_MAX = 256
 
 
 def _make_prop(attrs):
     """Instantiate (with memoization — each nd.Custom call consults this
     from out_count, kw ordering, and the op body) the prop registered
-    under attrs['op_type']."""
+    under attrs['op_type']. Props should treat infer_shape/infer_type as
+    pure: the instance is shared across calls with equal attrs (the
+    reference constructs one prop per op creation; per-call state belongs
+    in create_operator's CustomOp)."""
     op_type = attrs.get("op_type")
     if op_type is None:
         raise MXNetError("Custom op requires op_type=")
@@ -142,5 +157,7 @@ def _make_prop(attrs):
     prop = _PROP_CACHE.get(key)
     if prop is None:
         prop = _PROP_REGISTRY[op_type](**kwargs)
+        if len(_PROP_CACHE) >= _PROP_CACHE_MAX:
+            _PROP_CACHE.clear()
         _PROP_CACHE[key] = prop
     return prop

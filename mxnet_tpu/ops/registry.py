@@ -187,13 +187,17 @@ class OpDef:
         return out
 
     def ordered_kw_inputs(self, kw_inputs, attrs, n_positional=0):
-        """Order keyword tensor inputs of a variadic op. Positional args
-        fill the first ``n_positional`` slots of the declared order;
-        keyword names may not collide with them, may not be unknown, and
-        must fill the remaining slots contiguously — anything else would
-        silently bind tensors to the wrong arguments."""
-        order = (self.kw_input_order(attrs) if self.kw_input_order
-                 else sorted(kw_inputs))
+        """Order keyword tensor inputs of a variadic op. With a declared
+        ``kw_input_order`` (Custom), positional args fill the first
+        ``n_positional`` slots; keyword names may not collide with them,
+        may not be unknown, and must fill the remaining slots
+        contiguously — anything else would silently bind tensors to the
+        wrong arguments. Without a declared order (Concat, add_n, ...)
+        keyword tensors simply append after the positional ones in name
+        order (the pre-existing behavior; there are no names to check)."""
+        if self.kw_input_order is None:
+            return [kw_inputs[n] for n in sorted(kw_inputs)]
+        order = self.kw_input_order(attrs)
         unknown = set(kw_inputs) - set(order)
         if unknown:
             raise MXNetError("%s: unexpected tensor input(s) %s (expected "
