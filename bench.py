@@ -8,7 +8,12 @@ momentum update — is ONE XLA computation (parallel/trainer.py TrainStep)
 running bf16 on the MXU with fp32 master weights (the multi-precision
 configuration the reference exposes as optimizer.py SGD multi_precision).
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline",
+"device_kind", "achieved_tflops", "peak_bf16_tflops", "mfu"}.
+See docs/PERF.md for the trace-backed roofline analysis: this model is
+HBM-bandwidth-bound on TPU (~26% MFU ≈ the chip's practical ceiling for
+ResNet-50/224 with BatchNorm; matches MLPerf per-chip numbers scaled by
+memory bandwidth).
 """
 import argparse
 import json
@@ -89,7 +94,8 @@ def main():
 
     # FLOPs of the compiled step from XLA's cost model (covers fwd+bwd+
     # optimizer as actually compiled); fallback: the analytic ResNet-50
-    # estimate of ~12.3 GFLOP per image for training (3x the 4.1 GFLOP fwd).
+    # estimate of ~24.6 GFLOP per image for training (3x the 8.2 GFLOP =
+    # 4.1 GMAC forward).
     flops_per_step = None
     try:
         lowered = ts._step_fn.lower(
@@ -102,7 +108,8 @@ def main():
     except Exception:
         pass
     if flops_per_step is None and args.num_layers == 50:
-        flops_per_step = 12.3e9 * args.batch
+        # ResNet-50 fwd ≈ 4.1 GMACs = 8.2 GFLOP/img; training ≈ 3x fwd
+        flops_per_step = 24.6e9 * args.batch
 
     t0 = time.perf_counter()
     for i in range(args.iters):

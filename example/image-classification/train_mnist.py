@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Train MNIST classifiers (reference example/image-classification/
 train_mnist.py). Uses mx.io.MNISTIter when the idx files are present
-(--data-dir); with no dataset on disk, --synthetic 1 (default when files
-are absent) trains on generated digit-prototype data so the script runs
-in offline environments.
+under --data-dir; when they are absent the script automatically falls
+back to generated digit-prototype data so it runs in offline
+environments.
 """
 import argparse
 import os

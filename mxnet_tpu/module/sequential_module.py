@@ -84,12 +84,12 @@ class SequentialModule(BaseModule):
                                aux_params=aux_params,
                                allow_missing=allow_missing,
                                force_init=force_init, allow_extra=True)
-        if not allow_extra and arg_params:
+        if not allow_extra and (arg_params or aux_params):
             known = set()
             for module in self._modules:
                 known.update(module._arg_params or {})
                 known.update(module._aux_params or {})
-            extra = [n for n in arg_params if n not in known]
+            extra = [n for n in (arg_params or {}) if n not in known]
             extra += [n for n in (aux_params or {}) if n not in known]
             if extra:
                 from ..base import MXNetError

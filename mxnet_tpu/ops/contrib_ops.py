@@ -93,28 +93,47 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
     A = anchors.shape[0]
     v = jnp.asarray(variances, jnp.float32)
 
-    def one(lab):
+    def one(lab, cls_pred_one):
         gt_cls = lab[:, 0]
         gt_boxes = lab[:, 1:5]
         valid = gt_cls >= 0  # (M,)
         iou = _iou_matrix(anchors, gt_boxes)  # (A, M)
         iou = jnp.where(valid[None, :], iou, -1.0)
 
-        # stage 1: each valid gt claims its best anchor
+        # stage 1: each valid gt claims its best anchor (pad rows scatter
+        # out of bounds and are dropped — they must not clobber claims)
         best_anchor_per_gt = jnp.argmax(iou, axis=0)          # (M,)
+        scatter_idx = jnp.where(valid, best_anchor_per_gt, A)
         # stage 2: anchors claim their best gt if above threshold
         best_gt = jnp.argmax(iou, axis=1)                     # (A,)
         best_iou = jnp.max(iou, axis=1)                       # (A,)
         matched_gt = jnp.where(best_iou > overlap_threshold, best_gt, -1)
         # gt-claimed anchors override
         claimed = jnp.full((A,), -1, jnp.int32)
-        claimed = claimed.at[best_anchor_per_gt].set(
-            jnp.where(valid, jnp.arange(lab.shape[0]), -1).astype(jnp.int32))
+        claimed = claimed.at[scatter_idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
         matched = jnp.where(claimed >= 0, claimed, matched_gt)  # (A,)
 
         is_pos = matched >= 0
         mg = jnp.clip(matched, 0, lab.shape[0] - 1)
         cls_t = jnp.where(is_pos, gt_cls[mg] + 1.0, 0.0)
+
+        # hard-negative mining (reference multibox_target.cc NegativeMining):
+        # rank unmatched anchors by predicted non-background confidence,
+        # keep the hardest ratio*num_pos (>= minimum_negative_samples) as
+        # class-0 negatives, mark the rest ignore_label
+        if negative_mining_ratio > 0:
+            p = jax.nn.softmax(cls_pred_one, axis=0)  # (C, A)
+            neg_conf = 1.0 - p[0]
+            neg_conf = jnp.where(is_pos, -1.0, neg_conf)
+            neg_conf = jnp.where(neg_conf > negative_mining_thresh,
+                                 neg_conf, -1.0)
+            num_pos = is_pos.sum()
+            k = jnp.maximum(num_pos * negative_mining_ratio,
+                            minimum_negative_samples)
+            rank = jnp.argsort(jnp.argsort(-neg_conf))
+            is_neg = (~is_pos) & (neg_conf > 0) & (rank < k)
+            cls_t = jnp.where(is_pos | is_neg, cls_t, float(ignore_label))
 
         # encode offsets (SSD parameterization)
         acx = (anchors[:, 0] + anchors[:, 2]) / 2
@@ -136,7 +155,7 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
                           jnp.ones((A, 4), jnp.float32), 0.0)
         return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
     return loc_t, loc_m, cls_t
 
 
@@ -178,31 +197,34 @@ def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
         score = jnp.where(keep, score, 0.0)
 
         # NMS: suppression by any higher-scored overlapping box of the
-        # same class (or any class when force_suppress)
-        order = jnp.argsort(-score)
+        # same class (or any class when force_suppress). Only the static
+        # top-k ranks enter the k×k suppression matrix — boxes beyond
+        # nms_topk are dropped outright (reference nms_topk semantics),
+        # keeping the matrix O(k²) for SSD-scale anchor counts.
+        k = min(int(nms_topk), A) if nms_topk > 0 else A
+        order = jnp.argsort(-score)[:k]
         b_s = boxes[order]
         s_s = score[order]
         c_s = cls_id[order]
-        if nms_topk > 0:
-            live_rank = jnp.arange(A) < nms_topk
-        else:
-            live_rank = jnp.ones((A,), bool)
         iou = _iou_matrix(b_s, b_s)
-        higher = jnp.tril(jnp.ones((A, A), bool), k=-1)  # j < i: higher score
+        higher = jnp.tril(jnp.ones((k, k), bool), k=-1)  # j < i: higher score
         same_cls = (c_s[:, None] == c_s[None, :]) if not force_suppress \
-            else jnp.ones((A, A), bool)
-        valid_j = (c_s >= 0)[None, :] & live_rank[None, :]
+            else jnp.ones((k, k), bool)
 
         def nms_body(i, alive):
-            sup = (higher[i] & same_cls[i] & valid_j[0] & alive
+            sup = (higher[i] & same_cls[i] & (c_s >= 0) & alive
                    & (iou[i] > nms_threshold)).any()
-            keep_i = (c_s[i] >= 0) & live_rank[i] & ~sup
+            keep_i = (c_s[i] >= 0) & ~sup
             return alive.at[i].set(keep_i)
 
-        alive = jax.lax.fori_loop(0, A, nms_body,
-                                  jnp.zeros((A,), bool))
+        alive = jax.lax.fori_loop(0, k, nms_body, jnp.zeros((k,), bool))
         out_cls = jnp.where(alive, c_s.astype(jnp.float32), -1.0)
         out = jnp.concatenate([out_cls[:, None], s_s[:, None], b_s], axis=1)
+        if k < A:
+            pad = jnp.concatenate(
+                [jnp.full((A - k, 1), -1.0),
+                 jnp.zeros((A - k, 5), jnp.float32)], axis=1)
+            out = jnp.concatenate([out, pad], axis=0)
         return out
 
     return jax.vmap(one)(cls_prob, loc_pred)
@@ -252,6 +274,14 @@ def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
         alive = jax.lax.fori_loop(0, N, body, jnp.zeros((N,), bool))
         out = r_s.at[:, score_index].set(
             jnp.where(alive, r_s[:, score_index], -1.0))
+        if out_format != in_format:
+            if out_format == "corner":  # center -> corner (b_s already is)
+                out = out.at[:, cs:cs + 4].set(b_s)
+            else:  # corner -> center
+                x1, y1, x2, y2 = (b_s[:, 0], b_s[:, 1], b_s[:, 2], b_s[:, 3])
+                ctr = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2,
+                                 x2 - x1, y2 - y1], axis=1)
+                out = out.at[:, cs:cs + 4].set(ctr)
         return out
 
     out = jax.vmap(one)(flat)
