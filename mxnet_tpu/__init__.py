@@ -82,6 +82,9 @@ from . import gluon
 from . import recordio
 from . import image
 from . import operator
+from . import visualization
+from . import viz
+from . import predictor
 from . import profiler
 from . import monitor
 from .monitor import Monitor

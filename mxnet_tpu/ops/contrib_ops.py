@@ -123,16 +123,19 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
         # keep the hardest ratio*num_pos (>= minimum_negative_samples) as
         # class-0 negatives, mark the rest ignore_label
         if negative_mining_ratio > 0:
+            # eligibility: true negatives are anchors whose best IoU stays
+            # below negative_mining_thresh (reference multibox_target.cc);
+            # ranking is by predicted non-background confidence, so the
+            # requested count is always met when enough anchors exist
             p = jax.nn.softmax(cls_pred_one, axis=0)  # (C, A)
             neg_conf = 1.0 - p[0]
-            neg_conf = jnp.where(is_pos, -1.0, neg_conf)
-            neg_conf = jnp.where(neg_conf > negative_mining_thresh,
-                                 neg_conf, -1.0)
+            eligible = (~is_pos) & (best_iou < negative_mining_thresh)
+            score = jnp.where(eligible, neg_conf, -jnp.inf)
             num_pos = is_pos.sum()
             k = jnp.maximum(num_pos * negative_mining_ratio,
                             minimum_negative_samples)
-            rank = jnp.argsort(jnp.argsort(-neg_conf))
-            is_neg = (~is_pos) & (neg_conf > 0) & (rank < k)
+            rank = jnp.argsort(jnp.argsort(-score))
+            is_neg = eligible & (rank < k)
             cls_t = jnp.where(is_pos | is_neg, cls_t, float(ignore_label))
 
         # encode offsets (SSD parameterization)

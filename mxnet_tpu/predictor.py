@@ -1,0 +1,104 @@
+"""Predictor — the standalone inference entry.
+
+Reference parity: src/c_api/c_predict_api.cc (MXPredCreate /
+MXPredSetInput / MXPredForward / MXPredGetOutput — the deployment API
+the amalgamation build ships). TPU-native: one class that loads
+``prefix-symbol.json`` + ``prefix-%04d.params`` (or the raw
+json/params bytes, like the C API takes buffers), binds an
+inference-only executor, and runs jitted forwards. Reshape re-binds
+with the jit cache keyed on shape, mirroring MXPredReshape.
+
+Usage::
+
+    pred = mx.predictor.Predictor.load("model", epoch=9,
+                                       input_shapes={"data": (1, 3, 224, 224)})
+    out = pred.forward(data=batch)[0]        # numpy in, numpy out
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Inference-only bound model (see module docstring)."""
+
+    def __init__(self, symbol, arg_params, aux_params, input_shapes,
+                 ctx=None, dtype="float32"):
+        from .ndarray.ndarray import NDArray
+        self._ctx = ctx if ctx is not None else current_context()
+        self._symbol = symbol
+        self._input_names = list(input_shapes)
+        type_dict = {n: dtype for n in input_shapes} \
+            if dtype != "float32" else None
+        self._exe = symbol.simple_bind(ctx=self._ctx, grad_req="null",
+                                       type_dict=type_dict, **input_shapes)
+        missing = [n for n in self._exe.arg_dict
+                   if n not in arg_params and n not in input_shapes]
+        # training-only label inputs are ignored by eval forward; leave
+        # them zero (the reference deploys the same symbol by slicing off
+        # the loss, but SoftmaxOutput's forward is label-free anyway)
+        real_missing = [n for n in missing if not n.endswith("label")]
+        if real_missing:
+            raise MXNetError("params missing for %s" % real_missing)
+        self._exe.copy_params_from(
+            {k: v if isinstance(v, NDArray) else NDArray(_np.asarray(v))
+             for k, v in arg_params.items()},
+            {k: v if isinstance(v, NDArray) else NDArray(_np.asarray(v))
+             for k, v in (aux_params or {}).items()},
+            allow_extra_params=True)
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(prefix, epoch, input_shapes, ctx=None, dtype="float32"):
+        """Load ``prefix-symbol.json`` + ``prefix-%04d.params``
+        (MXPredCreate's file form)."""
+        from . import model as _model
+        sym, arg_params, aux_params = _model.load_checkpoint(prefix, epoch)
+        return Predictor(sym, arg_params, aux_params, input_shapes, ctx,
+                         dtype)
+
+    @staticmethod
+    def create(symbol_json, param_bytes, input_shapes, ctx=None,
+               dtype="float32"):
+        """Create from in-memory buffers (MXPredCreate's buffer form:
+        the json string and the serialized params blob)."""
+        import io as _io
+        from . import symbol as _sym
+        from .serialization import load_ndarray_bytes
+        sym = _sym.load_json(symbol_json)
+        saved = load_ndarray_bytes(param_bytes)
+        arg_params, aux_params = {}, {}
+        for k, v in saved.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        return Predictor(sym, arg_params, aux_params, input_shapes, ctx,
+                         dtype)
+
+    # ------------------------------------------------------------------
+    def forward(self, **inputs):
+        """Set inputs (numpy or NDArray), run forward, return a list of
+        host numpy outputs (MXPredSetInput + MXPredForward +
+        MXPredGetOutput in one call)."""
+        self._exe.forward(is_train=False, **inputs)
+        return [o.asnumpy() for o in self._exe.outputs]
+
+    def reshape(self, input_shapes):
+        """Re-bind for new input shapes, keeping params
+        (MXPredReshape)."""
+        return Predictor(self._symbol, self._arg_params, self._aux_params,
+                         input_shapes, self._ctx)
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["save_ndarray_file", "load_ndarray_file"]
+__all__ = ["save_ndarray_file", "load_ndarray_file", "load_ndarray_bytes"]
 
 _LIST_KEY = "__mx_list_%d"
 
@@ -39,3 +39,11 @@ def load_ndarray_file(fname):
                 out[int(k[len("__mx_list_"):])] = array(npz[k])
             return out
         return {k: array(npz[k]) for k in keys}
+
+
+def load_ndarray_bytes(buf):
+    """Load a serialized params blob from memory (the reference C predict
+    API takes the params file as a buffer; same .npz container here,
+    same list/dict semantics as load_ndarray_file)."""
+    import io as _io
+    return load_ndarray_file(_io.BytesIO(buf))

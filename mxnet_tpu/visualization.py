@@ -1,0 +1,181 @@
+"""Network visualization: print_summary and plot_network.
+
+Reference parity: python/mxnet/visualization.py (print_summary:47 — the
+Keras-style layer table with shapes and parameter counts;
+plot_network:196 — graphviz digraph). plot_network returns a
+``graphviz.Digraph`` when graphviz is importable and otherwise emits DOT
+text to a file (this image has no graphviz renderer; the DOT source is
+the portable artifact either way).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_info(symbol, shape):
+    """Per-node (name, op, out_shape, params, inputs) from the DAG."""
+    interior = {}
+    if shape:
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        args = symbol.list_arguments()
+        auxs = symbol.list_auxiliary_states()
+        arg_shape = dict(zip(args, arg_shapes))
+        arg_shape.update(zip(auxs, aux_shapes))
+        # per-node output shapes via the internals trick: eval each node
+        interior = _interior_shapes(symbol, shape)
+    else:
+        arg_shape = {}
+    rows = []
+    for node in symbol._topo():
+        if node.is_var:
+            continue
+        in_names = [inp.name for inp, _ in node.inputs]
+        params = 0
+        for inp, _ in node.inputs:
+            if inp.is_var and inp.name != "data" \
+                    and not inp.name.endswith("_label") \
+                    and inp.name in arg_shape and arg_shape[inp.name]:
+                n = 1
+                for s in arg_shape[inp.name]:
+                    n *= s
+                params += n
+        rows.append((node.name, node.op.name,
+                     interior.get(node.output_name(0)), params,
+                     [n for n in in_names
+                      if not (n.endswith("_weight") or n.endswith("_bias")
+                              or n.endswith("_gamma") or n.endswith("_beta")
+                              or n.endswith("_moving_mean")
+                              or n.endswith("_moving_var"))]))
+    return rows
+
+
+def _interior_shapes(symbol, shape):
+    """Shapes of every node output, by tap name (reference: the
+    get_internals().infer_shape trick)."""
+    internals = symbol.get_internals()
+    try:
+        _, out_shapes, _ = internals.infer_shape_partial(**shape)
+    except MXNetError:
+        return {}
+    return {name: tuple(s) for name, s in
+            zip(internals.list_outputs(), out_shapes) if s is not None}
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a Keras-style summary table (reference visualization.py:47).
+    ``shape``: dict of input shapes, e.g. {'data': (1, 3, 224, 224)}."""
+    rows = _node_info(symbol, shape)
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line += str(f)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(headers)
+    print("=" * line_length)
+    total = 0
+    for name, op, out_shape, params, prev in rows:
+        shape_str = str(out_shape) if out_shape else ""
+        print_row(["%s(%s)" % (name, op), shape_str, params,
+                   ",".join(prev)])
+        total += params
+        print("_" * line_length)
+    print("Total params: {:,}".format(total))
+    print("_" * line_length)
+    return total
+
+
+_OP_STYLE = {
+    "FullyConnected": "#fb8072", "Convolution": "#fb8072",
+    "Deconvolution": "#fb8072", "BatchNorm": "#bebada",
+    "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+    "Pooling": "#80b1d3", "Concat": "#fdb462", "Flatten": "#fdb462",
+    "Reshape": "#fdb462", "Softmax": "#fccde5",
+    "SoftmaxOutput": "#fccde5",
+}
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the symbol (reference
+    visualization.py:196). Returns a graphviz.Digraph if the graphviz
+    package is available; otherwise writes '<title>.dot' DOT source and
+    returns its path."""
+    interior = _interior_shapes(symbol, shape) if shape else {}
+    attrs = {"shape": "box", "fixedsize": "true", "width": "1.3",
+             "height": "0.8034", "style": "filled"}
+    attrs.update(node_attrs or {})
+
+    nodes = []
+    edges = []
+    hidden_suffixes = ("_weight", "_bias", "_gamma", "_beta",
+                       "_moving_mean", "_moving_var")
+    for node in symbol._topo():
+        if node.is_var:
+            if hide_weights and node.name.endswith(hidden_suffixes):
+                continue
+            nodes.append((node.name, node.name, "#8dd3c7"))
+            continue
+        label = node.op.name
+        if node.op.name in ("Convolution", "Pooling"):
+            k = node.attrs.get("kernel")
+            s = node.attrs.get("stride") or ""
+            label = "%s\n%s/%s" % (node.op.name, k, s)
+        elif node.op.name == "FullyConnected":
+            label = "FullyConnected\n%s" % node.attrs.get("num_hidden")
+        elif node.op.name == "Activation":
+            label = "Activation\n%s" % node.attrs.get("act_type")
+        color = _OP_STYLE.get(node.op.name, "#b3de69")
+        nodes.append((node.name, label, color))
+        for inp, oi in node.inputs:
+            if inp.is_var and hide_weights and \
+                    inp.name.endswith(hidden_suffixes):
+                continue
+            elabel = ""
+            if interior and not inp.is_var:
+                s = interior.get(inp.output_name(oi))
+                if s:
+                    elabel = "x".join(str(x) for x in s[1:])
+            edges.append((inp.name, node.name, elabel))
+
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        Digraph = None
+
+    if Digraph is not None:
+        dot = Digraph(name=title, format=save_format)
+        for name, label, color in nodes:
+            a = dict(attrs)
+            a["fillcolor"] = color
+            dot.node(name=name, label=label, **a)
+        for src, dst, elabel in edges:
+            dot.edge(src, dst, label=elabel,
+                     **{"dir": "back", "arrowtail": "open"})
+        return dot
+
+    lines = ["digraph %s {" % json.dumps(title)]
+    for name, label, color in nodes:
+        lines.append('  %s [label=%s, shape=box, style=filled, '
+                     'fillcolor="%s"];' % (json.dumps(name),
+                                           json.dumps(label), color))
+    for src, dst, elabel in edges:
+        lines.append('  %s -> %s [label=%s, dir=back, arrowtail=open];'
+                     % (json.dumps(src), json.dumps(dst),
+                        json.dumps(elabel)))
+    lines.append("}")
+    path = "%s.dot" % title
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path

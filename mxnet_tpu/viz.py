@@ -1,0 +1,2 @@
+"""mx.viz alias (the reference exposes visualization as mx.viz)."""
+from .visualization import print_summary, plot_network  # noqa: F401

@@ -1,0 +1,84 @@
+"""visualization + Predictor tests (reference visualization.py,
+c_predict_api.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _net():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.Flatten(net),
+                                               num_hidden=4, name="fc"),
+                            name="softmax")
+    return net
+
+
+def test_print_summary(capsys):
+    total = mx.viz.print_summary(_net(), shape={"data": (1, 1, 16, 16)})
+    out = capsys.readouterr().out
+    assert "conv1(Convolution)" in out
+    assert "(1, 8, 14, 14)" in out
+    # conv 80 + bn 32 (gamma/beta + moving stats) + fc 392*4+4
+    assert total == 80 + 32 + 392 * 4 + 4
+
+
+def test_plot_network(tmp_path):
+    out = mx.viz.plot_network(_net(), title=str(tmp_path / "net"),
+                              shape={"data": (1, 1, 16, 16)})
+    if isinstance(out, str):
+        src = open(out).read()
+    else:  # graphviz.Digraph
+        src = out.source
+    assert "conv1" in src and "->" in src
+
+
+def _train_and_save(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 1, 16, 16).astype(np.float32)
+    y = (rng.rand(32) > 0.5).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.Module(_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    it.reset()
+    return prefix, X, mod.predict(it).asnumpy()
+
+
+def test_predictor_file_and_buffer(tmp_path):
+    prefix, X, ref = _train_and_save(tmp_path)
+    pred = mx.predictor.Predictor.load(
+        prefix, 1, input_shapes={"data": (8, 1, 16, 16)})
+    np.testing.assert_allclose(pred.forward(data=X[:8])[0], ref[:8],
+                               rtol=1e-5)
+    assert pred.output_names == ["softmax_output"]
+    # buffer form (the C API's in-memory variant)
+    pred2 = mx.predictor.Predictor.create(
+        open(prefix + "-symbol.json").read(),
+        open(prefix + "-0001.params", "rb").read(),
+        {"data": (4, 1, 16, 16)})
+    np.testing.assert_allclose(pred2.forward(data=X[:4])[0], ref[:4],
+                               rtol=1e-4)
+    # MXPredReshape analog
+    pred3 = pred2.reshape({"data": (2, 1, 16, 16)})
+    np.testing.assert_allclose(pred3.forward(data=X[:2])[0], ref[:2],
+                               rtol=1e-4)
+
+
+def test_predictor_missing_params_raises(tmp_path):
+    prefix, X, ref = _train_and_save(tmp_path)
+    from mxnet_tpu import model as _model
+    s, arg_params, aux_params = _model.load_checkpoint(prefix, 1)
+    del arg_params["fc_weight"]
+    with pytest.raises(mx.MXNetError):
+        mx.predictor.Predictor(s, arg_params, aux_params,
+                               {"data": (1, 1, 16, 16)})
