@@ -84,6 +84,7 @@ from . import image
 from . import operator
 from . import visualization
 from . import viz
+from . import contrib
 from . import predictor
 from . import profiler
 from . import monitor

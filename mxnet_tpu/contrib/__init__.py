@@ -1,0 +1,8 @@
+"""mx.contrib — experimental subsystems (reference python/mxnet/contrib/).
+
+Present: ``quantization`` (INT8 post-training quantization). Control
+flow lives in ``mx.sym.contrib`` / ``mx.nd.contrib``; ONNX
+import/export is not implemented (the reference's contrib.onnx targets
+a serialization ecosystem outside this rebuild's scope).
+"""
+from . import quantization  # noqa: F401

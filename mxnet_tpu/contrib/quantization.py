@@ -1,0 +1,293 @@
+"""Post-training INT8 quantization.
+
+Reference parity: python/mxnet/contrib/quantization.py:412
+(quantize_model) over src/operator/quantization/. The transform walks
+the Symbol DAG and rewraps each Convolution/FullyConnected node as
+
+    quantize(data) -> quantized_op(int8xint8 -> int32)
+        -> requantize(calibrated range) -> dequantize
+
+so the heavy math runs int8 on the MXU while every surrounding op sees
+fp32 (the reference chains quantized ops more aggressively to skip
+intermediate dequantize/quantize pairs — a fusion XLA largely recovers
+by eliding the back-to-back rescales).
+
+Calibration modes (reference calib_mode):
+- 'none'   — requantize uses the per-batch actual int32 range,
+- 'naive'  — run calib batches through the fp32 net, record per-layer
+             output min/max, bake them in as requantize calib ranges,
+- 'entropy'— like 'naive' but pick per-layer thresholds minimizing the
+             KL divergence between the fp32 histogram and its quantized
+             projection (reference _LayerOutputMinMaxCollector /
+             _get_optimal_thresholds).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model", "quantize_symbol"]
+
+_QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
+                "FullyConnected": "_contrib_quantized_fully_connected"}
+
+
+def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
+                    calib_ranges=None, param_shapes=None):
+    """Rewrite ``sym`` with int8 conv/FC (see module docstring).
+    ``calib_ranges``: {node_name: (min, max)} output ranges from
+    calibration; nodes without a range requantize on the fly.
+    ``param_shapes``: {name: shape} stamped as ``__shape__`` on the
+    parameter variables — the quantize chain between a weight var and
+    its consumer blocks backward shape inference, so the shapes the
+    caller already knows (from arg_params) ride along explicitly."""
+    from ..symbol import Symbol
+    from ..symbol.symbol import _Node
+    from ..ops import registry as _reg
+
+    excluded = set(excluded_sym_names)
+    offline_params = set(offline_params)
+    calib_ranges = calib_ranges or {}
+    q_op = {k: _reg.get_op(v) for k, v in _QUANTIZABLE.items()}
+    op_quantize = _reg.get_op("_contrib_quantize")
+    op_requant = _reg.get_op("_contrib_requantize")
+    op_dequant = _reg.get_op("_contrib_dequantize")
+    op_min = _reg.get_op("min")
+    op_max = _reg.get_op("max")
+
+    mapping = {}  # id(old_node) -> new node
+
+    def _fp32_entry(entry):
+        node, oi = entry
+        return (mapping[id(node)], oi)
+
+    def _quantize_chain(entry, name):
+        """fp32 entry -> (q_entry, min_entry, max_entry) via online
+        min/max + quantize (reference inserts _contrib_quantize the same
+        way; ranges for activations are computed on the fly)."""
+        src = _fp32_entry(entry)
+        mn = _Node(op_min, name + "_min", {}, [src])
+        mx_ = _Node(op_max, name + "_max", {}, [src])
+        q = _Node(op_quantize, name + "_quantize", {"out_type": "int8"},
+                  [src, (mn, 0), (mx_, 0)])
+        return (q, 0), (q, 1), (q, 2)
+
+    param_shapes = param_shapes or {}
+    for node in sym._topo():
+        if node.is_var:
+            if node.name in param_shapes:
+                sa = dict(node.str_attrs)
+                sa["__shape__"] = str(tuple(param_shapes[node.name]))
+                mapping[id(node)] = _Node(None, node.name, {}, [], sa)
+            else:
+                mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(inp)], oi) for inp, oi in node.inputs]
+        if node.op.name not in _QUANTIZABLE or node.name in excluded \
+                or node.attrs.get("num_group", 1) != 1:
+            mapping[id(node)] = _Node(node.op, node.name, dict(node.attrs),
+                                      new_inputs, dict(node.str_attrs))
+            continue
+
+        # quantized replacement: quantize data online; weights come
+        # pre-quantized as int8 vars when listed in offline_params
+        # (reference quantize_model bakes them into qarg_params), else
+        # they quantize online like activations
+        data_q, data_min, data_max = _quantize_chain(node.inputs[0],
+                                                     node.name + "_data")
+        w_node = node.inputs[1][0]
+        if w_node.is_var and w_node.name in offline_params:
+            wshape = param_shapes.get(w_node.name)
+            qname = w_node.name + "_quantize"
+            sa = {"__dtype__": "int8"}
+            if wshape is not None:
+                sa["__shape__"] = str(tuple(wshape))
+            qw_var = _Node(None, qname, {}, [], sa)
+            lo_var = _Node(None, qname + "_min", {}, [],
+                           {"__shape__": "()"})
+            hi_var = _Node(None, qname + "_max", {}, [],
+                           {"__shape__": "()"})
+            w_q, w_min, w_max = (qw_var, 0), (lo_var, 0), (hi_var, 0)
+        else:
+            w_q, w_min, w_max = _quantize_chain(node.inputs[1],
+                                                node.name + "_weight")
+        attrs = {k: v for k, v in node.attrs.items()
+                 if k not in ("no_bias", "cudnn_tune", "cudnn_off",
+                              "workspace")}
+        attrs["no_bias"] = True
+        qnode = _Node(q_op[node.op.name], node.name + "_quantized", attrs,
+                      [data_q, w_q, data_min, data_max, w_min, w_max])
+        rq_attrs = {}
+        if node.name in calib_ranges:
+            lo, hi = calib_ranges[node.name]
+            rq_attrs = {"min_calib_range": float(lo),
+                        "max_calib_range": float(hi)}
+        rq = _Node(op_requant, node.name + "_requantize", rq_attrs,
+                   [(qnode, 0), (qnode, 1), (qnode, 2)])
+        dq = _Node(op_dequant, node.name + "_dequantize", {},
+                   [(rq, 0), (rq, 1), (rq, 2)])
+        out = dq
+        # re-apply the bias in fp32 (the reference folds it via
+        # quantized bias inputs; adding it post-dequantize is exact)
+        if not node.attrs.get("no_bias", False) and len(node.inputs) > 2:
+            add = _reg.get_op("broadcast_add")
+            bias_entry = _fp32_entry(node.inputs[2])
+            if node.op.name == "Convolution":
+                rs = _reg.get_op("reshape")
+                ndim = 4
+                bias_r = _Node(rs, node.name + "_bias_r",
+                               {"shape": (1, -1) + (1,) * (ndim - 2)},
+                               [bias_entry])
+                bias_entry = (bias_r, 0)
+            out = _Node(add, node.name + "_bias_add", {},
+                        [(dq, 0), bias_entry])
+        mapping[id(node)] = out
+
+    return Symbol([(mapping[id(n)], oi) for n, oi in sym._entries])
+
+
+def _collect_layer_outputs(sym, arg_params, aux_params, calib_data,
+                           data_names, label_names, max_batches, ctx,
+                           collect):
+    """Run fp32 forward over calib batches, feeding every targeted
+    node's output into ``collect(name, np_array)``."""
+    from .. import io as _io
+    from ..module import Module
+    wanted = {n.name for n in sym._topo()
+              if not n.is_var and n.op.name in _QUANTIZABLE}
+    mod = Module(sym, data_names=data_names,
+                 label_names=list(label_names or []), context=ctx)
+    provide_label = calib_data.provide_label if label_names else None
+    mod.bind(data_shapes=calib_data.provide_data,
+             label_shapes=provide_label, for_training=False)
+    mod.set_params(arg_params, aux_params)
+
+    def callback(name, arr):
+        base = name[:-len("_output")] if name.endswith("_output") else name
+        if base in wanted:
+            collect(base, arr.asnumpy())
+
+    mod.install_monitor(type("M", (), {"stat_helper": staticmethod(callback),
+                                       "monitor_all": False})())
+    calib_data.reset()
+    for i, batch in enumerate(calib_data):
+        if i >= max_batches:
+            break
+        mod.forward(batch, is_train=False)
+        for o in mod.get_outputs():
+            o.wait_to_read()
+    return wanted
+
+
+def _entropy_threshold(samples, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| (reference
+    _get_optimal_threshold, contrib/quantization.py)."""
+    arr = _np.abs(_np.concatenate([s.ravel() for s in samples]))
+    amax = float(arr.max()) if arr.size else 0.0
+    if amax == 0.0:
+        return 1e-8
+    hist, edges = _np.histogram(arr, bins=num_bins, range=(0, amax))
+    total = hist.sum()
+    best_kl, best_t = _np.inf, amax
+    # candidate thresholds sweep the top half of the histogram
+    for i in range(num_quantized_bins // 2, num_bins + 1,
+                   max(num_bins // 64, 1)):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(_np.float64).copy()
+        outliers = hist[i:].sum()
+        if p.size == 0 or p.sum() + outliers == 0:
+            continue
+        p[-1] += outliers
+        # project p onto num_quantized_bins then expand back
+        factor = p.size / num_quantized_bins
+        q = _np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = int((j + 1) * factor) or lo + 1
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+        pm = p / p.sum()
+        qm = q / q.sum() if q.sum() else q
+        mask = pm > 0
+        kl = float(_np.sum(_np.where(
+            mask & (qm > 0), pm * _np.log(_np.maximum(pm, 1e-30)
+                                          / _np.maximum(qm, 1e-30)), 0)))
+        kl += float(_np.sum(pm[mask & (qm <= 0)]))  # infinite-KL penalty
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    # guard against over-clipping on small calibration sets: never cut
+    # below the 99.5th percentile of observed magnitudes
+    return max(best_t, float(_np.percentile(arr, 99.5)))
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=(), calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging):
+    """Quantize a trained fp32 model (reference
+    contrib/quantization.py:412 quantize_model). Returns
+    (qsym, qarg_params, aux_params)."""
+    if quantized_dtype != "int8":
+        raise MXNetError("only quantized_dtype='int8' is supported")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be none/naive/entropy")
+
+    calib_ranges = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_mode=%s requires calib_data"
+                             % calib_mode)
+        batch_size = calib_data.provide_data[0].shape[0]
+        max_batches = ((num_calib_examples or batch_size) + batch_size - 1) \
+            // batch_size
+        stats = {}
+
+        def collect(name, arr):
+            stats.setdefault(name, []).append(arr)
+
+        _collect_layer_outputs(sym, arg_params, aux_params, calib_data,
+                               list(data_names), list(label_names or []),
+                               max_batches, ctx, collect)
+        for name, samples in stats.items():
+            if calib_mode == "naive":
+                t = max(abs(float(min(s.min() for s in samples))),
+                        abs(float(max(s.max() for s in samples))))
+            else:
+                t = _entropy_threshold(samples)
+            calib_ranges[name] = (-t, t)
+            logger.info("calibrated %s: |range|=%.4f (%s)", name, t,
+                        calib_mode)
+
+    # weights of quantizable nodes are quantized offline into qarg_params
+    # (reference quantize_params) so inference never re-quantizes them
+    offline = []
+    for node in sym._topo():
+        if not node.is_var and node.op.name in _QUANTIZABLE \
+                and node.name not in set(excluded_sym_names) \
+                and node.attrs.get("num_group", 1) == 1:
+            w = node.inputs[1][0]
+            if w.is_var and w.name in arg_params:
+                offline.append(w.name)
+
+    qsym = quantize_symbol(
+        sym, excluded_sym_names=excluded_sym_names,
+        offline_params=offline, calib_ranges=calib_ranges,
+        param_shapes={k: tuple(v.shape) for k, v in arg_params.items()})
+
+    from .. import ndarray as _nd
+    qarg_params = dict(arg_params)
+    for name in offline:
+        w = arg_params[name]
+        lo = _nd.array(_np.float32(float(w.asnumpy().min())))
+        hi = _nd.array(_np.float32(float(w.asnumpy().max())))
+        qw, qlo, qhi = _nd.quantize(w, lo, hi, out_type=quantized_dtype)
+        qarg_params[name + "_quantize"] = qw
+        qarg_params[name + "_quantize_min"] = qlo
+        qarg_params[name + "_quantize_max"] = qhi
+    return qsym, qarg_params, dict(aux_params or {})

@@ -1,0 +1,139 @@
+"""INT8 quantization operators.
+
+Reference parity: src/operator/quantization/ (quantize.cc,
+dequantize.cc, requantize.cc, quantized_conv.cc,
+quantized_fully_connected.cc, quantized_pooling.cc,
+quantized_flatten.cc). TPU-native: int8 tensors with explicit
+(min, max) range companions; quantized conv/FC accumulate in int32 via
+``preferred_element_type`` so the MXU runs the 8-bit multiplies. The
+range calculus matches the reference: int8 is symmetric around 0
+(scale = 127 / max|range|), int32 accumulators carry the product of the
+input scales.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_INT8_MAX = 127.0
+_INT32_MAX = 2147483647.0
+
+
+def _range_scale(min_r, max_r):
+    # symmetric int8 quantization (reference quantize.cc int8 branch)
+    abs_max = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return _INT8_MAX / jnp.maximum(abs_max, 1e-30)
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3)
+def quantize(data, min_range, max_range, *, out_type="int8"):
+    """fp32 -> int8 with the given range; returns (q, min, max)
+    (reference quantize.cc)."""
+    if out_type != "int8":
+        raise NotImplementedError("only int8 quantization is supported "
+                                  "(reference also has uint8)")
+    scale = _range_scale(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), -_INT8_MAX, _INT8_MAX)
+    abs_max = _INT8_MAX / scale
+    return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    """int8/int32 -> fp32 (reference dequantize.cc)."""
+    imax = _INT8_MAX if data.dtype == jnp.int8 else _INT32_MAX
+    abs_max = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (abs_max / imax)
+
+
+@register("_contrib_requantize", aliases=("requantize",), num_outputs=3)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8, rescaling into the calibrated range (reference
+    requantize.cc; with no calib range the actual range is used)."""
+    f32 = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / _INT32_MAX)
+    if min_calib_range is not None and max_calib_range is not None:
+        lo = jnp.asarray(min_calib_range, jnp.float32)
+        hi = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        hi = jnp.max(jnp.abs(f32))
+        lo = -hi
+    scale = _range_scale(lo, hi)
+    q = jnp.clip(jnp.rint(f32 * scale), -_INT8_MAX, _INT8_MAX)
+    abs_max = _INT8_MAX / scale
+    return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+
+
+def _in_scales(min_d, max_d, min_w, max_w):
+    sd = _range_scale(min_d, max_d)
+    sw = _range_scale(min_w, max_w)
+    # int32 accumulator range corresponds to INT32_MAX / (sd*sw)
+    abs_out = _INT32_MAX / (sd * sw)
+    return -abs_out.reshape(()), abs_out.reshape(())
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",),
+          num_outputs=3)
+def quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
+                   *, kernel, num_filter, stride=(), dilate=(), pad=(),
+                   num_group=1, no_bias=True, layout=None):
+    """int8 conv with int32 accumulation (reference quantized_conv.cc);
+    returns (int32 out, min_out, max_out)."""
+    nd_ = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data.ndim == 4 else ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    lo, hi = _in_scales(min_data, max_data, min_weight, max_weight)
+    return out, lo, hi
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), num_outputs=3)
+def quantized_fully_connected(data, weight, min_data, max_data, min_weight,
+                              max_weight, *, num_hidden, no_bias=True,
+                              flatten=True):
+    """int8 FC with int32 accumulation (reference
+    quantized_fully_connected.cc)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    lo, hi = _in_scales(min_data, max_data, min_weight, max_weight)
+    return out, lo, hi
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          num_outputs=3)
+def quantized_pooling(data, min_data, max_data, *, kernel, pool_type="max",
+                      stride=(), pad=(), global_pool=False,
+                      pooling_convention="valid"):
+    """int8 max/avg pooling — range passes through (reference
+    quantized_pooling.cc)."""
+    from .nn import pooling
+    out = pooling(data.astype(jnp.float32), kernel=kernel,
+                  pool_type=pool_type, stride=stride, pad=pad,
+                  global_pool=global_pool,
+                  pooling_convention=pooling_convention)
+    return out.astype(data.dtype), min_data.reshape(()), max_data.reshape(())
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          num_outputs=3)
+def quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), min_data.reshape(()),
+            max_data.reshape(()))

@@ -249,7 +249,9 @@ def _parse_attr_string(v, default):
         inner = s[1:-1].strip()
         if not inner:
             return ()
-        return tuple(_parse_attr_string(t, None) for t in inner.split(","))
+        # "(8,)" has a trailing comma — skip empty segments
+        return tuple(_parse_attr_string(t, None) for t in inner.split(",")
+                     if t.strip())
     try:
         return int(s)
     except ValueError:

@@ -32,6 +32,7 @@ class Predictor:
         from .ndarray.ndarray import NDArray
         self._ctx = ctx if ctx is not None else current_context()
         self._symbol = symbol
+        self._dtype = dtype
         self._input_names = list(input_shapes)
         type_dict = {n: dtype for n in input_shapes} \
             if dtype != "float32" else None
@@ -43,6 +44,8 @@ class Predictor:
         # them zero (the reference deploys the same symbol by slicing off
         # the loss, but SoftmaxOutput's forward is label-free anyway)
         real_missing = [n for n in missing if not n.endswith("label")]
+        real_missing += [n for n in self._exe.aux_dict
+                         if n not in (aux_params or {})]
         if real_missing:
             raise MXNetError("params missing for %s" % real_missing)
         self._exe.copy_params_from(
@@ -69,7 +72,6 @@ class Predictor:
                dtype="float32"):
         """Create from in-memory buffers (MXPredCreate's buffer form:
         the json string and the serialized params blob)."""
-        import io as _io
         from . import symbol as _sym
         from .serialization import load_ndarray_bytes
         sym = _sym.load_json(symbol_json)
@@ -94,10 +96,10 @@ class Predictor:
         return [o.asnumpy() for o in self._exe.outputs]
 
     def reshape(self, input_shapes):
-        """Re-bind for new input shapes, keeping params
+        """Re-bind for new input shapes, keeping params and dtype
         (MXPredReshape)."""
         return Predictor(self._symbol, self._arg_params, self._aux_params,
-                         input_shapes, self._ctx)
+                         input_shapes, self._ctx, self._dtype)
 
     @property
     def output_names(self):

@@ -34,9 +34,10 @@ def _node_info(symbol, shape):
         if node.is_var:
             continue
         in_names = [inp.name for inp, _ in node.inputs]
+        data_inputs = set(shape or {})
         params = 0
         for inp, _ in node.inputs:
-            if inp.is_var and inp.name != "data" \
+            if inp.is_var and inp.name not in data_inputs \
                     and not inp.name.endswith("_label") \
                     and inp.name in arg_shape and arg_shape[inp.name]:
                 n = 1

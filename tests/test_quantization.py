@@ -1,0 +1,125 @@
+"""INT8 quantization tests (reference
+tests/python/quantization/test_quantization.py subset)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(np.linspace(-3, 5, 64, dtype=np.float32).reshape(8, 8))
+    q, lo, hi = nd.quantize(x, nd.array(np.float32(-3)),
+                            nd.array(np.float32(5)))
+    assert q.dtype == np.int8
+    assert float(lo.asnumpy()) == -float(hi.asnumpy())
+    back = nd.dequantize(q, lo, hi)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                               atol=float(hi.asnumpy()) / 127 + 1e-6)
+
+
+def test_requantize_calibrated():
+    data = nd.array(np.array([[1000, -2000, 500]], np.int32))
+    lo = nd.array(np.float32(-1.0))
+    hi = nd.array(np.float32(1.0))
+    q, qlo, qhi = nd.requantize(data, lo, hi, min_calib_range=-1e-7,
+                                max_calib_range=1e-7)
+    # 1000/2^31 = 4.7e-7 etc. all exceed the 1e-7 calib range -> clip
+    assert set(np.abs(q.asnumpy()).ravel()) == {127}
+    np.testing.assert_allclose(float(qhi.asnumpy()), 1e-7, rtol=1e-5)
+
+
+def test_quantized_fully_connected_matches_fp32():
+    rng = np.random.RandomState(0)
+    data = rng.randn(4, 16).astype(np.float32)
+    w = (rng.randn(8, 16) * 0.2).astype(np.float32)
+    qd, dlo, dhi = nd.quantize(nd.array(data),
+                               nd.array(np.float32(data.min())),
+                               nd.array(np.float32(data.max())))
+    qw, wlo, whi = nd.quantize(nd.array(w), nd.array(np.float32(w.min())),
+                               nd.array(np.float32(w.max())))
+    out, olo, ohi = nd.quantized_fully_connected(qd, qw, dlo, dhi, wlo, whi,
+                                                 num_hidden=8)
+    assert out.dtype == np.int32
+    deq = nd.dequantize(out, olo, ohi).asnumpy()
+    ref = data @ w.T
+    assert np.abs(deq - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_quantized_conv_matches_fp32():
+    rng = np.random.RandomState(1)
+    data = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = (rng.randn(8, 4, 3, 3) * 0.3).astype(np.float32)
+    qd, dlo, dhi = nd.quantize(nd.array(data),
+                               nd.array(np.float32(data.min())),
+                               nd.array(np.float32(data.max())))
+    qw, wlo, whi = nd.quantize(nd.array(w), nd.array(np.float32(w.min())),
+                               nd.array(np.float32(w.max())))
+    out, olo, ohi = nd.quantized_conv(qd, qw, dlo, dhi, wlo, whi,
+                                      kernel=(3, 3), num_filter=8)
+    deq = nd.dequantize(out, olo, ohi).asnumpy()
+    from jax import lax
+    import jax.numpy as jnp
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(data), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)]))
+    assert np.abs(deq - ref).max() / np.abs(ref).max() < 0.05
+
+
+def _small_model():
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 1, 8, 8).astype(np.float32)
+    y = (rng.rand(64) > 0.5).astype(np.float32)
+    d = sym.Variable("data")
+    net = sym.Convolution(d, kernel=(3, 3), num_filter=8, name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Flatten(net), num_hidden=2, name="fc"),
+        name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="adam",
+            initializer=mx.initializer.Xavier())
+    return net, mod, it
+
+
+@pytest.mark.parametrize("mode", ["none", "naive", "entropy"])
+def test_quantize_model_agreement(mode):
+    net, mod, it = _small_model()
+    arg_p, aux_p = mod.get_params()
+    it.reset()
+    fp32_pred = mod.predict(it).asnumpy()
+    it.reset()
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg_p, aux_p, calib_mode=mode, calib_data=it,
+        num_calib_examples=32, ctx=mx.cpu())
+    qmod = mx.Module(qsym, context=mx.cpu())
+    qmod.bind(data_shapes=[("data", (16, 1, 8, 8))],
+              label_shapes=[("softmax_label", (16,))], for_training=False)
+    qmod.set_params(qarg, qaux, allow_extra=True)
+    it.reset()
+    qpred = qmod.predict(it).asnumpy()
+    agree = (qpred.argmax(1) == fp32_pred.argmax(1)).mean()
+    assert agree > 0.9, "calib_mode=%s agreement %.3f" % (mode, agree)
+
+
+def test_quantize_model_excluded_layers():
+    net, mod, it = _small_model()
+    arg_p, aux_p = mod.get_params()
+    qsym, _, _ = mx.contrib.quantization.quantize_model(
+        net, arg_p, aux_p, calib_mode="none",
+        excluded_sym_names=["conv1"], ctx=mx.cpu())
+    names = [n.name for n in qsym._topo() if not n.is_var]
+    assert "conv1" in names                 # excluded: untouched fp32 node
+    assert "fc_quantized" in names          # fc converted
+    assert not any(n == "conv1_quantized" for n in names)
+
+
+def test_quantize_model_rejects_bad_args():
+    net, mod, it = _small_model()
+    arg_p, aux_p = mod.get_params()
+    with pytest.raises(mx.MXNetError):
+        mx.contrib.quantization.quantize_model(
+            net, arg_p, aux_p, calib_mode="naive", calib_data=None)
+    with pytest.raises(mx.MXNetError):
+        mx.contrib.quantization.quantize_model(
+            net, arg_p, aux_p, calib_mode="bogus")
