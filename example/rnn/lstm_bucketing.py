@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""LSTM language model with BucketingModule (BASELINE config 4).
+
+Port of reference example/rnn/bucketing/lstm_bucketing.py. PTB cannot be
+downloaded offline, so by default the script trains on a generated
+template-grammar corpus (structured enough that the LM must learn real
+transition statistics); point --train-data at a tokenized text file to
+use real data.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def synthetic_corpus(n_sentences=600, seed=5):
+    rng = np.random.RandomState(seed)
+    subjects = ["cat", "dog", "bird", "horse"]
+    verbs = ["sees", "likes", "chases", "finds"]
+    objs = ["food", "toys", "water", "grass"]
+    adjs = ["big", "small", "red", "fast"]
+    sents = []
+    for _ in range(n_sentences):
+        s = ["<s>", rng.choice(subjects), rng.choice(verbs), "the"]
+        for _ in range(rng.randint(0, 4)):
+            s.append(rng.choice(adjs))
+        s += [rng.choice(objs), "</s>"]
+        sents.append(s)
+    return sents
+
+
+def tokenize_file(fname):
+    with open(fname) as f:
+        return [["<s>"] + line.split() + ["</s>"]
+                for line in f if line.strip()]
+
+
+def main():
+    parser = argparse.ArgumentParser(description="LSTM LM with bucketing")
+    parser.add_argument("--train-data", type=str, default=None)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--num-layers", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--buckets", type=str, default="6,8,10,12")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    sents = (tokenize_file(args.train_data) if args.train_data
+             else synthetic_corpus())
+    encoded, vocab = mx.rnn.encode_sentences(sents, invalid_label=0,
+                                             invalid_key="<pad>",
+                                             start_label=1)
+    vocab_size = len(vocab) + 1
+    buckets = [int(b) for b in args.buckets.split(",")]
+    train = mx.rnn.BucketSentenceIter(encoded, args.batch_size,
+                                      buckets=buckets, invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        # fused multi-layer LSTM over the bucket length (ops/rnn.py —
+        # one lax.scan; the cuDNN-RNN analog the reference's cells
+        # hand-unroll per bucket)
+        rnn_in = sym.transpose(embed, axes=(1, 0, 2))  # (T, N, C)
+        out = sym.RNN(rnn_in, mode="lstm", state_size=args.num_hidden,
+                      num_layers=args.num_layers, name="lstm")
+        out = sym.transpose(out, axes=(1, 0, 2))       # (N, T, C)
+        pred = sym.Reshape(out, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, lab, use_ignore=True,
+                                 ignore_label=0, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(sym_gen,
+                                   default_bucket_key=train.default_bucket_key,
+                                   context=mx.tpu(0) if mx.num_tpus()
+                                   else mx.cpu())
+    model.fit(train,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              kvstore=args.kv_store,
+              optimizer="adam",
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+              num_epoch=args.num_epochs,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         args.disp_batches))
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,7 @@ from . import operator
 from . import visualization
 from . import viz
 from . import contrib
+from . import rnn
 from . import predictor
 from . import profiler
 from . import monitor

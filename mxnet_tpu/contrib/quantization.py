@@ -137,7 +137,7 @@ def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
             bias_entry = _fp32_entry(node.inputs[2])
             if node.op.name == "Convolution":
                 rs = _reg.get_op("reshape")
-                ndim = 4
+                ndim = len(tuple(node.attrs["kernel"])) + 2
                 bias_r = _Node(rs, node.name + "_bias_r",
                                {"shape": (1, -1) + (1,) * (ndim - 2)},
                                [bias_entry])
@@ -165,10 +165,29 @@ def _collect_layer_outputs(sym, arg_params, aux_params, calib_data,
              label_shapes=provide_label, for_training=False)
     mod.set_params(arg_params, aux_params)
 
+    # the quantized graph requantizes the PRE-bias accumulator (bias is
+    # re-added after dequantize), so calibration must see bias-free
+    # outputs — subtract each node's bias from the tapped samples
+    biases = {}
+    for n in sym._topo():
+        if n.is_var or n.name not in wanted:
+            continue
+        if not n.attrs.get("no_bias", False) and len(n.inputs) > 2:
+            bname = n.inputs[2][0].name
+            if bname in arg_params:
+                b = arg_params[bname].asnumpy()
+                if n.op.name == "Convolution":
+                    nd_ = len(tuple(n.attrs["kernel"])) + 2
+                    b = b.reshape((1, -1) + (1,) * (nd_ - 2))
+                biases[n.name] = b
+
     def callback(name, arr):
         base = name[:-len("_output")] if name.endswith("_output") else name
         if base in wanted:
-            collect(base, arr.asnumpy())
+            sample = arr.asnumpy()
+            if base in biases:
+                sample = sample - biases[base]
+            collect(base, sample)
 
     mod.install_monitor(type("M", (), {"stat_helper": staticmethod(callback),
                                        "monitor_all": False})())
@@ -284,10 +303,13 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     qarg_params = dict(arg_params)
     for name in offline:
         w = arg_params[name]
-        lo = _nd.array(_np.float32(float(w.asnumpy().min())))
-        hi = _nd.array(_np.float32(float(w.asnumpy().max())))
+        wn = w.asnumpy()
+        lo = _nd.array(_np.float32(float(wn.min())))
+        hi = _nd.array(_np.float32(float(wn.max())))
         qw, qlo, qhi = _nd.quantize(w, lo, hi, out_type=quantized_dtype)
         qarg_params[name + "_quantize"] = qw
         qarg_params[name + "_quantize_min"] = qlo
         qarg_params[name + "_quantize_max"] = qhi
+        # the fp32 original is no longer an argument of qsym
+        del qarg_params[name]
     return qsym, qarg_params, dict(aux_params or {})

@@ -77,12 +77,22 @@ class Initializer:
             self._init_zero(desc, arr)
         elif name.endswith("_min") or name.endswith("_max"):
             self._init_zero(desc, arr)
+        elif name.endswith("_parameters"):
+            # the fused RNN op's packed 1-D parameter vector (ops/rnn.py,
+            # cuDNN packed-weight parity). Delegates to _init_default so
+            # Zero/Constant/FusedRNN keep their semantics; initializers
+            # whose structured rule needs >=2-D fan info (Xavier)
+            # override _init_rnn_packed with a small-uniform fallback
+            self._init_rnn_packed(desc, arr)
         else:
             self._init_default(desc, arr)
 
     # -- rules ----------------------------------------------------------
     def _init_weight(self, name, arr):
         raise NotImplementedError
+
+    def _init_rnn_packed(self, name, arr):
+        self._init_default(name, arr)
 
     def _init_bias(self, name, arr):
         arr[:] = 0.0
@@ -163,6 +173,12 @@ class Xavier(Initializer):
         self.rnd_type = rnd_type
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
+
+    def _init_rnn_packed(self, name, arr):
+        # the packed 1-D fused-RNN vector has no fan structure for the
+        # Xavier rule; small uniform matches the reference examples'
+        # default for raw RNN params
+        arr[:] = _np.random.uniform(-0.07, 0.07, arr.shape)
 
     def _init_weight(self, name, arr):
         shape = arr.shape

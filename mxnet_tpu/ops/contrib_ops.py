@@ -190,11 +190,14 @@ def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
         boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
-        # best non-background class per anchor
+        # best non-background class per anchor; reported ids are 0-based
+        # object classes (channel index minus the background slot,
+        # reference multibox_detection.cc class_id = j - 1)
         pr = probs.T  # (A, C)
         masked = pr.at[:, background_id].set(-1.0)
-        cls_id = jnp.argmax(masked, axis=1)
+        chan = jnp.argmax(masked, axis=1)
         score = jnp.max(masked, axis=1)
+        cls_id = chan - (chan > background_id).astype(chan.dtype)
         keep = score > threshold
         cls_id = jnp.where(keep, cls_id, -1)
         score = jnp.where(keep, score, 0.0)

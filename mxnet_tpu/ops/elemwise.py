@@ -149,12 +149,41 @@ _defunary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
 _defunary("gammaln", jax.scipy.special.gammaln)
 _defunary("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype))
 _defunary("identity", lambda x: x, aliases=("_copy", "stop_gradient_off"))
-_defunary("make_loss", lambda x: x, aliases=("MakeLoss",))
+# make_loss is registered below with its real gradient contract
 _defunary("zeros_like", jnp.zeros_like)
 _defunary("ones_like", jnp.ones_like)
 _defunary("isnan", lambda x: jnp.isnan(x).astype("float32"))
 _defunary("isinf", lambda x: jnp.isinf(x).astype("float32"))
 _defunary("isfinite", lambda x: jnp.isfinite(x).astype("float32"))
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null"):
+    """Loss head: identity forward; the backward seeds grad_scale into
+    the graph regardless of head gradients (reference
+    src/operator/make_loss.cc, incl. 'batch'/'valid' normalization)."""
+    import jax
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, x
+
+    def f_bwd(x, g):
+        scale = jnp.asarray(grad_scale, jnp.float32)
+        if normalization == "batch":
+            scale = scale / x.shape[0]
+        elif normalization == "valid":
+            valid = jnp.maximum((jnp.abs(x) > valid_thresh)
+                                .sum().astype(jnp.float32), 1.0)
+            scale = scale / valid
+        return (jnp.full(x.shape, 1.0, x.dtype) * scale.astype(x.dtype),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data)
 
 
 @register("BlockGrad", aliases=("stop_gradient",))

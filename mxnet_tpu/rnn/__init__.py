@@ -1,0 +1,11 @@
+"""mx.rnn — symbol-side RNN utilities (reference python/mxnet/rnn/).
+
+The reference package carries symbol RNN cells plus BucketSentenceIter.
+Cells live in ``mx.gluon.rnn`` here (the imperative-first home); the
+symbol path uses the fused ``sym.RNN`` op directly (ops/rnn.py — one
+lax.scan per graph, the cuDNN-RNN analog). This package provides the
+data-side parity surface: BucketSentenceIter and encode_sentences.
+"""
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BucketSentenceIter", "encode_sentences"]

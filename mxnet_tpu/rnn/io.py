@@ -1,0 +1,133 @@
+"""BucketSentenceIter — bucketed language-model batches.
+
+Reference parity: python/mxnet/rnn/io.py (BucketSentenceIter:
+sentences assigned to the smallest bucket that fits, padded there,
+batched per bucket with ``bucket_key`` so BucketingModule picks the
+right executor; labels are the inputs shifted by one).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter", "encode_sentences"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\\n", start_label=0):
+    """Map token sequences to int ids, building the vocab on the fly
+    (reference rnn/io.py encode_sentences)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                if not new_vocab:
+                    raise ValueError("unknown token %s" % word)
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over encoded sentences (see module docstring)."""
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            counts = _np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(counts)
+                       if n >= batch_size]
+        buckets = sorted(buckets)
+        if not buckets:
+            raise MXNetError("no usable buckets for this corpus")
+
+        self.data = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            buck = _np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = _np.full((buckets[buck],), invalid_label, _np.float32)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        self.data = [_np.asarray(x, _np.float32).reshape(-1, b)
+                     for x, b in zip(self.data, buckets)]
+        if ndiscard:
+            import logging
+            logging.warning("discarded %d sentences longer than the "
+                            "largest bucket", ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size, self.default_bucket_key),
+                                      dtype, layout=layout)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, self.default_bucket_key),
+                                       dtype, layout=layout)]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend((i, j) for j in
+                            range(0, len(buck) - batch_size + 1, batch_size))
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        _pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            _np.random.shuffle(buck)
+        # label = input shifted left by one (next-token prediction)
+        self.ndlabel = []
+        self.nddata = []
+        for buck in self.data:
+            label = _np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(buck)
+            self.ndlabel.append(label)
+
+    def next(self):
+        from .. import ndarray as nd
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.nddata[i][j:j + self.batch_size]
+        label = self.ndlabel[i][j:j + self.batch_size]
+        key = self.buckets[i]
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         bucket_key=key, pad=0,
+                         provide_data=[DataDesc(self.data_name,
+                                                (self.batch_size, key),
+                                                self.dtype,
+                                                layout=self.layout)],
+                         provide_label=[DataDesc(self.label_name,
+                                                 (self.batch_size, key),
+                                                 self.dtype,
+                                                 layout=self.layout)])

@@ -95,7 +95,7 @@ def test_quantize_model_agreement(mode):
     qmod = mx.Module(qsym, context=mx.cpu())
     qmod.bind(data_shapes=[("data", (16, 1, 8, 8))],
               label_shapes=[("softmax_label", (16,))], for_training=False)
-    qmod.set_params(qarg, qaux, allow_extra=True)
+    qmod.set_params(qarg, qaux)
     it.reset()
     qpred = qmod.predict(it).asnumpy()
     agree = (qpred.argmax(1) == fp32_pred.argmax(1)).mean()
