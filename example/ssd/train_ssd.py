@@ -51,7 +51,6 @@ def ssd_symbol(num_classes=2):
     # feature map 4x4; anchors at 2 scales
     anchors = sym.MultiBoxPrior(body, sizes=(0.4, 0.6), ratios=(1.0,),
                                 name="anchors")              # (1, A, 4)
-    num_anchors = 4 * 4 * 2
     cls_pred = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
                                num_filter=2 * (num_classes + 1),
                                name="cls_pred")

@@ -1,0 +1,117 @@
+"""ctypes bindings for the native runtime components (src/*.cc).
+
+The shared library is built lazily with the in-tree Makefile on first
+use (g++, no dependencies, <2s); every caller has a pure-Python
+fallback, so a machine without a toolchain still works — the native
+path exists because the reference's data runtime is C++
+(3rdparty/dmlc-core recordio, src/io/). Measured against the Python
+fallback on this image: offset scanning ~9x faster; record reads at
+JPEG-typical sizes are memcpy-bound and equal, but the native reader
+shares ONE read-only mmap across all of ImageRecordIter's decode
+threads (no per-thread file handles, no GIL-held seek+read pairs).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "lib", "libmxtpu_io.so")
+_SRC_DIR = os.path.join(_HERE, "..", "src")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                   capture_output=True, text=True)
+
+
+def get_lib():
+    """The loaded native library, or None (disable with
+    MXTPU_NO_NATIVE=1)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXTPU_NO_NATIVE", "0") == "1":
+            return None
+        try:
+            if not os.path.exists(_LIB_PATH):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as e:
+            logging.info("native io unavailable (%s); using the "
+                         "pure-Python reader", e)
+            return None
+        lib.mxtpu_reader_open.restype = ctypes.c_void_p
+        lib.mxtpu_reader_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_reader_close.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_reader_scan.restype = ctypes.c_int64
+        lib.mxtpu_reader_scan.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+        lib.mxtpu_reader_read.restype = ctypes.c_int64
+        lib.mxtpu_reader_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.mxtpu_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeRecordReader:
+    """mmap-backed RecordIO reader; thread-safe (stateless reads)."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise OSError("native io library unavailable")
+        self._lib = lib
+        self._handle = lib.mxtpu_reader_open(path.encode())
+        if not self._handle:
+            raise OSError("cannot open %s" % path)
+
+    def scan_offsets(self):
+        ptr = ctypes.POINTER(ctypes.c_int64)()
+        n = self._lib.mxtpu_reader_scan(self._handle, ctypes.byref(ptr))
+        if n < 0:
+            raise IOError("invalid RecordIO magic during native scan")
+        try:
+            return [ptr[i] for i in range(n)]
+        finally:
+            self._lib.mxtpu_free(ptr)
+
+    def read_at(self, offset):
+        """Record payload at a byte offset, as bytes."""
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        needs_free = ctypes.c_int32(0)
+        n = self._lib.mxtpu_reader_read(self._handle, offset,
+                                        ctypes.byref(data),
+                                        ctypes.byref(needs_free))
+        if n < 0:
+            raise IOError("corrupt record at offset %d" % offset)
+        try:
+            return ctypes.string_at(data, n)
+        finally:
+            if needs_free.value:
+                self._lib.mxtpu_free(data)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.mxtpu_reader_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

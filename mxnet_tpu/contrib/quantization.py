@@ -284,15 +284,28 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                         calib_mode)
 
     # weights of quantizable nodes are quantized offline into qarg_params
-    # (reference quantize_params) so inference never re-quantizes them
-    offline = []
+    # (reference quantize_params) so inference never re-quantizes them.
+    # A weight shared with any non-quantized consumer must keep its fp32
+    # var (that consumer still reads it), so it stays on the online path.
+    excluded_set = set(excluded_sym_names)
+
+    def _is_quantized_node(n):
+        return (not n.is_var and n.op.name in _QUANTIZABLE
+                and n.name not in excluded_set
+                and n.attrs.get("num_group", 1) == 1)
+
+    candidates, shared_fp32 = set(), set()
     for node in sym._topo():
-        if not node.is_var and node.op.name in _QUANTIZABLE \
-                and node.name not in set(excluded_sym_names) \
-                and node.attrs.get("num_group", 1) == 1:
-            w = node.inputs[1][0]
-            if w.is_var and w.name in arg_params:
-                offline.append(w.name)
+        if node.is_var:
+            continue
+        for pos, (inp, _) in enumerate(node.inputs):
+            if not (inp.is_var and inp.name in arg_params):
+                continue
+            if _is_quantized_node(node) and pos == 1:
+                candidates.add(inp.name)
+            else:
+                shared_fp32.add(inp.name)
+    offline = sorted(candidates - shared_fp32)
 
     qsym = quantize_symbol(
         sym, excluded_sym_names=excluded_sym_names,

@@ -112,12 +112,24 @@ class ImageRecordIter:
         std = np.array([std_r, std_g, std_b][:c], np.float32)
         self._std = std if np.any(std != 1.0) else None
 
+        # native mmap reader when available (src/recordio.cc): one shared
+        # zero-copy mapping across the decode threads; falls back to the
+        # pure-Python per-thread file readers
+        self._native = None
+        try:
+            from .._native import NativeRecordReader
+            self._native = NativeRecordReader(path_imgrec)
+        except OSError:
+            pass
+
         # index the .rec so shuffle/partition never needs a separate pass
-        from ..recordio import MXIndexedRecordIO, MXRecordIO
+        from ..recordio import MXIndexedRecordIO
         if path_imgidx:
             rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             offsets = [rec.idx[k] for k in rec.keys]
             rec.close()
+        elif self._native is not None:
+            offsets = self._native.scan_offsets()
         else:
             offsets = _scan_offsets(path_imgrec)
         n = len(offsets) // num_parts if num_parts > 1 else len(offsets)
@@ -150,7 +162,11 @@ class ImageRecordIter:
         return fp
 
     def _read_at(self, offset):
-        """Read one record's payload at a byte offset (thread-local fp)."""
+        """Read one record's payload at a byte offset (native mmap or
+        thread-local fp)."""
+        native = self._native
+        if native is not None:
+            return native.read_at(offset)
         fp = self._reader()
         fp.seek(offset)
         parts = []
@@ -302,7 +318,21 @@ class ImageRecordIter:
     def close(self):
         if self._epoch_stop is not None:
             self._epoch_stop.set()
-        self._pool.shutdown(wait=False)
+        if self._queue is not None:
+            # unblock a producer waiting on a full queue so it can exit
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+        if self._producer is not None and self._producer.is_alive():
+            self._producer.join(timeout=10)
+        # wait for in-flight reads before munmapping the native mapping —
+        # a worker mid-read on an unmapped page would SIGSEGV
+        self._pool.shutdown(wait=True)
+        if self._native is not None:
+            self._native.close()
+            self._native = None
 
 
 def _truthy(v):

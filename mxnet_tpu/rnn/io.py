@@ -18,7 +18,7 @@ __all__ = ["BucketSentenceIter", "encode_sentences"]
 
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
-                     invalid_key="\\n", start_label=0):
+                     invalid_key="\n", start_label=0):
     """Map token sequences to int ids, building the vocab on the fly
     (reference rnn/io.py encode_sentences)."""
     idx = start_label
@@ -65,10 +65,11 @@ class BucketSentenceIter(DataIter):
             if buck == len(buckets):
                 ndiscard += 1
                 continue
-            buff = _np.full((buckets[buck],), invalid_label, _np.float32)
+            buff = _np.full((buckets[buck],), invalid_label,
+                            _np.dtype(dtype))
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [_np.asarray(x, _np.float32).reshape(-1, b)
+        self.data = [_np.asarray(x, _np.dtype(dtype)).reshape(-1, b)
                      for x, b in zip(self.data, buckets)]
         if ndiscard:
             import logging

@@ -1,0 +1,88 @@
+"""Native C++ RecordIO reader tests (src/recordio.cc via
+mxnet_tpu/_native.py) — scan/read parity with the pure-Python reader,
+incl. multipart records and the ImageRecordIter integration."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu._native import NativeRecordReader, get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native io unavailable")
+
+
+def _write_rec(path, records):
+    rec = recordio.MXRecordIO(path, "w")
+    for r in records:
+        rec.write(r)
+    rec.close()
+
+
+def test_native_scan_and_read_parity(tmp_path):
+    path = str(tmp_path / "t.rec")
+    records = [b"x" * n for n in (1, 2, 3, 4, 5, 1023, 64)]
+    _write_rec(path, records)
+    # python offsets via the python scanner
+    from mxnet_tpu.image.record_iter import _scan_offsets
+    py_offs = _scan_offsets(path)
+    r = NativeRecordReader(path)
+    assert r.scan_offsets() == py_offs
+    for off, expected in zip(py_offs, records):
+        assert r.read_at(off) == expected
+    r.close()
+
+
+def test_native_multipart_record(tmp_path):
+    """Force a multipart record by writing chunks with continue flags."""
+    path = str(tmp_path / "mp.rec")
+    magic = 0xCED7230A
+    parts = [b"a" * 10, b"b" * 7, b"c" * 3]
+    with open(path, "wb") as f:
+        for i, p in enumerate(parts):
+            cflag = 1 if i == 0 else (3 if i == len(parts) - 1 else 2)
+            f.write(struct.pack("<II", magic, (cflag << 29) | len(p)))
+            f.write(p)
+            f.write(b"\x00" * ((-len(p)) % 4))
+        # plus one normal record after
+        f.write(struct.pack("<II", magic, 5))
+        f.write(b"hello\x00\x00\x00")
+    r = NativeRecordReader(path)
+    offs = r.scan_offsets()
+    assert len(offs) == 2
+    assert r.read_at(offs[0]) == b"".join(parts)
+    assert r.read_at(offs[1]) == b"hello"
+    r.close()
+
+
+def test_native_corrupt_magic(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 16)
+    r = NativeRecordReader(path)
+    with pytest.raises(IOError):
+        r.scan_offsets()
+    r.close()
+
+
+def test_image_record_iter_uses_native(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    recp = str(tmp_path / "d.rec")
+    rec = recordio.MXRecordIO(recp, "w")
+    for i in range(12):
+        img = rng.randint(0, 255, (20, 20, 3)).astype(np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=recp, data_shape=(3, 16, 16),
+                               batch_size=4, preprocess_threads=2)
+    assert it._native is not None  # the native mmap reader is active
+    n = sum(b.data[0].shape[0] - (b.pad or 0) for b in it)
+    assert n == 12
+    it.close()
