@@ -45,8 +45,15 @@ def get_lib():
         if os.environ.get("MXTPU_NO_NATIVE", "0") == "1":
             return None
         try:
-            if not os.path.exists(_LIB_PATH):
+            # always run make: it no-ops when the .so is newer than the
+            # sources, and rebuilds after a source update (a stale binary
+            # silently resurrecting fixed bugs is worse than a 2s build).
+            # An existing .so still loads if the toolchain is gone.
+            try:
                 _build()
+            except Exception:
+                if not os.path.exists(_LIB_PATH):
+                    raise
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception as e:
             logging.info("native io unavailable (%s); using the "
@@ -84,9 +91,12 @@ class NativeRecordReader:
         ptr = ctypes.POINTER(ctypes.c_int64)()
         n = self._lib.mxtpu_reader_scan(self._handle, ctypes.byref(ptr))
         if n < 0:
-            raise IOError("invalid RecordIO magic during native scan")
+            raise IOError("invalid RecordIO magic (or out of memory) "
+                          "during native scan")
         try:
-            return [ptr[i] for i in range(n)]
+            import numpy as _np
+            return _np.ctypeslib.as_array(ptr, shape=(n,)).tolist() \
+                if n else []
         finally:
             self._lib.mxtpu_free(ptr)
 

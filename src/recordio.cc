@@ -83,6 +83,7 @@ int64_t mxtpu_reader_scan(void* handle, int64_t** offsets_out) {
   Reader* r = static_cast<Reader*>(handle);
   int64_t cap = 1024, n = 0;
   int64_t* offs = static_cast<int64_t*>(std::malloc(cap * sizeof(int64_t)));
+  if (!offs) return -1;
   int64_t pos = 0;
   bool pending = false;
   while (pos + 8 <= r->size) {
@@ -97,8 +98,13 @@ int64_t mxtpu_reader_scan(void* handle, int64_t** offsets_out) {
     if (!pending) {
       if (n == cap) {
         cap *= 2;
-        offs = static_cast<int64_t*>(
+        int64_t* grown = static_cast<int64_t*>(
             std::realloc(offs, cap * sizeof(int64_t)));
+        if (!grown) {
+          std::free(offs);
+          return -1;
+        }
+        offs = grown;
       }
       offs[n++] = pos;
     }
@@ -140,6 +146,7 @@ int64_t mxtpu_reader_read(void* handle, int64_t offset,
     if (cf == 0 || cf == 3) break;
   }
   uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+  if (!buf) return -1;
   int64_t w = 0;
   p = pos;
   while (true) {
