@@ -27,9 +27,37 @@ _lib = None
 _tried = False
 
 
+def _stale():
+    """True when the .so is missing or older than the native sources."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_m = os.path.getmtime(_LIB_PATH)
+    for fname in os.listdir(_SRC_DIR):
+        if fname.endswith((".cc", ".h")) or fname == "Makefile":
+            if os.path.getmtime(os.path.join(_SRC_DIR, fname)) > so_m:
+                return True
+    return False
+
+
 def _build():
-    subprocess.run(["make", "-C", _SRC_DIR], check=True,
-                   capture_output=True, text=True)
+    """Build under an inter-process lock, compiling to a temp name and
+    renaming atomically — concurrent dataloader processes must never
+    dlopen a half-written .so."""
+    import fcntl
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    lock_path = _LIB_PATH + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if not _stale():  # another process built it while we waited
+                return
+            tmp = "%s.tmp.%d" % (_LIB_PATH, os.getpid())
+            subprocess.run(
+                ["make", "-C", _SRC_DIR, "LIB=%s" % os.path.abspath(tmp)],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB_PATH)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def get_lib():
@@ -45,15 +73,16 @@ def get_lib():
         if os.environ.get("MXTPU_NO_NATIVE", "0") == "1":
             return None
         try:
-            # always run make: it no-ops when the .so is newer than the
-            # sources, and rebuilds after a source update (a stale binary
-            # silently resurrecting fixed bugs is worse than a 2s build).
-            # An existing .so still loads if the toolchain is gone.
-            try:
-                _build()
-            except Exception:
-                if not os.path.exists(_LIB_PATH):
-                    raise
+            # rebuild when the .so is missing or older than the sources
+            # (a stale binary silently resurrecting fixed bugs is worse
+            # than a 2s build); an existing .so still loads if the
+            # toolchain is gone.
+            if _stale():
+                try:
+                    _build()
+                except Exception:
+                    if not os.path.exists(_LIB_PATH):
+                        raise
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception as e:
             logging.info("native io unavailable (%s); using the "

@@ -14,6 +14,7 @@ from . import rnn           # noqa: F401
 from . import custom        # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import extra         # noqa: F401
 from . import shape_rules   # noqa: F401
 
 __all__ = ["registry", "register", "get_op", "list_ops", "OpDef"]

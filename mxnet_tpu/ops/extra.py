@@ -1,0 +1,432 @@
+"""Long-tail operator corpus: linalg, ROI, spatial transform, misc.
+
+Reference parity: src/operator/tensor/la_op.cc (_linalg_* family over
+LAPACK/cuSOLVER — here jnp.linalg/lax.linalg, which XLA lowers to its
+native decompositions), src/operator/roi_pooling.cc +
+contrib/roi_align.cc (detection feature extraction),
+src/operator/spatial_transformer.cc + grid_generator.cc,
+contrib/{fft,ifft,quadratic,bounding_box}.cc, image/image_random.cc,
+and assorted tensor ops (histogram, ravel/unravel, reshape_like,
+khatri_rao, SVMOutput, legacy *_v1 aliases).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, get_op
+
+
+# ----------------------------------------------------------------------
+# linalg (la_op.cc) — all operate on (..., m, n) batches like the ref
+# ----------------------------------------------------------------------
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False,
+                alpha=1.0, beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b)
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    """Cholesky factor (lower) — ref la_op.cc potrf."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    """Inverse from the Cholesky factor: A is L, returns (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.swapaxes(linv, -1, -2) @ linv
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    out = (B @ a) if rightside else (a @ B)
+    return alpha * out
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    out = lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (a @ jnp.swapaxes(a, -1, -2))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization (ref la_op gelqf): A = L Q with Q row-orthonormal."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition; returns (U, lambda) with rows of U
+    the eigenvectors (ref la_op syevd: A = U^T diag(l) U)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.log(diag).sum(axis=-1)
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (ref contrib/krprod.cc)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# ----------------------------------------------------------------------
+# ROI feature extraction (roi_pooling.cc, contrib/roi_align.cc)
+# ----------------------------------------------------------------------
+@register("ROIPooling")
+def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
+    """Max-pool each ROI to a fixed grid (ref roi_pooling.cc). rois:
+    (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    H, W = data.shape[2], data.shape[3]
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = data[b]  # (C, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(i, j):
+            cy1 = y1 + (i * rh) // ph
+            cy2 = y1 + ((i + 1) * rh + ph - 1) // ph
+            cx1 = x1 + (j * rw) // pw
+            cx2 = x1 + ((j + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= cy1) & (ys[:, None] < cy2)
+                    & (xs[None, :] >= cx1) & (xs[None, :] < cx2))
+            vals = jnp.where(mask[None], img, -jnp.inf)
+            m = vals.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+
+        grid = jnp.stack([jnp.stack([cell(i, j) for j in range(pw)], -1)
+                          for i in range(ph)], -2)  # (C, ph, pw)
+        return grid
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
+              sample_ratio=2):
+    """Bilinear ROI align (ref contrib/roi_align.cc), avg mode."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    s = max(int(sample_ratio), 1)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[i] * spatial_scale for i in range(1, 5))
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        img = data[b]  # (C, H, W)
+        # sample points: s per bin side
+        iy = (jnp.arange(ph * s) + 0.5) / s  # in bin units
+        ix = (jnp.arange(pw * s) + 0.5) / s
+        ys = y1 + iy * rh / ph
+        xs = x1 + ix * rw / pw
+        from jax.scipy.ndimage import map_coordinates
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+
+        def chan(c):
+            return map_coordinates(c, [gy, gx], order=1, mode="nearest")
+
+        samp = jax.vmap(chan)(img)  # (C, ph*s, pw*s)
+        return samp.reshape(img.shape[0], ph, s, pw, s).mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, *, format="corner"):
+    """Pairwise IoU (ref contrib/bounding_box.cc box_iou)."""
+    def corners(b):
+        if format == "center":
+            return jnp.stack([b[..., 0] - b[..., 2] / 2,
+                              b[..., 1] - b[..., 3] / 2,
+                              b[..., 0] + b[..., 2] / 2,
+                              b[..., 1] + b[..., 3] / 2], axis=-1)
+        return b
+
+    a = corners(lhs).reshape(-1, 4)
+    b = corners(rhs).reshape(-1, 4)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    ar_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ar_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = ar_a[:, None] + ar_b[None, :] - inter
+    out = jnp.where(union > 0, inter / union, 0.0)
+    return out.reshape(lhs.shape[:-1] + rhs.shape[:-1])
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          num_outputs=2)
+def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a score matrix (ref bounding_box.cc).
+    Returns (row->col match, col->row match), -1 for unmatched."""
+    rows, cols = data.shape[-2], data.shape[-1]
+    flat = data.reshape(-1, rows, cols)
+
+    def one(mat):
+        order_val = mat if is_ascend else -mat
+        n_iter = rows if topk <= 0 else min(topk, rows)
+
+        def body(carry, _):
+            m, row_done, col_done = carry
+            masked = jnp.where(row_done[:, None] | col_done[None, :],
+                               jnp.inf, order_val)
+            idx = jnp.argmin(masked)
+            r, c = idx // cols, idx % cols
+            ok = jnp.isfinite(masked[r, c]) & (
+                (mat[r, c] >= threshold) if not is_ascend
+                else (mat[r, c] <= threshold))
+            m = m.at[r].set(jnp.where(ok, c, m[r]))
+            row_done = row_done.at[r].set(row_done[r] | ok)
+            col_done = col_done.at[c].set(col_done[c] | ok)
+            return (m, row_done, col_done), None
+
+        init = (jnp.full((rows,), -1.0), jnp.zeros((rows,), bool),
+                jnp.zeros((cols,), bool))
+        (m, _, _), _ = lax.scan(body, init, None, length=n_iter)
+        cmatch = jnp.full((cols,), -1.0)
+        valid = m >= 0
+        cmatch = cmatch.at[jnp.where(valid, m, cols).astype(jnp.int32)].set(
+            jnp.where(valid, jnp.arange(rows, dtype=jnp.float32), -1.0),
+            mode="drop")
+        return m, cmatch
+
+    a, b = jax.vmap(one)(flat)
+    return (a.reshape(data.shape[:-1]),
+            b.reshape(data.shape[:-2] + (cols,)))
+
+
+# ----------------------------------------------------------------------
+# spatial transformer (grid_generator.cc, spatial_transformer.cc)
+# ----------------------------------------------------------------------
+@register("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=()):
+    """Affine sampling grid (ref grid_generator.cc): data (N, 6) affine
+    params -> grid (N, 2, H, W) of normalized (x, y) coords."""
+    if transform_type != "affine":
+        raise NotImplementedError("only affine GridGenerator")
+    h, w = int(target_shape[0]), int(target_shape[1])
+    theta = data.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, H*W)
+    out = theta @ coords  # (N, 2, H*W)
+    return out.reshape(-1, 2, h, w)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, *, target_shape=(),
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """Affine-warp data with a learnt transform (ref
+    spatial_transformer.cc)."""
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=target_shape)
+    return get_op("BilinearSampler").fn(data, grid)
+
+
+# ----------------------------------------------------------------------
+# resize / adaptive pooling / image ops
+# ----------------------------------------------------------------------
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize_2d(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None):
+    h = int(height) if height else int(data.shape[2] * scale_height)
+    w = int(width) if width else int(data.shape[3] * scale_width)
+    return jax.image.resize(data, data.shape[:2] + (h, w), "bilinear")
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, *, output_size=()):
+    if not output_size:
+        return data.mean(axis=(2, 3), keepdims=True)
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh = int(output_size[0])
+        ow = int(output_size[1]) if len(output_size) > 1 else oh
+    # exact adaptive bins: cell (i, j) averages rows [i*H//oh,
+    # ceil((i+1)*H/oh)) etc. — matches the reference/torch definition
+    H, W = data.shape[2], data.shape[3]
+    rows = [data[:, :, (i * H) // oh:((i + 1) * H + oh - 1) // oh, :]
+            .mean(axis=2) for i in range(oh)]
+    stacked = jnp.stack(rows, axis=2)  # (N, C, oh, W)
+    cols = [stacked[:, :, :, (j * W) // ow:((j + 1) * W + ow - 1) // ow]
+            .mean(axis=3) for j in range(ow)]
+    return jnp.stack(cols, axis=3)
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float [0,1] (ref image_random.cc)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def image_normalize(data, *, mean=(0.0,), std=(1.0,)):
+    m = jnp.asarray(mean, jnp.float32).reshape(-1, 1, 1)
+    s = jnp.asarray(std, jnp.float32).reshape(-1, 1, 1)
+    if data.ndim == 4:
+        m, s = m[None], s[None]
+    return (data - m) / s
+
+
+# ----------------------------------------------------------------------
+# misc tensor ops
+# ----------------------------------------------------------------------
+@register("_histogram", aliases=("histogram",), num_outputs=2)
+def histogram(data, bins=None, *, bin_cnt=None, range=None):
+    if bins is not None and bin_cnt is None:
+        hist, edges = jnp.histogram(data.reshape(-1), bins=bins)
+        return hist, edges
+    cnt = int(bin_cnt) if bin_cnt else 10
+    lo, hi = (range if range else
+              (float(data.min()), float(data.max())))
+    hist, edges = jnp.histogram(data.reshape(-1), bins=cnt,
+                                range=(lo, hi))
+    return hist, edges
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
+def ravel_multi_index(data, *, shape):
+    idx = [data[i].astype(jnp.int32) for i in range(data.shape[0])]
+    out = jnp.zeros_like(idx[0])
+    for i, s in enumerate(shape):
+        out = out * int(s) + idx[i]
+    return out.astype(jnp.float32)
+
+
+@register("_unravel_index", aliases=("unravel_index",))
+def unravel_index(data, *, shape):
+    rem = data.astype(jnp.int32)
+    outs = []
+    for s in reversed(shape):
+        outs.append(rem % int(s))
+        rem = rem // int(s)
+    return jnp.stack(list(reversed(outs)), axis=0).astype(jnp.float32)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+@register("SVMOutput")
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Hinge-loss output head (ref svm_output.cc): forward is identity
+    (scores); the gradient implements the (squared) hinge loss."""
+    @jax.custom_vjp
+    def f(x, lab):
+        return x
+
+    def fwd(x, lab):
+        return x, (x, lab)
+
+    def bwd(res, g):
+        x, lab = res
+        n, c = x.shape
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), c, dtype=x.dtype)
+        # margin violation per class: score_j - score_y + margin > 0
+        correct = (x * onehot).sum(axis=1, keepdims=True)
+        viol = (x - correct + margin > 0) & (onehot == 0)
+        if use_linear:
+            gx = jnp.where(viol, regularization_coefficient, 0.0)
+        else:
+            gx = jnp.where(viol,
+                           2.0 * regularization_coefficient
+                           * (x - correct + margin), 0.0)
+        gx = gx - gx.sum(axis=1, keepdims=True) * onehot
+        return (gx.astype(x.dtype), jnp.zeros_like(lab))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, *, compute_size=128):
+    """Real->complex FFT over the last axis, interleaved re/im layout
+    (ref contrib/fft.cc: output last dim is 2x input)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, *, compute_size=128):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    # reference ifft is unnormalized (scale by n like cuFFT)
+    return jnp.fft.ifft(comp, axis=-1).real * n
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """The contrib example op: a*x^2 + b*x + c (ref quadratic_op.cc)."""
+    return a * jnp.square(data) + b * data + c
+
+
+def _register_legacy_aliases():
+    """BatchNorm_v1 / Convolution_v1 / Pooling_v1 behave like the modern
+    ops for all supported options (the reference kept both registrations
+    during migration; here they share one implementation)."""
+    from .registry import _OP_REGISTRY
+    for legacy, modern in (("BatchNorm_v1", "BatchNorm"),
+                           ("Convolution_v1", "Convolution"),
+                           ("Pooling_v1", "Pooling")):
+        if legacy not in _OP_REGISTRY:
+            _OP_REGISTRY[legacy] = _OP_REGISTRY[modern]
+
+
+_register_legacy_aliases()

@@ -29,6 +29,13 @@ __all__ = ["attention_reference", "ring_attention", "ulysses_attention"]
 
 _NEG_INF = -1e30
 
+def _shard_map():
+    try:
+        return jax.shard_map          # jax >= 0.8
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+        return shard_map
+
 
 def attention_reference(q, k, v, causal=False, scale=None):
     """Plain softmax attention, (B, T, H, D) layout — the single-device
@@ -72,7 +79,6 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
     ``batch_axis`` to compose with data parallelism (batch sharded over
     that mesh axis)."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
@@ -109,9 +115,21 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
             k_cur = lax.ppermute(k_cur, axis, perm)
             v_cur = lax.ppermute(v_cur, axis, perm)
             src = (rank + i) % n            # block origin of k_cur
-            o, l, m = _block_attn(ql, k_cur, v_cur,
-                                  rank * tq, src * tq, causal, scale,
-                                  o, l, m)
+
+            def compute(olm):
+                return _block_attn(ql, k_cur, v_cur, rank * tq, src * tq,
+                                   causal, scale, *olm)
+
+            if causal:
+                # blocks strictly above the causal diagonal (src > rank)
+                # are fully masked — skip their QK^T/PV entirely. (Load
+                # stays imbalanced across the ring — the zigzag block
+                # assignment that fixes it is a layout choice above this
+                # kernel.)
+                o, l, m = lax.cond(src <= rank, compute,
+                                   lambda olm: olm, (o, l, m))
+            else:
+                o, l, m = compute((o, l, m))
             return (o, l, m, k_cur, v_cur), None
 
         if n > 1:
@@ -122,8 +140,8 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
         out = o / jnp.maximum(l, 1e-30)[..., None]
         return jnp.transpose(out, (0, 2, 1, 3)).astype(ql.dtype)
 
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec)
+    fn = _shard_map()(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     return fn(q, k, v)
 
 
@@ -133,7 +151,6 @@ def ulysses_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
     seq-split -> head-split, dense attention per head group, re-shard
     back. Requires num_heads %% mesh.shape[axis] == 0."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     n = mesh.shape[axis]
     h = q.shape[2]
@@ -156,6 +173,6 @@ def ulysses_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
                                   causal=causal, scale=scale)
         return bwd(out)
 
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec)
+    fn = _shard_map()(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
     return fn(q, k, v)

@@ -1,0 +1,185 @@
+"""Long-tail op tests (ops/extra.py): linalg family, ROI ops, spatial
+transformer, image/resize ops, misc tensor ops, SVMOutput, legacy
+aliases."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sym
+
+
+def test_linalg_gemm_family():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 4).astype(np.float32)
+    B = rng.randn(4, 5).astype(np.float32)
+    C = rng.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C),
+                       alpha=2.0, beta=0.5).asnumpy(),
+        2 * A @ B + 0.5 * C, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.linalg_gemm2(nd.array(A), nd.array(A),
+                        transpose_b=True).asnumpy(),
+        A @ A.T, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.linalg_syrk(nd.array(A)).asnumpy(), A @ A.T, rtol=1e-5)
+
+
+def test_linalg_cholesky_roundtrip():
+    rng = np.random.RandomState(1)
+    S = rng.randn(4, 4).astype(np.float32)
+    S = S @ S.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg_potrf(nd.array(S)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, S, rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg_potri(nd.array(L)).asnumpy(),
+                               np.linalg.inv(S), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        float(nd.linalg_sumlogdiag(nd.array(L)).asnumpy()),
+        np.log(np.diag(L)).sum(), rtol=1e-5)
+    # trsm solves L x = b
+    b = rng.randn(4, 2).astype(np.float32)
+    x = nd.linalg_trsm(nd.array(L), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(L @ x, b, rtol=1e-4, atol=1e-5)
+    # trmm multiplies
+    np.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(L), nd.array(b)).asnumpy(), L @ b,
+        rtol=1e-5)
+
+
+def test_linalg_factorizations():
+    rng = np.random.RandomState(2)
+    A = rng.randn(3, 5).astype(np.float32)
+    L, Q = nd.linalg_gelqf(nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               atol=1e-5)
+    S = rng.randn(4, 4).astype(np.float32)
+    S = (S + S.T) / 2
+    U, lam = nd.linalg_syevd(nd.array(S))
+    Un, ln = U.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(Un.T @ np.diag(ln) @ Un, S, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_khatri_rao():
+    A = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    B = np.array([[5.0, 6.0]], np.float32)
+    out = nd.khatri_rao(nd.array(A), nd.array(B)).asnumpy()
+    exp = np.stack([np.kron(A[:, 0], B[:, 0]),
+                    np.kron(A[:, 1], B[:, 1])], axis=1)
+    np.testing.assert_allclose(out, exp)
+
+
+def test_roi_pooling_values():
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_align_shape_and_grad():
+    data = nd.array(np.random.RandomState(0).rand(2, 3, 8, 8)
+                    .astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 5, 5], [1, 0, 0, 7, 7]],
+                             np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.ROIAlign(data, rois, pooled_size=(3, 3),
+                          spatial_scale=1.0)
+    assert out.shape == (2, 3, 3, 3)
+    out.backward(nd.ones((2, 3, 3, 3)))
+    assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_box_iou_and_bipartite_matching():
+    a = nd.array(np.array([[0, 0, 2, 2]], np.float32))
+    b = nd.array(np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32))
+    np.testing.assert_allclose(nd.box_iou(a, b).asnumpy()[0],
+                               [1.0 / 7, 1.0], rtol=1e-5)
+    scores = nd.array(np.array([[0.9, 0.1], [0.8, 0.7]], np.float32))
+    rmatch, cmatch = nd.bipartite_matching(scores, threshold=0.5)
+    np.testing.assert_array_equal(rmatch.asnumpy(), [0, 1])
+    np.testing.assert_array_equal(cmatch.asnumpy(), [0, 1])
+
+
+def test_spatial_transformer_identity_and_shift():
+    rng = np.random.RandomState(3)
+    img = nd.array(rng.rand(1, 1, 5, 5).astype(np.float32))
+    ident = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(img, ident, target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy(), atol=1e-5)
+    grid = nd.GridGenerator(ident, transform_type="affine",
+                            target_shape=(4, 6))
+    assert grid.shape == (1, 2, 4, 6)
+
+
+def test_resize_and_adaptive_pool():
+    img = nd.array(np.random.RandomState(4).rand(2, 3, 6, 6)
+                   .astype(np.float32))
+    assert nd.BilinearResize2D(img, height=12, width=9).shape == (2, 3, 12, 9)
+    ap = nd.AdaptiveAvgPooling2D(img, output_size=1).asnumpy()
+    np.testing.assert_allclose(ap[:, :, 0, 0],
+                               img.asnumpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_image_ops():
+    img = np.random.RandomState(5).randint(0, 255, (4, 4, 3)) \
+        .astype(np.uint8)
+    t = nd.image_to_tensor(nd.array(img)).asnumpy()
+    assert t.shape == (3, 4, 4) and t.max() <= 1.0
+    norm = nd.image_normalize(nd.array(t), mean=(0.5, 0.5, 0.5),
+                              std=(0.5, 0.5, 0.5)).asnumpy()
+    np.testing.assert_allclose(norm, (t - 0.5) / 0.5, rtol=1e-6)
+
+
+def test_histogram_ravel_unravel_reshape_like():
+    h, e = nd.histogram(nd.array(np.arange(10, dtype=np.float32)),
+                        bin_cnt=5, range=(0.0, 10.0))
+    np.testing.assert_array_equal(h.asnumpy(), [2, 2, 2, 2, 2])
+    ri = nd.ravel_multi_index(
+        nd.array(np.array([[1.0, 2.0], [0.0, 1.0]], np.float32)),
+        shape=(3, 4))
+    np.testing.assert_array_equal(ri.asnumpy(), [4.0, 9.0])
+    ui = nd.unravel_index(nd.array(np.array([4.0, 9.0], np.float32)),
+                          shape=(3, 4))
+    np.testing.assert_array_equal(ui.asnumpy(), [[1, 2], [0, 1]])
+    assert nd.reshape_like(nd.array(np.arange(6, dtype=np.float32)),
+                           nd.zeros((3, 2))).shape == (3, 2)
+
+
+def test_fft_roundtrip():
+    x = np.random.RandomState(6).randn(2, 8).astype(np.float32)
+    f = nd.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    np.testing.assert_allclose(nd.ifft(f).asnumpy() / 8, x, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_svm_output_training():
+    """SVMOutput head learns a linearly separable problem."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(64, 4).astype(np.float32)
+    y = (X[:, 0] > X[:, 1]).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = sym.SVMOutput(net, sym.Variable("softmax_label"), name="svm")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.initializer.Xavier())
+    it.reset()
+    preds = mod.predict(it).asnumpy()
+    assert (preds.argmax(1) == y).mean() > 0.9
+
+
+def test_quadratic_and_legacy_aliases():
+    q = nd.quadratic(nd.array(np.array([2.0], np.float32)),
+                     a=1.0, b=2.0, c=3.0)
+    assert q.asnumpy()[0] == 11.0
+    s = sym.Convolution_v1(sym.Variable("d"), kernel=(3, 3), num_filter=2,
+                           name="c")
+    exe = s.simple_bind(ctx=mx.cpu(), d=(1, 1, 5, 5))
+    assert exe.forward()[0].shape == (1, 2, 3, 3)
