@@ -86,6 +86,8 @@ from . import visualization
 from . import viz
 from . import contrib
 from . import rnn
+from . import rtc
+from . import config
 from . import predictor
 from . import profiler
 from . import monitor

@@ -28,9 +28,12 @@ _tried = False
 
 
 def _stale():
-    """True when the .so is missing or older than the native sources."""
+    """True when the .so is missing or older than the native sources.
+    A prebuilt .so without the src/ tree (installed package) is fresh."""
     if not os.path.exists(_LIB_PATH):
         return True
+    if not os.path.isdir(_SRC_DIR):
+        return False
     so_m = os.path.getmtime(_LIB_PATH)
     for fname in os.listdir(_SRC_DIR):
         if fname.endswith((".cc", ".h")) or fname == "Makefile":

@@ -1,0 +1,82 @@
+"""MXNET_* environment-variable layer.
+
+Reference parity: docs/faq/env_var.md — the reference's only runtime
+configuration mechanism is ~40 ``MXNET_*`` env vars read at singleton
+init. Most of them tune machinery XLA replaced (engine threads, memory
+pools, op bulking); those are **accepted and documented as inert** here
+so existing launch scripts keep working. The ones with a real TPU-native
+meaning are wired:
+
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` — the reference's synchronous debug
+  oracle (src/engine/engine.cc:32): every eager op blocks until the
+  device finishes, surfacing async errors at the faulting op instead of
+  a later sync point.
+- ``MXNET_BACKWARD_DO_MIRROR=1`` — gradient mirroring
+  (graph_executor.cc:193): trade compute for activation memory. Maps to
+  ``jax.checkpoint`` (rematerialization) around the compiled
+  forward when building fused fwd+bwd programs.
+- ``MXNET_PROFILER_AUTOSTART=1`` — handled in profiler.py.
+- ``MXTPU_NO_NATIVE=1`` — disable the native C++ io library.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_bool", "naive_engine", "backward_do_mirror", "summary"]
+
+# name -> (default, wired?, doc)
+_KNOWN = {
+    "MXNET_ENGINE_TYPE": ("ThreadedEnginePerDevice", True,
+                          "NaiveEngine = synchronous eager ops (debug "
+                          "oracle); other values inert (XLA dispatch)"),
+    "MXNET_BACKWARD_DO_MIRROR": ("0", True,
+                                 "1 = rematerialize forward in fused "
+                                 "fwd+bwd programs (jax.checkpoint)"),
+    "MXNET_PROFILER_AUTOSTART": ("0", True, "1 = start mx.profiler at "
+                                 "import (profiler.py)"),
+    "MXTPU_NO_NATIVE": ("0", True, "1 = disable the native io library"),
+    # accepted-but-inert: the subsystem they tuned is XLA's problem now
+    "MXNET_CPU_WORKER_NTHREADS": ("1", False, "engine threads (XLA)"),
+    "MXNET_GPU_WORKER_NTHREADS": ("2", False, "engine threads (XLA)"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": ("1", False, "op bulking (XLA fusion)"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": ("1", False,
+                                       "op bulking (XLA fusion)"),
+    "MXNET_EXEC_NUM_TEMP": ("1", False, "temp space pool (XLA alloc)"),
+    "MXNET_GPU_MEM_POOL_RESERVE": ("5", False, "memory pool (XLA alloc)"),
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": ("4", False,
+                                         "kvstore reduce (collectives)"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", False,
+                                     "key sharding (collectives)"),
+    "MXNET_KVSTORE_USETREE": ("0", False, "tree reduce (XLA scheduling)"),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": ("1", False, "cudnn autotune (XLA)"),
+    "MXNET_ENFORCE_DETERMINISM": ("0", False,
+                                  "deterministic by construction"),
+}
+
+
+def env_bool(name, default=False):
+    return os.environ.get(name, "1" if default else "0") in ("1", "true",
+                                                             "True")
+
+
+def naive_engine():
+    """True when eager ops must run synchronously (the reference's
+    NaiveEngine debug oracle)."""
+    return os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def backward_do_mirror():
+    """True when fused fwd+bwd programs should rematerialize the forward
+    (reference MXNET_BACKWARD_DO_MIRROR)."""
+    return env_bool("MXNET_BACKWARD_DO_MIRROR")
+
+
+def summary():
+    """Current values of every known MXNET_* variable and whether it has
+    effect here (docs/faq/env_var.md analog)."""
+    lines = ["%-36s %-10s %-6s %s" % ("Variable", "Value", "Wired", "Notes")]
+    for name, (default, wired, doc) in sorted(_KNOWN.items()):
+        lines.append("%-36s %-10s %-6s %s"
+                     % (name, os.environ.get(name, default),
+                        "yes" if wired else "inert", doc))
+    return "\n".join(lines)

@@ -124,6 +124,9 @@ def _monitor_fn(symbol, is_train, monitor_all):
 
 
 def _make_fwd_bwd(graph_fn, diff_names):
+    from . import config as _config
+    mirror = _config.backward_do_mirror()
+
     @jax.jit
     def _fwd_bwd(args, auxs, seed, ograds):
         diff = {n: args[n] for n in diff_names}
@@ -132,6 +135,13 @@ def _make_fwd_bwd(graph_fn, diff_names):
         def f(d):
             outs, new_auxs = graph_fn({**rest, **d}, auxs, seed, True)
             return outs, new_auxs
+
+        if mirror:
+            # MXNET_BACKWARD_DO_MIRROR: recompute the forward during
+            # backward instead of keeping activations (jax.checkpoint —
+            # the reference's gradient-mirroring memory/compute trade,
+            # graph_executor.cc:193)
+            f = jax.checkpoint(f)
 
         outs, vjp_fn, new_auxs = jax.vjp(f, diff, has_aux=True)
         cts = [g if g is not None else jnp.ones_like(o)

@@ -110,6 +110,12 @@ def invoke(opdef, args, kwargs, out=None, name=None):
     else:
         raw = fn(rng, *arrs) if needs_rng else fn(*arrs)
 
+    from .. import config as _config
+    if _config.naive_engine():
+        # MXNET_ENGINE_TYPE=NaiveEngine: the synchronous debug oracle —
+        # async device errors surface at the faulting op
+        jax.block_until_ready(raw)
+
     n_out = opdef.out_count(attrs)
     outs_raw = list(raw) if isinstance(raw, (tuple, list)) else [raw]
     if len(outs_raw) != n_out:

@@ -54,7 +54,10 @@ def linalg_potri(A):
 @register("_linalg_trmm", aliases=("linalg_trmm",))
 def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
                 alpha=1.0):
-    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    # BLAS trmm references only the named triangle of A
+    a = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
     out = (B @ a) if rightside else (a @ B)
     return alpha * out
 
@@ -110,7 +113,15 @@ def khatri_rao(*matrices):
 @register("ROIPooling")
 def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
     """Max-pool each ROI to a fixed grid (ref roi_pooling.cc). rois:
-    (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords."""
+    (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords.
+
+    Implementation: one segment-max over the feature map per ROI —
+    each pixel maps to its pooled cell index, done twice (floor and
+    ceil assignment) because the reference's floor/ceil cell bounds let
+    adjacent cells share a boundary pixel. O(C·H·W) per ROI. In the
+    rare upsampling regime (pooled grid finer than the ROI) interior
+    cells a pixel spans beyond the two assignments read as empty (0)
+    where the reference repeats the pixel."""
     ph, pw = int(pooled_size[0]), int(pooled_size[1])
     H, W = data.shape[2], data.shape[3]
 
@@ -126,20 +137,31 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
         ys = jnp.arange(H)
         xs = jnp.arange(W)
 
-        def cell(i, j):
-            cy1 = y1 + (i * rh) // ph
-            cy2 = y1 + ((i + 1) * rh + ph - 1) // ph
-            cx1 = x1 + (j * rw) // pw
-            cx2 = x1 + ((j + 1) * rw + pw - 1) // pw
-            mask = ((ys[:, None] >= cy1) & (ys[:, None] < cy2)
-                    & (xs[None, :] >= cx1) & (xs[None, :] < cx2))
-            vals = jnp.where(mask[None], img, -jnp.inf)
-            m = vals.max(axis=(1, 2))
-            return jnp.where(jnp.isfinite(m), m, 0.0)
+        def bins(p, p1, extent, nbins):
+            """(first-bin, last-bin, in-roi) for coordinates p."""
+            rel = p - p1
+            inside = (rel >= 0) & (rel < extent)
+            first = jnp.clip((rel * nbins) // extent, 0, nbins - 1)
+            last = jnp.clip(((rel + 1) * nbins - 1) // extent, 0,
+                            nbins - 1)
+            return first, last, inside
 
-        grid = jnp.stack([jnp.stack([cell(i, j) for j in range(pw)], -1)
-                          for i in range(ph)], -2)  # (C, ph, pw)
-        return grid
+        iy1, iy2, in_y = bins(ys, y1, rh, ph)
+        ix1, ix2, in_x = bins(xs, x1, rw, pw)
+
+        def seg(iy, ix):
+            cell = iy[:, None] * pw + ix[None, :]
+            valid = in_y[:, None] & in_x[None, :]
+            cell = jnp.where(valid, cell, ph * pw)  # dropped segment
+            flat = img.reshape(img.shape[0], -1)
+            return jax.ops.segment_max(
+                flat.T, cell.reshape(-1), num_segments=ph * pw + 1,
+                indices_are_sorted=False)[: ph * pw].T  # (C, ph*pw)
+
+        m = jnp.maximum(jnp.maximum(seg(iy1, ix1), seg(iy1, ix2)),
+                        jnp.maximum(seg(iy2, ix1), seg(iy2, ix2)))
+        m = jnp.where(jnp.isfinite(m), m, 0.0)  # empty cells -> 0
+        return m.reshape(img.shape[0], ph, pw)
 
     return jax.vmap(one)(rois)
 
@@ -166,7 +188,10 @@ def roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
         gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
 
         def chan(c):
-            return map_coordinates(c, [gy, gx], order=1, mode="nearest")
+            # zero contribution outside the map (reference roi_align.cc
+            # bilinear_interpolate returns 0 out of bounds)
+            return map_coordinates(c, [gy, gx], order=1, mode="constant",
+                                   cval=0.0)
 
         samp = jax.vmap(chan)(img)  # (C, ph*s, pw*s)
         return samp.reshape(img.shape[0], ph, s, pw, s).mean(axis=(2, 4))
@@ -330,8 +355,11 @@ def histogram(data, bins=None, *, bin_cnt=None, range=None):
         hist, edges = jnp.histogram(data.reshape(-1), bins=bins)
         return hist, edges
     cnt = int(bin_cnt) if bin_cnt else 10
-    lo, hi = (range if range else
-              (float(data.min()), float(data.max())))
+    if range:
+        lo, hi = range
+    else:
+        # traced min/max keep the op jit/graph-safe
+        lo, hi = data.min(), data.max()
     hist, edges = jnp.histogram(data.reshape(-1), bins=cnt,
                                 range=(lo, hi))
     return hist, edges
