@@ -1,0 +1,30 @@
+"""kvstore_server — parameter-server bootstrap (reference parity shim).
+
+Reference: python/mxnet/kvstore_server.py enters the ps-lite server loop
+when a process is launched with DMLC_ROLE=server. The TPU-native
+distributed kvstore has **no server processes** — ps-lite is replaced by
+jax.distributed collectives with the server state replicated on every
+worker (kvstore_dist.py) — so a process launched in the server role has
+nothing to do and this module documents exactly that. tools/launch.py
+accordingly spawns workers only.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["_init_kvstore_server_module"]
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        logging.warning(
+            "process launched with DMLC_ROLE=%s: the TPU-native kvstore "
+            "has no %s processes (collectives replace ps-lite; see "
+            "kvstore_dist.py). Exiting idle.", role, role)
+        raise SystemExit(0)
+
+
+if os.environ.get("DMLC_ROLE") in ("server", "scheduler"):
+    _init_kvstore_server_module()

@@ -115,3 +115,135 @@ def load_params(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
     return arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy estimator API (reference model.py:452 FeedForward — already
+    deprecated there in favor of Module; kept as a thin Module adapter so
+    old scripts run)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter) or hasattr(X, "provide_data"):
+            return X
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=shuffle)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        """(reference model.py FeedForward.fit)"""
+        from .module import Module
+        train = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(*eval_data) \
+                if isinstance(eval_data, tuple) else self._as_iter(eval_data)
+        self._module = Module(self.symbol, context=self.ctx)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=self.kwargs,
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def _ensure_module(self, data):
+        """Bind a Module lazily from saved params — load-then-infer is
+        the legacy API's primary flow (reference binds a predictor the
+        same way)."""
+        if self._module is not None:
+            return
+        from .base import MXNetError
+        from .module import Module
+        if self.arg_params is None:
+            raise MXNetError("FeedForward: call fit() or load() first")
+        self._module = Module(self.symbol, context=self.ctx)
+        self._module.bind(data_shapes=data.provide_data,
+                          label_shapes=getattr(data, "provide_label", None),
+                          for_training=False)
+        self._module.set_params(self.arg_params, self.aux_params or {},
+                                allow_missing=False, allow_extra=True)
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """(reference FeedForward.predict) — returns host numpy; with
+        ``return_data`` also the concatenated data and labels."""
+        import numpy as _np2
+        data = self._as_iter(X)
+        self._ensure_module(data)
+        if not return_data:
+            return self._module.predict(data, num_batch=num_batch,
+                                        reset=reset).asnumpy()
+        if reset:
+            data.reset()
+        preds, xs, ys = [], [], []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            keep = batch.data[0].shape[0] - (batch.pad or 0)
+            preds.append(self._module.get_outputs()[0].asnumpy()[:keep])
+            xs.append(batch.data[0].asnumpy()[:keep])
+            if batch.label:
+                ys.append(batch.label[0].asnumpy()[:keep])
+        return (_np2.concatenate(preds), _np2.concatenate(xs),
+                _np2.concatenate(ys) if ys else None)
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        data = self._as_iter(X)
+        self._ensure_module(data)
+        data.reset()
+        return self._module.score(data, eval_metric,
+                                  num_batch=num_batch)[0][1]
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               **kwargs):
+        """(reference model.py:950 FeedForward.create) train-and-return."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            optimizer=optimizer, initializer=initializer,
+                            **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model

@@ -374,9 +374,17 @@ class Module(BaseModule):
             if hasattr(data_batch, "provide_label") and data_batch.provide_label:
                 new_lshape = data_batch.provide_label
             elif hasattr(data_batch, "label") and data_batch.label:
-                new_lshape = [DataDesc(i.name, j.shape, i.dtype, i.layout)
-                              for i, j in
-                              zip(self._label_shapes, data_batch.label)]
+                if self._label_shapes:
+                    new_lshape = [DataDesc(i.name, j.shape, i.dtype,
+                                           i.layout)
+                                  for i, j in
+                                  zip(self._label_shapes, data_batch.label)]
+                else:
+                    # a previous unlabeled batch dropped the label
+                    # shapes; rebuild them from the declared label names
+                    new_lshape = [DataDesc(name, j.shape)
+                                  for name, j in zip(self._label_names,
+                                                     data_batch.label)]
             else:
                 new_lshape = None
             self.reshape(new_dshape, new_lshape)

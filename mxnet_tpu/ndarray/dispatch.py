@@ -15,6 +15,9 @@ from ..ops import registry as _reg
 
 _JIT_CACHE = {}
 
+import os as _os
+_NAIVE_ENGINE = _os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
 
 def _freeze(v):
     if isinstance(v, (list, tuple)):
@@ -110,10 +113,10 @@ def invoke(opdef, args, kwargs, out=None, name=None):
     else:
         raw = fn(rng, *arrs) if needs_rng else fn(*arrs)
 
-    from .. import config as _config
-    if _config.naive_engine():
+    if _NAIVE_ENGINE:
         # MXNET_ENGINE_TYPE=NaiveEngine: the synchronous debug oracle —
-        # async device errors surface at the faulting op
+        # async device errors surface at the faulting op (read once at
+        # import, like the reference's engine-singleton init)
         jax.block_until_ready(raw)
 
     n_out = opdef.out_count(attrs)

@@ -115,13 +115,11 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
     """Max-pool each ROI to a fixed grid (ref roi_pooling.cc). rois:
     (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords.
 
-    Implementation: one segment-max over the feature map per ROI —
-    each pixel maps to its pooled cell index, done twice (floor and
-    ceil assignment) because the reference's floor/ceil cell bounds let
-    adjacent cells share a boundary pixel. O(C·H·W) per ROI. In the
-    rare upsampling regime (pooled grid finer than the ROI) interior
-    cells a pixel spans beyond the two assignments read as empty (0)
-    where the reference repeats the pixel."""
+    Implementation: separable per-axis masked max — stage 1 reduces the
+    H axis into ph row-bins, stage 2 reduces W into pw col-bins
+    (O((ph+pw)·C·H·W) compute, O(C·H·W) memory). Correct in every
+    regime incl. overlapping floor/ceil bin bounds and pooled grids
+    finer than the ROI (where a pixel belongs to several bins)."""
     ph, pw = int(pooled_size[0]), int(pooled_size[1])
     H, W = data.shape[2], data.shape[3]
 
@@ -134,34 +132,24 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
         rw = jnp.maximum(x2 - x1 + 1, 1)
         rh = jnp.maximum(y2 - y1 + 1, 1)
         img = data[b]  # (C, H, W)
+
+        def axis_mask(coords, p1, extent, i, nbins):
+            lo = p1 + (i * extent) // nbins
+            hi = p1 + ((i + 1) * extent + nbins - 1) // nbins
+            return (coords >= lo) & (coords < hi)
+
         ys = jnp.arange(H)
         xs = jnp.arange(W)
-
-        def bins(p, p1, extent, nbins):
-            """(first-bin, last-bin, in-roi) for coordinates p."""
-            rel = p - p1
-            inside = (rel >= 0) & (rel < extent)
-            first = jnp.clip((rel * nbins) // extent, 0, nbins - 1)
-            last = jnp.clip(((rel + 1) * nbins - 1) // extent, 0,
-                            nbins - 1)
-            return first, last, inside
-
-        iy1, iy2, in_y = bins(ys, y1, rh, ph)
-        ix1, ix2, in_x = bins(xs, x1, rw, pw)
-
-        def seg(iy, ix):
-            cell = iy[:, None] * pw + ix[None, :]
-            valid = in_y[:, None] & in_x[None, :]
-            cell = jnp.where(valid, cell, ph * pw)  # dropped segment
-            flat = img.reshape(img.shape[0], -1)
-            return jax.ops.segment_max(
-                flat.T, cell.reshape(-1), num_segments=ph * pw + 1,
-                indices_are_sorted=False)[: ph * pw].T  # (C, ph*pw)
-
-        m = jnp.maximum(jnp.maximum(seg(iy1, ix1), seg(iy1, ix2)),
-                        jnp.maximum(seg(iy2, ix1), seg(iy2, ix2)))
-        m = jnp.where(jnp.isfinite(m), m, 0.0)  # empty cells -> 0
-        return m.reshape(img.shape[0], ph, pw)
+        # stage 1: (C, H, W) -> (C, ph, W)
+        rows = [jnp.where(axis_mask(ys, y1, rh, i, ph)[None, :, None],
+                          img, -jnp.inf).max(axis=1) for i in range(ph)]
+        stage1 = jnp.stack(rows, axis=1)
+        # stage 2: (C, ph, W) -> (C, ph, pw)
+        cols = [jnp.where(axis_mask(xs, x1, rw, j, pw)[None, None, :],
+                          stage1, -jnp.inf).max(axis=2)
+                for j in range(pw)]
+        out = jnp.stack(cols, axis=2)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty cells -> 0
 
     return jax.vmap(one)(rois)
 

@@ -32,8 +32,8 @@ def CudaModule(*args, **kwargs):
     raise MXNetError(
         "mx.rtc.CudaModule compiles CUDA source, which cannot target a "
         "TPU. Write the kernel as a Pallas function and wrap it in "
-        "mx.rtc.PallasModule (see /opt/skills/guides/pallas_guide.md "
-        "for the kernel model).")
+        "mx.rtc.PallasModule (kernel model: "
+        "https://docs.jax.dev/en/latest/pallas/index.html).")
 
 
 class PallasKernel:

@@ -229,3 +229,25 @@ def test_module_multi_device_data_parallel():
             initializer=mx.initializer.Xavier())
     it.reset()
     assert mod.score(it, "acc")[0][1] > 0.9
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """The deprecated FeedForward estimator still trains/saves/loads
+    (reference model.py:452)."""
+    from mxnet_tpu.model import FeedForward
+    X, y = _fit_data()
+    net = _mlp()
+    model = FeedForward.create(net, X, y, ctx=mx.cpu(), num_epoch=20,
+                               optimizer="adam", learning_rate=0.02,
+                               initializer=mx.initializer.Xavier())
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert acc > 0.9
+    assert model.predict(X[:8]).shape == (8, 4)
+    model.save(str(tmp_path / "ff"), 20)
+    m2 = FeedForward.load(str(tmp_path / "ff"), 20, ctx=mx.cpu())
+    # load-then-infer (the primary legacy flow) must work without fit
+    p2 = m2.predict(X[:8])
+    np.testing.assert_allclose(p2, model.predict(X[:8]), rtol=1e-5)
+    preds, xs, ys = model.predict(
+        mx.io.NDArrayIter(X, y, batch_size=16), return_data=True)
+    assert preds.shape[0] == xs.shape[0] == ys.shape[0] == len(X)
