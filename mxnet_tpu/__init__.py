@@ -48,6 +48,10 @@ def _maybe_init_distributed():
 
 _maybe_init_distributed()
 
+# server/scheduler-role processes exit idle here (reference wires
+# kvstore_server the same way: python/mxnet/__init__.py:57)
+from . import kvstore_server  # noqa: E402,F401
+
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)

@@ -15,3 +15,4 @@ from . import rnn
 from . import data
 from . import model_zoo
 from . import utils
+from . import contrib

@@ -1,0 +1,3 @@
+"""gluon.contrib — experimental layers (reference gluon/contrib/)."""
+from . import nn
+from . import rnn

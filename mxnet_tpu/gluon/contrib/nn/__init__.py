@@ -1,0 +1,6 @@
+"""gluon.contrib.nn (reference gluon/contrib/nn/basic_layers.py)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]

@@ -1,0 +1,71 @@
+"""Experimental basic layers (reference
+gluon/contrib/nn/basic_layers.py:29-220)."""
+from __future__ import annotations
+
+from ...nn.basic_layers import (Sequential, HybridSequential, BatchNorm,
+                                Embedding)
+from ...block import HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concatenate outputs on ``axis``
+    (reference basic_layers.py:29)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference basic_layers.py:62)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block for skip connections in Concurrent
+    (reference basic_layers.py:95)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding declared with row-sparse gradients (reference
+    basic_layers.py:116). The compiled graph computes the weight grad as
+    a dense scatter-add (XLA's efficient form); convert with
+    ``nd.sparse.cast_storage(grad, 'row_sparse')`` to drive the lazy
+    optimizer updates when desired."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference
+    basic_layers.py:163, contrib SyncBatchNorm over an NCCL key-value
+    sync). TPU-native: when the batch axis is sharded over a mesh (the
+    fused TrainStep / a pjit'd step), the batch-mean/variance reductions
+    inside BatchNorm run over the GLOBAL batch — XLA inserts the
+    cross-device collectives during SPMD partitioning — so BatchNorm is
+    already synchronized and this class only documents that;
+    ``num_devices`` is accepted for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)

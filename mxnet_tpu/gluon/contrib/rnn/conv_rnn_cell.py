@@ -1,0 +1,172 @@
+"""Convolutional recurrent cells (reference
+gluon/contrib/rnn/conv_rnn_cell.py:37-420).
+
+Hidden state is a feature map; input-to-hidden and hidden-to-hidden
+transforms are convolutions with 'same' padding on the hidden path so
+state shape is preserved across steps. Gate order matches the dense
+cells (cuDNN: LSTM i,f,g,o; GRU r,z,n).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared machinery: conv i2h/h2h params + state bookkeeping."""
+
+    _num_gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", prefix=None, params=None,
+                 conv_layout="NCHW", dims=2):
+        super().__init__(prefix=prefix, params=params)
+        self._dims = dims
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = _tuple(i2h_kernel, dims)
+        self._h2h_kernel = _tuple(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, "h2h kernel must be odd for same-padding"
+        self._i2h_pad = _tuple(i2h_pad, dims)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+
+        in_c = self._input_shape[0]
+        ng = self._num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ng * hidden_channels, hidden_channels)
+            + self._h2h_kernel, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+
+        # state spatial dims after the i2h conv
+        spatial = self._input_shape[1:]
+        self._state_spatial = tuple(
+            (s + 2 * p - k) + 1 for s, p, k in
+            zip(spatial, self._i2h_pad, self._i2h_kernel))
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._dims:]}
+                for _ in range(len(self._state_names))]
+
+    _state_names = ("h",)
+
+    def _convs(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        ng = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=ng * self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _num_gates = 1
+    _state_names = ("h",)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _num_gates = 4
+    _state_names = ("h", "c")
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sl = F.SliceChannel(gates, num_outputs=4)
+        i = F.Activation(sl[0], act_type="sigmoid")
+        f = F.Activation(sl[1], act_type="sigmoid")
+        g = self._act(F, sl[2])
+        o = F.Activation(sl[3], act_type="sigmoid")
+        next_c = f * states[1] + i * g
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _num_gates = 3
+    _state_names = ("h",)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i2h_sl = F.SliceChannel(i2h, num_outputs=3)
+        h2h_sl = F.SliceChannel(h2h, num_outputs=3)
+        r = F.Activation(i2h_sl[0] + h2h_sl[0], act_type="sigmoid")
+        z = F.Activation(i2h_sl[1] + h2h_sl[1], act_type="sigmoid")
+        n = self._act(F, i2h_sl[2] + r * h2h_sl[2])
+        out = (1.0 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make(name, base, dims, doc_ref):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, activation="tanh", prefix=None,
+                 params=None):
+        base.__init__(self, input_shape, hidden_channels, i2h_kernel,
+                      h2h_kernel, i2h_pad=i2h_pad, activation=activation,
+                      prefix=prefix, params=params, dims=dims)
+
+    cls = type(name, (base,), {
+        "__init__": __init__,
+        "__doc__": "%dD convolutional %s cell (reference "
+                   "conv_rnn_cell.py %s)." % (dims, doc_ref, name),
+    })
+    return cls
+
+
+Conv1DRNNCell = _make("Conv1DRNNCell", _ConvRNNCell, 1, "RNN")
+Conv2DRNNCell = _make("Conv2DRNNCell", _ConvRNNCell, 2, "RNN")
+Conv3DRNNCell = _make("Conv3DRNNCell", _ConvRNNCell, 3, "RNN")
+Conv1DLSTMCell = _make("Conv1DLSTMCell", _ConvLSTMCell, 1, "LSTM")
+Conv2DLSTMCell = _make("Conv2DLSTMCell", _ConvLSTMCell, 2, "LSTM")
+Conv3DLSTMCell = _make("Conv3DLSTMCell", _ConvLSTMCell, 3, "LSTM")
+Conv1DGRUCell = _make("Conv1DGRUCell", _ConvGRUCell, 1, "GRU")
+Conv2DGRUCell = _make("Conv2DGRUCell", _ConvGRUCell, 2, "GRU")
+Conv3DGRUCell = _make("Conv3DGRUCell", _ConvGRUCell, 3, "GRU")

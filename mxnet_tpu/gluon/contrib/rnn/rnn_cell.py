@@ -1,0 +1,60 @@
+"""Experimental recurrent cells (reference
+gluon/contrib/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell
+
+__all__ = ["VariationalDropoutCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Apply the SAME dropout mask at every time step (variational /
+    locked dropout, reference contrib/rnn/rnn_cell.py
+    VariationalDropoutCell) to inputs, states, and/or outputs."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, p, like):
+        from .... import ndarray as nd
+        from .... import autograd
+        if not autograd.is_training() or p <= 0.0:
+            return None
+        keep = 1.0 - p
+        return nd.random.uniform(0.0, 1.0, shape=like.shape) \
+            .__lt__(keep) / keep
+
+    def __call__(self, inputs, states):
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self.drop_inputs, inputs)
+            if self._input_mask is not None:
+                inputs = inputs * self._input_mask
+        if self.drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self.drop_states, states[0])
+            if self._state_mask is not None:
+                states = [states[0] * self._state_mask] + list(states[1:])
+        output, states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, output)
+            if self._output_mask is not None:
+                output = output * self._output_mask
+        return output, states
+
+    def _alias(self):
+        return "vardrop"

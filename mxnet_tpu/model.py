@@ -164,6 +164,8 @@ class FeedForward:
             epoch_end_callback=epoch_end_callback,
             batch_end_callback=batch_end_callback, kvstore=kvstore,
             optimizer=self.optimizer, optimizer_params=self.kwargs,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
             initializer=self.initializer, arg_params=self.arg_params,
             aux_params=self.aux_params,
             allow_missing=self.arg_params is not None,
@@ -196,8 +198,11 @@ class FeedForward:
         data = self._as_iter(X)
         self._ensure_module(data)
         if not return_data:
-            return self._module.predict(data, num_batch=num_batch,
-                                        reset=reset).asnumpy()
+            out = self._module.predict(data, num_batch=num_batch,
+                                       reset=reset)
+            if isinstance(out, list):  # multi-output symbol / empty iter
+                return [o.asnumpy() for o in out]
+            return out.asnumpy()
         if reset:
             data.reset()
         preds, xs, ys = [], [], []

@@ -1,0 +1,95 @@
+"""gluon.contrib tests (reference gluon/contrib/nn + rnn)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_concurrent_and_identity():
+    net = gluon.contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3), gluon.nn.Dense(4),
+            gluon.contrib.nn.Identity())
+    net.initialize()
+    x = nd.array(np.ones((2, 5), np.float32))
+    out = net(x)
+    assert out.shape == (2, 12)
+    net.hybridize()
+    np.testing.assert_allclose(net(x).asnumpy(), out.asnumpy(), rtol=1e-5)
+    seq = gluon.contrib.nn.Concurrent(axis=1)
+    seq.add(gluon.contrib.nn.Identity(), gluon.contrib.nn.Identity())
+    assert seq(x).shape == (2, 10)
+
+
+def test_sync_batchnorm_and_sparse_embedding():
+    bn = gluon.contrib.nn.SyncBatchNorm(num_devices=4)
+    bn.initialize()
+    x = nd.array(np.random.RandomState(0).rand(4, 3, 2, 2)
+                 .astype(np.float32))
+    assert bn(x).shape == (4, 3, 2, 2)
+    emb = gluon.contrib.nn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array(np.array([1.0, 3.0])))
+    assert out.shape == (2, 4)
+
+
+def test_conv_rnn_cells():
+    cell = gluon.contrib.rnn.Conv2DLSTMCell(
+        input_shape=(2, 8, 8), hidden_channels=4, i2h_kernel=3,
+        h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    xs = [nd.array(np.random.RandomState(i).rand(2, 2, 8, 8)
+                   .astype(np.float32)) for i in range(3)]
+    outs, states = cell.unroll(3, xs)
+    assert outs[0].shape == (2, 4, 8, 8)
+    assert len(states) == 2 and states[1].shape == (2, 4, 8, 8)
+
+    c1 = gluon.contrib.rnn.Conv1DGRUCell(
+        input_shape=(2, 10), hidden_channels=3, i2h_kernel=3,
+        h2h_kernel=3, i2h_pad=1)
+    c1.initialize()
+    o, _ = c1(nd.array(np.ones((2, 2, 10), np.float32)),
+              c1.begin_state(2))
+    assert o.shape == (2, 3, 10)
+
+    r3 = gluon.contrib.rnn.Conv3DRNNCell(
+        input_shape=(1, 4, 4, 4), hidden_channels=2, i2h_kernel=3,
+        h2h_kernel=3, i2h_pad=1)
+    r3.initialize()
+    o, _ = r3(nd.array(np.ones((2, 1, 4, 4, 4), np.float32)),
+              r3.begin_state(2))
+    assert o.shape == (2, 2, 4, 4, 4)
+
+
+def test_conv_lstm_trains():
+    """Gradients flow through an unrolled conv LSTM."""
+    cell = gluon.contrib.rnn.Conv2DLSTMCell(
+        input_shape=(1, 6, 6), hidden_channels=2, i2h_kernel=3,
+        h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    xs = [nd.array(np.random.RandomState(i).rand(2, 1, 6, 6)
+                   .astype(np.float32)) for i in range(2)]
+    params = list(cell.collect_params().values())
+    with autograd.record():
+        outs, _ = cell.unroll(2, xs)
+        loss = (outs[-1] * outs[-1]).sum()
+    loss.backward()
+    assert any(np.abs(p.grad().asnumpy()).sum() > 0 for p in params)
+
+
+def test_variational_dropout_cell():
+    base = gluon.rnn.RNNCell(6, input_size=6)
+    vd = gluon.contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    with autograd.record():
+        ones = nd.array(np.ones((4, 6), np.float32))
+        st = vd.begin_state(4)
+        vd(ones, st)
+        m1 = vd._input_mask.asnumpy()
+        vd(ones, st)
+        m2 = vd._input_mask.asnumpy()
+    np.testing.assert_array_equal(m1, m2)  # locked mask across steps
+    vd.reset()
+    assert vd._input_mask is None
+    # eval mode: no dropout
+    out, _ = vd(ones, vd.begin_state(4))
+    assert np.isfinite(out.asnumpy()).all()
