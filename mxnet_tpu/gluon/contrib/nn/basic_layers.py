@@ -18,6 +18,12 @@ class Concurrent(Sequential):
         super().__init__(prefix=prefix, params=params)
         self.axis = axis
 
+    def __getitem__(self, key):
+        out = super().__getitem__(key)
+        if isinstance(out, Concurrent):
+            out.axis = self.axis  # slices must keep the concat axis
+        return out
+
     def forward(self, x):
         from .... import ndarray as nd
         outs = [block(x) for block in self._children.values()]
@@ -30,6 +36,12 @@ class HybridConcurrent(HybridSequential):
     def __init__(self, axis=-1, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self.axis = axis
+
+    def __getitem__(self, key):
+        out = super().__getitem__(key)
+        if isinstance(out, HybridConcurrent):
+            out.axis = self.axis
+        return out
 
     def hybrid_forward(self, F, x):
         outs = [block(x) for block in self._children.values()]

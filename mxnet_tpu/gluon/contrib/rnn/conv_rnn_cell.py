@@ -8,8 +8,6 @@ cells (cuDNN: LSTM i,f,g,o; GRU r,z,n).
 """
 from __future__ import annotations
 
-import numpy as _np
-
 from ...rnn.rnn_cell import HybridRecurrentCell
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
@@ -30,7 +28,7 @@ class _BaseConvRNNCell(HybridRecurrentCell):
 
     def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
                  i2h_pad=0, activation="tanh", prefix=None, params=None,
-                 conv_layout="NCHW", dims=2):
+                 dims=2):
         super().__init__(prefix=prefix, params=params)
         self._dims = dims
         self._input_shape = tuple(input_shape)  # (C, *spatial)

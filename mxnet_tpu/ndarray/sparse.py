@@ -465,3 +465,19 @@ def sparse_adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
     var._set_data(v.at[rows].set(new_vr.astype(v.dtype)))
     new_wr = wr - lr * new_mr / (jnp.sqrt(new_vr) + epsilon)
     weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
+
+
+def sparse_adagrad_update(weight, grad, state, lr, epsilon=1e-7, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad on the gradient's rows only (reference
+    _sparse_adagrad_update, optimizer_op.cc AdagradUpdateRspRspRspImpl).
+    Same formula as the dense adagrad_update restricted to the rows:
+    history accumulates the pure gradient, wd decays decoupled."""
+    rows, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows].astype(jnp.float32)
+    h = state._data
+    new_hr = h[rows] + jnp.square(g)
+    state._set_data(h.at[rows].set(new_hr.astype(h.dtype)))
+    new_wr = wr - lr * (g / jnp.sqrt(new_hr + epsilon) + wd * wr)
+    weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))

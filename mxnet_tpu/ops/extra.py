@@ -446,3 +446,47 @@ def _register_legacy_aliases():
 
 
 _register_legacy_aliases()
+
+
+@register("Crop")
+def crop(data, crop_like=None, *, offset=(0, 0), h_w=(0, 0),
+         center_crop=False, num_args=1):
+    """Legacy spatial crop (ref src/operator/crop.cc): crop data's H/W to
+    ``h_w`` (or to crop_like's spatial dims) at ``offset`` or centered."""
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    if oy < 0 or ox < 0 or oy + th > H or ox + tw > W:
+        # the reference CHECKs bounds; silent slice clamping would
+        # surface as a confusing downstream shape mismatch
+        raise ValueError("Crop out of bounds: offset (%d, %d) + size "
+                         "(%d, %d) exceeds input (%d, %d)"
+                         % (oy, ox, th, tw, H, W))
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+def _crop_unused(attrs):
+    return {"crop_like"} if int(attrs.get("num_args", 1)) < 2 else set()
+
+
+get_op("Crop").unused_inputs = _crop_unused
+
+
+def _register_syncbn_alias():
+    """_contrib_SyncBatchNorm shares the BatchNorm implementation: under
+    GSPMD batch sharding the batch-statistic reductions are already
+    global (XLA inserts the cross-device collectives), which is exactly
+    the synchronization the reference op implemented by hand."""
+    from .registry import _OP_REGISTRY
+    if "_contrib_SyncBatchNorm" not in _OP_REGISTRY:
+        _OP_REGISTRY["_contrib_SyncBatchNorm"] = _OP_REGISTRY["BatchNorm"]
+        _OP_REGISTRY["SyncBatchNorm"] = _OP_REGISTRY["BatchNorm"]
+
+
+_register_syncbn_alias()

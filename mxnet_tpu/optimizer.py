@@ -347,9 +347,15 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        adagrad_update(weight, grad, state, out=weight, lr=lr, wd=wd,
-                       epsilon=self.float_stable_eps,
-                       **self._common_kwargs(index))
+        if getattr(grad, "stype", "default") == "row_sparse":
+            from .ndarray import sparse as _sp
+            _sp.sparse_adagrad_update(weight, grad, state, lr,
+                                      epsilon=self.float_stable_eps, wd=wd,
+                                      **self._common_kwargs(index))
+        else:
+            adagrad_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                           epsilon=self.float_stable_eps,
+                           **self._common_kwargs(index))
 
 
 @register

@@ -183,3 +183,16 @@ def test_quadratic_and_legacy_aliases():
                            name="c")
     exe = s.simple_bind(ctx=mx.cpu(), d=(1, 1, 5, 5))
     assert exe.forward()[0].shape == (1, 2, 3, 3)
+
+
+def test_crop_and_syncbn_alias():
+    x = nd.array(np.arange(2 * 1 * 5 * 5, dtype=np.float32)
+                 .reshape(2, 1, 5, 5))
+    c = nd.Crop(x, h_w=(3, 3), center_crop=True)
+    np.testing.assert_array_equal(c.asnumpy()[0, 0],
+                                  x.asnumpy()[0, 0, 1:4, 1:4])
+    c2 = nd.Crop(x, nd.zeros((1, 1, 2, 2)), offset=(1, 2), num_args=2)
+    assert c2.shape == (2, 1, 2, 2)
+    s = sym.SyncBatchNorm(sym.Variable("d"), name="sbn")
+    exe = s.simple_bind(ctx=mx.cpu(), d=(2, 3, 4, 4))
+    assert exe.forward()[0].shape == (2, 3, 4, 4)

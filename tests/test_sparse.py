@@ -218,3 +218,20 @@ def test_embedding_sparse_grad_param_accepted():
                        sparse_grad=True)
     np.testing.assert_array_equal(out.asnumpy(),
                                   weight.asnumpy()[[1, 3]])
+
+
+def test_sparse_adagrad_lazy_update():
+    """AdaGrad rows-only update (reference _sparse_adagrad_update)."""
+    w0 = np.ones((5, 2), np.float32)
+    weight = nd.array(w0.copy())
+    opt = mx.optimizer.AdaGrad(learning_rate=0.5)
+    state = opt.create_state(0, weight)
+    g = sp.row_sparse_array((np.full((1, 2), 2.0, np.float32), [3]),
+                            shape=(5, 2))
+    opt.update(0, weight, g, state)
+    got = weight.asnumpy()
+    # h = 4, w = 1 - 0.5*2/(2+eps) ~ 0.5
+    np.testing.assert_allclose(got[3], 0.5, rtol=1e-4)
+    np.testing.assert_array_equal(got[[0, 1, 2, 4]], w0[[0, 1, 2, 4]])
+    np.testing.assert_array_equal(state.asnumpy()[[0, 1, 2, 4]],
+                                  np.zeros((4, 2)))
