@@ -12,3 +12,6 @@ from .image import (imread, imdecode, imresize, scale_down, resize_short,
                     LightingAug, ColorNormalizeAug, RandomGrayAug,
                     HorizontalFlipAug, CastAug, CreateAugmenter, ImageIter)
 from .record_iter import ImageRecordIter
+from .detection import (ImageDetIter, CreateDetAugmenter,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetBorderAug)

@@ -1,0 +1,278 @@
+"""Detection data iterator + box-aware augmenters.
+
+Reference parity: python/mxnet/image/detection.py (ImageDetIter:625 and
+the Det* augmenters) + src/io/iter_image_det_recordio.cc. Labels follow
+the reference wire format: per image a flat float array
+``[header_width, object_width, <header...>, (id, x1, y1, x2, y2)...]``
+with normalized corner coords; batches pad the object dimension with -1
+rows to the epoch-wide max. Augmentations transform boxes together with
+pixels (crop clips + renormalizes, flip mirrors x), all host-side numpy
+like the rest of mx.image.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import image as _img
+
+__all__ = ["ImageDetIter", "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetBorderAug", "CreateDetAugmenter"]
+
+
+def _parse_det_label(raw):
+    """Flat reference label -> (K, 1+4+extra) object array
+    (reference detection.py:723 _check_valid_label)."""
+    raw = _np.asarray(raw, _np.float32).ravel()
+    if raw.size >= 2 and raw.size > int(raw[0]):
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if header_width >= 2 and obj_width >= 5 \
+                and (raw.size - header_width) % obj_width == 0:
+            return raw[header_width:].reshape(-1, obj_width)
+    # plain (id, x1, y1, x2, y2)* fallback
+    if raw.size % 5 == 0:
+        return raw.reshape(-1, 5)
+    raise MXNetError("invalid detection label of size %d" % raw.size)
+
+
+class DetAugmenter:
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p (reference
+    detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetBorderAug(DetAugmenter):
+    """Pad the image with a filled border, rescaling boxes (reference
+    DetRandomPadAug simplified to a fixed expansion)."""
+
+    def __init__(self, expand=1.5, fill=127):
+        self.expand = float(expand)
+        self.fill = fill
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        nh, nw = int(h * self.expand), int(w * self.expand)
+        oy = _pyrandom.randint(0, nh - h)
+        ox = _pyrandom.randint(0, nw - w)
+        out = _np.full((nh, nw) + src.shape[2:], self.fill, src.dtype)
+        out[oy:oy + h, ox:ox + w] = src
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * w + ox) / nw
+        label[:, 3] = (label[:, 3] * w + ox) / nw
+        label[:, 2] = (label[:, 2] * h + oy) / nh
+        label[:, 4] = (label[:, 4] * h + oy) / nh
+        return out, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough box overlap; boxes are clipped and
+    renormalized, fully-cropped-out boxes dropped (reference
+    DetRandomCropAug, min_object_covered semantics simplified)."""
+
+    def __init__(self, min_crop_scale=0.6, min_object_covered=0.3,
+                 max_attempts=10):
+        self.min_crop_scale = min_crop_scale
+        self.min_object_covered = min_object_covered
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            s = _pyrandom.uniform(self.min_crop_scale, 1.0)
+            cw, ch = int(w * s), int(h * s)
+            x0 = _pyrandom.randint(0, w - cw)
+            y0 = _pyrandom.randint(0, h - ch)
+            new = self._crop_boxes(label, x0, y0, cw, ch, w, h)
+            if len(new):
+                return src[y0:y0 + ch, x0:x0 + cw], new
+        return src, label
+
+    def _crop_boxes(self, label, x0, y0, cw, ch, w, h):
+        out = []
+        for row in label:
+            bx1, by1, bx2, by2 = (row[1] * w, row[2] * h,
+                                  row[3] * w, row[4] * h)
+            ix1, iy1 = max(bx1, x0), max(by1, y0)
+            ix2, iy2 = min(bx2, x0 + cw), min(by2, y0 + ch)
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            area = max((bx2 - bx1) * (by2 - by1), 1e-8)
+            if inter / area < self.min_object_covered:
+                continue
+            new = row.copy()
+            new[1] = (ix1 - x0) / cw
+            new[2] = (iy1 - y0) / ch
+            new[3] = (ix2 - x0) / cw
+            new[4] = (iy2 - y0) / ch
+            out.append(new)
+        return _np.asarray(out, _np.float32).reshape(-1, label.shape[1])
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, inter_method=2, **kwargs):
+    """Standard detection augmenter list (reference
+    detection.py CreateDetAugmenter). Pixel-only augmenters wrap the
+    mx.image classes; geometric ones are box-aware."""
+    auglist = []
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug())
+    if rand_pad > 0:
+        auglist.append(DetBorderAug())
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+
+    pixel = []
+    if brightness or contrast or saturation:
+        pixel.append(_img.ColorJitterAug(brightness, contrast, saturation))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53], _np.float32)
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375], _np.float32)
+    if mean is not None or std is not None:
+        pixel.append(_img.ColorNormalizeAug(mean, std))
+
+    class _PixelWrap(DetAugmenter):
+        # pixel-only augs leave boxes untouched AND may produce float
+        # arrays, so ImageDetIter runs them after the final resize
+        pixel = True
+
+        def __init__(self, aug):
+            self.aug = aug
+
+        def __call__(self, src, label):
+            return self.aug._apply_np(src), label
+
+    auglist.extend(_PixelWrap(a) for a in pixel)
+    return auglist
+
+
+class ImageDetIter(_img.ImageIter):
+    """ImageIter for detection: labels are padded object arrays
+    (reference detection.py:625)."""
+
+    _ITER_KWARGS = ("label_width", "part_index", "num_parts", "dtype")
+    _SCAN_LIMIT = 512
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="label",
+                 last_batch_handle="pad", label_shape=None, **kwargs):
+        iter_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                       if k in self._ITER_KWARGS}
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        elif kwargs:
+            raise MXNetError("unexpected arguments with explicit "
+                             "aug_list: %s" % sorted(kwargs))
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         path_imgidx=path_imgidx, shuffle=shuffle,
+                         aug_list=[], imglist=imglist, data_name=data_name,
+                         label_name=label_name,
+                         last_batch_handle=last_batch_handle,
+                         **iter_kwargs)
+        self.det_auglist = aug_list
+        if label_shape is not None:
+            self._max_objects = int(label_shape[0])
+            self._obj_width = int(label_shape[1])
+        else:
+            self._max_objects = self._scan_max_objects()
+        from ..io import DataDesc
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, self._max_objects,
+                                        self._obj_width), "float32")]
+
+    def _scan_max_objects(self):
+        """Estimate the object pad width from the first _SCAN_LIMIT
+        labels (the reference sizes via ``label_shape``; pass it
+        explicitly for exact control — an image exceeding the estimate
+        raises at iteration, never silently truncates)."""
+        from ..recordio import unpack
+        max_obj, obj_w = 1, 5
+
+        def see(raw):
+            nonlocal max_obj, obj_w
+            lab = _parse_det_label(raw)
+            max_obj = max(max_obj, len(lab))
+            obj_w = max(obj_w, lab.shape[1])
+
+        if self.imgrec is not None and self.seq is not None:
+            for idx in self.seq[:self._SCAN_LIMIT]:
+                header, _ = unpack(self.imgrec.read_idx(idx))
+                see(header.label)
+        elif self.imgrec is not None:
+            for _ in range(self._SCAN_LIMIT):
+                s = self.imgrec.read()
+                if s is None:
+                    break
+                see(unpack(s)[0].label)
+            self.imgrec.reset()
+        elif self.imglist is not None:
+            for label, _ in list(self.imglist.values())[:self._SCAN_LIMIT]:
+                see(label)
+        self._obj_width = obj_w
+        return max_obj
+
+    def next(self):
+        from ..io import DataBatch
+        from .. import ndarray as nd
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        label = _np.full((self.batch_size, self._max_objects,
+                          self._obj_width), -1.0, _np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                lab, raw = self.next_sample()
+                img = _img._to_np(_img.imdecode(raw))
+                objs = _parse_det_label(lab)
+                # geometric (box-aware) augs on uint8, then resize, then
+                # pixel-only augs (they may produce float, which the
+                # PIL-backed resize cannot take)
+                for aug in self.det_auglist:
+                    if not getattr(aug, "pixel", False):
+                        img, objs = aug(img, objs)
+                img = _img._to_np(_img.imresize(img, w, h))
+                for aug in self.det_auglist:
+                    if getattr(aug, "pixel", False):
+                        img, objs = aug(img, objs)
+                img = img.astype(_np.float32)
+                data[i] = img.transpose(2, 0, 1)
+                if len(objs) > self._max_objects:
+                    raise MXNetError(
+                        "image has %d objects but label pad width is %d "
+                        "— pass label_shape=(max_objects, %d)"
+                        % (len(objs), self._max_objects, self._obj_width))
+                if len(objs):
+                    label[i, :len(objs)] = objs
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            if self.last_batch_handle == "discard":
+                raise
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         pad=self.batch_size - i,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -478,15 +478,19 @@ def _crop_unused(attrs):
 get_op("Crop").unused_inputs = _crop_unused
 
 
-def _register_syncbn_alias():
-    """_contrib_SyncBatchNorm shares the BatchNorm implementation: under
-    GSPMD batch sharding the batch-statistic reductions are already
-    global (XLA inserts the cross-device collectives), which is exactly
-    the synchronization the reference op implemented by hand."""
-    from .registry import _OP_REGISTRY
-    if "_contrib_SyncBatchNorm" not in _OP_REGISTRY:
-        _OP_REGISTRY["_contrib_SyncBatchNorm"] = _OP_REGISTRY["BatchNorm"]
-        _OP_REGISTRY["SyncBatchNorm"] = _OP_REGISTRY["BatchNorm"]
-
-
-_register_syncbn_alias()
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",),
+          num_outputs=5, num_visible_outputs=1,
+          mutate_inputs=(("moving_mean", 3), ("moving_var", 4)))
+def sync_batch_norm(data, gamma, beta, moving_mean=None, moving_var=None,
+                    *, eps=1e-3, momentum=0.9, fix_gamma=True,
+                    use_global_stats=False, key=None, ndev=1):
+    """Cross-device synchronized BatchNorm (ref
+    contrib/sync_batch_norm.cc). Under GSPMD batch sharding the batch
+    statistics reductions are already global — XLA inserts the
+    cross-device collectives — so this forwards to BatchNorm; ``key``
+    and ``ndev`` (the reference's comm handle) are accepted and
+    unused."""
+    return get_op("BatchNorm").fn(
+        data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats)

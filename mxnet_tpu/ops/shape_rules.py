@@ -88,6 +88,7 @@ def _channel_shapes(known, attrs):
 
 
 _set("BatchNorm", _channel_shapes)
+_set("_contrib_SyncBatchNorm", _channel_shapes)
 
 
 def _ln_shapes(known, attrs):

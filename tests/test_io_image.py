@@ -266,3 +266,51 @@ def test_gluon_image_record_dataset(tmp_path):
     loader = mx.gluon.data.DataLoader(ds, batch_size=4)
     batches = list(loader)
     assert len(batches) == 2
+
+
+def test_image_det_iter(tmp_path):
+    """ImageDetIter: reference det label wire format, padded object
+    labels, box-aware flip (reference image/detection.py)."""
+    rng = np.random.RandomState(11)
+    idxp, recp = str(tmp_path / "det.idx"), str(tmp_path / "det.rec")
+    rec = recordio.MXIndexedRecordIO(idxp, recp, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+        nobj = 1 + i % 3
+        objs = []
+        for j in range(nobj):
+            objs += [float(j % 2), 0.1, 0.2, 0.5, 0.6]
+        # reference wire format: [header_width, object_width, <header>, objs]
+        label = np.array([2.0, 5.0] + objs, np.float32)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    rec.close()
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 28, 28),
+                               path_imgrec=recp, path_imgidx=idxp)
+    assert it.provide_label[0].shape == (4, 3, 5)  # max 3 objects
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (4, 3, 5)
+        valid = lab[lab[:, :, 0] >= 0]
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+        n += 4 - (batch.pad or 0)
+    assert n == 8
+
+    # flip aug mirrors boxes
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    img = np.zeros((10, 10, 3), np.float32)
+    lab = np.array([[0, 0.1, 0.2, 0.5, 0.6]], np.float32)
+    _, flipped = aug(img, lab)
+    np.testing.assert_allclose(flipped[0], [0, 0.5, 0.2, 0.9, 0.6],
+                               rtol=1e-6)
+
+    # crop clips + renormalizes boxes into [0, 1]
+    crop = mx.image.DetRandomCropAug(min_crop_scale=0.5)
+    img2 = np.zeros((20, 20, 3), np.float32)
+    lab2 = np.array([[1, 0.25, 0.25, 0.75, 0.75]], np.float32)
+    out_img, out_lab = crop(img2, lab2)
+    if len(out_lab):
+        assert (out_lab[:, 1:] >= -1e-6).all() \
+            and (out_lab[:, 1:] <= 1 + 1e-6).all()
