@@ -82,3 +82,24 @@ def cond(pred, then_func, else_func):
     if bool(pred.asscalar()):
         return then_func()
     return else_func()
+
+
+# ----------------------------------------------------------------------
+# expose every _contrib_* registry op under its stripped name
+# (reference python/mxnet/ndarray/contrib.py is code-generated the same
+# way from the _contrib_ prefix)
+# ----------------------------------------------------------------------
+def _install_contrib_ops():
+    from ..ops import registry as _reg
+    from .register import _make_op_func
+    g = globals()
+    for _name in _reg.list_ops():
+        if not _name.startswith("_contrib_"):
+            continue
+        short = _name[len("_contrib_"):]
+        if short in g:  # hand-written wrappers (foreach/while_loop/cond) win
+            continue
+        g[short] = _make_op_func(_reg.get_op(_name), short)
+
+
+_install_contrib_ops()

@@ -68,11 +68,28 @@ def _defscalar(name, fwd, rev=None, aliases=()):
 
 
 _defscalar("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
-_defscalar("_minus_scalar", jnp.subtract, jnp.subtract, aliases=("_rminus_scalar", "_MinusScalar"))
+_defscalar("_minus_scalar", jnp.subtract, jnp.subtract, aliases=("_MinusScalar",))
 _defscalar("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
-_defscalar("_div_scalar", jnp.divide, jnp.divide, aliases=("_rdiv_scalar", "_DivScalar"))
-_defscalar("_mod_scalar", jnp.mod, jnp.mod, aliases=("_rmod_scalar",))
-_defscalar("_power_scalar", jnp.power, jnp.power, aliases=("_rpower_scalar", "_PowerScalar"))
+_defscalar("_div_scalar", jnp.divide, jnp.divide, aliases=("_DivScalar",))
+_defscalar("_mod_scalar", jnp.mod, jnp.mod, aliases=("_ModScalar",))
+_defscalar("_power_scalar", jnp.power, jnp.power, aliases=("_PowerScalar",))
+
+
+def _defrscalar(name, fn, aliases=()):
+    """Reversed scalar op: out = fn(scalar, data) — the reference's
+    _r*_scalar ops (elemwise_binary_scalar_op_basic.cc) where the scalar
+    is the LEFT operand."""
+    def impl(data, *, scalar=1.0):
+        s = jnp.asarray(scalar, dtype=data.dtype)
+        return fn(s, data)
+    impl.__name__ = name
+    register(name, aliases=aliases)(impl)
+
+
+_defrscalar("_rminus_scalar", jnp.subtract, aliases=("_RMinusScalar",))
+_defrscalar("_rdiv_scalar", jnp.divide, aliases=("_RDivScalar",))
+_defrscalar("_rmod_scalar", jnp.mod, aliases=("_RModScalar",))
+_defrscalar("_rpower_scalar", jnp.power, aliases=("_RPowerScalar",))
 _defscalar("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
 _defscalar("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
 

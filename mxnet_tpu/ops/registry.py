@@ -279,6 +279,21 @@ def register(name=None, **opts):
     return deco
 
 
+def add_alias(name, *aliases):
+    """Register additional alias names for an existing op (the analog of
+    NNVM ``.add_alias`` applied after the fact — used for the legacy
+    CamelCase names the reference keeps for 0.x compatibility)."""
+    opdef = get_op(name)
+    for alias in aliases:
+        existing = _OP_REGISTRY.get(alias)
+        if existing is not None and existing is not opdef:
+            raise MXNetError("alias '%s' already registered to '%s'"
+                             % (alias, existing.name))
+        _OP_REGISTRY[alias] = opdef
+        if alias not in opdef.aliases:
+            opdef.aliases = opdef.aliases + (alias,)
+
+
 def get_op(name) -> OpDef:
     try:
         return _OP_REGISTRY[name]

@@ -160,3 +160,10 @@ _set("SequenceLast",
      unused_inputs=lambda attrs: set() if attrs.get("use_sequence_length") else {"sequence_length"})
 _set("SequenceReverse",
      unused_inputs=lambda attrs: set() if attrs.get("use_sequence_length") else {"sequence_length"})
+
+
+# same weight/bias shapes as Convolution (offset is a data input)
+_set("_contrib_DeformableConvolution", _conv_shapes,
+     lambda attrs: {"bias"} if attrs.get("no_bias") else set())
+_set("_contrib_DeformablePSROIPooling",
+     unused_inputs=lambda attrs: {"trans"} if attrs.get("no_trans") else set())

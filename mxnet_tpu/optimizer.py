@@ -523,18 +523,16 @@ class FTML(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
-        grad = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, a_min=-self.clip_gradient,
-                           a_max=self.clip_gradient)
         d_t, v_t, z_t = state
-        v_t[:] = self.beta2 * v_t + (1.0 - self.beta2) * grad * grad
-        d_new = ((1.0 - self.beta1 ** t) / lr
-                 * (nd.sqrt(v_t / (1.0 - self.beta2 ** t)) + self.epsilon))
-        sigma_t = d_new - self.beta1 * d_t
-        z_t[:] = self.beta1 * z_t + (1.0 - self.beta1) * grad - sigma_t * weight
-        weight[:] = -z_t / d_new
-        d_t[:] = d_new
+        # one fused XLA computation (ref optimizer_op.cc FTMLUpdate; note
+        # the reference op applies wd to the gradient pre-clip)
+        nd.ftml_update(weight, grad, d_t, v_t, z_t, out=weight, lr=lr, t=t,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, wd=wd,
+                       rescale_grad=self.rescale_grad,
+                       clip_grad=(self.clip_gradient
+                                  if self.clip_gradient is not None
+                                  else -1.0))
 
 
 @register

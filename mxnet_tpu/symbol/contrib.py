@@ -227,3 +227,24 @@ def cond(pred, then_func, else_func, name="cond"):
     out_all = _make_node("_cond", fn, n_out,
                          [pred] + list(map(Variable, all_params)), name)
     return out_all[0] if single else out_all
+
+
+# ----------------------------------------------------------------------
+# expose every _contrib_* registry op under its stripped name
+# (reference python/mxnet/symbol/contrib.py is code-generated the same
+# way from the _contrib_ prefix)
+# ----------------------------------------------------------------------
+def _install_contrib_ops():
+    from ..ops import registry as _reg
+    from . import _make_sym_func
+    g = globals()
+    for _name in _reg.list_ops():
+        if not _name.startswith("_contrib_"):
+            continue
+        short = _name[len("_contrib_"):]
+        if short in g:  # hand-written wrappers (foreach/while_loop/cond) win
+            continue
+        g[short] = _make_sym_func(_reg.get_op(_name), short)
+
+
+_install_contrib_ops()

@@ -1,0 +1,426 @@
+"""Long-tail + RCNN op tests.
+
+Models: reference tests/python/unittest/test_operator.py (slice_assign,
+hard_sigmoid, samplers) and the contrib op tests (proposal, deformable ops,
+count_sketch).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ops import get_op
+
+
+def test_legacy_aliases_resolve():
+    for alias, canon in [
+            ("_Equal", "broadcast_equal"),
+            ("_Maximum", "broadcast_maximum"),
+            ("_Mod", "broadcast_mod"),
+            ("_Hypot", "broadcast_hypot"),
+            ("_EqualScalar", "_equal_scalar"),
+            ("_LogicalAndScalar", "_logical_and_scalar"),
+            ("_RMinusScalar", "_rminus_scalar"),
+            ("_RDivScalar", "_rdiv_scalar"),
+            ("_RPowerScalar", "_rpower_scalar"),
+            ("_HypotScalar", "_hypot_scalar"),
+            ("_contrib_CTCLoss", "_contrib_ctc_loss"),
+            ("_contrib_box_non_maximum_suppression", "_contrib_box_nms"),
+            ("_contrib_SparseEmbedding", "Embedding"),
+            ("_crop_assign", "_slice_assign"),
+    ]:
+        assert get_op(alias) is get_op(canon)
+
+
+def test_reverse_scalar_semantics():
+    x = nd.array(np.asarray([1.0, 2.0, 4.0], np.float32))
+    assert np.allclose(get_op("_rminus_scalar").fn(x._data, scalar=5.0),
+                       [4.0, 3.0, 1.0])
+    assert np.allclose(get_op("_rdiv_scalar").fn(x._data, scalar=8.0),
+                       [8.0, 4.0, 2.0])
+    assert np.allclose(get_op("_rpower_scalar").fn(x._data, scalar=2.0),
+                       [2.0, 4.0, 16.0])
+    assert np.allclose(get_op("_rmod_scalar").fn(x._data, scalar=5.0),
+                       [0.0, 1.0, 1.0])
+
+
+def test_hard_sigmoid():
+    x = nd.array(np.asarray([-10.0, -1.0, 0.0, 1.0, 10.0], np.float32))
+    out = nd.hard_sigmoid(x).asnumpy()
+    assert np.allclose(out, np.clip(0.2 * x.asnumpy() + 0.5, 0, 1))
+    out = nd.hard_sigmoid(x, alpha=0.5, beta=0.0).asnumpy()
+    assert np.allclose(out, np.clip(0.5 * x.asnumpy(), 0, 1))
+
+
+def test_slice_assign():
+    lhs = np.zeros((4, 5), np.float32)
+    rhs = np.ones((2, 2), np.float32) * 3
+    out = get_op("_slice_assign").fn(jnp.asarray(lhs), jnp.asarray(rhs),
+                                     begin=(1, 2), end=(3, 4))
+    expect = lhs.copy()
+    expect[1:3, 2:4] = 3
+    assert np.allclose(out, expect)
+    out = get_op("_slice_assign_scalar").fn(jnp.asarray(lhs), scalar=7,
+                                            begin=(0,), end=(2,))
+    expect = lhs.copy()
+    expect[0:2] = 7
+    assert np.allclose(out, expect)
+
+
+def test_scatter_ops_dense_semantics():
+    x = jnp.asarray(np.arange(6, dtype=np.float32))
+    assert np.allclose(get_op("_scatter_plus_scalar").fn(x, scalar=2.0),
+                       np.arange(6) + 2)
+    assert np.allclose(get_op("_scatter_minus_scalar").fn(x, scalar=1.0),
+                       np.arange(6) - 1)
+    y = jnp.asarray(np.full(6, 2.0, np.float32))
+    assert np.allclose(get_op("_scatter_elemwise_div").fn(x, y),
+                       np.arange(6) / 2.0)
+    assert np.allclose(get_op("_identity_with_attr_like_rhs").fn(x, y), x)
+
+
+def test_sparse_named_registry_ops():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    assert np.allclose(get_op("cast_storage").fn(jnp.asarray(x),
+                                                 stype="row_sparse"), x)
+    with pytest.raises(ValueError):
+        get_op("cast_storage").fn(jnp.asarray(x), stype="bogus")
+    out = get_op("_square_sum").fn(jnp.asarray(x), axis=1)
+    assert np.allclose(out, (x ** 2).sum(axis=1))
+    out = get_op("_sparse_retain").fn(jnp.asarray(x), jnp.asarray([1, 3]))
+    expect = np.zeros_like(x)
+    expect[[1, 3]] = x[[1, 3]]
+    assert np.allclose(out, expect)
+
+
+def test_sparse_adagrad_update_op():
+    w = jnp.ones((4,), jnp.float32)
+    g = jnp.full((4,), 0.5, jnp.float32)
+    h = jnp.zeros((4,), jnp.float32)
+    new_w, new_h = get_op("_sparse_adagrad_update").fn(
+        w, g, h, lr=0.1, epsilon=1e-7)
+    assert np.allclose(new_h, 0.25)
+    # reference AdagradDnsRspDnsKernel: eps inside the sqrt
+    assert np.allclose(new_w, 1.0 - 0.1 * 0.5 / np.sqrt(0.25 + 1e-7),
+                       atol=1e-6)
+    # same math as the row-sliced frontend in ndarray/sparse.py
+    from mxnet_tpu.ndarray import sparse as sp
+    wnd = nd.array(np.ones((4, 2), np.float32))
+    hnd = nd.array(np.zeros((4, 2), np.float32))
+    gnd = sp.cast_storage(nd.array(np.full((4, 2), 0.5, np.float32)),
+                          "row_sparse")
+    sp.sparse_adagrad_update(wnd, gnd, hnd, 0.1, epsilon=1e-7, wd=0.01)
+    w2 = jnp.ones((4, 2), jnp.float32)
+    h2 = jnp.zeros((4, 2), jnp.float32)
+    new_w2, _ = get_op("_sparse_adagrad_update").fn(
+        w2, jnp.full((4, 2), 0.5), h2, lr=0.1, epsilon=1e-7, wd=0.01)
+    assert np.allclose(wnd.asnumpy(), new_w2, atol=1e-6)
+
+
+def test_ftml_optimizer_converges():
+    w = nd.array(np.ones(4, np.float32) * 5)
+    opt = mx.optimizer.FTML(learning_rate=0.1)
+    state = opt.create_state(0, w)
+    for _ in range(200):
+        g = 2.0 * (w - 3.0)
+        opt.update(0, w, g, state)
+    assert np.allclose(w.asnumpy(), 3.0, atol=1e-2)
+
+
+def test_negative_binomial_samplers():
+    mx.random.seed(7)
+    x = nd.random_negative_binomial(k=5, p=0.5, shape=(2000,)).asnumpy()
+    # NB(k, p): mean = k(1-p)/p = 5
+    assert abs(x.mean() - 5.0) < 0.5
+    assert (x >= 0).all() and np.allclose(x, np.round(x))
+    y = nd.random_generalized_negative_binomial(
+        mu=4.0, alpha=0.25, shape=(2000,)).asnumpy()
+    assert abs(y.mean() - 4.0) < 0.5
+
+
+def test_sample_row_distributions():
+    mx.random.seed(3)
+    lam = nd.array(np.asarray([1.0, 10.0], np.float32))
+    x = nd.sample_poisson(lam, shape=(1000,)).asnumpy()
+    assert x.shape == (2, 1000)
+    assert abs(x[0].mean() - 1.0) < 0.3 and abs(x[1].mean() - 10.0) < 1.0
+    a = nd.array(np.asarray([2.0, 50.0], np.float32))
+    b = nd.array(np.asarray([1.0, 0.1], np.float32))
+    g = nd.sample_gamma(a, b, shape=(1000,)).asnumpy()
+    assert abs(g[0].mean() - 2.0) < 0.4 and abs(g[1].mean() - 5.0) < 0.8
+    e = nd.sample_exponential(lam, shape=(1000,)).asnumpy()
+    assert abs(e[0].mean() - 1.0) < 0.3 and abs(e[1].mean() - 0.1) < 0.05
+    k = nd.array(np.asarray([4.0], np.float32))
+    p = nd.array(np.asarray([0.5], np.float32))
+    s = nd.sample_negative_binomial(k, p, shape=(1500,)).asnumpy()
+    assert abs(s.mean() - 4.0) < 0.6
+
+
+def test_count_sketch_and_div_sqrt_dim():
+    d = jnp.asarray([[1.0, 2.0, 3.0]])
+    h = jnp.asarray([0, 2, 0])
+    s = jnp.asarray([1.0, -1.0, 1.0])
+    out = get_op("_contrib_count_sketch").fn(d, h, s, out_dim=3)
+    assert np.allclose(out, [[4.0, 0.0, -2.0]])
+    x = jnp.ones((2, 16))
+    assert np.allclose(get_op("_contrib_div_sqrt_dim").fn(x), 0.25)
+
+
+def test_identity_attach_kl_sparse_reg():
+    from mxnet_tpu.ops.registry import _OpCtxScope
+    # per-unit activations: column j has mean j/8 (ref tracks a PER-UNIT
+    # moving average, sumall_except_dim<1>/batch)
+    cols = (np.arange(8, dtype=np.float32) + 1) / 10.0
+    x = jnp.asarray(np.tile(cols, (4, 1)))
+    avg = jnp.full((8,), 0.1, jnp.float32)
+    with _OpCtxScope(True, jax.random.PRNGKey(0)):
+        out, new_avg = get_op("IdentityAttachKLSparseReg").fn(
+            x, avg, sparseness_target=0.1, penalty=0.01, momentum=0.9)
+    assert np.allclose(out, x)  # identity forward
+    expect_avg = 0.9 * 0.1 + 0.1 * cols
+    assert np.allclose(new_avg, expect_avg, atol=1e-6)
+
+    # gradient = upstream + penalty * KL'(new_avg), per unit, using the
+    # momentum-smoothed average (reference Backward)
+    def f(z):
+        with _OpCtxScope(True, jax.random.PRNGKey(0)):
+            o, _ = get_op("IdentityAttachKLSparseReg").fn(
+                z, avg, sparseness_target=0.1, penalty=0.01, momentum=0.9)
+        return o.sum()
+
+    g = np.asarray(jax.grad(f)(x))
+    kl = 0.01 * (-0.1 / expect_avg + 0.9 / (1 - expect_avg))
+    assert np.allclose(g, 1.0 + kl[None, :], atol=1e-6)
+
+    # eval mode leaves the moving average untouched (ref updates it only
+    # in Backward, i.e. training)
+    with _OpCtxScope(False, jax.random.PRNGKey(0)):
+        _, same_avg = get_op("IdentityAttachKLSparseReg").fn(
+            x, avg, sparseness_target=0.1, penalty=0.01, momentum=0.9)
+    assert np.allclose(same_avg, avg)
+
+
+# ----------------------------------------------------------------------
+# RCNN family
+# ----------------------------------------------------------------------
+def _proposal_inputs(B=1, A=3, H=8, W=8, seed=0):
+    rng = np.random.RandomState(seed)
+    cls = rng.rand(B, 2 * A, H, W).astype(np.float32)
+    bbox = (rng.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+    info = np.tile(np.asarray([[128.0, 128.0, 1.0]], np.float32), (B, 1))
+    return jnp.asarray(cls), jnp.asarray(bbox), jnp.asarray(info)
+
+
+def test_proposal_shapes_and_validity():
+    cls, bbox, info = _proposal_inputs()
+    rois = get_op("_contrib_Proposal").fn(
+        cls, bbox, info, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+        feature_stride=16, scales=(8,), ratios=(0.5, 1, 2))
+    assert rois.shape == (10, 5)
+    r = np.asarray(rois)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 127).all()
+    assert (r[:, 2] >= 0).all() and (r[:, 4] <= 127).all()
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+def test_proposal_nms_suppresses():
+    cls, bbox, info = _proposal_inputs()
+    loose = get_op("_contrib_Proposal").fn(
+        cls, bbox, info, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=20,
+        threshold=0.95, feature_stride=16, scales=(8,), ratios=(0.5, 1, 2))
+    tight = get_op("_contrib_Proposal").fn(
+        cls, bbox, info, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=20,
+        threshold=0.05, feature_stride=16, scales=(8,), ratios=(0.5, 1, 2))
+    # a stricter overlap threshold keeps fewer distinct boxes (padding
+    # recycles survivors, so count unique rows)
+    n_loose = len(np.unique(np.asarray(loose), axis=0))
+    n_tight = len(np.unique(np.asarray(tight), axis=0))
+    assert n_tight <= n_loose
+
+
+def test_multi_proposal_batched():
+    cls1, bbox1, info1 = _proposal_inputs(B=1, A=2)
+    cls = jnp.concatenate([cls1, cls1])
+    bbox = jnp.concatenate([bbox1, bbox1])
+    info = jnp.concatenate([info1, info1])
+    rois, scores = get_op("_contrib_MultiProposal").fn(
+        cls, bbox, info, rpn_pre_nms_top_n=40, rpn_post_nms_top_n=8,
+        feature_stride=16, scales=(8,), ratios=(1, 2), output_score=True)
+    assert rois.shape == (16, 5) and scores.shape == (16, 1)
+    r = np.asarray(rois)
+    assert (r[:8, 0] == 0).all() and (r[8:, 0] == 1).all()
+    # identical images -> identical per-image proposals
+    assert np.allclose(r[:8, 1:], r[8:, 1:])
+
+
+def test_psroi_pooling():
+    C_out, G = 2, 3
+    data = jnp.full((1, C_out * G * G, 16, 16), 7.0)
+    rois = jnp.asarray([[0.0, 2.0, 2.0, 10.0, 10.0]])
+    out = get_op("_contrib_PSROIPooling").fn(
+        data, rois, spatial_scale=1.0, output_dim=C_out, pooled_size=3,
+        group_size=G)
+    assert out.shape == (1, C_out, 3, 3)
+    assert np.allclose(out, 7.0, atol=1e-4)
+    # position sensitivity: only channel c*G*G + i*G + j feeds bin (i, j)
+    d2 = np.zeros((1, C_out * G * G, 16, 16), np.float32)
+    d2[0, 4] = 100.0  # c=0, i=1, j=1
+    o2 = np.asarray(get_op("_contrib_PSROIPooling").fn(
+        jnp.asarray(d2), rois, spatial_scale=1.0, output_dim=C_out,
+        pooled_size=3, group_size=G))
+    assert abs(o2[0, 0, 1, 1] - 100) < 1e-3
+    assert abs(o2[0, 0, 0, 0]) < 1e-6 and abs(o2[0, 1, 1, 1]) < 1e-6
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    B, C, H, W, F = 2, 4, 8, 8, 6
+    x = rng.randn(B, C, H, W).astype(np.float32)
+    w = rng.randn(F, C, 3, 3).astype(np.float32)
+    off = np.zeros((B, 18, H, W), np.float32)
+    out = get_op("_contrib_DeformableConvolution").fn(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), None,
+        kernel=(3, 3), num_filter=F, pad=(1, 1), no_bias=True)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    assert float(jnp.abs(out - ref).max()) < 1e-3
+
+
+def test_deformable_conv_integer_offset_shifts():
+    rng = np.random.RandomState(1)
+    B, C, H, W, F = 1, 2, 8, 8, 3
+    x = rng.randn(B, C, H, W).astype(np.float32)
+    w = rng.randn(F, C, 3, 3).astype(np.float32)
+    off = np.zeros((B, 18, H, W), np.float32)
+    off[:, 1::2] = 1.0  # all x-offsets +1: sample one pixel right
+    out = get_op("_contrib_DeformableConvolution").fn(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), None,
+        kernel=(3, 3), num_filter=F, pad=(1, 1), no_bias=True)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(np.roll(x, -1, axis=3)), jnp.asarray(w), (1, 1),
+        [(1, 1), (1, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    err = float(jnp.abs(out[:, :, 1:-1, 1:-2] - ref[:, :, 1:-1, 1:-2]).max())
+    assert err < 1e-3
+
+
+def test_deformable_conv_groups_and_bias():
+    rng = np.random.RandomState(2)
+    B, C, H, W, F = 1, 4, 6, 6, 4
+    x = rng.randn(B, C, H, W).astype(np.float32)
+    w = rng.randn(F, C // 2, 3, 3).astype(np.float32)
+    b = rng.randn(F).astype(np.float32)
+    off = np.zeros((B, 2 * 9 * 2, H, W), np.float32)
+    out = get_op("_contrib_DeformableConvolution").fn(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), jnp.asarray(b),
+        kernel=(3, 3), num_filter=F, pad=(1, 1), num_group=2,
+        num_deformable_group=2)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=2) + jnp.asarray(b).reshape(1, F, 1, 1)
+    assert float(jnp.abs(out - ref).max()) < 1e-3
+
+
+def test_deformable_psroi_pooling():
+    C_out, G = 2, 3
+    data = jnp.full((1, C_out * G * G, 16, 16), 3.0)
+    rois = jnp.asarray([[0.0, 2.0, 2.0, 10.0, 10.0]])
+    tr = jnp.zeros((1, 2, 3, 3))
+    out = get_op("_contrib_DeformablePSROIPooling").fn(
+        data, rois, tr, spatial_scale=1.0, output_dim=C_out, group_size=G,
+        pooled_size=3, part_size=3, sample_per_part=2, trans_std=0.1)
+    assert out.shape == (1, C_out, 3, 3)
+    assert np.allclose(out, 3.0, atol=1e-4)
+    out = get_op("_contrib_DeformablePSROIPooling").fn(
+        data, rois, None, spatial_scale=1.0, output_dim=C_out,
+        group_size=G, pooled_size=3, no_trans=True)
+    assert np.allclose(out, 3.0, atol=1e-4)
+
+
+def test_deformable_psroi_trans_channel_order():
+    # channel 2*cls is trans_x, 2*cls+1 is trans_y
+    # (deformable_psroi_pooling.cu:118-124)
+    C_out, G = 1, 1
+    ramp_x = np.broadcast_to(np.arange(16, dtype=np.float32), (16, 16))
+    data = jnp.asarray(ramp_x[None, None])  # varies along x only
+    rois = jnp.asarray([[0.0, 4.0, 4.0, 8.0, 8.0]])
+    kw = dict(spatial_scale=1.0, output_dim=C_out, group_size=G,
+              pooled_size=1, part_size=1, sample_per_part=2,
+              trans_std=0.5)
+    base = get_op("_contrib_DeformablePSROIPooling").fn(
+        data, rois, jnp.zeros((1, 2, 1, 1)), **kw)
+    tx = jnp.zeros((1, 2, 1, 1)).at[0, 0].set(1.0)  # trans_x
+    ty = jnp.zeros((1, 2, 1, 1)).at[0, 1].set(1.0)  # trans_y
+    out_x = get_op("_contrib_DeformablePSROIPooling").fn(
+        data, rois, tx, **kw)
+    out_y = get_op("_contrib_DeformablePSROIPooling").fn(
+        data, rois, ty, **kw)
+    # x-offset shifts the window right on x-varying data; y-offset no-op
+    assert float(out_x[0, 0, 0, 0]) > float(base[0, 0, 0, 0]) + 1.0
+    assert abs(float(out_y[0, 0, 0, 0]) - float(base[0, 0, 0, 0])) < 1e-4
+
+
+def test_contrib_namespaces_expose_stripped_names():
+    # reference exposes _contrib_* ops as mx.nd.contrib.X / mx.sym.contrib.X
+    x = nd.array(np.ones((2, 16), np.float32))
+    out = nd.contrib.div_sqrt_dim(x)
+    assert np.allclose(out.asnumpy(), 0.25)
+    for name in ["Proposal", "MultiProposal", "PSROIPooling",
+                 "DeformableConvolution", "DeformablePSROIPooling",
+                 "count_sketch", "box_nms", "ctc_loss", "ROIAlign"]:
+        assert hasattr(nd.contrib, name), name
+        assert hasattr(mx.sym.contrib, name), name
+    # hand-written control flow not clobbered
+    assert mx.sym.contrib.foreach.__module__.endswith("symbol.contrib")
+
+
+def test_proposal_anchor_mismatch_raises():
+    cls, bbox, info = _proposal_inputs(A=3)
+    with pytest.raises(ValueError):
+        get_op("_contrib_Proposal").fn(
+            cls, bbox, info, feature_stride=16, scales=(8,), ratios=(1,))
+
+
+def test_deformable_conv_through_symbol():
+    # no_bias=True must NOT create a phantom bias arg, and simple_bind
+    # must infer the weight shape (shape_rules parity with Convolution)
+    data = mx.sym.Variable("data")
+    offset = mx.sym.Variable("offset")
+    out = mx.sym.contrib.DeformableConvolution(
+        data, offset, name="dc", kernel=(3, 3), num_filter=8, pad=(1, 1),
+        no_bias=True)
+    args = out.list_arguments()
+    assert "dc_bias" not in args, args
+    ex = out.simple_bind(mx.cpu(), data=(1, 4, 8, 8), offset=(1, 18, 8, 8))
+    shapes = dict(zip(out.list_arguments(),
+                      out.infer_shape(data=(1, 4, 8, 8),
+                                      offset=(1, 18, 8, 8))[0]))
+    assert tuple(shapes["dc_weight"]) == (8, 4, 3, 3)
+    ex.forward()
+    # trans is absent from DeformablePSROIPooling args when no_trans
+    d = mx.sym.Variable("d")
+    r = mx.sym.Variable("r")
+    pool = mx.sym.contrib.DeformablePSROIPooling(
+        d, r, name="dp", spatial_scale=1.0, output_dim=2, group_size=2,
+        pooled_size=2, no_trans=True)
+    assert "dp_trans" not in pool.list_arguments()
+
+
+def test_proposal_through_symbol():
+    cls = mx.sym.Variable("cls")
+    bbox = mx.sym.Variable("bbox")
+    info = mx.sym.Variable("info")
+    rois = mx.sym.contrib.Proposal(
+        cls, bbox, info, rpn_pre_nms_top_n=30,
+        rpn_post_nms_top_n=6, feature_stride=16, scales=(8,), ratios=(1,))
+    c, b, i = _proposal_inputs(A=1)
+    ex = rois.bind(mx.cpu(), {"cls": nd.array(np.asarray(c)),
+                              "bbox": nd.array(np.asarray(b)),
+                              "info": nd.array(np.asarray(i))})
+    out = ex.forward()[0]
+    assert out.shape == (6, 5)
