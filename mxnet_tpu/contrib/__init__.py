@@ -1,8 +1,18 @@
 """mx.contrib — experimental subsystems (reference python/mxnet/contrib/).
 
-Present: ``quantization`` (INT8 post-training quantization). Control
-flow lives in ``mx.sym.contrib`` / ``mx.nd.contrib``; ONNX
-import/export is not implemented (the reference's contrib.onnx targets
-a serialization ecosystem outside this rebuild's scope).
+Present: ``quantization`` (INT8 post-training quantization),
+``autograd`` (legacy pre-Gluon autograd surface), ``io``
+(DataLoaderIter), ``tensorboard`` (metric logging callback), ``text``
+(Vocabulary + token embeddings), ``ndarray``/``symbol`` (contrib op
+namespaces, same objects as mx.nd.contrib / mx.sym.contrib), ``onnx``
+(entry points gated on the third-party onnx package, as in the
+reference).
 """
 from . import quantization  # noqa: F401
+from . import autograd      # noqa: F401
+from . import io            # noqa: F401
+from . import tensorboard   # noqa: F401
+from . import text          # noqa: F401
+from . import ndarray       # noqa: F401
+from . import symbol        # noqa: F401
+from . import onnx          # noqa: F401

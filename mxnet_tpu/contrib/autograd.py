@@ -1,0 +1,88 @@
+"""Legacy contrib autograd API.
+
+Reference parity: python/mxnet/contrib/autograd.py:32-226 — the pre-Gluon
+autograd surface (train_section/test_section scopes, compute_gradient,
+grad_and_loss/grad decorators). Thin adapters over mxnet_tpu.autograd's
+tape (which replaces the reference's global C-side recording flags).
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray, zeros_like
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Enter/leave recording+training mode globally (ref
+    contrib/autograd.py:32 flips both MXAutogradSetIsTraining and
+    SetIsRecording). Returns the previous state."""
+    prev = _ag.is_training() and _ag.is_recording()
+    _ag._state.recording = bool(is_train)
+    _ag._state.training = bool(is_train)
+    return prev
+
+
+def train_section():
+    """``with train_section():`` — record with is_train=True
+    (ref contrib/autograd.py:74)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """``with test_section():`` — predict-mode recording
+    (ref contrib/autograd.py:88)."""
+    return _ag.record(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, out_grads, retain_graph)
+
+
+def compute_gradient(outputs):
+    """Alias of backward (ref contrib/autograd.py:158)."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate ``func`` to return (arg_gradients, loss)
+    (ref contrib/autograd.py:163)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            if not isinstance(x, NDArray):
+                raise TypeError("type of autograd input should NDArray.")
+        grads = [zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        compute_gradient([outputs] if isinstance(outputs, NDArray)
+                         else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorate ``func`` to return only the argument gradients
+    (ref contrib/autograd.py:195)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

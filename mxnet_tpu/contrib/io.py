@@ -1,0 +1,72 @@
+"""Contrib IO: run a Gluon DataLoader through the symbolic DataIter
+protocol.
+
+Reference parity: python/mxnet/contrib/io.py (DataLoaderIter) — lets
+``Module.fit`` consume a ``gluon.data.DataLoader``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io.io import DataIter, DataBatch, DataDesc
+from ..ndarray import NDArray, array
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a ``gluon.data.DataLoader`` as ``DataBatch``es of
+    (data, label) pairs (ref contrib/io.py DataLoaderIter)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._dtype = dtype
+        self._data_name = data_name
+        self._label_name = label_name
+        first = next(self._iter)
+        data, label = self._split(first)
+        self._provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self._provide_label = [DataDesc(label_name, label.shape, dtype)]
+        self._first = (data, label)
+
+    def _split(self, batch):
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            data, label = batch
+        else:
+            raise ValueError("DataLoader must yield (data, label) pairs.")
+
+        def to_nd(x):
+            if isinstance(x, NDArray):
+                return x.astype(self._dtype)
+            return array(_np.asarray(x), dtype=self._dtype)
+
+        return to_nd(data), to_nd(label)
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._first = None
+
+    def next(self):
+        if self._first is not None:
+            data, label = self._first
+            self._first = None
+        else:
+            try:
+                data, label = self._split(next(self._iter))
+            except StopIteration:
+                raise StopIteration
+        pad = 0
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self._provide_data,
+                         provide_label=self._provide_label)

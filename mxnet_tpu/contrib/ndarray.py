@@ -1,0 +1,12 @@
+"""mx.contrib.ndarray — the imperative contrib op namespace.
+
+Reference parity: python/mxnet/contrib/ndarray.py (generated module
+re-exporting every _contrib_* op). Same objects as ``mx.nd.contrib``.
+"""
+from ..ndarray import contrib as _c
+
+__all__ = []
+for _n in dir(_c):
+    if not _n.startswith("_"):
+        globals()[_n] = getattr(_c, _n)
+        __all__.append(_n)

@@ -1,0 +1,66 @@
+"""TensorBoard metric logging callback.
+
+Reference parity: python/mxnet/contrib/tensorboard.py (LogMetricsCallback
+over mxboard's SummaryWriter). Here the writer resolves in order:
+mxboard → torch.utils.tensorboard → a built-in JSONL scalar writer (one
+``{"tag", "value", "step"}`` object per line under ``logging_dir``), so
+metric logging works without optional dependencies.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback", "JsonlSummaryWriter"]
+
+
+class JsonlSummaryWriter:
+    """Fallback scalar writer: newline-delimited JSON events."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        self._path = os.path.join(logdir, "scalars.jsonl")
+        self._f = open(self._path, "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write(json.dumps({"tag": tag, "value": float(value),
+                                  "step": global_step,
+                                  "time": time.time()}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter      # noqa: F401
+        return SummaryWriter(logdir=logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(log_dir=logging_dir)
+    except Exception:
+        pass
+    return JsonlSummaryWriter(logging_dir)
+
+
+class LogMetricsCallback(object):
+    """Batch/epoch-end callback writing each metric as a scalar series
+    (ref contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

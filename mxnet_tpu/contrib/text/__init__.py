@@ -1,0 +1,11 @@
+"""Text utilities: vocabulary + token embeddings.
+
+Reference parity: python/mxnet/contrib/text/ (vocab.py, embedding.py,
+utils.py).
+"""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary"]

@@ -1,0 +1,318 @@
+"""Token embeddings.
+
+Reference parity: python/mxnet/contrib/text/embedding.py:39-770
+(_TokenEmbedding, GloVe, FastText, CustomEmbedding, CompositeEmbedding,
+register/create/get_pretrained_file_names). Vectors live in an NDArray;
+lookups are row gathers, so `get_vecs_by_tokens` output feeds
+`mx.nd.Embedding` / `gluon.nn.Embedding` weight initialization directly.
+
+Pretrained archives are NOT auto-downloaded here (this build has no
+network egress); point `pretrained_file_path` / `embedding_root` at a
+local copy instead.
+"""
+from __future__ import annotations
+
+import io
+import os
+import warnings
+
+from ... import ndarray as nd
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+UNKNOWN_IDX = 0
+
+_EMBED_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a subclass of TokenEmbedding under its lower-cased class
+    name (ref embedding.py:39)."""
+    _EMBED_REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create by registered name, e.g. create('glove',
+    pretrained_file_name=...) (ref embedding.py:62)."""
+    key = embedding_name.lower()
+    if key not in _EMBED_REGISTRY:
+        raise KeyError(
+            "Cannot find `embedding_name` %s. Valid embedding names: %s"
+            % (embedding_name, ", ".join(sorted(_EMBED_REGISTRY))))
+    return _EMBED_REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or all as a dict
+    (ref embedding.py:89)."""
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in _EMBED_REGISTRY:
+            raise KeyError("Cannot find `embedding_name` %s."
+                           % embedding_name)
+        return list(_EMBED_REGISTRY[key].pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _EMBED_REGISTRY.items()}
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Base token embedding: a Vocabulary plus an (len, vec_len) NDArray
+    of vectors (ref embedding.py:132 _TokenEmbedding)."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = None
+        self._idx_to_vec = None
+
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        """Locate a pretrained file under ``embedding_root`` — no
+        download in this environment (ref embedding.py:199 downloads
+        from the embedding's URL)."""
+        embedding_dir = os.path.join(
+            os.path.expanduser(embedding_root), cls.__name__.lower())
+        path = os.path.join(embedding_dir, pretrained_file_name)
+        if not os.path.isfile(path):
+            raise RuntimeError(
+                "Pretrained file %s was not found under %s and automatic "
+                "download is unavailable in this environment. Place the "
+                "file there, or use CustomEmbedding with a local "
+                "`pretrained_file_path`." % (pretrained_file_name,
+                                             embedding_dir))
+        return path
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse a text embedding file: `token<d>v1<d>v2...` per line
+        (ref embedding.py:231-303: first-seen vector wins on duplicate
+        tokens, 1-dim lines are treated as headers and skipped, the
+        unknown token's vector comes from the file when present)."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError("`pretrained_file_path` must be a valid path "
+                             "to the pre-trained token embedding file.")
+        vec_len = None
+        all_rows = []
+        seen = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 1:
+                    raise ValueError(
+                        "At line %d: unexpected data format in %s."
+                        % (line_num, pretrained_file_path))
+                token, vals = elems[0], [float(x) for x in elems[1:]]
+                if token == self.unknown_token and loaded_unknown_vec is None:
+                    loaded_unknown_vec = vals
+                    seen.add(token)
+                elif token in seen:
+                    warnings.warn("At line %d: duplicate embedding for "
+                                  "token %s skipped." % (line_num, token))
+                elif len(vals) == 1:
+                    warnings.warn("At line %d: token %s with 1-dimensional "
+                                  "vector %s is likely a header, skipped."
+                                  % (line_num, token, vals))
+                else:
+                    if vec_len is None:
+                        vec_len = len(vals)
+                    elif len(vals) != vec_len:
+                        raise ValueError(
+                            "At line %d: token %s has dimension %d but "
+                            "previous tokens have %d."
+                            % (line_num, token, len(vals), vec_len))
+                    all_rows.append(vals)
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+                    seen.add(token)
+        self._vec_len = vec_len
+        import numpy as np
+        mat = np.zeros((1 + len(all_rows), vec_len), dtype="float32")
+        if all_rows:
+            mat[1:] = np.asarray(all_rows, dtype="float32")
+        if loaded_unknown_vec is None:
+            mat[UNKNOWN_IDX] = init_unknown_vec(shape=vec_len).asnumpy()
+        else:
+            mat[UNKNOWN_IDX] = np.asarray(loaded_unknown_vec,
+                                          dtype="float32")
+        self._idx_to_vec = nd.array(mat)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = (list(vocabulary.reserved_tokens)
+                                 if vocabulary.reserved_tokens is not None
+                                 else None)
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        """Concatenate vectors from one or more embeddings per vocabulary
+        token (ref embedding.py:313-341)."""
+        import numpy as np
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        out = np.zeros((vocab_len, new_vec_len), dtype="float32")
+        col = 0
+        for embed in token_embeddings:
+            end = col + embed.vec_len
+            out[0, col:end] = embed.idx_to_vec[0].asnumpy()
+            if vocab_len > 1:
+                out[1:, col:end] = embed.get_vecs_by_tokens(
+                    vocab_idx_to_token[1:]).asnumpy()
+            col = end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = nd.array(out)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        if vocabulary is not None:
+            if not isinstance(vocabulary, _vocab.Vocabulary):
+                raise TypeError("`vocabulary` must be an instance of "
+                                "Vocabulary.")
+            self._set_idx_to_vec_by_embeddings(
+                [self], len(vocabulary), vocabulary.idx_to_token)
+            self._index_tokens_from_vocabulary(vocabulary)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector,
+        optionally retrying lower-cased (ref embedding.py:365)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if not lower_case_backup:
+            idxs = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in toks]
+        else:
+            idxs = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), UNKNOWN_IDX))
+                for t in toks]
+        import numpy as np
+        vecs = self._idx_to_vec.asnumpy()[np.asarray(idxs, dtype=np.int64)]
+        return nd.array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (ref embedding.py:404)."""
+        if self._idx_to_vec is None:
+            raise ValueError("The property `idx_to_vec` has not been "
+                             "properly set.")
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        import numpy as np
+        newv = new_vectors.asnumpy()
+        if newv.ndim == 1:
+            newv = newv[None, :]
+        if len(toks) != newv.shape[0]:
+            raise ValueError("The length of `tokens` and the number of "
+                             "rows of `new_vectors` must match.")
+        idxs = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idxs.append(self._token_to_idx[t])
+            else:
+                raise ValueError(
+                    "Token %s is unknown. To update the embedding vector "
+                    "for an unknown token, please specify it explicitly "
+                    "as the `unknown_token` %s."
+                    % (t, self.unknown_token))
+        mat = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        mat[np.asarray(idxs, dtype=np.int64)] = newv
+        self._idx_to_vec = nd.array(mat)
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_names:
+            raise KeyError(
+                "Cannot find pretrained file %s for token embedding %s. "
+                "Valid pretrained files: %s"
+                % (pretrained_file_name, cls.__name__.lower(),
+                   ", ".join(cls.pretrained_file_names)))
+
+
+# reference name: module-private base class alias
+_TokenEmbedding = TokenEmbedding
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe embeddings (ref embedding.py:468). Requires the unpacked
+    .txt file locally under ``embedding_root``/glove/."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText embeddings (ref embedding.py:558). Requires the .vec
+    file locally under ``embedding_root``/fasttext/."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+        "wiki.de.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file `token<delim>v1<delim>v2...`
+    (ref embedding.py:658)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenation of multiple embeddings over one vocabulary
+    (ref embedding.py:719)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, _vocab.Vocabulary):
+            raise TypeError("`vocabulary` must be an instance of "
+                            "Vocabulary.")
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for embed in token_embeddings:
+            if not isinstance(embed, TokenEmbedding):
+                raise TypeError("`token_embeddings` must contain "
+                                "TokenEmbedding instances.")
+        super().__init__()
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(vocabulary), vocabulary.idx_to_token)
+        self._index_tokens_from_vocabulary(vocabulary)

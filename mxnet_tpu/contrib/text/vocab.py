@@ -1,0 +1,99 @@
+"""Text token indexing.
+
+Reference parity: python/mxnet/contrib/text/vocab.py:30-210 (Vocabulary).
+Pure Python — nothing device-specific to redesign.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary(object):
+    """Maps tokens to indices.
+
+    Index 0 is the unknown token; reserved tokens follow, then counter
+    keys sorted by frequency (descending), ties broken alphabetically
+    (ref vocab.py:113-140). Tokens below ``min_freq`` or beyond
+    ``most_freq_count`` are dropped.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("`min_freq` must be set to a positive value.")
+        if reserved_tokens is not None:
+            reserved = set(reserved_tokens)
+            if unknown_token in reserved:
+                raise ValueError("`reserved_tokens` must not contain the "
+                                 "unknown token.")
+            if len(reserved) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` must not contain "
+                                 "duplicates.")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        if reserved_tokens is not None:
+            for tok in reserved_tokens:
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        if not isinstance(counter, collections.Counter):
+            raise TypeError("`counter` must be a collections.Counter.")
+        special = set(self._idx_to_token)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        cap = len(special) + (len(counter) if most_freq_count is None
+                              else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == cap:
+                break
+            if token not in special:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (str) or list of tokens → index or list of indices;
+        unknown tokens map to index 0 (ref vocab.py:160)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index (int) or list of indices → token or list of tokens
+        (ref vocab.py:186)."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("Token index %d in the provided `indices` "
+                                 "is invalid." % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

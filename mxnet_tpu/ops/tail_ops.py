@@ -190,17 +190,21 @@ def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
 
 @register("ftml_update", num_outputs=4, num_visible_outputs=1,
           mutate_inputs=(("d", 1), ("v", 2), ("z", 3)))
-def ftml_update(weight, grad, d, v, z, *, lr, t, beta1=0.6, beta2=0.999,
-                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
-    """Follow The Moving Leader update (ref optimizer_op.cc FTMLUpdate)."""
+def ftml_update(weight, grad, d, v, z, t=None, *, lr, beta1=0.6,
+                beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """Follow The Moving Leader update (ref optimizer_op.cc FTMLUpdate).
+    The step count ``t`` is a TENSOR input (python ints auto-convert),
+    not a static attr — an attr would force one fresh XLA compile per
+    optimizer step in the eager dispatch cache."""
     g = grad.astype(jnp.float32) * rescale_grad + wd * weight.astype(
         jnp.float32)
     if clip_grad is not None and clip_grad >= 0:
         g = jnp.clip(g, -clip_grad, clip_grad)
-    t = float(t)
+    tf = jnp.asarray(1.0 if t is None else t, jnp.float32)
     new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
-    d_new = ((1.0 - beta1 ** t) / lr
-             * (jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon))
+    d_new = ((1.0 - jnp.power(beta1, tf)) / lr
+             * (jnp.sqrt(new_v / (1.0 - jnp.power(beta2, tf))) + epsilon))
     sigma = d_new - beta1 * d
     new_z = beta1 * z + (1.0 - beta1) * g - sigma * weight.astype(jnp.float32)
     new_w = -new_z / d_new

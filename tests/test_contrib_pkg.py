@@ -1,0 +1,217 @@
+"""mx.contrib package tests: text, autograd, io, tensorboard, onnx gate.
+
+Models: reference tests/python/unittest/test_contrib_text.py and the
+contrib module docstrings.
+"""
+import collections
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib
+
+
+# ----------------------------------------------------------------------
+# text
+# ----------------------------------------------------------------------
+def test_count_tokens_from_str():
+    c = contrib.text.utils.count_tokens_from_str("a b b c\nc c d")
+    assert c["a"] == 1 and c["b"] == 2 and c["c"] == 3 and c["d"] == 1
+    c2 = contrib.text.utils.count_tokens_from_str(
+        "A a", to_lower=True, counter_to_update=c)
+    assert c2 is c and c["a"] == 3
+
+
+def test_vocabulary_indexing():
+    c = collections.Counter({"c": 3, "b": 2, "a": 2, "d": 1})
+    v = contrib.text.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    # index 0 unknown, then reserved, then freq desc / alphabetical ties
+    assert v.idx_to_token == ["<unk>", "<pad>", "c", "a", "b"]
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["a", "zzz"]) == [3, 0]
+    assert v.to_tokens([0, 1]) == ["<unk>", "<pad>"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+    assert len(v) == 5
+    # most_freq_count caps the vocabulary
+    v2 = contrib.text.Vocabulary(c, most_freq_count=2)
+    assert len(v2) == 3  # unk + 2
+
+
+def test_vocabulary_validation():
+    with pytest.raises(ValueError):
+        contrib.text.Vocabulary(min_freq=0)
+    with pytest.raises(ValueError):
+        contrib.text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        contrib.text.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+
+
+@pytest.fixture
+def emb_file(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1 2 3\nworld 4 5 6\n")
+    return str(p)
+
+
+def test_custom_embedding(emb_file):
+    emb = contrib.text.embedding.CustomEmbedding(emb_file)
+    assert emb.vec_len == 3
+    vecs = emb.get_vecs_by_tokens(["hello", "world", "missing"]).asnumpy()
+    assert np.allclose(vecs, [[1, 2, 3], [4, 5, 6], [0, 0, 0]])
+    one = emb.get_vecs_by_tokens("world").asnumpy()
+    assert one.shape == (3,) and np.allclose(one, [4, 5, 6])
+    # lower-case backup
+    up = emb.get_vecs_by_tokens(["HELLO"], lower_case_backup=True).asnumpy()
+    assert np.allclose(up, [[1, 2, 3]])
+    # update vectors
+    emb.update_token_vectors(
+        "hello", mx.nd.array(np.asarray([9.0, 9.0, 9.0], np.float32)))
+    assert np.allclose(emb.get_vecs_by_tokens("hello").asnumpy(), 9)
+    with pytest.raises(ValueError):
+        emb.update_token_vectors(
+            "nope", mx.nd.array(np.asarray([1.0, 1.0, 1.0], np.float32)))
+
+
+def test_custom_embedding_header_and_duplicates(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("2 3\nhello 1 2 3\nhello 7 8 9\n")
+    with pytest.warns(UserWarning):
+        emb = contrib.text.embedding.CustomEmbedding(str(p))
+    # header skipped, first-seen vector wins
+    assert np.allclose(emb.get_vecs_by_tokens("hello").asnumpy(),
+                       [1, 2, 3])
+
+
+def test_embedding_with_vocabulary(emb_file):
+    counter = collections.Counter(["hello", "hello", "there"])
+    v = contrib.text.Vocabulary(counter)
+    emb = contrib.text.embedding.CustomEmbedding(emb_file, vocabulary=v)
+    # vocabulary indexing wins; vectors come from the file where known
+    assert len(emb) == len(v)
+    assert np.allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    assert np.allclose(
+        emb.get_vecs_by_tokens("there").asnumpy(), [0, 0, 0])
+
+
+def test_composite_embedding(emb_file):
+    counter = collections.Counter(["hello", "world"])
+    v = contrib.text.Vocabulary(counter)
+    e1 = contrib.text.embedding.CustomEmbedding(emb_file)
+    comp = contrib.text.embedding.CompositeEmbedding(v, [e1, e1])
+    assert comp.vec_len == 6
+    got = comp.get_vecs_by_tokens("hello").asnumpy()
+    assert np.allclose(got, [1, 2, 3, 1, 2, 3])
+
+
+def test_embedding_registry():
+    names = contrib.text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        contrib.text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        contrib.text.embedding.create("nope")
+    with pytest.raises(KeyError):
+        contrib.text.embedding.get_pretrained_file_names("nope")
+    # pretrained files are not downloadable here: clear error
+    with pytest.raises(RuntimeError):
+        contrib.text.embedding.create(
+            "glove", pretrained_file_name="glove.6B.50d.txt",
+            embedding_root=tempfile.mkdtemp())
+
+
+# ----------------------------------------------------------------------
+# contrib.autograd (legacy API)
+# ----------------------------------------------------------------------
+def test_contrib_autograd_grad_and_loss():
+    x = mx.nd.array(np.asarray([1.0, 2.0, 3.0], np.float32))
+    grads, loss = contrib.autograd.grad_and_loss(lambda a: a * a)(x)
+    assert np.allclose(grads[0].asnumpy(), [2, 4, 6])
+    assert np.allclose(loss.asnumpy(), [1, 4, 9])
+    g = contrib.autograd.grad(lambda a: a * a)(x)
+    assert np.allclose(g[0].asnumpy(), [2, 4, 6])
+
+
+def test_contrib_autograd_argnum_and_sections():
+    x = mx.nd.array(np.asarray([2.0], np.float32))
+    y = mx.nd.array(np.asarray([5.0], np.float32))
+    grads, _ = contrib.autograd.grad_and_loss(
+        lambda a, b: a * b, argnum=1)(x, y)
+    assert np.allclose(grads[0].asnumpy(), [2.0])  # d(xy)/dy = x
+    prev = contrib.autograd.set_is_training(True)
+    assert contrib.autograd.set_is_training(prev) is True
+
+
+# ----------------------------------------------------------------------
+# contrib.io
+# ----------------------------------------------------------------------
+def test_dataloader_iter_with_module():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.RandomState(0).rand(40, 4).astype("float32")
+    Y = (X.sum(axis=1) > 2).astype("float32")
+    it = contrib.io.DataLoaderIter(
+        DataLoader(ArrayDataset(X, Y), batch_size=8))
+    assert it.provide_data[0].shape == (8, 4)
+    assert sum(1 for _ in it) == 5
+    it.reset()
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            initializer=mx.initializer.Xavier())
+
+
+# ----------------------------------------------------------------------
+# contrib.tensorboard
+# ----------------------------------------------------------------------
+def _metric_param():
+    class P:
+        pass
+
+    p = P()
+    p.eval_metric = mx.metric.Accuracy()
+    p.eval_metric.update(
+        [mx.nd.array(np.asarray([0.0, 1.0], np.float32))],
+        [mx.nd.array(np.asarray([[0.9, 0.1], [0.2, 0.8]], np.float32))])
+    return p
+
+
+def test_tensorboard_callback_writes(tmp_path):
+    cb = contrib.tensorboard.LogMetricsCallback(str(tmp_path),
+                                                prefix="train")
+    cb(_metric_param())
+    files = [f for _, _, fs in os.walk(str(tmp_path)) for f in fs]
+    assert files  # an event/scalars file exists
+
+
+def test_jsonl_writer(tmp_path):
+    w = contrib.tensorboard.JsonlSummaryWriter(str(tmp_path))
+    w.add_scalar("acc", 0.5, 1)
+    w.close()
+    import json
+    line = open(os.path.join(str(tmp_path), "scalars.jsonl")).readline()
+    rec = json.loads(line)
+    assert rec["tag"] == "acc" and rec["value"] == 0.5 and rec["step"] == 1
+
+
+# ----------------------------------------------------------------------
+# namespaces + onnx gate
+# ----------------------------------------------------------------------
+def test_contrib_namespaces():
+    assert hasattr(contrib.ndarray, "div_sqrt_dim")
+    assert hasattr(contrib.ndarray, "box_nms")
+    assert hasattr(contrib.symbol, "Proposal")
+    assert hasattr(contrib.symbol, "foreach")
+
+
+def test_onnx_gate():
+    for fn, args in [(contrib.onnx.import_model, ("m.onnx",)),
+                     (contrib.onnx.get_model_metadata, ("m.onnx",)),
+                     (contrib.onnx.export_model, (None, None, None))]:
+        with pytest.raises((ImportError, NotImplementedError)):
+            fn(*args)

@@ -120,6 +120,20 @@ def test_sparse_adagrad_update_op():
     assert np.allclose(wnd.asnumpy(), new_w2, atol=1e-6)
 
 
+def test_ftml_no_per_step_recompile():
+    # t is a tensor input: stepping the optimizer must not add one JIT
+    # cache entry per step
+    from mxnet_tpu.ndarray import dispatch
+    w = nd.array(np.ones(3, np.float32))
+    opt = mx.optimizer.FTML(learning_rate=0.05)
+    state = opt.create_state(0, w)
+    opt.update(0, w, nd.array(np.full(3, 0.1, np.float32)), state)
+    n0 = len(dispatch._JIT_CACHE)
+    for _ in range(5):
+        opt.update(0, w, nd.array(np.full(3, 0.1, np.float32)), state)
+    assert len(dispatch._JIT_CACHE) == n0
+
+
 def test_ftml_optimizer_converges():
     w = nd.array(np.ones(4, np.float32) * 5)
     opt = mx.optimizer.FTML(learning_rate=0.1)
