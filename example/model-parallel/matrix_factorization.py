@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Model-parallel matrix factorization (reference
+example/model-parallel/matrix_factorization/{model.py,train.py}).
+
+The reference splits the net across two GPUs with
+``mx.AttrScope(ctx_group=...)`` + ``group2ctxs``: embeddings on dev1,
+dense layers on dev2. On TPU the idiomatic equivalent is GSPMD model
+parallelism: the same symbol trains through ``parallel.TrainStep`` over
+a dp×tp ``jax.sharding.Mesh``, where the big embedding tables shard
+over the ``tp`` axis and XLA inserts the collectives — no explicit
+device placement, one compiled step.
+
+Runs offline on synthetic MovieLens-shaped data. With no TPU mesh
+available, ``--num-devices N`` simulates N virtual CPU devices.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def matrix_fact_net(factor_size, num_hidden, max_user, max_item):
+    """Reference model.py matrix_fact_model_parallel_net: the ctx_group
+    annotations are kept for API parity (on TPU they are advisory —
+    sharding, not device placement, distributes the work)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    with mx.AttrScope(ctx_group="dev1"):
+        user = sym.Variable("user")
+        item = sym.Variable("item")
+        user_weight = sym.Variable("user_weight")
+        user = sym.Embedding(data=user, weight=user_weight,
+                             input_dim=max_user, output_dim=factor_size,
+                             name="user_embed")
+        item_weight = sym.Variable("item_weight")
+        item = sym.Embedding(data=item, weight=item_weight,
+                             input_dim=max_item, output_dim=factor_size,
+                             name="item_embed")
+    with mx.AttrScope(ctx_group="dev2"):
+        user = sym.Activation(data=user, act_type="relu")
+        user = sym.FullyConnected(data=user, num_hidden=num_hidden,
+                                  name="fc_user")
+        item = sym.Activation(data=item, act_type="relu")
+        item = sym.FullyConnected(data=item, num_hidden=num_hidden,
+                                  name="fc_item")
+        pred = user * item
+        pred = sym.sum(data=pred, axis=1)
+        pred = sym.Flatten(data=pred)
+        score = sym.Variable("score")
+        pred = sym.LinearRegressionOutput(data=pred, label=score,
+                                          name="lro")
+    return pred
+
+
+def synthetic_ratings(n, max_user, max_item, rank=8, seed=0):
+    """Low-rank synthetic ratings so the model has signal to fit."""
+    rng = np.random.RandomState(seed)
+    U = rng.randn(max_user, rank).astype(np.float32) / np.sqrt(rank)
+    V = rng.randn(max_item, rank).astype(np.float32) / np.sqrt(rank)
+    users = rng.randint(0, max_user, n).astype(np.float32)
+    items = rng.randint(0, max_item, n).astype(np.float32)
+    scores = (U[users.astype(int)] * V[items.astype(int)]).sum(axis=1)
+    scores += rng.randn(n).astype(np.float32) * 0.05
+    return users, items, scores
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--num-epoch", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--factor-size", type=int, default=64)
+    ap.add_argument("--num-hidden", type=int, default=32)
+    ap.add_argument("--max-user", type=int, default=512)
+    ap.add_argument("--max-item", type=int, default=512)
+    ap.add_argument("--num-samples", type=int, default=8192)
+    ap.add_argument("--num-devices", type=int, default=0,
+                    help="simulate N virtual cpu devices for the dp×tp "
+                         "mesh (0 = use whatever jax.devices() offers)")
+    args = ap.parse_args()
+
+    if args.num_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=%d"
+            % args.num_devices).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import TrainStep
+
+    net = matrix_fact_net(args.factor_size, args.num_hidden,
+                          args.max_user, args.max_item)
+
+    devices = jax.devices()
+    n = len(devices)
+    tp = 2 if n % 2 == 0 and n >= 2 else 1
+    mesh = Mesh(np.array(devices).reshape(n // tp, tp), ("dp", "tp"))
+    print("mesh:", dict(mesh.shape))
+
+    opt = mx.optimizer.Adam(learning_rate=0.01,
+                            rescale_grad=1.0 / args.batch_size)
+    ts = TrainStep(net, opt,
+                   data_shapes={"user": (args.batch_size,),
+                                "item": (args.batch_size,)},
+                   label_shapes={"score": (args.batch_size,)},
+                   mesh=mesh)
+    ts.init_params(mx.init.Xavier())
+
+    users, items, scores = synthetic_ratings(
+        args.num_samples, args.max_user, args.max_item)
+    nb = args.num_samples // args.batch_size
+    for epoch in range(args.num_epoch):
+        mse_sum, cnt = 0.0, 0
+        for b in range(nb):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            outs = ts.step({"user": users[sl], "item": items[sl],
+                            "score": scores[sl]})
+            pred = np.asarray(outs[0]).reshape(-1)
+            mse_sum += float(((pred - scores[sl]) ** 2).mean())
+            cnt += 1
+        print("epoch %d: train mse %.4f" % (epoch, mse_sum / cnt))
+    return mse_sum / cnt
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,10 @@ class DataLoaderIter(DataIter):
         self._dtype = dtype
         self._data_name = data_name
         self._label_name = label_name
-        first = next(self._iter)
+        try:
+            first = next(self._iter)
+        except StopIteration:
+            raise ValueError("DataLoader is empty") from None
         data, label = self._split(first)
         self._provide_data = [DataDesc(data_name, data.shape, dtype)]
         self._provide_label = [DataDesc(label_name, label.shape, dtype)]

@@ -198,8 +198,10 @@ class TokenEmbedding(_vocab.Vocabulary):
                 t, self._token_to_idx.get(t.lower(), UNKNOWN_IDX))
                 for t in toks]
         import numpy as np
-        vecs = self._idx_to_vec.asnumpy()[np.asarray(idxs, dtype=np.int64)]
-        return nd.array(vecs[0] if single else vecs)
+        # device-side row gather — never copies the whole matrix to host
+        vecs = nd.take(self._idx_to_vec,
+                       nd.array(np.asarray(idxs, np.float32)), axis=0)
+        return vecs[0] if single else vecs
 
     def update_token_vectors(self, tokens, new_vectors):
         """Overwrite vectors of known tokens (ref embedding.py:404)."""
@@ -225,9 +227,12 @@ class TokenEmbedding(_vocab.Vocabulary):
                     "for an unknown token, please specify it explicitly "
                     "as the `unknown_token` %s."
                     % (t, self.unknown_token))
-        mat = np.array(self._idx_to_vec.asnumpy())  # writable copy
-        mat[np.asarray(idxs, dtype=np.int64)] = newv
-        self._idx_to_vec = nd.array(mat)
+        # device-side row scatter — never copies the whole matrix to host
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+        mat = self._idx_to_vec._data.at[jnp.asarray(idxs)].set(
+            jnp.asarray(newv, self._idx_to_vec._data.dtype))
+        self._idx_to_vec = NDArray(mat)
 
     @classmethod
     def _check_pretrained_file_names(cls, pretrained_file_name):

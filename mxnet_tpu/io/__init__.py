@@ -7,9 +7,9 @@ so the `PrefetcherIter` role (overlap host decode with device compute) is
 preserved.
 """
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, MXDataIter, CSVIter, MNISTIter,
-                 ImageRecordIter)
+                 PrefetchingIter, MXDataIter, CSVIter, LibSVMIter,
+                 MNISTIter, ImageRecordIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MXDataIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter"]
+           "PrefetchingIter", "MXDataIter", "CSVIter", "LibSVMIter",
+           "MNISTIter", "ImageRecordIter"]

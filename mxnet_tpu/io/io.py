@@ -19,8 +19,8 @@ from ..ndarray import NDArray, array as nd_array
 from ..ndarray.ndarray import concatenate
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MXDataIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter"]
+           "PrefetchingIter", "MXDataIter", "CSVIter", "LibSVMIter",
+           "MNISTIter", "ImageRecordIter"]
 
 
 class DataDesc:
@@ -406,6 +406,117 @@ class CSVIter(DataIter):
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """Zero-based-indexed LibSVM text file → CSR data batches
+    (reference src/io/iter_libsvm.cc). Labels are the leading scalar of
+    each data line unless ``label_libsvm`` names a separate LibSVM file
+    (multi-dimensional labels, returned dense). ``num_parts``/
+    ``part_index`` partition rows round-robin for distributed reading
+    (the reference partitions the byte stream via dmlc InputSplit)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=(1,), num_parts=1,
+                 part_index=0, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        if num_parts <= 0 or not 0 <= part_index < num_parts:
+            raise ValueError("invalid num_parts=%s part_index=%s"
+                             % (num_parts, part_index))
+        self._dtype = dtype
+        self._dim = int(data_shape[0]) if isinstance(
+            data_shape, (tuple, list)) else int(data_shape)
+        labels, rows = self._parse(data_libsvm)
+        if label_libsvm is not None:
+            ldim = int(label_shape[0]) if isinstance(
+                label_shape, (tuple, list)) else int(label_shape)
+            lab_vals, lab_rows = self._parse(label_libsvm)
+            labels = [self._densify(r, ldim) for r in lab_rows]
+        else:
+            labels = [[l] for l in labels]
+        labels = _np.asarray(labels, dtype=dtype)
+        if labels.shape[-1] == 1:
+            labels = labels.reshape(labels.shape[:-1])
+        self._rows = rows[part_index::num_parts]
+        self._labels = labels[part_index::num_parts]
+        self._round_batch = round_batch
+        self._cursor = 0
+        self._provide_data = [DataDesc("data", (batch_size, self._dim),
+                                       dtype)]
+        self._provide_label = [DataDesc(
+            "label", (batch_size,) + tuple(labels.shape[1:]), dtype)]
+
+    @staticmethod
+    def _parse(path):
+        labels, rows = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                if ":" in parts[0]:
+                    label, feats = 0.0, parts
+                else:
+                    label, feats = float(parts[0]), parts[1:]
+                row = []
+                for tok in feats:
+                    idx, val = tok.split(":")
+                    row.append((int(idx), float(val)))
+                labels.append(label)
+                rows.append(row)
+        return labels, rows
+
+    def _densify(self, row, dim):
+        out = [0.0] * dim
+        for idx, val in row:
+            out[idx] = val
+        return out
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        take = list(range(self._cursor,
+                          min(self._cursor + self.batch_size, n)))
+        pad = self.batch_size - len(take)
+        if pad:
+            if self._round_batch:
+                # wrap to the beginning, repeatedly if batch_size
+                # exceeds the partition size
+                take += [j % n for j in range(pad)]
+            else:
+                self._cursor = n
+                raise StopIteration
+        self._cursor += self.batch_size
+
+        # assemble one CSR batch
+        from ..ndarray import sparse as _sp
+        indptr, indices, values = [0], [], []
+        for i in take:
+            for idx, val in self._rows[i]:
+                indices.append(idx)
+                values.append(val)
+            indptr.append(len(indices))
+        data = _sp.csr_matrix(
+            (_np.asarray(values, dtype=self._dtype),
+             _np.asarray(indices, dtype=_np.int64),
+             _np.asarray(indptr, dtype=_np.int64)),
+            shape=(self.batch_size, self._dim))
+        label = nd_array(self._labels[_np.asarray(take)], dtype=self._dtype)
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self._provide_data,
+                         provide_label=self._provide_label)
 
 
 class MNISTIter(DataIter):

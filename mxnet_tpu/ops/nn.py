@@ -504,7 +504,49 @@ def embedding(data, weight, *, input_dim, output_dim, dtype="float32",
 @register("Correlation")
 def correlation(data1, data2, *, kernel_size=1, max_displacement=1, stride1=1,
                 stride2=1, pad_size=0, is_multiply=True):
-    raise NotImplementedError("Correlation is not yet implemented")
+    """FlowNet correlation layer (ref src/operator/correlation-inl.h):
+    out[b, (dy,dx), y, x] = mean over the k×k×C patch of
+    data1(center) · data2(center + (dy,dx)·stride2). The CUDA kernel's
+    per-displacement loop becomes one static Python loop over the
+    (2r+1)² displacements, each a shifted elementwise product + box-sum
+    (reduce_window) that XLA fuses; gradients ride autodiff."""
+    import numpy as _np
+    B, C, H, W = data1.shape
+    k = int(kernel_size)
+    kr = (k - 1) // 2
+    d = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    pad = int(pad_size)
+    ngr = d // s2                     # neighborhood grid radius
+    gw = 2 * ngr + 1
+    border = d + kr
+    ph, pw = H + 2 * pad, W + 2 * pad
+    top_h = max(int(_np.ceil((ph - 2 * border) / s1)), 1)
+    top_w = max(int(_np.ceil((pw - 2 * border) / s1)), 1)
+    sumelems = k * k * C
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    # extra max_displacement halo on data2 so every shift is a slice
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad + d, pad + d),
+                         (pad + d, pad + d)))
+    outs = []
+    for dy in range(-ngr, ngr + 1):
+        for dx in range(-ngr, ngr + 1):
+            oy, ox = dy * s2, dx * s2
+            p2s = lax.dynamic_slice(
+                p2, (0, 0, d + oy, d + ox), (B, C, ph, pw))
+            prod = (p1 * p2s) if is_multiply else jnp.abs(p1 - p2s)
+            csum = prod.sum(axis=1)               # (B, ph, pw)
+            patch = lax.reduce_window(
+                csum, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                "VALID")                          # (B, ph-k+1, pw-k+1)
+            # center (y,x) of output cell o: y = o*s1 + border; its
+            # k×k window starts at y-kr -> patch index o*s1 + d
+            patch = jnp.pad(patch, ((0, 0), (0, s1), (0, s1)))
+            outs.append(patch[:, d:d + top_h * s1:s1,
+                              d:d + top_w * s1:s1])
+    out = jnp.stack(outs, axis=1)                 # (B, gw*gw, th, tw)
+    return (out / sumelems).astype(data1.dtype)
 
 
 @register("BilinearSampler")

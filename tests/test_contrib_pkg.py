@@ -215,3 +215,12 @@ def test_onnx_gate():
                      (contrib.onnx.export_model, (None, None, None))]:
         with pytest.raises((ImportError, NotImplementedError)):
             fn(*args)
+
+
+def test_dataloader_iter_empty_raises():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    empty = DataLoader(ArrayDataset(np.zeros((0, 2), np.float32),
+                                    np.zeros((0,), np.float32)),
+                       batch_size=4)
+    with pytest.raises(ValueError, match="empty"):
+        contrib.io.DataLoaderIter(empty)

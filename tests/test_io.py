@@ -101,3 +101,59 @@ def test_iter_provide_data_desc():
     desc = it.provide_data[0]
     assert desc.name == "data"
     assert tuple(desc.shape) == (2, 3, 4, 4)
+
+
+def test_libsvm_iter(tmp_path):
+    # reference src/io/iter_libsvm.cc: zero-based indices, inline labels,
+    # CSR data batches, round_batch wrap, num_parts partitioning
+    p = tmp_path / "data.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:1.0\n"
+                 "0 0:0.5\n1 1:2.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    assert it.provide_data[0].shape == (2, 4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].stype == "csr"
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])
+    assert batches[2].pad == 1
+    np.testing.assert_allclose(batches[2].data[0].asnumpy()[1],
+                               [1.5, 0, 0, 2.0])  # wrapped row
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_libsvm_iter_parts_and_label_file(tmp_path):
+    p = tmp_path / "data.libsvm"
+    p.write_text("".join("%d 0:%d\n" % (i % 2, i) for i in range(5)))
+    it0 = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(1,),
+                           batch_size=1, num_parts=2, part_index=0)
+    it1 = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(1,),
+                           batch_size=1, num_parts=2, part_index=1)
+    assert len(list(it0)) == 3 and len(list(it1)) == 2
+    lp = tmp_path / "label.libsvm"
+    lp.write_text("".join("0:%d 1:%d\n" % (i, i + 1) for i in range(5)))
+    it2 = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(1,),
+                           label_libsvm=str(lp), label_shape=(2,),
+                           batch_size=2)
+    b = next(it2)
+    assert b.label[0].shape == (2, 2)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[0, 1], [1, 2]])
+    with pytest.raises(ValueError):
+        mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(1,),
+                         batch_size=1, num_parts=2, part_index=5)
+
+
+def test_libsvm_iter_batch_larger_than_dataset(tmp_path):
+    p = tmp_path / "tiny.libsvm"
+    p.write_text("1 0:1.0\n0 1:2.0\n2 0:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(2,), batch_size=8)
+    b = next(it)
+    assert b.pad == 5
+    dense = b.data[0].asnumpy()
+    # rows wrap repeatedly: 0,1,2,0,1,2,0,1
+    np.testing.assert_allclose(dense[3], dense[0])
+    np.testing.assert_allclose(dense[7], dense[1])
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [1, 0, 2, 1, 0, 2, 1, 0])

@@ -1,0 +1,45 @@
+"""Model-parallel matrix factorization + gluon MNIST example CLIs."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run_example(rel, *args, timeout=480, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.basename(rel)] + list(args),
+        cwd=os.path.join(ROOT, os.path.dirname(rel)),
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout + proc.stderr
+
+
+def test_matrix_factorization_model_parallel():
+    out = _run_example("example/model-parallel/matrix_factorization.py",
+                       "--num-devices", "4", "--num-epoch", "5",
+                       "--num-samples", "2048", "--batch-size", "128")
+    assert "mesh: {'dp': 2, 'tp': 2}" in out
+    mses = [float(l.split("train mse")[1])
+            for l in out.splitlines() if "train mse" in l]
+    assert len(mses) == 5
+    assert mses[-1] < mses[0] * 0.7, mses  # descending loss over the mesh
+
+
+def test_gluon_mnist_example():
+    out = _run_example("example/gluon/mnist.py", "--epochs", "4")
+    accs = [float(l.split("val acc")[1])
+            for l in out.splitlines() if "val acc" in l]
+    assert accs[-1] > 0.9, accs
+
+
+def test_gluon_mnist_example_eager():
+    out = _run_example("example/gluon/mnist.py", "--epochs", "3",
+                       "--no-hybridize")
+    accs = [float(l.split("val acc")[1])
+            for l in out.splitlines() if "val acc" in l]
+    assert accs[-1] > 0.85, accs

@@ -1,0 +1,395 @@
+"""Broad operator-corpus sweep: forward vs numpy + numeric gradients.
+
+Extends the check_numeric_gradient pattern (reference
+tests/python/unittest/test_operator.py) across op families that lacked
+dedicated tests: unary math, the full broadcast-binary family,
+reductions, shape manipulation, indexing, normalization (InstanceNorm /
+LRN), smooth_l1, Correlation, and the remaining fused optimizer ops.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _rand(shape, seed=0, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi, shape).astype(
+        np.float32)
+
+
+# ----------------------------------------------------------------------
+# unary math vs numpy
+# ----------------------------------------------------------------------
+UNARY_CASES = [
+    ("sin", np.sin, (-3, 3)), ("cos", np.cos, (-3, 3)),
+    ("tan", np.tan, (-1, 1)), ("arcsin", np.arcsin, (-0.9, 0.9)),
+    ("arccos", np.arccos, (-0.9, 0.9)), ("arctan", np.arctan, (-3, 3)),
+    ("sinh", np.sinh, (-2, 2)), ("cosh", np.cosh, (-2, 2)),
+    ("tanh", np.tanh, (-2, 2)), ("arcsinh", np.arcsinh, (-2, 2)),
+    ("arctanh", np.arctanh, (-0.9, 0.9)),
+    ("exp", np.exp, (-2, 2)), ("log", np.log, (0.1, 4)),
+    ("log2", np.log2, (0.1, 4)), ("log10", np.log10, (0.1, 4)),
+    ("log1p", np.log1p, (-0.5, 3)), ("expm1", np.expm1, (-2, 2)),
+    ("sqrt", np.sqrt, (0.1, 4)), ("rsqrt", lambda x: 1 / np.sqrt(x),
+                                  (0.1, 4)),
+    ("cbrt", np.cbrt, (-4, 4)), ("square", np.square, (-3, 3)),
+    ("abs", np.abs, (-3, 3)), ("sign", np.sign, (-3, 3)),
+    ("floor", np.floor, (-3, 3)), ("ceil", np.ceil, (-3, 3)),
+    ("round", np.round, (-3, 3)), ("trunc", np.trunc, (-3, 3)),
+    ("rint", np.rint, (-3, 3)),
+    ("erf", None, (-2, 2)), ("gamma", None, (0.5, 4)),
+    ("gammaln", None, (0.5, 4)),
+]
+
+
+@pytest.mark.parametrize("name,ref,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward(name, ref, rng):
+    x = _rand((3, 4), seed=1, lo=rng[0], hi=rng[1])
+    out = getattr(nd, name)(nd.array(x)).asnumpy()
+    if ref is None:
+        import scipy.special as sp  # pragma: no cover - fallback path
+        ref = {"erf": sp.erf, "gamma": sp.gamma,
+               "gammaln": sp.gammaln}[name]
+    np.testing.assert_allclose(out, ref(x), rtol=1e-4, atol=1e-5)
+
+
+def test_erfinv_roundtrip():
+    x = _rand((10,), seed=2, lo=-0.9, hi=0.9)
+    back = nd.erf(nd.erfinv(nd.array(x))).asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["tanh", "exp", "log", "sqrt", "square"])
+def test_unary_gradient(name):
+    lo, hi = (0.2, 3.0) if name in ("log", "sqrt") else (-2.0, 2.0)
+    data = sym.Variable("data")
+    check_numeric_gradient(getattr(sym, name)(data),
+                           {"data": _rand((3, 4), seed=3, lo=lo, hi=hi)})
+
+
+# ----------------------------------------------------------------------
+# broadcast binary family vs numpy (with real broadcasting shapes)
+# ----------------------------------------------------------------------
+BINARY_CASES = [
+    ("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply), ("broadcast_div", np.divide),
+    ("broadcast_mod", np.mod), ("broadcast_power", np.power),
+    ("broadcast_maximum", np.maximum), ("broadcast_minimum", np.minimum),
+    ("broadcast_hypot", np.hypot),
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_greater_equal", lambda a, b: (a >= b).astype(np.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_lesser_equal", lambda a, b: (a <= b).astype(np.float32)),
+    ("broadcast_logical_and",
+     lambda a, b: np.logical_and(a, b).astype(np.float32)),
+    ("broadcast_logical_or",
+     lambda a, b: np.logical_or(a, b).astype(np.float32)),
+    ("broadcast_logical_xor",
+     lambda a, b: np.logical_xor(a, b).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_broadcast_binary_forward(name, ref):
+    a = _rand((2, 3, 4), seed=4, lo=0.5, hi=3.0)
+    b = _rand((1, 3, 1), seed=5, lo=0.5, hi=3.0)
+    out = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, ref(a, b).astype(np.float32),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_like():
+    a = _rand((1, 3, 1), seed=6)
+    b = _rand((2, 3, 4), seed=7)
+    out = nd.broadcast_like(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np.broadcast_to(a, (2, 3, 4)))
+
+
+# ----------------------------------------------------------------------
+# reductions
+# ----------------------------------------------------------------------
+REDUCE_CASES = [
+    ("sum", np.sum), ("mean", np.mean), ("prod", np.prod),
+    ("max", np.max), ("min", np.min),
+]
+
+
+@pytest.mark.parametrize("name,ref", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis,keepdims", [(None, False), (1, False),
+                                           ((0, 2), True)])
+def test_reduce_forward(name, ref, axis, keepdims):
+    x = _rand((2, 3, 4), seed=8, lo=0.5, hi=1.5)
+    kw = {} if axis is None else {"axis": axis}
+    out = getattr(nd, name)(nd.array(x), keepdims=keepdims, **kw).asnumpy()
+    expect = ref(x, axis=axis, keepdims=keepdims)
+    np.testing.assert_allclose(out.reshape(np.shape(expect)), expect,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_argmax_argmin_nansum():
+    x = _rand((3, 5), seed=9)
+    np.testing.assert_array_equal(
+        nd.argmax(nd.array(x), axis=1).asnumpy(), x.argmax(axis=1))
+    np.testing.assert_array_equal(
+        nd.argmin(nd.array(x), axis=1).asnumpy(), x.argmin(axis=1))
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    np.testing.assert_allclose(
+        nd.nansum(nd.array(xn), axis=1).asnumpy(), np.nansum(xn, axis=1),
+        rtol=1e-5)
+
+
+def test_sum_gradient_with_axis():
+    data = sym.Variable("data")
+    check_numeric_gradient(sym.sum(data, axis=1),
+                           {"data": _rand((3, 4), seed=10)})
+
+
+# ----------------------------------------------------------------------
+# shape manipulation
+# ----------------------------------------------------------------------
+def test_tile_repeat_reverse_flip():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(
+        nd.tile(nd.array(x), reps=(2, 2)).asnumpy(), np.tile(x, (2, 2)))
+    np.testing.assert_array_equal(
+        nd.repeat(nd.array(x), repeats=2, axis=1).asnumpy(),
+        np.repeat(x, 2, axis=1))
+    np.testing.assert_array_equal(
+        nd.reverse(nd.array(x), axis=1).asnumpy(), x[:, ::-1])
+    np.testing.assert_array_equal(
+        nd.flip(nd.array(x), axis=0).asnumpy(), x[::-1])
+
+
+def test_swapaxes_expand_squeeze_stack():
+    x = _rand((2, 3, 4), seed=11)
+    np.testing.assert_array_equal(
+        nd.swapaxes(nd.array(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+    e = nd.expand_dims(nd.array(x), axis=1)
+    assert e.shape == (2, 1, 3, 4)
+    np.testing.assert_array_equal(
+        nd.squeeze(e).asnumpy(), x)
+    s = nd.stack(nd.array(x), nd.array(x), axis=1)
+    assert s.shape == (2, 2, 3, 4)
+
+
+def test_depth_space_roundtrip():
+    x = _rand((1, 8, 2, 2), seed=12)
+    d = nd.depth_to_space(nd.array(x), block_size=2)
+    assert d.shape == (1, 2, 4, 4)
+    back = nd.space_to_depth(d, block_size=2).asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_pad_modes():
+    x = _rand((1, 1, 3, 3), seed=13)
+    out = nd.Pad(nd.array(x), mode="constant",
+                 pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                 constant_value=5.0).asnumpy()
+    assert out.shape == (1, 1, 5, 7)
+    assert (out[0, 0, 0] == 5.0).all() and (out[0, 0, :, 0] == 5.0).all()
+    np.testing.assert_allclose(out[0, 0, 1:-1, 2:-2], x[0, 0])
+    ref = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="edge")
+    out = nd.Pad(nd.array(x), mode="edge",
+                 pad_width=(0, 0, 0, 0, 1, 1, 2, 2)).asnumpy()
+    np.testing.assert_allclose(out, ref)
+    ref = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="reflect")
+    out = nd.Pad(nd.array(x), mode="reflect",
+                 pad_width=(0, 0, 0, 0, 1, 1, 2, 2)).asnumpy()
+    np.testing.assert_allclose(out, ref)
+
+
+# ----------------------------------------------------------------------
+# indexing
+# ----------------------------------------------------------------------
+def test_gather_scatter_nd():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.asarray([[0, 2], [1, 3]], np.float32)  # rows: (0,1),(2,3)
+    out = nd.gather_nd(nd.array(x), nd.array(idx)).asnumpy()
+    np.testing.assert_array_equal(out, [x[0, 1], x[2, 3]])
+    sc = nd.scatter_nd(nd.array(np.asarray([7.0, 9.0], np.float32)),
+                       nd.array(idx), shape=(3, 4)).asnumpy()
+    expect = np.zeros((3, 4), np.float32)
+    expect[0, 1], expect[2, 3] = 7, 9
+    np.testing.assert_array_equal(sc, expect)
+
+
+def test_batch_take_and_take_modes():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = nd.batch_take(nd.array(x),
+                        nd.array(np.asarray([0, 2, 1, 0],
+                                            np.float32))).asnumpy()
+    np.testing.assert_array_equal(out, [0, 5, 7, 9])
+    out = nd.take(nd.array(x), nd.array(np.asarray([1, 5], np.float32)),
+                  axis=0, mode="clip").asnumpy()
+    np.testing.assert_array_equal(out, x[[1, 3]])
+    out = nd.take(nd.array(x), nd.array(np.asarray([-1, 5], np.float32)),
+                  axis=0, mode="wrap").asnumpy()
+    np.testing.assert_array_equal(out, x[[3, 1]])
+
+
+def test_gather_nd_gradient():
+    data = sym.Variable("data")
+    idx = sym.Variable("idx")
+    out = sym.gather_nd(data, idx)
+    check_numeric_gradient(
+        out, {"data": _rand((3, 4), seed=14),
+              "idx": np.asarray([[0, 2], [1, 3]], np.float32)},
+        grad_nodes=["data"])
+
+
+# ----------------------------------------------------------------------
+# normalization + misc nn
+# ----------------------------------------------------------------------
+def test_instance_norm_forward():
+    x = _rand((2, 3, 4, 4), seed=15)
+    g = np.ones(3, np.float32) * 1.5
+    b = np.full(3, 0.25, np.float32)
+    out = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b),
+                          eps=1e-5).asnumpy()
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    expect = ((x - mean) / np.sqrt(var + 1e-5)
+              * g.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_instance_norm_gradient():
+    data = sym.Variable("data")
+    gamma = sym.Variable("gamma")
+    beta = sym.Variable("beta")
+    out = sym.InstanceNorm(data, gamma, beta)
+    check_numeric_gradient(
+        out, {"data": _rand((2, 2, 3, 3), seed=16),
+              "gamma": np.asarray([1.0, 1.2], np.float32),
+              "beta": np.asarray([0.1, -0.1], np.float32)},
+        rtol=3e-2, atol=1e-3)
+
+
+def test_lrn_forward():
+    x = _rand((1, 5, 3, 3), seed=17, lo=0.1, hi=1.0)
+    out = nd.LRN(nd.array(x), nsize=3, alpha=1e-3, beta=0.75,
+                 knorm=2.0).asnumpy()
+    expect = np.empty_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        sq = (x[:, lo:hi] ** 2).sum(axis=1)
+        # reference lrn-inl.h:103: salpha = alpha / nsize
+        expect[:, c] = x[:, c] / (2.0 + (1e-3 / 3) * sq) ** 0.75
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_smooth_l1():
+    x = np.asarray([-2.0, -0.3, 0.0, 0.4, 3.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expect = np.where(np.abs(x) < 1.0, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    data = sym.Variable("data")
+    check_numeric_gradient(sym.smooth_l1(data, scalar=1.0),
+                           {"data": _rand((8,), seed=18, lo=-2, hi=2)})
+
+
+def _naive_correlation(a, b, d=1, pad=1, is_multiply=True):
+    """k=1, stride1=stride2=1 reference semantics, plain numpy."""
+    B, C, H, W = a.shape
+    ph, pw = H + 2 * pad, W + 2 * pad
+    p1 = np.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(b, ((0, 0), (0, 0), (pad + d, pad + d), (pad + d, pad + d)))
+    th, tw = ph - 2 * d, pw - 2 * d
+    gw = 2 * d + 1
+    out = np.zeros((B, gw * gw, th, tw), np.float32)
+    for ci, (dy, dx) in enumerate(
+            (dy, dx) for dy in range(-d, d + 1) for dx in range(-d, d + 1)):
+        for y in range(th):
+            for x in range(tw):
+                y1, x1 = y + d, x + d
+                v1 = p1[:, :, y1, x1]
+                v2 = p2[:, :, d + y1 + dy, d + x1 + dx]
+                val = (v1 * v2 if is_multiply else np.abs(v1 - v2))
+                out[:, ci, y, x] = val.sum(axis=1) / C
+    return out
+
+
+def test_correlation_vs_naive():
+    a = _rand((2, 3, 6, 6), seed=19, lo=-1, hi=1)
+    b = _rand((2, 3, 6, 6), seed=20, lo=-1, hi=1)
+    for is_multiply in (True, False):
+        out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                             max_displacement=1, stride1=1, stride2=1,
+                             pad_size=1,
+                             is_multiply=is_multiply).asnumpy()
+        expect = _naive_correlation(a, b, is_multiply=is_multiply)
+        assert out.shape == expect.shape
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_gradient():
+    d1 = sym.Variable("d1")
+    d2 = sym.Variable("d2")
+    out = sym.Correlation(d1, d2, kernel_size=1, max_displacement=1,
+                          stride1=1, stride2=1, pad_size=1)
+    check_numeric_gradient(out, {"d1": _rand((1, 2, 4, 4), seed=21),
+                                 "d2": _rand((1, 2, 4, 4), seed=22)},
+                           rtol=3e-2, atol=1e-3)
+
+
+# ----------------------------------------------------------------------
+# fused optimizer update ops: one-step analytic checks
+# ----------------------------------------------------------------------
+def test_rmsprop_update_op():
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 0.5, np.float32))
+    n = nd.array(np.zeros(4, np.float32))
+    nd.rmsprop_update(w, g, n, out=w, lr=0.1, gamma1=0.9, epsilon=1e-8)
+    new_n = 0.1 * 0.25
+    expect = 1.0 - 0.1 * 0.5 / (np.sqrt(new_n) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(n.asnumpy(), new_n, rtol=1e-5)
+
+
+def test_ftrl_update_op():
+    w = nd.array(np.zeros(3, np.float32))
+    g = nd.array(np.full(3, 1.0, np.float32))
+    z = nd.array(np.zeros(3, np.float32))
+    n = nd.array(np.zeros(3, np.float32))
+    nd.ftrl_update(w, g, z, n, out=w, lr=0.1, lamda1=0.01, beta=1.0)
+    assert np.isfinite(w.asnumpy()).all()
+    assert (np.abs(w.asnumpy()) > 0).all()  # grad above l1 threshold
+
+
+def test_signum_update_op():
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.asarray([0.5, -0.2, 0.0], np.float32))
+    m = nd.array(np.zeros(3, np.float32))
+    nd.signum_update(w, g, m, out=w, lr=0.1, momentum=0.9)
+    # m = -(1-momentum)*grad... sign step moves opposite the gradient
+    out = w.asnumpy()
+    assert out[0] < 1.0 and out[1] > 1.0
+
+
+def test_adagrad_update_op():
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 0.5, np.float32))
+    h = nd.array(np.zeros(4, np.float32))
+    nd.adagrad_update(w, g, h, out=w, lr=0.1, epsilon=1e-7)
+    np.testing.assert_allclose(h.asnumpy(), 0.25, rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# sampler sanity (moments)
+# ----------------------------------------------------------------------
+def test_sample_multinomial_distribution():
+    mx.random.seed(11)
+    probs = nd.array(np.asarray([[0.2, 0.8], [0.9, 0.1]], np.float32))
+    s = nd.sample_multinomial(probs, shape=(2000,)).asnumpy()
+    assert s.shape == (2, 2000)
+    assert abs(s[0].mean() - 0.8) < 0.05
+    assert abs(s[1].mean() - 0.1) < 0.05
