@@ -258,19 +258,31 @@ def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
 # ----------------------------------------------------------------------
 @register("GridGenerator")
 def grid_generator(data, *, transform_type="affine", target_shape=()):
-    """Affine sampling grid (ref grid_generator.cc): data (N, 6) affine
-    params -> grid (N, 2, H, W) of normalized (x, y) coords."""
-    if transform_type != "affine":
-        raise NotImplementedError("only affine GridGenerator")
-    h, w = int(target_shape[0]), int(target_shape[1])
-    theta = data.reshape(-1, 2, 3)
-    ys = jnp.linspace(-1.0, 1.0, h)
-    xs = jnp.linspace(-1.0, 1.0, w)
-    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-    ones = jnp.ones_like(gx)
-    coords = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, H*W)
-    out = theta @ coords  # (N, 2, H*W)
-    return out.reshape(-1, 2, h, w)
+    """Sampling grid (ref grid_generator.cc). ``affine``: data (N, 6)
+    affine params -> grid (N, 2, H, W) of normalized (x, y) coords.
+    ``warp``: data (N, 2, H, W) pixel-offset flow added to the identity
+    grid, normalized to [-1, 1] (grid_generator-inl.h warp kernel)."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, H*W)
+        out = theta @ coords  # (N, 2, H*W)
+        return out.reshape(-1, 2, h, w)
+    if transform_type == "warp":
+        # identity grid built in f32: low-precision dtypes (bf16) can't
+        # represent pixel indices past 256 exactly
+        h, w = int(data.shape[2]), int(data.shape[3])
+        gx = jnp.broadcast_to(jnp.arange(w, dtype=jnp.float32), (h, w))
+        gy = jnp.broadcast_to(jnp.arange(h, dtype=jnp.float32)[:, None],
+                              (h, w))
+        x = (data[:, 0].astype(jnp.float32) + gx) * (2.0 / max(w - 1, 1)) - 1.0
+        y = (data[:, 1].astype(jnp.float32) + gy) * (2.0 / max(h - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1).astype(data.dtype)
+    raise ValueError("transform_type must be 'affine' or 'warp'")
 
 
 @register("SpatialTransformer")

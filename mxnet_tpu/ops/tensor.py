@@ -289,17 +289,19 @@ def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float
     ax = int(axis) % data.ndim
     k = int(k) if int(k) > 0 else data.shape[ax]
     x = jnp.moveaxis(data, ax, -1)
-    vals, idxs = jax.lax.top_k(-x if is_ascend else x, k)
+    vals, raw_idxs = jax.lax.top_k(-x if is_ascend else x, k)
     if is_ascend:
         vals = -vals
+    if ret_typ == "mask":
+        # 0/1 mask marking the top-k entries (ordering.cc ret_typ=mask)
+        onehot = jax.nn.one_hot(raw_idxs, x.shape[-1], dtype=data.dtype)
+        return jnp.moveaxis(onehot.sum(axis=-2), -1, ax)
     vals = jnp.moveaxis(vals, -1, ax)
-    idxs = jnp.moveaxis(idxs, -1, ax).astype(dtype)
+    idxs = jnp.moveaxis(raw_idxs, -1, ax).astype(dtype)
     if ret_typ == "value":
         return vals
     if ret_typ == "both":
         return vals, idxs
-    if ret_typ == "mask":
-        raise NotImplementedError("topk ret_typ=mask")
     return idxs
 
 

@@ -93,3 +93,26 @@ def test_variational_dropout_cell():
     # eval mode: no dropout
     out, _ = vd(ones, vd.begin_state(4))
     assert np.isfinite(out.asnumpy()).all()
+
+
+def test_lstmp_cell():
+    # projection cell: output/recurrent state sized projection_size,
+    # cell state sized hidden_size (ref contrib/rnn LSTMPCell)
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+    from mxnet_tpu import autograd
+    cell = LSTMPCell(hidden_size=8, projection_size=4)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(2, 5).astype("float32"))
+    out, new_states = cell(x, cell.begin_state(batch_size=2))
+    assert out.shape == (2, 4)
+    assert new_states[0].shape == (2, 4)
+    assert new_states[1].shape == (2, 8)
+    for p in cell.collect_params().values():
+        p.grad_req = "write"
+    seq = [nd.array(np.random.rand(2, 5).astype("float32"))
+           for _ in range(3)]
+    with autograd.record():
+        outs, _ = cell.unroll(3, seq, merge_outputs=False)
+        loss = sum((o * o).sum() for o in outs)
+    loss.backward()
+    assert float(np.abs(cell.h2r_weight.grad().asnumpy()).max()) > 0

@@ -393,3 +393,30 @@ def test_sample_multinomial_distribution():
     assert s.shape == (2, 2000)
     assert abs(s[0].mean() - 0.8) < 0.05
     assert abs(s[1].mean() - 0.1) < 0.05
+
+
+def test_topk_mask():
+    x = np.asarray([[1.0, 5.0, 3.0, 2.0], [4.0, 0.0, 6.0, 1.0]],
+                   np.float32)
+    mask = nd.topk(nd.array(x), k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_array_equal(mask, [[0, 1, 1, 0], [1, 0, 1, 0]])
+    mask = nd.topk(nd.array(x), k=1, ret_typ="mask",
+                   is_ascend=True).asnumpy()
+    np.testing.assert_array_equal(mask, [[1, 0, 0, 0], [0, 1, 0, 0]])
+
+
+def test_grid_generator_warp():
+    # zero flow -> identity grid; constant x-flow shifts normalized x
+    flow = np.zeros((1, 2, 3, 4), np.float32)
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+    flow[:, 0] = 1.5  # +1.5 px in x = 2*1.5/(w-1)=1.0 in normalized units
+    grid2 = nd.GridGenerator(nd.array(flow),
+                             transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid2[0, 0] - grid[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(grid2[0, 1], grid[0, 1], atol=1e-6)
+    with pytest.raises(ValueError):
+        nd.GridGenerator(nd.array(flow), transform_type="bogus")
