@@ -1,0 +1,7 @@
+"""Vision datasets + transforms (reference gluon/data/vision/)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset)
+from . import transforms
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "transforms"]

@@ -12,8 +12,8 @@ import struct
 
 import numpy as np
 
-from ...base import MXNetError
-from .dataset import Dataset, RecordFileDataset
+from ....base import MXNetError
+from ..dataset import Dataset, RecordFileDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset"]
@@ -36,7 +36,7 @@ class _DownloadedDataset(Dataset):
         self._get_data()
 
     def __getitem__(self, idx):
-        from ... import ndarray as nd
+        from .... import ndarray as nd
         data = nd.array(self._data[idx])
         if self._transform is not None:
             return self._transform(data, self._label[idx])
@@ -154,10 +154,10 @@ class ImageRecordDataset(RecordFileDataset):
         self._transform = transform
 
     def __getitem__(self, idx):
-        from ...recordio import unpack_img
+        from ....recordio import unpack_img
         record = super().__getitem__(idx)
         header, img = unpack_img(record, iscolor=self._flag)
-        from ... import ndarray as nd
+        from .... import ndarray as nd
         img = nd.array(img)
         label = header.label
         if self._transform is not None:
@@ -190,7 +190,7 @@ class ImageFolderDataset(Dataset):
                     self.items.append((os.path.join(path, filename), label))
 
     def __getitem__(self, idx):
-        from ...image import imread
+        from ....image import imread
         img = imread(self.items[idx][0], flag=self._flag)
         label = self.items[idx][1]
         if self._transform is not None:

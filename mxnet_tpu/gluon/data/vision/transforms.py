@@ -1,0 +1,189 @@
+"""Gluon vision transforms.
+
+Reference parity: python/mxnet/gluon/data/vision/transforms.py
+(Compose, Cast, ToTensor, Normalize, RandomResizedCrop, CenterCrop,
+Resize, RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/
+Saturation/Hue, RandomColorJitter, RandomLighting). Transforms operate
+on HWC uint8/float images until ToTensor flips to CHW float [0, 1] —
+same contract as the reference; the jitter math reuses mx.image's
+augmenters (image.py BrightnessJitterAug etc.) so DataLoader pipelines
+and ImageIter pipelines share one implementation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import NDArray, array
+from .... import image as _image
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
+
+
+def _as_nd(x):
+    return x if isinstance(x, NDArray) else array(np.asarray(x))
+
+
+class Compose(Sequential):
+    """Sequentially apply child transforms (ref transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    """Cast to dtype (ref transforms.py Cast)."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """(H, W, C) or (N, H, W, C) uint8 [0,255] -> (C, H, W) float32
+    [0,1] (ref transforms.py ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        out = F.cast(x, dtype="float32") / 255.0
+        if len(x.shape) == 4:
+            return F.transpose(out, axes=(0, 3, 1, 2))
+        return F.transpose(out, axes=(2, 0, 1))
+
+
+class Normalize(HybridBlock):
+    """Channel-wise (x - mean) / std on CHW tensors
+    (ref transforms.py Normalize)."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = tuple(np.ravel(mean).tolist())
+        self._std = tuple(np.ravel(std).tolist())
+
+    def hybrid_forward(self, F, x):
+        # one fused op with static mean/std attrs — hybridize-safe, no
+        # per-call constant uploads (ref uses the image.normalize op too)
+        return F.image_normalize(x, mean=self._mean, std=self._std)
+
+
+class Resize(Block):
+    """Resize to (w, h) = size (ref transforms.py Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        x = _as_nd(x)
+        if isinstance(self._size, int):
+            if self._keep:
+                return _image.resize_short(x, self._size, self._interp)
+            w = h = self._size
+        else:
+            w, h = self._size
+        return _image.imresize(x, w, h, self._interp)
+
+
+class CenterCrop(Block):
+    """Center-crop to size, upsampling if needed
+    (ref transforms.py CenterCrop)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        out, _ = _image.center_crop(_as_nd(x), self._size, self._interp)
+        return out
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop resized to size
+    (ref transforms.py RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = tuple(scale)
+        self._ratio = tuple(ratio)
+        self._interp = interpolation
+
+    def forward(self, x):
+        out, _ = _image.random_size_crop(_as_nd(x), self._size,
+                                         self._scale, self._ratio,
+                                         self._interp)
+        return out
+
+
+class _AugBlock(Block):
+    """Adapter: run one mx.image Augmenter as a gluon transform."""
+
+    def __init__(self, aug):
+        super().__init__()
+        self._aug = aug
+
+    def forward(self, x):
+        return self._aug(_as_nd(x))
+
+
+class RandomFlipLeftRight(_AugBlock):
+    def __init__(self):
+        super().__init__(_image.HorizontalFlipAug(0.5))
+
+
+class RandomFlipTopBottom(_AugBlock):
+    def __init__(self):
+        super().__init__(_image.VerticalFlipAug(0.5))
+
+
+class RandomBrightness(_AugBlock):
+    def __init__(self, brightness):
+        super().__init__(_image.BrightnessJitterAug(brightness))
+
+
+class RandomContrast(_AugBlock):
+    def __init__(self, contrast):
+        super().__init__(_image.ContrastJitterAug(contrast))
+
+
+class RandomSaturation(_AugBlock):
+    def __init__(self, saturation):
+        super().__init__(_image.SaturationJitterAug(saturation))
+
+
+class RandomHue(_AugBlock):
+    def __init__(self, hue):
+        super().__init__(_image.HueJitterAug(hue))
+
+
+class RandomColorJitter(_AugBlock):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        augs = _image.ColorJitterAug(brightness, contrast, saturation)
+        if hue:
+            augs = _image.RandomOrderAug(
+                [augs, _image.HueJitterAug(hue)])
+        super().__init__(augs)
+
+
+class RandomLighting(_AugBlock):
+    def __init__(self, alpha):
+        super().__init__(_image.LightingAug(
+            alpha,
+            eigval=np.asarray([55.46, 4.794, 1.148], np.float32),
+            eigvec=np.asarray([[-0.5675, 0.7192, 0.4009],
+                               [-0.5808, -0.0045, -0.8140],
+                               [-0.5836, -0.6948, 0.4203]], np.float32)))

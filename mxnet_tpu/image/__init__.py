@@ -10,7 +10,8 @@ from .image import (imread, imdecode, imresize, scale_down, resize_short,
                     CenterCropAug, BrightnessJitterAug, ContrastJitterAug,
                     SaturationJitterAug, HueJitterAug, ColorJitterAug,
                     LightingAug, ColorNormalizeAug, RandomGrayAug,
-                    HorizontalFlipAug, CastAug, CreateAugmenter, ImageIter)
+                    HorizontalFlipAug, VerticalFlipAug, CastAug,
+                    CreateAugmenter, ImageIter)
 from .record_iter import ImageRecordIter
 from .detection import (ImageDetIter, CreateDetAugmenter,
                         DetHorizontalFlipAug, DetRandomCropAug,

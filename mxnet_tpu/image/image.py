@@ -429,6 +429,17 @@ class HorizontalFlipAug(Augmenter):
         return src
 
 
+class VerticalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def _apply_np(self, src):
+        if _pyrandom.random() < self.p:
+            return src[::-1]
+        return src
+
+
 class CastAug(Augmenter):
     def __init__(self, typ="float32"):
         super().__init__(type=typ)

@@ -1,0 +1,93 @@
+"""gluon.data.vision.transforms tests (reference
+tests/python/unittest/test_gluon_data_vision.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+@pytest.fixture
+def img():
+    arr = (np.random.RandomState(0).rand(10, 12, 3) * 255).astype(np.uint8)
+    return nd.array(arr, dtype="uint8")
+
+
+def test_to_tensor_and_normalize(img):
+    out = transforms.ToTensor()(img)
+    assert out.shape == (3, 10, 12)
+    assert str(out.dtype).startswith("float32")
+    np.testing.assert_allclose(
+        out.asnumpy(),
+        img.asnumpy().astype(np.float32).transpose(2, 0, 1) / 255.0,
+        rtol=1e-6)
+    norm = transforms.Normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))
+    o2 = norm(out)
+    np.testing.assert_allclose(o2.asnumpy(),
+                               (out.asnumpy() - 0.5) / 0.25, rtol=1e-5)
+    # batch layout NHWC -> NCHW
+    batch = nd.array(np.stack([img.asnumpy()] * 2), dtype="uint8")
+    ob = transforms.ToTensor()(batch)
+    assert ob.shape == (2, 3, 10, 12)
+
+
+def test_compose_pipeline(img):
+    comp = transforms.Compose([
+        transforms.Resize(8), transforms.CenterCrop(6),
+        transforms.ToTensor(),
+        transforms.Normalize((0.5,) * 3, (0.25,) * 3)])
+    out = comp(img)
+    assert out.shape == (3, 6, 6)
+
+
+def test_spatial_transforms(img):
+    assert transforms.Resize((6, 4))(img).shape == (4, 6, 3)
+    rs = transforms.Resize(8, keep_ratio=True)(img)
+    assert min(rs.shape[:2]) == 8
+    assert transforms.CenterCrop(6)(img).shape == (6, 6, 3)
+    assert transforms.RandomResizedCrop(5)(img).shape == (5, 5, 3)
+
+
+def test_flips_deterministic_shapes(img):
+    for t in [transforms.RandomFlipLeftRight(),
+              transforms.RandomFlipTopBottom()]:
+        out = t(img)
+        assert out.shape == (10, 12, 3)
+        # flipping permutes pixels, never changes the multiset
+        np.testing.assert_allclose(np.sort(out.asnumpy().ravel()),
+                                   np.sort(img.asnumpy().ravel()))
+
+
+def test_color_transforms_shapes(img):
+    for t in [transforms.RandomBrightness(0.2),
+              transforms.RandomContrast(0.2),
+              transforms.RandomSaturation(0.2),
+              transforms.RandomHue(0.1),
+              transforms.RandomColorJitter(0.2, 0.2, 0.2, 0.1),
+              transforms.RandomLighting(0.1)]:
+        assert t(img).shape == (10, 12, 3)
+
+
+def test_cast(img):
+    out = transforms.Cast("float16")(transforms.ToTensor()(img))
+    assert str(out.dtype).startswith("float16")
+
+
+def test_transforms_in_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = (np.random.RandomState(1).rand(12, 10, 12, 3) * 255).astype(
+        np.uint8)
+    y = np.arange(12).astype(np.float32)
+    ds = ArrayDataset(X, y).transform_first(
+        transforms.Compose([transforms.ToTensor()]))
+    batch = next(iter(DataLoader(ds, batch_size=4)))
+    assert tuple(batch[0].shape) == (4, 3, 10, 12)
+    assert float(np.asarray(batch[0].asnumpy()).max()) <= 1.0
+
+
+def test_vision_package_layout():
+    # reference path mx.gluon.data.vision.transforms + datasets intact
+    from mxnet_tpu.gluon.data import vision
+    assert hasattr(vision, "MNIST") and hasattr(vision, "transforms")
+    assert hasattr(vision, "ImageFolderDataset")
