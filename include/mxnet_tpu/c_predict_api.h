@@ -1,0 +1,96 @@
+/*
+ * C predict API — standalone inference entry callable from C/C++.
+ *
+ * Reference parity: include/mxnet/c_predict_api.h (MXPredCreate:78,
+ * MXPredReshape:137, MXPredGetOutputShape:152, MXPredSetInput:165,
+ * MXPredForward:174, MXPredGetOutput:200, MXPredFree:209,
+ * MXNDListCreate:219). The implementation (src/c_predict_api.cc) embeds
+ * the CPython interpreter and drives mxnet_tpu.predictor.Predictor, so a
+ * C/C++ application links ONE shared library (libmxtpu_predict.so) and
+ * runs the same XLA-compiled inference path as Python callers.
+ *
+ * Requirements at runtime: PYTHONPATH must reach the mxnet_tpu package
+ * and its dependencies (e.g. the deployment virtualenv's site-packages).
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/* Return the last error message from a failed (-1) call. */
+const char *MXGetLastError();
+
+/*
+ * Create a predictor from an in-memory symbol json string and a
+ * serialized parameter blob (the bytes of a .params file).
+ * dev_type: 1 = cpu, 2 = gpu/tpu accelerator. input_keys names the
+ * num_input_nodes inputs; shapes are packed in input_shape_data with
+ * prefix offsets input_shape_indptr (length num_input_nodes + 1).
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Create with only the listed output nodes (ref MXPredCreatePartialOut). */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+
+/* Re-bind an existing predictor for new input shapes. */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
+
+/* Shape of output `index`; pointers valid until the next MXPred call. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy float32 input data (size elements) into input `key`. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the forward pass on the bound inputs. */
+int MXPredForward(PredictorHandle handle);
+
+/* Partial forward for layer-wise stepping: this build always runs the
+ * whole fused XLA program, so *step_left is 0 after one call. */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+/* Copy output `index` as float32 into data (size elements). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+/* Load a serialized NDArray dict (e.g. mean image .nd file). */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -104,3 +104,60 @@ class Predictor:
     @property
     def output_names(self):
         return self._symbol.list_outputs()
+
+
+# ----------------------------------------------------------------------
+# helpers for the embedded C predict API (src/c_predict_api.cc) — the
+# C side passes flat float32 buffers; these reshape to the bind shapes,
+# run forward, and hand back C-contiguous float32 numpy arrays
+# ----------------------------------------------------------------------
+def _c_api_forward(pred, flat_inputs):
+    """Run ``pred`` on a dict of FLAT float32 numpy arrays, reshaping
+    each to its bind-time shape. Returns a list of float32 C-contiguous
+    outputs (filtered to ``_c_api_partial_outputs`` when set)."""
+    inputs = {}
+    for name, flat in flat_inputs.items():
+        shape = pred._exe.arg_dict[name].shape
+        inputs[name] = _np.ascontiguousarray(
+            _np.asarray(flat, _np.float32).reshape(shape))
+    outs = pred.forward(**inputs)
+    wanted = getattr(pred, "_c_api_partial_outputs", None)
+    if wanted:
+        names = pred.output_names
+        index = {n: i for i, n in enumerate(names)}
+        picked = []
+        for key in wanted:
+            if key in index:
+                picked.append(outs[index[key]])
+            elif key + "_output" in index:
+                picked.append(outs[index[key + "_output"]])
+            else:
+                raise MXNetError("unknown output %r (have %s)"
+                                 % (key, names))
+        outs = picked
+    return [_np.ascontiguousarray(_np.asarray(o, _np.float32))
+            for o in outs]
+
+
+def _c_api_ndlist(blob):
+    """Decode a serialized NDArray dict blob into ([keys], [float32
+    arrays]) for MXNDListCreate."""
+    from .serialization import load_ndarray_bytes
+    saved = load_ndarray_bytes(bytes(blob))
+    keys, arrays = [], []
+    for k, v in saved.items():
+        keys.append(k)
+        arrays.append(_np.ascontiguousarray(
+            _np.asarray(v.asnumpy(), _np.float32)))
+    return keys, arrays
+
+
+def _c_api_set_partial_outputs(pred, keys):
+    """Validate + install a partial-output selection (fails fast at
+    MXPredCreatePartialOut time, like the reference)."""
+    names = pred.output_names
+    for key in keys:
+        if key not in names and key + "_output" not in names:
+            raise MXNetError("unknown output %r (have %s)" % (key, names))
+    pred._c_api_partial_outputs = list(keys)
+    return True

@@ -1,0 +1,512 @@
+// C predict API over an embedded CPython interpreter.
+//
+// Reference parity: src/c_api/c_predict_api.cc (461 LoC) binds a
+// GraphExecutor for inference behind flat C functions. Here the same
+// flat surface drives mxnet_tpu.predictor.Predictor: the interpreter is
+// initialized once (honoring PYTHONPATH so the deployment venv and the
+// mxnet_tpu package resolve), every entry point holds the GIL for its
+// duration, and tensors cross the boundary as plain float32 buffers.
+// Inference itself is the one jitted XLA program Predictor binds.
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string &msg) { g_last_error = msg; }
+
+// Fetch and format the current Python exception into g_last_error.
+void SetPyError(const char *what) {
+  std::string msg = what;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value != nullptr) {
+      PyObject *s = PyObject_Str(value);
+      if (s != nullptr) {
+        const char *utf8 = PyUnicode_AsUTF8(s);
+        if (utf8 != nullptr) {
+          msg += ": ";
+          msg += utf8;
+        } else {
+          PyErr_Clear();  // unencodable exception text; keep the prefix
+        }
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  SetError(msg);
+}
+
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+
+void InitPython() {
+  std::call_once(g_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);  // no signal handlers: we are a guest runtime
+      // release the GIL acquired by initialization so entry points can
+      // take it with PyGILState_Ensure from any thread
+      PyEval_SaveThread();
+    }
+    g_init_ok = true;
+  });
+}
+
+struct GIL {
+  PyGILState_STATE state;
+  GIL() { state = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(state); }
+};
+
+struct Predictor {
+  PyObject *obj = nullptr;              // mxnet_tpu.predictor.Predictor
+  std::vector<std::string> input_keys;  // bind-time input names
+  PyObject *inputs = nullptr;           // dict name -> numpy array
+  PyObject *outputs = nullptr;          // list of numpy arrays (fwd result)
+  std::vector<mx_uint> shape_buf;       // backing store for GetOutputShape
+};
+
+struct NDList {
+  PyObject *keys = nullptr;    // list of str
+  PyObject *arrays = nullptr;  // list of float32 C-contiguous numpy arrays
+  std::vector<std::vector<mx_uint>> shapes;
+};
+
+PyObject *ImportAttr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (mod == nullptr) return nullptr;
+  PyObject *out = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return out;
+}
+
+// Build {name: (d0, d1, ...)} shape dict from the packed C arrays.
+PyObject *BuildShapeDict(mx_uint num, const char **keys,
+                         const mx_uint *indptr, const mx_uint *data) {
+  PyObject *dict = PyDict_New();
+  if (dict == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint ndim = indptr[i + 1] - indptr[i];
+    PyObject *tup = PyTuple_New(ndim);
+    for (mx_uint j = 0; j < ndim; ++j) {
+      PyTuple_SET_ITEM(tup, j,
+                       PyLong_FromUnsignedLong(data[indptr[i] + j]));
+    }
+    if (PyDict_SetItemString(dict, keys[i], tup) != 0) {
+      Py_DECREF(tup);
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    Py_DECREF(tup);
+  }
+  return dict;
+}
+
+// np.frombuffer(bytes, float32).reshape(shape).copy() — returns a new ref.
+PyObject *FloatArrayFromBuffer(const mx_float *data, mx_uint size) {
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) return nullptr;
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ);
+  PyObject *out = nullptr;
+  if (mem != nullptr) {
+    PyObject *frombuffer = PyObject_GetAttrString(np, "frombuffer");
+    if (frombuffer != nullptr) {
+      PyObject *flat = PyObject_CallFunction(frombuffer, "Os", mem,
+                                             "float32");
+      if (flat != nullptr) {
+        out = PyObject_CallMethod(flat, "copy", nullptr);
+        Py_DECREF(flat);
+      }
+      Py_DECREF(frombuffer);
+    }
+    Py_DECREF(mem);
+  }
+  Py_DECREF(np);
+  return out;
+}
+
+int CreateImpl(const char *symbol_json_str, const void *param_bytes,
+               int param_size, int dev_type, mx_uint num_input_nodes,
+               const char **input_keys, const mx_uint *input_shape_indptr,
+               const mx_uint *input_shape_data, mx_uint num_output_nodes,
+               const char **output_keys, PredictorHandle *out) {
+  InitPython();
+  if (!g_init_ok) {
+    SetError("embedded Python failed to initialize");
+    return -1;
+  }
+  GIL gil;
+  PyObject *cls = ImportAttr("mxnet_tpu.predictor", "Predictor");
+  if (cls == nullptr) {
+    SetPyError("cannot import mxnet_tpu.predictor.Predictor (is "
+               "PYTHONPATH set to reach mxnet_tpu and its deps?)");
+    return -1;
+  }
+  PyObject *create = PyObject_GetAttrString(cls, "create");
+  PyObject *shapes = BuildShapeDict(num_input_nodes, input_keys,
+                                    input_shape_indptr, input_shape_data);
+  PyObject *json = PyUnicode_FromString(symbol_json_str);
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *pred = nullptr;
+  if (create != nullptr && shapes != nullptr && json != nullptr &&
+      params != nullptr) {
+    PyObject *args = PyTuple_Pack(3, json, params, shapes);
+    PyObject *kwargs = PyDict_New();
+    if (dev_type == 1) {
+      PyObject *ctx_fn = ImportAttr("mxnet_tpu", "cpu");
+      if (ctx_fn != nullptr) {
+        PyObject *ctx = PyObject_CallNoArgs(ctx_fn);
+        if (ctx != nullptr) {
+          PyDict_SetItemString(kwargs, "ctx", ctx);
+          Py_DECREF(ctx);
+        }
+        Py_DECREF(ctx_fn);
+      }
+      PyErr_Clear();
+    }
+    if (args != nullptr && kwargs != nullptr) {
+      pred = PyObject_Call(create, args, kwargs);
+    }
+    Py_XDECREF(args);
+    Py_XDECREF(kwargs);
+  }
+  Py_XDECREF(create);
+  Py_XDECREF(shapes);
+  Py_XDECREF(json);
+  Py_XDECREF(params);
+  Py_DECREF(cls);
+  if (pred == nullptr) {
+    SetPyError("MXPredCreate failed");
+    return -1;
+  }
+  if (num_output_nodes > 0) {
+    // partial-out: validate the requested names NOW (the reference
+    // fails fast at create) and remember them for forward-time filtering
+    PyObject *keys = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i) {
+      PyList_SET_ITEM(keys, i, PyUnicode_FromString(output_keys[i]));
+    }
+    PyObject *setter = ImportAttr("mxnet_tpu.predictor",
+                                  "_c_api_set_partial_outputs");
+    PyObject *ok = setter != nullptr
+                       ? PyObject_CallFunction(setter, "OO", pred, keys)
+                       : nullptr;
+    Py_XDECREF(setter);
+    Py_DECREF(keys);
+    if (ok == nullptr) {
+      SetPyError("MXPredCreatePartialOut failed");
+      Py_DECREF(pred);
+      return -1;
+    }
+    Py_DECREF(ok);
+  }
+  auto *h = new Predictor();
+  h->obj = pred;
+  h->inputs = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    h->input_keys.emplace_back(input_keys[i]);
+  }
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int /*dev_id*/,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int /*dev_id*/,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, num_output_nodes, output_keys, out);
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  auto *h = static_cast<Predictor *>(handle);
+  GIL gil;
+  PyObject *shapes = BuildShapeDict(num_input_nodes, input_keys,
+                                    input_shape_indptr, input_shape_data);
+  if (shapes == nullptr) {
+    SetPyError("MXPredReshape failed");
+    return -1;
+  }
+  PyObject *pred = PyObject_CallMethod(h->obj, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    SetPyError("MXPredReshape failed");
+    return -1;
+  }
+  // a partial-out selection survives reshape
+  PyObject *partial = PyObject_GetAttrString(h->obj,
+                                             "_c_api_partial_outputs");
+  if (partial != nullptr) {
+    int rc = PyObject_SetAttrString(pred, "_c_api_partial_outputs",
+                                    partial);
+    Py_DECREF(partial);
+    if (rc != 0) {
+      SetPyError("MXPredReshape failed");
+      Py_DECREF(pred);
+      return -1;
+    }
+  } else {
+    PyErr_Clear();
+  }
+  auto *nh = new Predictor();
+  nh->obj = pred;
+  nh->inputs = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    nh->input_keys.emplace_back(input_keys[i]);
+  }
+  *out = nh;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  auto *h = static_cast<Predictor *>(handle);
+  bool known = false;
+  for (const auto &k : h->input_keys) {
+    if (k == key) {
+      known = true;
+      break;
+    }
+  }
+  if (!known) {
+    SetError(std::string("MXPredSetInput: unknown input '") + key +
+             "' (declared at create time: check the key)");
+    return -1;
+  }
+  GIL gil;
+  PyObject *arr = FloatArrayFromBuffer(data, size);
+  if (arr == nullptr) {
+    SetPyError("MXPredSetInput failed");
+    return -1;
+  }
+  int rc = PyDict_SetItemString(h->inputs, key, arr);
+  Py_DECREF(arr);
+  if (rc != 0) {
+    SetPyError("MXPredSetInput failed");
+    return -1;
+  }
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto *h = static_cast<Predictor *>(handle);
+  GIL gil;
+  // reshape each flat input to its declared shape and run forward
+  PyObject *helper = ImportAttr("mxnet_tpu.predictor",
+                                "_c_api_forward");
+  if (helper == nullptr) {
+    SetPyError("MXPredForward failed");
+    return -1;
+  }
+  PyObject *outs = PyObject_CallFunction(helper, "OO", h->obj, h->inputs);
+  Py_DECREF(helper);
+  if (outs == nullptr) {
+    SetPyError("MXPredForward failed");
+    return -1;
+  }
+  Py_XDECREF(h->outputs);
+  h->outputs = outs;
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  if (step > 0) {
+    // the whole graph runs as one XLA program; step 0 does everything
+    *step_left = 0;
+    return 0;
+  }
+  int rc = MXPredForward(handle);
+  *step_left = 0;
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  auto *h = static_cast<Predictor *>(handle);
+  GIL gil;
+  if (h->outputs == nullptr ||
+      index >= static_cast<mx_uint>(PyList_Size(h->outputs))) {
+    SetError("MXPredGetOutputShape: no such output (run MXPredForward "
+             "first)");
+    return -1;
+  }
+  PyObject *arr = PyList_GetItem(h->outputs, index);  // borrowed
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (shape == nullptr) {
+    SetPyError("MXPredGetOutputShape failed");
+    return -1;
+  }
+  Py_ssize_t ndim = PyTuple_Size(shape);
+  h->shape_buf.resize(ndim > 0 ? ndim : 1);
+  for (Py_ssize_t i = 0; i < ndim; ++i) {
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i)));
+  }
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(ndim);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  auto *h = static_cast<Predictor *>(handle);
+  GIL gil;
+  if (h->outputs == nullptr ||
+      index >= static_cast<mx_uint>(PyList_Size(h->outputs))) {
+    SetError("MXPredGetOutput: no such output (run MXPredForward first)");
+    return -1;
+  }
+  PyObject *arr = PyList_GetItem(h->outputs, index);  // borrowed
+  PyObject *bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  if (bytes == nullptr) {
+    SetPyError("MXPredGetOutput failed");
+    return -1;
+  }
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  if (nbytes > static_cast<Py_ssize_t>(
+          static_cast<size_t>(size) * sizeof(mx_float))) {
+    Py_DECREF(bytes);
+    SetError("MXPredGetOutput: buffer too small");
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto *h = static_cast<Predictor *>(handle);
+  if (h != nullptr) {
+    GIL gil;
+    Py_XDECREF(h->obj);
+    Py_XDECREF(h->inputs);
+    Py_XDECREF(h->outputs);
+    delete h;
+  }
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  InitPython();
+  GIL gil;
+  PyObject *helper = ImportAttr("mxnet_tpu.predictor", "_c_api_ndlist");
+  if (helper == nullptr) {
+    SetPyError("MXNDListCreate failed");
+    return -1;
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *pair = blob != nullptr
+                       ? PyObject_CallFunction(helper, "O", blob)
+                       : nullptr;
+  Py_XDECREF(blob);
+  Py_DECREF(helper);
+  if (pair == nullptr) {
+    SetPyError("MXNDListCreate failed");
+    return -1;
+  }
+  auto *l = new NDList();
+  l->keys = PySequence_GetItem(pair, 0);
+  l->arrays = PySequence_GetItem(pair, 1);
+  Py_DECREF(pair);
+  if (l->keys == nullptr || l->arrays == nullptr) {
+    SetPyError("MXNDListCreate failed");
+    Py_XDECREF(l->keys);
+    Py_XDECREF(l->arrays);
+    delete l;
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(l->arrays);
+  l->shapes.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shape = PyObject_GetAttrString(PyList_GetItem(l->arrays, i),
+                                             "shape");
+    Py_ssize_t ndim = PyTuple_Size(shape);
+    for (Py_ssize_t j = 0; j < ndim; ++j) {
+      l->shapes[i].push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(shape, j))));
+    }
+    Py_DECREF(shape);
+  }
+  *out = l;
+  *out_length = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  auto *l = static_cast<NDList *>(handle);
+  GIL gil;
+  if (index >= static_cast<mx_uint>(PyList_Size(l->arrays))) {
+    SetError("MXNDListGet: index out of range");
+    return -1;
+  }
+  *out_key = PyUnicode_AsUTF8(PyList_GetItem(l->keys, index));
+  PyObject *arr = PyList_GetItem(l->arrays, index);
+  // float32 C-contiguous guaranteed by _c_api_ndlist; expose its buffer
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+    SetPyError("MXNDListGet failed");
+    return -1;
+  }
+  *out_data = static_cast<const mx_float *>(view.buf);
+  PyBuffer_Release(&view);  // arr stays alive in the list; buf valid
+  *out_shape = l->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(l->shapes[index].size());
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  auto *l = static_cast<NDList *>(handle);
+  if (l != nullptr) {
+    GIL gil;
+    Py_XDECREF(l->keys);
+    Py_XDECREF(l->arrays);
+    delete l;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+/* Minimal C consumer of the predict API (reference
+ * example/image-classification/predict-cpp uses the same call
+ * sequence). Usage:
+ *   c_predict_demo <symbol.json> <model.params> <n_inputs> <v0> <v1>...
+ * Prints output values space-separated on one line.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s symbol.json model.params n v...\n", argv[0]);
+    return 2;
+  }
+  long json_size = 0, param_size = 0;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!json || !params) {
+    fprintf(stderr, "cannot read model files\n");
+    return 2;
+  }
+  mx_uint n = (mx_uint)atoi(argv[3]);
+  if ((mx_uint)argc < 4 + n) {
+    fprintf(stderr, "need %u input values\n", n);
+    return 2;
+  }
+  mx_float *input = (mx_float *)malloc(n * sizeof(mx_float));
+  for (mx_uint i = 0; i < n; ++i) input[i] = (mx_float)atof(argv[4 + i]);
+
+  const char *input_keys[1] = {"data"};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint shape[2] = {1, n};
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, input_keys,
+                   indptr, shape, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredSetInput(pred, "data", input, n) != 0 ||
+      MXPredForward(pred) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint osize = 1;
+  for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+  mx_float *out = (mx_float *)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(pred, 0, out, osize) != 0) {
+    fprintf(stderr, "output: %s\n", MXGetLastError());
+    return 1;
+  }
+  for (mx_uint i = 0; i < osize; ++i) {
+    printf(i + 1 == osize ? "%.6f\n" : "%.6f ", (double)out[i]);
+  }
+  /* reshape to batch 2 and run again to exercise MXPredReshape */
+  mx_uint shape2[2] = {2, n};
+  PredictorHandle pred2 = NULL;
+  if (MXPredReshape(1, input_keys, indptr, shape2, pred, &pred2) != 0) {
+    fprintf(stderr, "reshape: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_float *input2 = (mx_float *)malloc(2 * n * sizeof(mx_float));
+  memcpy(input2, input, n * sizeof(mx_float));
+  memcpy(input2 + n, input, n * sizeof(mx_float));
+  if (MXPredSetInput(pred2, "data", input2, 2 * n) != 0 ||
+      MXPredForward(pred2) != 0) {
+    fprintf(stderr, "forward2: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredGetOutputShape(pred2, 0, &oshape, &ondim) != 0) return 1;
+  osize = 1;
+  for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+  mx_float *out2 = (mx_float *)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(pred2, 0, out2, osize) != 0) return 1;
+  for (mx_uint i = 0; i < osize; ++i) {
+    printf(i + 1 == osize ? "%.6f\n" : "%.6f ", (double)out2[i]);
+  }
+  MXPredFree(pred2);
+  MXPredFree(pred);
+  free(json);
+  free(params);
+  free(input);
+  free(input2);
+  free(out);
+  free(out2);
+  return 0;
+}
