@@ -103,8 +103,52 @@ def get_lib():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_int32)]
         lib.mxtpu_free.argtypes = [ctypes.c_void_p]
+        try:
+            # absent when the library was built without libjpeg dev
+            # files (the Makefile drops jpeg.cc); decode falls back to PIL
+            lib.mxtpu_jpeg_dims.restype = ctypes.c_int
+            lib.mxtpu_jpeg_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.mxtpu_jpeg_decode.restype = ctypes.c_int
+            lib.mxtpu_jpeg_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib._has_jpeg = True
+        except AttributeError:
+            lib._has_jpeg = False
         _lib = lib
         return _lib
+
+
+def native_jpeg_decode(buf, gray=False):
+    """Decode a JPEG byte buffer to an HWC uint8 numpy array with the
+    native libjpeg path (GIL released for the whole decode), or None
+    when the native library is unavailable or the data is not a JPEG
+    this decoder handles (caller falls back to PIL)."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_jpeg", False):
+        return None
+    buf = bytes(buf)
+    if len(buf) < 2 or buf[0] != 0xFF or buf[1] != 0xD8:
+        return None  # not JPEG
+    import numpy as np
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.mxtpu_jpeg_dims(buf, len(buf), int(gray), ctypes.byref(w),
+                           ctypes.byref(h), ctypes.byref(c)) != 0:
+        return None
+    out = np.empty((h.value, w.value, c.value), np.uint8)
+    rc = lib.mxtpu_jpeg_decode(
+        buf, len(buf), int(gray), out.ctypes.data_as(ctypes.c_void_p),
+        out.nbytes, ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
+    if rc != 0:
+        return None
+    return out
 
 
 class NativeRecordReader:

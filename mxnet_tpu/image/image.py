@@ -73,12 +73,18 @@ def _wrap(out, like):
 # ----------------------------------------------------------------------
 def imdecode(buf, flag=1, to_rgb=True, out=None):
     """Decode an encoded image buffer to an HWC uint8 NDArray (reference
-    image.py:86; PIL backend, output is RGB regardless of to_rgb — the
-    reference flag exists to flip cv2's BGR, which PIL never produces)."""
-    _require_pil()
-    img = Image.open(_pyio.BytesIO(bytes(buf)))
-    img = img.convert("RGB" if flag else "L")
-    arr = np.asarray(img)
+    image.py:86). JPEG content takes the native libjpeg path
+    (src/jpeg.cc — GIL-free, the decode-thread hot path, mirroring the
+    reference's C++ OpenCV decode in iter_image_recordio_2.cc:480);
+    everything else goes through PIL. Output is RGB regardless of
+    to_rgb — the reference flag exists to flip cv2's BGR."""
+    from .._native import native_jpeg_decode
+    arr = native_jpeg_decode(buf, gray=not flag)
+    if arr is None:
+        _require_pil()
+        img = Image.open(_pyio.BytesIO(bytes(buf)))
+        img = img.convert("RGB" if flag else "L")
+        arr = np.asarray(img)
     if arr.ndim == 2:
         arr = arr[:, :, None]
     nd = NDArray(arr)

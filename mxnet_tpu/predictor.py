@@ -161,3 +161,31 @@ def _c_api_set_partial_outputs(pred, keys):
             raise MXNetError("unknown output %r (have %s)" % (key, names))
     pred._c_api_partial_outputs = list(keys)
     return True
+
+
+def _c_api_output_shapes(pred):
+    """Bind-time output shapes (list of tuples), honoring a partial-out
+    selection — the reference serves shapes right after MXPredCreate."""
+    shapes = {n: pred._exe.arg_dict[n].shape for n in pred._input_names}
+    out_shapes = pred._symbol.infer_shape(**shapes)[1]
+    names = pred.output_names
+    wanted = getattr(pred, "_c_api_partial_outputs", None)
+    if wanted:
+        index = {n: i for i, n in enumerate(names)}
+        picked = []
+        for key in wanted:
+            i = index.get(key, index.get(key + "_output"))
+            picked.append(out_shapes[i])
+        out_shapes = picked
+    return [tuple(int(d) for d in s) for s in out_shapes]
+
+
+def _c_api_input_size(pred, name):
+    """Element count of a bind-time input, or -1 if unknown."""
+    arr = pred._exe.arg_dict.get(name)
+    if arr is None:
+        return -1
+    n = 1
+    for d in arr.shape:
+        n *= int(d)
+    return n

@@ -314,6 +314,27 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
     return -1;
   }
   GIL gil;
+  // reject size mismatches HERE (the reference fails at SetInput, not
+  // with a reshape error at Forward)
+  PyObject *sizer = ImportAttr("mxnet_tpu.predictor", "_c_api_input_size");
+  if (sizer != nullptr) {
+    PyObject *want = PyObject_CallFunction(sizer, "Os", h->obj, key);
+    Py_DECREF(sizer);
+    if (want != nullptr) {
+      long expected = PyLong_AsLong(want);
+      Py_DECREF(want);
+      if (expected >= 0 && expected != static_cast<long>(size)) {
+        SetError(std::string("MXPredSetInput: input '") + key + "' has " +
+                 std::to_string(expected) + " elements at bind time, got " +
+                 std::to_string(size));
+        return -1;
+      }
+    } else {
+      PyErr_Clear();
+    }
+  } else {
+    PyErr_Clear();
+  }
   PyObject *arr = FloatArrayFromBuffer(data, size);
   if (arr == nullptr) {
     SetPyError("MXPredSetInput failed");
@@ -364,16 +385,29 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim) {
   auto *h = static_cast<Predictor *>(handle);
   GIL gil;
-  if (h->outputs == nullptr ||
-      index >= static_cast<mx_uint>(PyList_Size(h->outputs))) {
-    SetError("MXPredGetOutputShape: no such output (run MXPredForward "
-             "first)");
-    return -1;
+  PyObject *shape = nullptr;
+  if (h->outputs != nullptr &&
+      index < static_cast<mx_uint>(PyList_Size(h->outputs))) {
+    PyObject *arr = PyList_GetItem(h->outputs, index);  // borrowed
+    shape = PyObject_GetAttrString(arr, "shape");
+  } else {
+    // pre-forward: serve the BIND-TIME shape like the reference, which
+    // computes out_shapes during MXPredCreate
+    PyObject *helper = ImportAttr("mxnet_tpu.predictor",
+                                  "_c_api_output_shapes");
+    if (helper != nullptr) {
+      PyObject *shapes = PyObject_CallFunction(helper, "O", h->obj);
+      Py_DECREF(helper);
+      if (shapes != nullptr) {
+        if (index < static_cast<mx_uint>(PyList_Size(shapes))) {
+          shape = PySequence_GetItem(shapes, index);
+        }
+        Py_DECREF(shapes);
+      }
+    }
   }
-  PyObject *arr = PyList_GetItem(h->outputs, index);  // borrowed
-  PyObject *shape = PyObject_GetAttrString(arr, "shape");
   if (shape == nullptr) {
-    SetPyError("MXPredGetOutputShape failed");
+    SetPyError("MXPredGetOutputShape: no such output");
     return -1;
   }
   Py_ssize_t ndim = PyTuple_Size(shape);

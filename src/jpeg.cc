@@ -1,0 +1,102 @@
+// Native JPEG decode — the C++ hot path of ImageRecordIter.
+//
+// Reference parity: the reference decodes JPEG in C++ (OpenCV imdecode
+// inside OMP-parallel ParseChunk, src/io/iter_image_recordio_2.cc:480).
+// Here libjpeg decodes straight into a caller-provided numpy buffer;
+// ctypes releases the GIL for the whole call, so ImageRecordIter's
+// decode threads run truly in parallel (PIL only drops the GIL in
+// parts of its path). Python falls back to PIL for non-JPEG content
+// or when the library is unavailable.
+#include <cstddef>
+#include <cstdio>   // jpeglib.h needs size_t/FILE declared first
+
+#include <jpeglib.h>
+
+#include <csetjmp>
+#include <cstring>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrMgr *err = reinterpret_cast<ErrMgr *>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+void emit_nothing(j_common_ptr, int) {}
+
+}  // namespace
+
+extern "C" {
+
+// Parse the header only: fills w/h/channels-after-conversion.
+// Returns 0 on success, -1 on malformed data.
+int mxtpu_jpeg_dims(const unsigned char *buf, long len, int gray, int *w,
+                    int *h, int *c) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  jerr.pub.emit_message = emit_nothing;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_calc_output_dimensions(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  *c = cinfo.out_color_components;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode into out (capacity bytes). gray=1 converts to single channel.
+// Returns 0 ok, -1 malformed, -2 buffer too small.
+int mxtpu_jpeg_decode(const unsigned char *buf, long len, int gray,
+                      unsigned char *out, long capacity, int *w, int *h,
+                      int *c) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  jerr.pub.emit_message = emit_nothing;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const long width = cinfo.output_width;
+  const long height = cinfo.output_height;
+  const long comps = cinfo.output_components;
+  *w = static_cast<int>(width);
+  *h = static_cast<int>(height);
+  *c = static_cast<int>(comps);
+  if (width * height * comps > capacity) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  const long stride = width * comps;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char *row = out + static_cast<long>(cinfo.output_scanline)
+        * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

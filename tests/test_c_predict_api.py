@@ -164,3 +164,29 @@ def test_c_ndlist(checkpoint, tmp_path):
     got = [data[i] for i in range(6)]
     assert got == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
     lib.MXNDListFree(handle)
+
+
+def test_c_predict_shape_before_forward(checkpoint):
+    import ctypes
+    prefix, net = checkpoint
+    _build_lib()
+    lib = _load_capi()
+    json = open(prefix + "-symbol.json", "rb").read()
+    params = open(prefix + "-0000.params", "rb").read()
+    keys, indptr, shape = _shape_args(4)
+    handle = ctypes.c_void_p()
+    assert lib.MXPredCreate(ctypes.c_char_p(json), params, len(params), 1,
+                            0, 1, keys, indptr, shape,
+                            ctypes.byref(handle)) == 0
+    # reference behavior: output shape available right after create
+    shp = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shp),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    assert ndim.value == 2 and shp[0] == 1 and shp[1] == 3
+    # size mismatch rejected AT SetInput
+    buf8 = (ctypes.c_float * 8)()
+    rc = lib.MXPredSetInput(handle, b"data", buf8, 8)
+    assert rc != 0 and b"elements" in lib.MXGetLastError()
+    lib.MXPredFree(handle)

@@ -86,3 +86,51 @@ def test_image_record_iter_uses_native(tmp_path):
     n = sum(b.data[0].shape[0] - (b.pad or 0) for b in it)
     assert n == 12
     it.close()
+
+
+def test_native_jpeg_decode_matches_pil():
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu._native import native_jpeg_decode
+    rng = np.random.RandomState(0)
+    img = (rng.rand(40, 56, 3) * 255).astype(np.uint8)
+    b = _io.BytesIO()
+    Image.fromarray(img).save(b, "JPEG", quality=95)
+    raw = b.getvalue()
+    nat = native_jpeg_decode(raw)
+    if nat is None:
+        pytest.skip("native io unavailable")
+    pil = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+    assert nat.shape == pil.shape
+    # same libjpeg under both: bit-identical (allow tiny IDCT slack)
+    assert np.abs(nat.astype(int) - pil.astype(int)).max() <= 2
+    gray = native_jpeg_decode(raw, gray=True)
+    assert gray.shape == (40, 56, 1)
+
+
+def test_native_jpeg_rejects_non_jpeg_and_garbage():
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu._native import native_jpeg_decode
+    img = np.zeros((8, 8, 3), np.uint8)
+    png = _io.BytesIO()
+    Image.fromarray(img).save(png, "PNG")
+    assert native_jpeg_decode(png.getvalue()) is None
+    assert native_jpeg_decode(b"\xff\xd8garbage") is None
+    assert native_jpeg_decode(b"") is None
+
+
+def test_imdecode_uses_native_path_consistently():
+    import io as _io
+    from PIL import Image
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(1)
+    img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+    b = _io.BytesIO()
+    Image.fromarray(img).save(b, "JPEG", quality=90)
+    raw = b.getvalue()
+    out = mx.image.imdecode(raw).asnumpy()
+    pil = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+    assert np.abs(out.astype(int) - pil.astype(int)).max() <= 2
+    g = mx.image.imdecode(raw, flag=0).asnumpy()
+    assert g.shape == (32, 32, 1)
