@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples (reference
+example/adversary/adversary_generation.ipynb): train a classifier, then
+perturb inputs along sign(dL/dx) and measure the accuracy drop.
+Gradients w.r.t. INPUTS come from autograd with mark_variables — the
+same mechanism the reference notebook uses.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def synthetic_digits(n=1200, seed=5):
+    rng = np.random.RandomState(seed)
+    protos = (rng.rand(10, 16, 16) > 0.6).astype(np.float32)
+    X = np.zeros((n, 256), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = rng.randint(10)
+        X[i] = np.clip(protos[c] + rng.randn(16, 16) * 0.1, 0,
+                       1).reshape(-1)
+        y[i] = c
+    return X, y
+
+
+def accuracy(net, X, y):
+    pred = net(nd.array(X)).asnumpy().argmax(axis=1)
+    return float((pred == y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--epsilon", type=float, default=0.3)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    X, y = synthetic_digits()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.hybridize()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, y),
+                                   batch_size=64, shuffle=True)
+    for epoch in range(args.epochs):
+        for data, label in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+
+    clean_acc = accuracy(net, X, y)
+    print("clean accuracy: %.3f" % clean_acc)
+
+    # FGSM: gradient of the loss w.r.t. the INPUT
+    x_nd = nd.array(X)
+    x_grad = nd.zeros(x_nd.shape)
+    autograd.mark_variables([x_nd], [x_grad])
+    with autograd.record():
+        loss = loss_fn(net(x_nd), nd.array(y))
+    loss.backward()
+    x_adv = np.clip(X + args.epsilon * np.sign(x_grad.asnumpy()), 0, 1)
+    adv_acc = accuracy(net, x_adv, y)
+    print("FGSM (eps=%.2f) accuracy: %.3f" % (args.epsilon, adv_acc))
+    assert adv_acc < clean_acc
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    main()

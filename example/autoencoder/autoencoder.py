@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""MLP autoencoder (reference example/autoencoder/: stacked autoencoder
+on MNIST). Offline-friendly: trains on synthetic digit prototypes and
+reports reconstruction MSE against the predict-the-mean baseline (the
+input variance) — the 16-dim bottleneck must beat it by a wide margin.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, HybridBlock
+
+
+def synthetic_digits(n=1500, seed=3):
+    rng = np.random.RandomState(seed)
+    protos = (rng.rand(10, 16, 16) > 0.6).astype(np.float32)
+    X = np.zeros((n, 256), np.float32)
+    y = np.zeros((n,), np.int64)
+    for i in range(n):
+        c = rng.randint(10)
+        img = np.roll(np.roll(protos[c], rng.randint(-1, 2), 0),
+                      rng.randint(-1, 2), 1)
+        X[i] = np.clip(img + rng.randn(16, 16) * 0.15, 0, 1).reshape(-1)
+        y[i] = c
+    return X, y
+
+
+class AutoEncoder(HybridBlock):
+    def __init__(self, dims=(256, 128, 64, 16)):
+        super().__init__()
+        self.encoder = nn.HybridSequential()
+        for d in dims[1:-1]:
+            self.encoder.add(nn.Dense(d, activation="relu"))
+        self.encoder.add(nn.Dense(dims[-1]))
+        self.decoder = nn.HybridSequential()
+        for d in reversed(dims[1:-1]):
+            self.decoder.add(nn.Dense(d, activation="relu"))
+        self.decoder.add(nn.Dense(dims[0], activation="sigmoid"))
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.encoder(x))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    X, y = synthetic_digits()
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(X, X), batch_size=args.batch_size,
+        shuffle=True)
+    net = AutoEncoder()
+    net.hybridize()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+
+    for epoch in range(args.epochs):
+        total = 0.0
+        nb = 0
+        for data, target in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), target)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += float(loss.asnumpy().mean())
+            nb += 1
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print("epoch %d: reconstruction loss %.5f"
+                  % (epoch, total / nb))
+
+    recon = net(nd.array(X)).asnumpy()
+    mse = float(((recon - X) ** 2).mean())
+    baseline = float(X.var())  # predicting the mean image
+    print("final mse %.5f vs mean-baseline %.5f (%.1fx better)"
+          % (mse, baseline, baseline / mse))
+    assert mse < baseline * 0.5
+    return mse
+
+
+if __name__ == "__main__":
+    main()

@@ -124,11 +124,18 @@ def get_lib():
         return _lib
 
 
+_jpeg_scratch = threading.local()
+
+
 def native_jpeg_decode(buf, gray=False):
     """Decode a JPEG byte buffer to an HWC uint8 numpy array with the
     native libjpeg path (GIL released for the whole decode), or None
     when the native library is unavailable or the data is not a JPEG
-    this decoder handles (caller falls back to PIL)."""
+    this decoder handles (caller falls back to PIL).
+
+    One native call per image: decodes into a per-thread scratch buffer
+    (the decode op reports the needed dims via rc=-2 when the scratch is
+    too small, so the header is parsed once per image, not twice)."""
     lib = get_lib()
     if lib is None or not getattr(lib, "_has_jpeg", False):
         return None
@@ -139,16 +146,24 @@ def native_jpeg_decode(buf, gray=False):
     w = ctypes.c_int()
     h = ctypes.c_int()
     c = ctypes.c_int()
-    if lib.mxtpu_jpeg_dims(buf, len(buf), int(gray), ctypes.byref(w),
-                           ctypes.byref(h), ctypes.byref(c)) != 0:
-        return None
-    out = np.empty((h.value, w.value, c.value), np.uint8)
+    scratch = getattr(_jpeg_scratch, "buf", None)
+    if scratch is None:
+        scratch = np.empty(1 << 20, np.uint8)
+        _jpeg_scratch.buf = scratch
     rc = lib.mxtpu_jpeg_decode(
-        buf, len(buf), int(gray), out.ctypes.data_as(ctypes.c_void_p),
-        out.nbytes, ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
+        buf, len(buf), int(gray), scratch.ctypes.data_as(ctypes.c_void_p),
+        scratch.nbytes, ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
+    if rc == -2:  # scratch too small; dims are filled — grow and retry
+        scratch = np.empty(h.value * w.value * c.value, np.uint8)
+        _jpeg_scratch.buf = scratch
+        rc = lib.mxtpu_jpeg_decode(
+            buf, len(buf), int(gray),
+            scratch.ctypes.data_as(ctypes.c_void_p), scratch.nbytes,
+            ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
     if rc != 0:
         return None
-    return out
+    n = h.value * w.value * c.value
+    return scratch[:n].reshape(h.value, w.value, c.value).copy()
 
 
 class NativeRecordReader:

@@ -87,8 +87,17 @@ def _accelerators():
 def _resolve_device(ctx: Context) -> jax.Device:
     if ctx.device_type == "cpu" or ctx.device_type == "cpu_pinned":
         cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
-        if not cpus:  # running with a TPU-only backend: fall back to default
-            cpus = jax.local_devices()
+        if not cpus:
+            # accelerator-platform processes still carry a host backend;
+            # mx.cpu() arrays MUST live there — a fallback to the
+            # accelerator would silently turn every data-iterator batch
+            # into device traffic. local_devices(backend=...) keeps this
+            # process's own cpu device in a jax.distributed world
+            # (jax.devices("cpu") would return rank 0's).
+            try:
+                cpus = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                cpus = jax.local_devices()  # truly no host backend
         return cpus[min(ctx.device_id, len(cpus) - 1)]
     devs = _accelerators()
     if ctx.device_id >= len(devs):

@@ -256,13 +256,26 @@ class Executor:
         return _np.uint32((int(self._base_seed) + self._step * 2654435761)
                           & 0x7FFFFFFF)
 
+    def _to_ctx(self, data):
+        """Colocate an input with the executor's device — data-iterator
+        batches live on the cpu context (reference iterator contract) and
+        must move to the bind device exactly once here."""
+        dev = self._ctx.jax_device
+        try:
+            if data.devices() == {dev}:
+                return data
+        except AttributeError:
+            pass
+        import jax as _jax
+        return _jax.device_put(data, dev)
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("forward: unknown argument '%s'" % k)
             dst = self.arg_dict[k]
             if isinstance(v, NDArray):
-                dst._set_data(v._data)
+                dst._set_data(self._to_ctx(v._data))
             else:
                 dst._sync_copyfrom(v)
         if is_train:

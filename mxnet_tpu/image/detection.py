@@ -245,7 +245,7 @@ class ImageDetIter(_img.ImageIter):
         try:
             while i < self.batch_size:
                 lab, raw = self.next_sample()
-                img = _img._to_np(_img.imdecode(raw))
+                img = _img._imdecode_np(raw)
                 objs = _parse_det_label(lab)
                 # geometric (box-aware) augs on uint8, then resize, then
                 # pixel-only augs (they may produce float, which the
@@ -272,7 +272,11 @@ class ImageDetIter(_img.ImageIter):
                 raise
             if self.last_batch_handle == "discard":
                 raise
-        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+        from ..context import cpu
+        # host-resident batches (reference iterator contract;
+        # consumers move them to the bind device exactly once)
+        return DataBatch(data=[nd.array(data, ctx=cpu())],
+                         label=[nd.array(label, ctx=cpu())],
                          pad=self.batch_size - i,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

@@ -71,13 +71,13 @@ def _wrap(out, like):
 # ----------------------------------------------------------------------
 # decode / resize / crop primitives
 # ----------------------------------------------------------------------
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an encoded image buffer to an HWC uint8 NDArray (reference
-    image.py:86). JPEG content takes the native libjpeg path
-    (src/jpeg.cc — GIL-free, the decode-thread hot path, mirroring the
-    reference's C++ OpenCV decode in iter_image_recordio_2.cc:480);
-    everything else goes through PIL. Output is RGB regardless of
-    to_rgb — the reference flag exists to flip cv2's BGR."""
+def _imdecode_np(buf, flag=1):
+    """Decode to an HWC uint8 NUMPY array — the decode-thread hot path
+    (ImageRecordIter). JPEG content takes the native libjpeg path
+    (src/jpeg.cc — GIL-free, mirroring the reference's C++ OpenCV decode
+    in iter_image_recordio_2.cc:480); everything else goes through PIL.
+    Staying in numpy here matters: wrapping per-image results in
+    NDArrays would bounce every image through the accelerator."""
     from .._native import native_jpeg_decode
     arr = native_jpeg_decode(buf, gray=not flag)
     if arr is None:
@@ -87,7 +87,14 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         arr = np.asarray(img)
     if arr.ndim == 2:
         arr = arr[:, :, None]
-    nd = NDArray(arr)
+    return arr
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image buffer to an HWC uint8 NDArray (reference
+    image.py:86). Output is RGB regardless of to_rgb — the reference
+    flag exists to flip cv2's BGR, which neither backend produces."""
+    nd = NDArray(_imdecode_np(buf, flag))
     if out is not None:
         out._set_data(nd._data)
         return out
@@ -609,7 +616,7 @@ class ImageIter:
         return header.label, img
 
     def _aug(self, raw):
-        img = _to_np(imdecode(raw, flag=1 if self.data_shape[0] == 3 else 0))
+        img = _imdecode_np(raw, flag=1 if self.data_shape[0] == 3 else 0)
         for aug in self.auglist:
             img = aug._apply_np(img)
         c, h, w = self.data_shape
@@ -640,7 +647,11 @@ class ImageIter:
             if self.last_batch_handle == "discard":
                 raise
         pad = self.batch_size - i
-        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+        from ..context import cpu
+        # host-resident batches (reference iterator contract;
+        # consumers move them to the bind device exactly once)
+        return DataBatch(data=[nd.array(data, ctx=cpu())],
+                         label=[nd.array(label, ctx=cpu())],
                          pad=pad)
 
     def __next__(self):

@@ -187,7 +187,9 @@ class ImageRecordIter:
         from ..recordio import unpack
         header, raw = unpack(self._read_at(offset))
         c, h, w = self.data_shape
-        img = _img._to_np(_img.imdecode(raw, flag=1 if c == 3 else 0))
+        # numpy end to end: no NDArray (= accelerator) round trips per
+        # image inside the decode pool
+        img = _img._imdecode_np(raw, flag=1 if c == 3 else 0)
 
         if self._resize > 0:
             img = _img._to_np(_img.resize_short(img, self._resize,
@@ -299,13 +301,19 @@ class ImageRecordIter:
     def next(self):
         from ..io import DataBatch
         from .. import ndarray as nd
+        from ..context import cpu
         item = self._queue.get()
         if item[0] == "end":
             raise StopIteration
         if item[0] == "error":
             raise item[1]
         _, data, label, pad = item
-        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+        # batches live on the HOST (cpu context), like the reference's
+        # iterators: the training step moves them to the accelerator
+        # exactly once — yielding device arrays here would force an
+        # upload+download round trip on any consumer that reads them
+        return DataBatch(data=[nd.array(data, ctx=cpu())],
+                         label=[nd.array(label, ctx=cpu())],
                          pad=pad, provide_data=self.provide_data,
                          provide_label=self.provide_label)
 

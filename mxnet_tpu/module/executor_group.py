@@ -109,6 +109,10 @@ class DataParallelExecutorGroup:
             nd_array(_np.asarray(value))._data
         if self._mesh is not None:
             data = jax.device_put(data, self._batch_sharding())
+        else:
+            # iterator batches live on the cpu context (reference
+            # contract); move them to the bind device exactly once here
+            data = self._exec._to_ctx(data)
         dst = self._exec.arg_dict[name]
         if data.shape != dst.shape:
             raise MXNetError("input '%s' shape %s != bound shape %s (use "

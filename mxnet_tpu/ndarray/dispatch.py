@@ -80,6 +80,13 @@ def invoke(opdef, args, kwargs, out=None, name=None):
         if isinstance(x, NDArray):
             if ctx is None:
                 ctx = x._ctx
+            elif x._ctx != ctx:
+                # reference semantics: eager ops require one context
+                # (imperative_utils.h CheckAndInferDevice)
+                raise MXNetError(
+                    "%s: all operands must live on one context, got %s "
+                    "and %s — move with copyto()/as_in_context()"
+                    % (opdef.name, ctx, x._ctx))
             arrs.append(x._data)
         elif x is None:
             arrs.append(None)

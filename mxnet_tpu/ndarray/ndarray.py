@@ -416,7 +416,10 @@ def array(source_array, ctx=None, dtype=None):
         arr = arr.astype(_np.float32)
     if dtype is None and arr.dtype == _np.int64:
         arr = arr.astype(_np.int32)
-    return NDArray(jax.device_put(jnp.asarray(arr), ctx.jax_device), ctx)
+    # device_put the numpy buffer DIRECTLY: jnp.asarray first would
+    # commit it to the default device (the accelerator) before copying
+    # to ctx — a full round trip for every cpu-context array
+    return NDArray(jax.device_put(arr, ctx.jax_device), ctx)
 
 
 def empty(shape, ctx=None, dtype="float32"):

@@ -314,8 +314,11 @@ class TrainStep:
               if optimizer.lr_scheduler is not None else optimizer.lr)
         from .. import optimizer as _opt
         if isinstance(optimizer, _opt.Adam):
-            # Adam bias correction is folded into lr host-side, matching the
-            # eager Adam.update (optimizer.py) without a recompile.
+            # Adam bias correction folded into lr host-side, one global t:
+            # in the fused whole-graph step EVERY parameter updates EVERY
+            # step, so the single counter equals the reference's per-index
+            # update counts exactly (indexes can only diverge in the eager
+            # per-key path, where optimizer.py keeps per-index counts).
             t = self._nstep
             lr *= ((1.0 - optimizer.beta2 ** t) ** 0.5
                    / (1.0 - optimizer.beta1 ** t))
@@ -323,12 +326,20 @@ class TrainStep:
         # on a symbol with no explicit Cast) before placing on device
         def _place(n, v):
             dt = self._arg_types.get(n)
+            # fast path only for UNcommitted arrays (already free to live
+            # on the default device); a cpu-committed iterator batch must
+            # be re-placed or the jit sees mixed devices
             if isinstance(v, jax.Array) and (dt is None or v.dtype == dt) \
-                    and self._mesh is None:
+                    and self._mesh is None and not getattr(v, "committed",
+                                                           True):
                 return v
             v = jnp.asarray(v, dt)
-            return (jax.device_put(v, self._batch_sharding())
-                    if self._mesh is not None else v)
+            if self._mesh is not None:
+                return jax.device_put(v, self._batch_sharding())
+            if getattr(v, "committed", False):
+                # cpu-context iterator batch: move to the step's device
+                v = jax.device_put(v, jax.devices()[0])
+            return v
 
         batch = {n: _place(n, v) for n, v in batch.items()}
         seed = _np.uint32((self._base_seed + self._nstep * 2654435761)

@@ -314,3 +314,27 @@ def test_image_det_iter(tmp_path):
     if len(out_lab):
         assert (out_lab[:, 1:] >= -1e-6).all() \
             and (out_lab[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_record_iter_batches_on_cpu_context(tmp_path):
+    # reference iterator contract: batches live on the HOST (cpu
+    # context); the executor moves them to the bind device exactly once.
+    # On an accelerator platform, yielding device arrays would force a
+    # device round trip on any consumer that reads them.
+    rec_path, idx_path = _make_rec(tmp_path, n=8, size=12)
+    it = mx.io.ImageRecordIter(path_imgrec=str(rec_path),
+                               path_imgidx=str(idx_path),
+                               data_shape=(3, 12, 12), batch_size=4)
+    batch = next(it)
+    assert batch.data[0].context.device_type == "cpu"
+    assert batch.label[0].context.device_type == "cpu"
+    # and cpu-context arrays actually live on a cpu jax device
+    assert all(d.platform == "cpu" for d in batch.data[0]._data.devices())
+
+
+def test_cpu_context_maps_to_cpu_backend():
+    import jax
+    dev = mx.cpu().jax_device
+    assert dev.platform == "cpu"
+    a = mx.nd.array(np.ones((4,), np.float32), ctx=mx.cpu())
+    assert all(d.platform == "cpu" for d in a._data.devices())

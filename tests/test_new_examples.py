@@ -38,8 +38,24 @@ def test_gluon_mnist_example():
 
 
 def test_gluon_mnist_example_eager():
-    out = _run_example("example/gluon/mnist.py", "--epochs", "3",
+    out = _run_example("example/gluon/mnist.py", "--epochs", "5",
                        "--no-hybridize")
     accs = [float(l.split("val acc")[1])
             for l in out.splitlines() if "val acc" in l]
     assert accs[-1] > 0.85, accs
+
+
+def test_autoencoder_example():
+    out = _run_example("example/autoencoder/autoencoder.py",
+                       "--epochs", "8")
+    assert "x better" in out
+    mse = float(out.split("final mse")[1].split()[0])
+    baseline = float(out.split("mean-baseline")[1].split()[0])
+    assert mse < baseline * 0.5
+
+
+def test_fgsm_example():
+    out = _run_example("example/adversary/fgsm.py")
+    clean = float(out.split("clean accuracy:")[1].splitlines()[0])
+    adv = float(out.split("accuracy:")[-1])
+    assert clean > 0.95 and adv < clean
