@@ -131,9 +131,16 @@ def num_tpus():
     return num_gpus()
 
 
+# process-wide fallback installed by test_utils.set_default_context;
+# the `with ctx:` stack always takes precedence
+_default_override = None
+
+
 def current_context() -> Context:
     if getattr(Context._default_ctx, "contexts", None):
         return Context._default_ctx.contexts[-1]
+    if _default_override is not None:
+        return _default_override
     return default_context()
 
 

@@ -183,7 +183,8 @@ class ImageRecordIter:
                 return b"".join(parts)
 
     def _process(self, offset):
-        """record → (CHW float32 image, label vector); runs in the pool."""
+        """record → (HWC float32 image, label vector); runs in the pool.
+        Batch-level normalize + CHW layout happen in _produce."""
         from ..recordio import unpack
         header, raw = unpack(self._read_at(offset))
         c, h, w = self.data_shape
@@ -237,17 +238,10 @@ class ImageRecordIter:
             alpha = np.random.normal(0, self._pca_noise, 3).astype(np.float32)
             img = img + eigvec @ (alpha * eigval)
 
-        if self._mean is not None:
-            img = img - (self._mean if self._mean.ndim > 1 else
-                         self._mean.reshape(1, 1, -1))
-        if self._std is not None:
-            img = img / self._std.reshape(1, 1, -1)
-        if self._out_scale != 1.0:
-            img = img * self._out_scale
-
-        chw = np.ascontiguousarray(img.transpose(2, 0, 1))
+        # mean/std/scale + HWC->CHW happen ON THE BATCH in _produce —
+        # one big vectorized numpy op instead of per-image passes
         label = np.atleast_1d(np.asarray(header.label, np.float32))
-        return chw, label[:self.label_width]
+        return img, label[:self.label_width]
 
     # ------------------------------------------------------------------
     def _produce(self, order, out_q, stop):
@@ -265,15 +259,26 @@ class ImageRecordIter:
                 futs = [self._pool.submit(self._process, self._offsets[i])
                         for i in idxs]
                 c, h, w = self.data_shape
-                data = np.empty((bs, c, h, w), self.dtype)
+                hwc = np.empty((bs, h, w, c), np.float32)
                 if self.label_width == 1:
                     label = np.empty((bs,), self.dtype)
                 else:
                     label = np.empty((bs, self.label_width), self.dtype)
                 for j, f in enumerate(futs):
                     img, lab = f.result()
-                    data[j] = img
+                    hwc[j] = img
                     label[j] = lab if self.label_width > 1 else lab[0]
+                # batch-level normalize + layout: one vectorized pass
+                if self._mean is not None:
+                    hwc -= (self._mean if self._mean.ndim > 1 else
+                            self._mean.reshape(1, 1, 1, -1))
+                if self._std is not None:
+                    hwc /= self._std.reshape(1, 1, 1, -1)
+                if self._out_scale != 1.0:
+                    hwc *= self._out_scale
+                data = np.ascontiguousarray(
+                    hwc.transpose(0, 3, 1, 2)).astype(self.dtype,
+                                                      copy=False)
                 out_q.put(("batch", data, label, pad))
             out_q.put(("end",))
         except BaseException as e:  # surface worker errors at next()

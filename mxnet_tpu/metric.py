@@ -8,7 +8,7 @@ import numpy as _np
 from .base import MXNetError
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
            "Caffe", "CustomMetric", "np", "create", "check_label_shapes"]
 
@@ -186,6 +186,24 @@ class TopKAccuracy(EvalMetric):
             self.num_inst += len(label.flatten())
 
 
+def _binary_counts(label, pred, check_binary=False, metric_name=""):
+    """(tp, fp, fn, tn) for one (label, pred) pair — the shared
+    sufficient statistics of F1/MCC (ref metric.py
+    _BinaryClassificationMetrics.update_binary_stats)."""
+    label = _asnp(label).flatten().astype("int32")
+    pred = _asnp(pred)
+    if pred.ndim > 1 and pred.shape[-1] > 1:
+        pred = pred.argmax(axis=-1)
+    pred = pred.flatten().astype("int32")
+    if check_binary and _np.unique(label).size > 2:
+        raise ValueError("%s currently only supports binary "
+                         "classification." % metric_name)
+    return (((pred == 1) & (label == 1)).sum(),
+            ((pred == 1) & (label == 0)).sum(),
+            ((pred == 0) & (label == 1)).sum(),
+            ((pred == 0) & (label == 0)).sum())
+
+
 @register
 class F1(EvalMetric):
     def __init__(self, name="f1", output_names=None, label_names=None,
@@ -201,19 +219,59 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
-            label = _asnp(label).flatten().astype("int32")
-            pred = _asnp(pred)
-            if pred.ndim > 1 and pred.shape[-1] > 1:
-                pred = pred.argmax(axis=-1)
-            pred = pred.flatten().astype("int32")
-            self._tp += ((pred == 1) & (label == 1)).sum()
-            self._fp += ((pred == 1) & (label == 0)).sum()
-            self._fn += ((pred == 0) & (label == 1)).sum()
+            tp, fp, fn, _ = _binary_counts(label, pred)
+            self._tp += tp
+            self._fp += fp
+            self._fn += fn
             precision = self._tp / max(self._tp + self._fp, 1e-12)
             recall = self._tp / max(self._tp + self._fn, 1e-12)
             f1 = 2 * precision * recall / max(precision + recall, 1e-12)
             self.sum_metric = f1
             self.num_inst = 1
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient for binary classification
+    (ref metric.py MCC over _BinaryClassificationMetrics: tp/fp/tn/fn
+    accumulated across batches; 'micro' averages over all samples,
+    'macro' re-reports per batch)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+        self._tp = self._fp = self._tn = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._tn = self._fn = 0.0
+
+    def _mcc(self):
+        terms = ((self._tp + self._fp) * (self._tp + self._fn)
+                 * (self._tn + self._fp) * (self._tn + self._fn))
+        denom = terms ** 0.5 if terms > 0 else 1.0
+        return (self._tp * self._tn - self._fp * self._fn) / denom
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            tp, fp, fn, tn = _binary_counts(label, pred,
+                                            check_binary=True,
+                                            metric_name="MCC")
+            self._tp += tp
+            self._fp += fp
+            self._fn += fn
+            self._tn += tn
+            if self.average == "macro":
+                # mean of per-batch MCCs (reference macro resets counts)
+                self.sum_metric += self._mcc()
+                self.num_inst += 1
+                self._tp = self._fp = self._tn = self._fn = 0.0
+            else:
+                # micro: one MCC over all samples seen so far
+                self.sum_metric = self._mcc()
+                self.num_inst = 1
 
 
 @register

@@ -14,8 +14,13 @@ from .context import Context, cpu, current_context
 from .ndarray import array as nd_array
 from .base import MXNetError
 
-__all__ = ["default_context", "assert_almost_equal", "rand_ndarray",
-           "rand_shape_nd", "check_numeric_gradient",
+__all__ = ["default_context", "set_default_context", "default_dtype",
+           "get_atol", "get_rtol", "assert_almost_equal", "rand_ndarray",
+           "rand_shape_nd", "rand_shape_2d", "rand_shape_3d",
+           "random_arrays", "random_sample", "np_reduce",
+           "find_max_violation", "almost_equal_ignore_nan",
+           "assert_almost_equal_ignore_nan", "assert_exception", "retry",
+           "list_gpus", "check_numeric_gradient",
            "check_symbolic_forward", "check_symbolic_backward",
            "check_consistency", "almost_equal", "same", "simple_forward"]
 
@@ -188,3 +193,144 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
             assert_almost_equal(ref_grads[n], grads[n], rtol, atol,
                                 names=("grad_%s" % n, "grad_%s'" % n))
     return results
+
+
+def set_default_context(ctx):
+    """Make ``ctx`` the fallback default (ref test_utils.py
+    set_default_context). Does NOT touch the ``with ctx:`` stack —
+    an active with-block still wins, and leaving it must not discard
+    this default."""
+    from . import context as _context
+    _context._default_override = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+def random_arrays(*shapes):
+    """Random float32 numpy arrays (scalars for () shapes); one array or
+    a list (ref test_utils.py random_arrays)."""
+    arrays = [np.array(np.random.randn(), dtype=np.float32) if not s
+              else np.random.randn(*s).astype(np.float32) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def random_sample(population, k):
+    """Sample without replacement, order preserved by shuffle semantics
+    (ref test_utils.py random_sample)."""
+    import random as _random
+    sample = list(population)
+    _random.shuffle(sample)
+    return sample[:k]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduce over (possibly multiple) axes with MXNet's
+    keepdims semantics (ref test_utils.py np_reduce)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """Location + value of the worst |a-b| relative violation
+    (ref test_utils.py find_max_violation)."""
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.unravel_index(np.argmax(violation), violation.shape)
+    return loc, np.max(violation)
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """almost_equal over the non-NaN entries only (ref test_utils.py
+    almost_equal_ignore_nan)."""
+    a = np.copy(a)
+    b = np.copy(b)
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return almost_equal(a, b, get_rtol(rtol), get_atol(atol))
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    a = np.copy(a)
+    b = np.copy(b)
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    assert_almost_equal(a, b, get_rtol(rtol), max(get_atol(atol), 1e-20),
+                        names)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Assert f(*args, **kwargs) raises exception_type (ref
+    test_utils.py assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    # raised OUTSIDE the try: must not be swallowed when the expected
+    # type is AssertionError/Exception itself
+    raise AssertionError("%s did not raise %s"
+                         % (f, exception_type.__name__))
+
+
+def retry(n):
+    """Decorator retrying a flaky (random) test up to n times (ref
+    test_utils.py retry)."""
+    if n <= 0:
+        raise ValueError("n must be positive")
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError as e:
+                    if i == n - 1:
+                        raise e
+        return wrapper
+
+    return decorate
+
+
+def list_gpus():
+    """Indices of visible accelerator devices — TPUs here (ref
+    test_utils.py list_gpus returns CUDA ordinals)."""
+    import jax
+    return list(range(len([d for d in jax.local_devices()
+                           if d.platform != "cpu"])))

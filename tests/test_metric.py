@@ -107,3 +107,50 @@ def test_loss_metric():
     m = mx.metric.Loss()
     m.update(None, [nd.array(np.array([2.0, 4.0], np.float32))])
     np.testing.assert_allclose(m.get()[1], 3.0)
+
+
+def test_mcc_metric():
+    # perfect prediction -> +1, inverted -> -1, macro averages batches
+    lab = nd.array(np.asarray([0.0, 1.0, 0.0, 1.0], np.float32))
+    pred = nd.array(np.asarray([[.9, .1], [.1, .9], [.8, .2], [.2, .8]],
+                               np.float32))
+    anti = nd.array(np.asarray([[.1, .9], [.9, .1], [.2, .8], [.8, .2]],
+                               np.float32))
+    m = mx.metric.MCC(average="micro")
+    m.update([lab], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    m = mx.metric.MCC(average="micro")
+    m.update([lab], [anti])
+    assert abs(m.get()[1] + 1.0) < 1e-6
+    m = mx.metric.MCC(average="macro")
+    m.update([lab], [pred])
+    m.update([lab], [anti])
+    assert abs(m.get()[1]) < 1e-6
+    with pytest.raises(ValueError):
+        m.update([nd.array(np.asarray([0., 1., 2.], np.float32))],
+                 [nd.array(np.asarray([[1., 0, 0]] * 3, np.float32))])
+
+
+def test_test_utils_helpers():
+    from mxnet_tpu import test_utils as tu
+    loc, v = tu.find_max_violation(np.asarray([1.0, 2.0]),
+                                   np.asarray([1.0, 2.1]), rtol=1e-2)
+    assert loc == (1,) and v > 1
+    assert tu.almost_equal_ignore_nan(np.asarray([np.nan, 1.0]),
+                                      np.asarray([np.nan, 1.0]))
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    assert tu.np_reduce(np.ones((2, 3, 4)), (0, 2), True,
+                        np.sum).shape == (1, 3, 1)
+    assert tu.rand_shape_2d(5, 5)[0] <= 5
+    assert isinstance(tu.list_gpus(), list)
+
+    calls = []
+
+    @tu.retry(3)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise AssertionError("first try fails")
+
+    flaky()
+    assert len(calls) == 2
