@@ -1,0 +1,105 @@
+/*
+ * Header-only C++ wrapper over the C predict API — the cpp-package
+ * analog (reference cpp-package/include/mxnet-cpp/ wraps the C API the
+ * same way). Link against libmxtpu_predict.so.
+ *
+ *   mxnet_tpu::cpp::Predictor pred(json, params, {{"data", {1, 3, 224,
+ *   224}}});
+ *   pred.SetInput("data", buf);
+ *   pred.Forward();
+ *   std::vector<float> out = pred.GetOutput(0);
+ */
+#ifndef MXNET_TPU_PREDICTOR_HPP_
+#define MXNET_TPU_PREDICTOR_HPP_
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class Predictor {
+ public:
+  using ShapeMap = std::map<std::string, std::vector<mx_uint>>;
+
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const ShapeMap &input_shapes, int dev_type = 1,
+            int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    if (MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                     static_cast<int>(param_bytes.size()), dev_type,
+                     dev_id, static_cast<mx_uint>(keys.size()),
+                     keys.data(), indptr.data(), shapes.data(),
+                     &handle_) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+
+  Predictor &operator=(Predictor &&other) noexcept {
+    std::swap(handle_, other.handle_);
+    return *this;
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string &key, const std::vector<mx_float> &data) {
+    if (MXPredSetInput(handle_, key.c_str(), data.data(),
+                       static_cast<mx_uint>(data.size())) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  void Forward() {
+    if (MXPredForward(handle_) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index) const {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    if (MXPredGetOutputShape(handle_, index, &shape, &ndim) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index) const {
+    mx_uint size = 1;
+    for (mx_uint d : GetOutputShape(index)) size *= d;
+    std::vector<mx_float> out(size);
+    if (MXPredGetOutput(handle_, index, out.data(), size) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_PREDICTOR_HPP_

@@ -18,25 +18,15 @@ from mxnet_tpu import nd, sym
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _build_lib():
-    r = subprocess.run(["make", "-C", os.path.join(ROOT, "src")],
-                       capture_output=True, text=True)
-    assert r.returncode == 0, r.stderr[-2000:]
-    lib = os.path.join(ROOT, "mxnet_tpu", "lib", "libmxtpu_predict.so")
-    assert os.path.exists(lib)
-    return lib
+from conftest import (build_native_lib as _build_lib,
+                      compile_against_predict_lib,
+                      predict_subprocess_env)
 
 
 def _build_demo(tmp_path, lib):
-    exe = str(tmp_path / "c_predict_demo")
-    r = subprocess.run(
-        ["gcc", "-O2", "-o", exe,
-         os.path.join(ROOT, "tests", "c_predict_demo.c"),
-         "-I", os.path.join(ROOT, "include"),
-         lib, "-Wl,-rpath," + os.path.dirname(lib)],
-        capture_output=True, text=True)
-    assert r.returncode == 0, r.stderr[-2000:]
-    return exe
+    return compile_against_predict_lib(
+        [os.path.join(ROOT, "tests", "c_predict_demo.c")],
+        str(tmp_path / "c_predict_demo"), lang="c")
 
 
 @pytest.fixture(scope="module")
@@ -67,10 +57,7 @@ def test_c_predict_matches_python(tmp_path, checkpoint):
     pred = Predictor.load(prefix, 0, {"data": (1, 4)})
     expect = pred.forward(data=x.reshape(1, 4))[0].reshape(-1)
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
-    env["PYTHONPATH"] = os.pathsep.join(
-        [ROOT] + [p for p in sys.path
-                  if "site-packages" in p or "dist-packages" in p])
+    env = predict_subprocess_env()
     r = subprocess.run(
         [exe, prefix + "-symbol.json", prefix + "-0000.params", "4"]
         + ["%.6f" % v for v in x],

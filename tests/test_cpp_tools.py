@@ -1,0 +1,113 @@
+"""C++ header wrapper (predictor.hpp) + tools/parse_log.py."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+CPP_DEMO = r"""
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include "mxnet_tpu/predictor.hpp"
+
+static std::string slurp(const char *p) {
+  std::ifstream f(p, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  mxnet_tpu::cpp::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                                 {{"data", {1, 4}}});
+  pred.SetInput("data", {0.25f, -0.5f, 0.75f, 0.1f});
+  pred.Forward();
+  auto shape = pred.GetOutputShape(0);
+  std::printf("shape %u %u\n", shape[0], shape[1]);
+  for (float v : pred.GetOutput(0)) std::printf("%.6f ", v);
+  std::printf("\n");
+  try {
+    pred.SetInput("bogus", {1.0f});
+    return 3;  // should have thrown
+  } catch (const std::runtime_error &) {
+  }
+  return 0;
+}
+"""
+
+
+def test_cpp_predictor_header(tmp_path):
+    # train + checkpoint via the Python API
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.Variable("data"),
+                                               num_hidden=3), name="softmax")
+    mod = mx.Module(net, context=mx.cpu())
+    X = np.random.RandomState(0).rand(24, 4).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 3, 24).astype(np.float32)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    from conftest import compile_against_predict_lib, predict_subprocess_env
+    src = tmp_path / "demo.cpp"
+    src.write_text(CPP_DEMO)
+    exe = compile_against_predict_lib([str(src)], str(tmp_path / "demo"),
+                                      lang="cpp")
+    env = predict_subprocess_env()
+    r = subprocess.run([exe, prefix + "-symbol.json",
+                        prefix + "-0000.params"],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "shape 1 3"
+    vals = [float(v) for v in lines[1].split()]
+    assert abs(sum(vals) - 1.0) < 1e-4  # softmax row
+
+    from mxnet_tpu.predictor import Predictor
+    expect = Predictor.load(prefix, 0, {"data": (1, 4)}).forward(
+        data=np.asarray([[0.25, -0.5, 0.75, 0.1]], np.float32))[0]
+    np.testing.assert_allclose(vals, expect.reshape(-1), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Time cost=1.25\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.55\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.75\n"
+        "INFO:root:Epoch[1] Time cost=1.1\n"
+        "INFO:root:Epoch[1] Validation-accuracy=0.8\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         str(log)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "| epoch | train-accuracy | val-accuracy | time |" in out.stdout
+    assert "| 1 | 0.75 | 0.8 | 1.1 |" in out.stdout
+    csv = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         str(log), "--format", "csv"], capture_output=True, text=True)
+    assert "epoch,train-accuracy,val-accuracy,time" in csv.stdout
+    assert "1,0.75,0.8,1.1" in csv.stdout
+
+
+def test_parse_log_nan_inf(tmp_path):
+    # diverged-training lines must not be silently dropped
+    log = tmp_path / "diverged.log"
+    log.write_text("INFO:root:Epoch[0] Train-cross-entropy=nan\n"
+                   "INFO:root:Epoch[0] Time cost=2.0\n"
+                   "INFO:root:Epoch[1] Train-cross-entropy=inf\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         str(log)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "nan" in out.stdout and "inf" in out.stdout

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown/csv table (reference
+tools/parse_log.py): extracts per-epoch train/validation metrics and
+epoch time from ``mod.fit`` logging output.
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    """Return (rows, metric_names): rows keyed by epoch with
+    {'train-<m>': v, 'val-<m>': v, 'time': s}."""
+    num = r"([-+]?(?:[\d.]+(?:[eE][-+]?\d+)?|nan|inf))"  # incl. nan/inf
+    res = [
+        re.compile(r"Epoch\[(\d+)\] Train-([^=\s]+)=" + num),
+        re.compile(r"Epoch\[(\d+)\] Validation-([^=\s]+)=" + num),
+        re.compile(r"Epoch\[(\d+)\] Time cost=" + num),
+    ]
+    rows = {}
+    metrics = []
+
+    def row(epoch):
+        return rows.setdefault(int(epoch), {})
+
+    for line in lines:
+        m = res[0].search(line)
+        if m:
+            key = "train-" + m.group(2)
+            row(m.group(1))[key] = float(m.group(3))
+            if key not in metrics:
+                metrics.append(key)
+            continue
+        m = res[1].search(line)
+        if m:
+            key = "val-" + m.group(2)
+            row(m.group(1))[key] = float(m.group(3))
+            if key not in metrics:
+                metrics.append(key)
+            continue
+        m = res[2].search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+    return rows, metrics + ["time"]
+
+
+def render(rows, columns, fmt="markdown"):
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | " + " | ".join(columns) + " |")
+        out.append("| --- " * (len(columns) + 1) + "|")
+        for epoch in sorted(rows):
+            vals = [("%.6g" % rows[epoch][c]) if c in rows[epoch] else ""
+                    for c in columns]
+            out.append("| %d | %s |" % (epoch, " | ".join(vals)))
+    elif fmt == "csv":
+        out.append("epoch," + ",".join(columns))
+        for epoch in sorted(rows):
+            vals = [("%.6g" % rows[epoch][c]) if c in rows[epoch] else ""
+                    for c in columns]
+            out.append("%d,%s" % (epoch, ",".join(vals)))
+    else:
+        raise ValueError("unknown format %r" % fmt)
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs=1, help="the log file to parse")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "csv"])
+    args = ap.parse_args()
+    with open(args.logfile[0]) as f:
+        rows, columns = parse(f)
+    if not rows:
+        sys.exit("no epoch records found in %s" % args.logfile[0])
+    print(render(rows, columns, args.format))
+
+
+if __name__ == "__main__":
+    main()
