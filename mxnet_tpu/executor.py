@@ -144,7 +144,11 @@ def _make_fwd_bwd(graph_fn, diff_names):
             f = jax.checkpoint(f)
 
         outs, vjp_fn, new_auxs = jax.vjp(f, diff, has_aux=True)
-        cts = [g if g is not None else jnp.ones_like(o)
+        # head grads cast to each output's dtype (a bf16/fp16 graph fed
+        # f32 out_grads — e.g. check_consistency's shared grads — must
+        # not fail the VJP dtype check)
+        cts = [jnp.asarray(g, o.dtype) if g is not None
+               else jnp.ones_like(o)
                for g, o in zip(ograds, outs)]
         (grads,) = vjp_fn(cts)
         return outs, new_auxs, grads

@@ -7,7 +7,6 @@ Reshape / Free).
 """
 import os
 import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -18,12 +17,12 @@ from mxnet_tpu import nd, sym
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-from conftest import (build_native_lib as _build_lib,
-                      compile_against_predict_lib,
-                      predict_subprocess_env)
+from native_build import (build_native_lib as _build_lib,
+                          compile_against_predict_lib,
+                          predict_subprocess_env)
 
 
-def _build_demo(tmp_path, lib):
+def _build_demo(tmp_path):
     return compile_against_predict_lib(
         [os.path.join(ROOT, "tests", "c_predict_demo.c")],
         str(tmp_path / "c_predict_demo"), lang="c")
@@ -49,8 +48,7 @@ def checkpoint(tmp_path_factory):
 
 def test_c_predict_matches_python(tmp_path, checkpoint):
     prefix, net = checkpoint
-    lib = _build_lib()
-    exe = _build_demo(tmp_path, lib)
+    exe = _build_demo(tmp_path)
 
     x = np.asarray([0.3, -0.1, 0.7, 0.2], np.float32)
     from mxnet_tpu.predictor import Predictor

@@ -4,7 +4,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import sym
@@ -55,7 +54,8 @@ def test_cpp_predictor_header(tmp_path):
     prefix = str(tmp_path / "m")
     mod.save_checkpoint(prefix, 0)
 
-    from conftest import compile_against_predict_lib, predict_subprocess_env
+    from native_build import (compile_against_predict_lib,
+                              predict_subprocess_env)
     src = tmp_path / "demo.cpp"
     src.write_text(CPP_DEMO)
     exe = compile_against_predict_lib([str(src)], str(tmp_path / "demo"),

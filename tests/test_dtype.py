@@ -1,0 +1,85 @@
+"""Low-precision training/consistency tests (reference
+tests/python/train/test_dtype.py: fp16 LeNet training; here bf16 is the
+TPU's native low-precision type and fp16 rides the same cast path).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _lenetish():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=10, name="fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.mark.parametrize("low_dtype,rtol,atol",
+                         [("float16", 5e-2, 5e-2),
+                          ("bfloat16", 1e-1, 2e-1)])  # ~8-bit mantissa
+def test_conv_net_low_precision_consistency(low_dtype, rtol, atol):
+    # same net, f32 vs low precision: outputs agree to low-precision tol
+    # (reference test_dtype.py trains fp16 LeNet and checks accuracy;
+    # check_consistency is the underlying cross-dtype mechanism)
+    net = _lenetish()
+    shapes = {"data": (4, 1, 12, 12), "softmax_label": (4,)}
+    ctx_list = [
+        dict(ctx=mx.cpu(), type_dict={}, **shapes),
+        dict(ctx=mx.cpu(),
+             type_dict={"data": low_dtype, "c1_weight": low_dtype,
+                        "c1_bias": low_dtype}, **shapes),
+    ]
+    check_consistency(net, ctx_list, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_ndarray_cast_roundtrip(dtype):
+    x = nd.array(np.linspace(-4, 4, 64).astype(np.float32))
+    lo = x.astype(dtype)
+    assert str(lo.dtype).startswith(dtype)
+    back = lo.astype("float32").asnumpy()
+    np.testing.assert_allclose(back, x.asnumpy(), rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_module_training_converges():
+    # bf16 activations with f32 master weights via multi_precision SGD
+    rng = np.random.RandomState(0)
+    X = rng.rand(128, 8).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) > X[:, 4:].sum(axis=1)).astype(np.float32)
+    data = sym.Cast(sym.Variable("data"), dtype="bfloat16")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(sym.Cast(net, dtype="float32"), name="softmax")
+    mod = mx.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9,
+                              "multi_precision": True},
+            initializer=mx.initializer.Xavier(), eval_metric="acc")
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_optimizer_multi_precision_state(dtype):
+    # multi-precision SGD keeps an f32 master copy for low-precision
+    # weights (reference optimizer.py:445-545)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    w = nd.array(np.ones(8, np.float32)).astype(dtype)
+    state = opt.create_state_multi_precision(0, w)
+    g = nd.array(np.full(8, 0.25, np.float32)).astype(dtype)
+    for _ in range(10):
+        opt.update_multi_precision(0, w, g, state)
+    # master weight is f32; model weight tracks it in low precision
+    mom, w32 = state
+    assert str(w32.dtype).startswith("float32")
+    np.testing.assert_allclose(w.astype("float32").asnumpy(),
+                               w32.asnumpy(), rtol=1e-2, atol=1e-2)
+    assert float(w32.asnumpy().mean()) < 1.0  # actually descended
