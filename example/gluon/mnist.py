@@ -49,6 +49,8 @@ def main():
     ap.add_argument("--no-hybridize", action="store_true")
     args = ap.parse_args()
 
+    mx.random.seed(0)
+    np.random.seed(0)
     X, y = synthetic_mnist()
     split = int(0.9 * len(X))
     train_data = gluon.data.DataLoader(
