@@ -70,6 +70,25 @@ class DataLoaderIter(DataIter):
             except StopIteration:
                 raise StopIteration
         pad = 0
+        batch_size = self._provide_data[0].shape[0]
+        actual = data.shape[0]
+        if actual < batch_size:
+            # Pad the trailing partial batch up to batch_size (ref
+            # contrib/io.py getdata/getpad): repeat the last row and
+            # report pad so Module slices the padded tail off again.
+            pad = batch_size - actual
+            data = self._pad_to(data, batch_size)
+            label = self._pad_to(label, batch_size)
         return DataBatch(data=[data], label=[label], pad=pad,
                          provide_data=self._provide_data,
                          provide_label=self._provide_label)
+
+    @staticmethod
+    def _pad_to(arr, batch_size):
+        # fill with repeats of real rows (like NDArrayIter's wrap-around)
+        # so the padded tail never injects fabricated zero-label samples
+        # into training gradients — fit does not slice pad off
+        np_arr = arr.asnumpy()
+        n = np_arr.shape[0]
+        idx = _np.arange(batch_size) % n
+        return array(np_arr[idx], dtype=str(np_arr.dtype))

@@ -131,6 +131,16 @@ class TokenEmbedding(_vocab.Vocabulary):
                     self._token_to_idx[token] = len(self._idx_to_token)
                     self._idx_to_token.append(token)
                     seen.add(token)
+        if vec_len is None:
+            raise ValueError(
+                "No valid embedding vectors found in %s: every line was a "
+                "header, a duplicate, or the unknown token."
+                % pretrained_file_path)
+        if loaded_unknown_vec is not None and len(loaded_unknown_vec) != vec_len:
+            raise ValueError(
+                "Unknown-token vector in %s has dimension %d but other "
+                "tokens have %d."
+                % (pretrained_file_path, len(loaded_unknown_vec), vec_len))
         self._vec_len = vec_len
         import numpy as np
         mat = np.zeros((1 + len(all_rows), vec_len), dtype="float32")

@@ -63,6 +63,16 @@ class KVStoreDist(KVStore):
 
     def __init__(self, name="dist_sync"):
         super().__init__(name)
+        if "async" in name:
+            import warnings
+            warnings.warn(
+                "kvstore '%s': async (Hogwild-style) application is not "
+                "supported on the collective transport; running with "
+                "dist_sync semantics instead. This diverges from the "
+                "reference's kvstore_dist_server.h async mode (updates "
+                "there apply immediately per-push); results here are the "
+                "deterministic sync ones." % name,
+                UserWarning, stacklevel=3)
         _ensure_dist()
         import jax
         self._rank = jax.process_index()

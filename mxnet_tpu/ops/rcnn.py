@@ -164,6 +164,9 @@ def _proposal_impl(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n,
     post_n = int(rpn_post_nms_top_n)
     rank = jnp.where(alive, jnp.arange(pre_n), pre_n + jnp.arange(pre_n))
     pick = jnp.argsort(rank)[:post_n]
+    # pick has min(pre_n, post_n) entries; each remapped index below is
+    # either i < min(n_alive, post_n) or i % n_alive, both < len(pick),
+    # so the gather stays in bounds even when pre_n < post_n
     n_alive = alive.sum()
     pick = pick[jnp.where(jnp.arange(post_n) < n_alive,
                           jnp.arange(post_n),

@@ -83,6 +83,9 @@ struct NDList {
   PyObject *keys = nullptr;    // list of str
   PyObject *arrays = nullptr;  // list of float32 C-contiguous numpy arrays
   std::vector<std::vector<mx_uint>> shapes;
+  // buffers handed out by MXNDListGet; held until MXNDListFree so the
+  // returned data pointers stay valid per the buffer protocol
+  std::vector<Py_buffer> views;
 };
 
 PyObject *ImportAttr(const char *module, const char *attr) {
@@ -526,7 +529,7 @@ int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
     return -1;
   }
   *out_data = static_cast<const mx_float *>(view.buf);
-  PyBuffer_Release(&view);  // arr stays alive in the list; buf valid
+  l->views.push_back(view);  // released in MXNDListFree
   *out_shape = l->shapes[index].data();
   *out_ndim = static_cast<mx_uint>(l->shapes[index].size());
   return 0;
@@ -536,6 +539,7 @@ int MXNDListFree(NDListHandle handle) {
   auto *l = static_cast<NDList *>(handle);
   if (l != nullptr) {
     GIL gil;
+    for (Py_buffer &view : l->views) PyBuffer_Release(&view);
     Py_XDECREF(l->keys);
     Py_XDECREF(l->arrays);
     delete l;

@@ -141,3 +141,24 @@ def test_pull_uninitialized_raises():
     kv = mx.kv.create("local")
     with pytest.raises(mx.MXNetError):
         kv.pull("missing", out=nd.zeros(SHAPE))
+
+
+def test_dist_async_warns_and_runs_sync():
+    """dist_async diverges from the reference (async server applies) —
+    the divergence must be loud: a UserWarning at create time, and the
+    store must behave exactly like dist_sync (single-process here)."""
+    import warnings as _warnings
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        kv = mx.kv.create("dist_async")
+    assert any("dist_sync semantics" in str(w.message) for w in caught), \
+        "creating dist_async must warn about the sync-semantics divergence"
+    assert kv.type == "dist_async"
+    # both dist_sync and dist_async dispatch to the same KVStoreDist by
+    # design — the discriminating assertion is the warning above; here we
+    # just pin that the store is functional after the divergence warning
+    kv.init("w", nd.ones(SHAPE))
+    kv.push("w", nd.ones(SHAPE) * 2)
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    assert np.isfinite(out.asnumpy()).all()
