@@ -4,11 +4,17 @@ example/model-parallel/matrix_factorization/{model.py,train.py}).
 
 The reference splits the net across two GPUs with
 ``mx.AttrScope(ctx_group=...)`` + ``group2ctxs``: embeddings on dev1,
-dense layers on dev2. On TPU the idiomatic equivalent is GSPMD model
-parallelism: the same symbol trains through ``parallel.TrainStep`` over
-a dp×tp ``jax.sharding.Mesh``, where the big embedding tables shard
-over the ``tp`` axis and XLA inserts the collectives — no explicit
-device placement, one compiled step.
+dense layers on dev2. Two TPU-native realizations, selectable with
+``--mode``:
+
+* ``mesh`` (default, the idiomatic one): GSPMD model parallelism — the
+  same symbol trains through ``parallel.TrainStep`` over a dp×tp
+  ``jax.sharding.Mesh``, the big embedding tables shard over ``tp``,
+  and XLA inserts the collectives.
+* ``group2ctx``: the reference's exact per-group placement contract —
+  Module binds with ``group2ctxs`` and the executor honors it with
+  ``jax.device_put`` at group boundaries inside one compiled program
+  (the TPU-native _CrossDeviceCopy, graph_executor.cc:408).
 
 Runs offline on synthetic MovieLens-shaped data. With no TPU mesh
 available, ``--num-devices N`` simulates N virtual CPU devices.
@@ -23,9 +29,10 @@ import numpy as np
 
 
 def matrix_fact_net(factor_size, num_hidden, max_user, max_item):
-    """Reference model.py matrix_fact_model_parallel_net: the ctx_group
-    annotations are kept for API parity (on TPU they are advisory —
-    sharding, not device placement, distributes the work)."""
+    """Reference model.py matrix_fact_model_parallel_net. The ctx_group
+    annotations are honored by ``--mode group2ctx`` (per-group
+    device_put placement) and advisory under ``--mode mesh`` (GSPMD
+    sharding distributes the work instead)."""
     import mxnet_tpu as mx
     from mxnet_tpu import sym
 
@@ -68,6 +75,42 @@ def synthetic_ratings(n, max_user, max_item, rank=8, seed=0):
     return users, items, scores
 
 
+def run_group2ctx(args):
+    """The reference's actual contract (train.py + group2ctxs): bind the
+    net with {'dev1': dev0, 'dev2': dev1} and train through Module — the
+    executor honors the placement with jax.device_put at group
+    boundaries inside ONE compiled program (executor.py group_devices,
+    the TPU-native _CrossDeviceCopy)."""
+    import jax
+    import mxnet_tpu as mx
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise SystemExit("group2ctx mode needs >=2 devices "
+                         "(use --num-devices 2)")
+    if devs[0].platform == "cpu":
+        ctx0, ctx1 = mx.cpu(0), mx.cpu(1)
+    else:
+        ctx0, ctx1 = mx.tpu(0), mx.tpu(1)
+    net = matrix_fact_net(args.factor_size, args.num_hidden,
+                          args.max_user, args.max_item)
+    users, items, scores = synthetic_ratings(
+        args.num_samples, args.max_user, args.max_item)
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score": scores}, batch_size=args.batch_size,
+                           shuffle=True, label_name="score")
+    mod = mx.Module(net, data_names=["user", "item"], label_names=["score"],
+                    context=ctx0,
+                    group2ctxs={"dev1": ctx0, "dev2": ctx1})
+    mod.fit(it, num_epoch=args.num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Normal(0.05), eval_metric="mse")
+    it.reset()
+    mse = mod.score(it, "mse")[0][1]
+    print("group2ctx mode: final mse %.4f" % mse)
+    return mse
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--num-epoch", type=int, default=4)
@@ -77,6 +120,11 @@ def main():
     ap.add_argument("--max-user", type=int, default=512)
     ap.add_argument("--max-item", type=int, default=512)
     ap.add_argument("--num-samples", type=int, default=8192)
+    ap.add_argument("--mode", type=str, default="mesh",
+                    choices=["mesh", "group2ctx"],
+                    help="'mesh' = GSPMD dp×tp sharding (TPU-idiomatic); "
+                         "'group2ctx' = the reference's per-group device "
+                         "placement, honored via in-program device_put")
     ap.add_argument("--num-devices", type=int, default=0,
                     help="simulate N virtual cpu devices for the dp×tp "
                          "mesh (0 = use whatever jax.devices() offers)")
@@ -94,6 +142,9 @@ def main():
 
     import mxnet_tpu as mx
     from mxnet_tpu.parallel import TrainStep
+
+    if args.mode == "group2ctx":
+        return run_group2ctx(args)
 
     net = matrix_fact_net(args.factor_size, args.num_hidden,
                           args.max_user, args.max_item)

@@ -1,0 +1,66 @@
+/*
+ * C NDArray + imperative API — the train-capable slice of the C surface.
+ *
+ * Reference parity: the NDArray/imperative subset of
+ * include/mxnet/c_api.h (MXNDArrayCreateEx:529, MXNDArrayFree,
+ * MXNDArraySyncCopyFromCPU/ToCPU, MXNDArrayGetShape,
+ * MXImperativeInvokeEx:887) that cpp-package's ndarray.h:1 training
+ * path is built on. Implemented over the embedded CPython runtime in
+ * the same shared library as the predict API (libmxtpu_predict.so);
+ * see tests/c_train_demo.c for a full C training loop (forward,
+ * manual backprop, sgd_update) written against this header.
+ *
+ * Conventions: every function returns 0 on success, -1 on failure
+ * (message via MXGetLastError from c_predict_api.h). All tensors cross
+ * the boundary as float32.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include "c_predict_api.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+
+typedef void *NDArrayHandle;
+
+/* Create a zero-filled float32 NDArray of the given shape. */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, NDArrayHandle *out);
+
+/* Release an NDArray handle. */
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* Copy `size` floats from host memory into the array (row-major).
+ * `size` must equal the array's element count. */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const mx_float *data,
+                             size_t size);
+
+/* Copy the array's contents to host memory (blocks until ready). */
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, mx_float *data,
+                           size_t size);
+
+/* Shape query. The returned pointer stays valid until the next call on
+ * the same handle or MXNDArrayFree. */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_shape);
+
+/*
+ * Invoke a registered operator eagerly (reference MXImperativeInvokeEx).
+ * `keys`/`vals` are num_params string attribute pairs, parsed with the
+ * same MXNet string syntax as symbol JSON ("(3, 3)", "True", "relu").
+ * On input *num_outputs is the capacity of `outputs`; on return it is
+ * the number of outputs written (each a fresh handle the caller frees).
+ */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle *outputs, int num_params,
+                       const char **keys, const char **vals);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -1,0 +1,54 @@
+"""Python side of the C NDArray/imperative API (src/c_api.cc).
+
+Reference parity: the NDArray + imperative-invoke slice of
+include/mxnet/c_api.h (MXNDArrayCreateEx:529, MXNDArraySyncCopyFromCPU,
+MXImperativeInvokeEx:887) that cpp-package's training path drives. The
+C layer (libmxtpu_predict.so) holds PyObject handles to the NDArrays
+returned here; every tensor crossing the boundary is float32 (the C
+surface's declared contract, like c_predict_api).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["create_ndarray", "copy_from", "copy_to", "get_shape",
+           "imperative_invoke"]
+
+
+def create_ndarray(shape, dtype="float32"):
+    from .ndarray.ndarray import zeros
+    return zeros(tuple(int(s) for s in shape), dtype=dtype)
+
+
+def copy_from(nd, buf):
+    """Fill ``nd`` from a C float32 buffer (memoryview/bytes)."""
+    arr = _np.frombuffer(buf, dtype=_np.float32)
+    if arr.size != nd.size:
+        raise ValueError("SyncCopyFromCPU: buffer has %d floats, NDArray "
+                         "has %d elements" % (arr.size, nd.size))
+    nd._sync_copyfrom(arr.reshape(nd.shape))
+    return None
+
+
+def copy_to(nd):
+    """Return a C-contiguous float32 numpy array of ``nd``'s contents
+    (the sync point — blocks until the value is ready)."""
+    return _np.ascontiguousarray(nd.asnumpy(), dtype=_np.float32)
+
+
+def get_shape(nd):
+    return [int(s) for s in nd.shape]
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    """Invoke a registered operator eagerly (reference
+    MXImperativeInvokeEx). ``keys``/``vals`` are string attribute pairs
+    coerced per-op exactly like symbol-JSON attrs. Returns a list of
+    output NDArrays."""
+    from .ops import registry as _reg
+    from .ndarray import dispatch as _dispatch
+
+    op = _reg.get_op(op_name)
+    kwargs = dict(zip(list(keys), list(vals)))
+    out = _dispatch.invoke(op, tuple(inputs), kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]

@@ -1,48 +1,18 @@
 """ONNX import/export (reference python/mxnet/contrib/onnx/).
 
-Like the reference, this package requires the third-party ``onnx``
-library (the reference raises ImportError from onnx2mx/mx2onnx when it
-is absent — import_model docstring: "Instructions to install - ...").
-``onnx`` is not installed in this environment, so the entry points
-raise with the same guidance instead of exposing half-working stubs.
+Reference: onnx2mx/import_model.py + mx2onnx/export_model.py (a 3.8k
+LoC translator pair built on the third-party ``onnx`` package). That
+package is not installable here, so this build vendors a minimal ONNX
+IR protobuf (onnx_proto/onnx.proto, generated bindings committed as
+onnx_pb2.py) whose field numbers match the upstream schema exactly —
+emitted files load in stock onnx/onnxruntime, and models serialized by
+stock exporters parse here (protobuf skips the upstream fields the
+subset omits). Translation covers the model-zoo operator subset; see
+mx2onnx.py / onnx2mx.py for the exact lists.
 """
 from __future__ import annotations
 
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata
+
 __all__ = ["import_model", "export_model", "get_model_metadata"]
-
-_MSG = ("ONNX support requires the `onnx` package, which is not "
-        "installed. Instructions to install - "
-        "https://github.com/onnx/onnx#installation")
-
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(_MSG) from None
-
-
-def import_model(model_file):
-    """Load an ONNX model file into (sym, arg_params, aux_params)
-    (ref onnx2mx/import_model.py)."""
-    _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph translation is not implemented in this build; the "
-        "reference-format symbol.json + .params checkpoint loaders "
-        "(mx.model.load_checkpoint) are the supported interchange path.")
-
-
-def get_model_metadata(model_file):
-    """Input/output shape metadata of an ONNX model
-    (ref onnx2mx/import_model.py:66)."""
-    _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph translation is not implemented in this build.")
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Export a symbol+params to ONNX (ref mx2onnx/export_model.py)."""
-    _require_onnx()
-    raise NotImplementedError(
-        "ONNX graph translation is not implemented in this build.")

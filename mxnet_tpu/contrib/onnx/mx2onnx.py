@@ -1,0 +1,372 @@
+"""Symbol graph -> ONNX ModelProto translation.
+
+Reference parity: python/mxnet/contrib/onnx/mx2onnx/export_model.py +
+_op_translations.py (3.8k LoC of per-op converters). This build vendors
+a minimal ONNX IR protobuf (onnx_proto/onnx.proto — field-compatible
+with the upstream schema, so the emitted files load in stock
+onnx/onnxruntime) instead of depending on the uninstallable ``onnx``
+package, and translates the model-zoo op subset: Convolution,
+BatchNorm, FullyConnected, Activation, LeakyReLU, Pooling, Flatten,
+Reshape, Concat, Dropout, Cast, SoftmaxOutput/softmax, LayerNorm,
+elementwise add/sub/mul, and broadcast_add.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import onnx_pb2 as O
+
+_OPSET = 13
+
+_DTYPE_TO_ONNX = {"float32": O.TensorProto.FLOAT,
+                  "float64": O.TensorProto.DOUBLE,
+                  "float16": O.TensorProto.FLOAT16,
+                  "bfloat16": O.TensorProto.BFLOAT16,
+                  "uint8": O.TensorProto.UINT8,
+                  "int8": O.TensorProto.INT8,
+                  "int32": O.TensorProto.INT32,
+                  "int64": O.TensorProto.INT64,
+                  "bool": O.TensorProto.BOOL}
+
+
+def _attr(name, value):
+    a = O.AttributeProto(name=name)
+    if isinstance(value, bool):
+        a.type = O.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = O.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = O.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = O.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (tuple, list)):
+        if value and isinstance(value[0], float):
+            a.type = O.AttributeProto.FLOATS
+            a.floats.extend(value)
+        else:
+            a.type = O.AttributeProto.INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise MXNetError("onnx export: bad attribute %s=%r" % (name, value))
+    return a
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    n = O.NodeProto(op_type=op_type, name=name)
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        n.attribute.append(_attr(k, v))
+    return n
+
+
+def _tensor(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    t = O.TensorProto(name=name)
+    t.dims.extend(arr.shape)
+    dt = str(arr.dtype)
+    if dt not in _DTYPE_TO_ONNX:
+        arr = arr.astype("float32")
+        dt = "float32"
+    t.data_type = _DTYPE_TO_ONNX[dt]
+    t.raw_data = arr.tobytes()
+    return t
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v) or (1,) * n
+    return (int(v),) * n
+
+
+class _Ctx:
+    """Per-export state: emitted nodes + fresh-name helper."""
+
+    def __init__(self):
+        self.nodes = []
+        self._uid = 0
+
+    def fresh(self, base):
+        self._uid += 1
+        return "%s__%d" % (base, self._uid)
+
+    def add(self, *nodes):
+        self.nodes.extend(nodes)
+
+
+# ---------------------------------------------------------------------
+# per-op converters: (node, in_names, out_name, ctx) -> None
+# ---------------------------------------------------------------------
+def _c_convolution(n, ins, out, ctx):
+    a = n.attrs
+    kernel = _pair(a["kernel"], len(a["kernel"]))
+    nd = len(kernel)
+    stride = _pair(a.get("stride") or (1,) * nd, nd)
+    pad = _pair(a.get("pad") or (0,) * nd, nd)
+    dilate = _pair(a.get("dilate") or (1,) * nd, nd)
+    layout = a.get("layout")
+    if layout and str(layout).endswith("C"):
+        raise MXNetError("onnx export: channel-last Convolution not "
+                         "supported (ONNX Conv is NCHW); build the "
+                         "symbol with layout='NCHW' for export")
+    ctx.add(_node("Conv", ins, [out], n.name,
+                  kernel_shape=kernel, strides=stride,
+                  pads=list(pad) + list(pad), dilations=dilate,
+                  group=int(a.get("num_group", 1))))
+
+
+def _c_batchnorm(n, ins, out, ctx):
+    a = n.attrs
+    # inputs: data gamma beta moving_mean moving_var (already this order)
+    ctx.add(_node("BatchNormalization", ins, [out], n.name,
+                  epsilon=float(a.get("eps", 1e-3)),
+                  momentum=float(a.get("momentum", 0.9))))
+
+
+def _c_fully_connected(n, ins, out, ctx):
+    a = n.attrs
+    data, weight = ins[0], ins[1]
+    bias = ins[2] if len(ins) > 2 and not a.get("no_bias") else None
+    if a.get("flatten", True):
+        flat = ctx.fresh(n.name + "_flat")
+        ctx.add(_node("Flatten", [data], [flat], flat, axis=1))
+        data = flat
+        gemm_in = [data, weight] + ([bias] if bias else [])
+        ctx.add(_node("Gemm", gemm_in, [out], n.name, alpha=1.0, beta=1.0,
+                      transA=0, transB=1))
+    else:
+        # (…, in) x (out, in)^T via MatMul on transposed weight
+        wt = ctx.fresh(n.name + "_wT")
+        ctx.add(_node("Transpose", [weight], [wt], wt, perm=[1, 0]))
+        mm = ctx.fresh(n.name + "_mm") if bias else out
+        ctx.add(_node("MatMul", [data, wt], [mm], n.name + "_matmul"))
+        if bias:
+            ctx.add(_node("Add", [mm, bias], [out], n.name))
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _c_activation(n, ins, out, ctx):
+    ctx.add(_node(_ACT[n.attrs["act_type"]], ins, [out], n.name))
+
+
+def _c_leaky_relu(n, ins, out, ctx):
+    a = n.attrs
+    act = a.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.add(_node("LeakyRelu", ins[:1], [out], n.name,
+                      alpha=float(a.get("slope", 0.25))))
+    elif act == "elu":
+        ctx.add(_node("Elu", ins[:1], [out], n.name,
+                      alpha=float(a.get("slope", 0.25))))
+    elif act == "prelu":
+        ctx.add(_node("PRelu", ins[:2], [out], n.name))
+    elif act == "selu":
+        ctx.add(_node("Selu", ins[:1], [out], n.name))
+    elif act == "gelu":
+        # Gelu is a standard op only from opset 20
+        raise MXNetError("onnx export: gelu not supported at opset %d"
+                         % _OPSET)
+    else:
+        raise MXNetError("onnx export: LeakyReLU act_type=%s" % act)
+
+
+def _c_pooling(n, ins, out, ctx):
+    a = n.attrs
+    layout = a.get("layout")
+    if layout and str(layout).endswith("C"):
+        raise MXNetError("onnx export: channel-last Pooling not supported")
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError("onnx export: global %s pooling" % ptype)
+        ctx.add(_node(op, ins, [out], n.name))
+        return
+    kernel = _pair(a["kernel"], len(a["kernel"]))
+    nd = len(kernel)
+    stride = _pair(a.get("stride") or (1,) * nd, nd)
+    pad = _pair(a.get("pad") or (0,) * nd, nd)
+    kw = dict(kernel_shape=kernel, strides=stride,
+              pads=list(pad) + list(pad))
+    if ptype == "max":
+        ctx.add(_node("MaxPool", ins, [out], n.name, **kw))
+    elif ptype == "avg":
+        kw["count_include_pad"] = 1 if a.get("count_include_pad", True) else 0
+        ctx.add(_node("AveragePool", ins, [out], n.name, **kw))
+    else:
+        raise MXNetError("onnx export: pool_type=%s" % ptype)
+
+
+def _c_flatten(n, ins, out, ctx):
+    ctx.add(_node("Flatten", ins, [out], n.name, axis=1))
+
+
+def _c_reshape(n, ins, out, ctx):
+    shape = [int(s) for s in n.attrs.get("shape", ())]
+    shp_name = ctx.fresh(n.name + "_shape")
+    const = _node("Constant", [], [shp_name], shp_name)
+    a = O.AttributeProto(name="value", type=O.AttributeProto.TENSOR)
+    a.t.CopyFrom(_tensor(shp_name + "_v",
+                         _np.asarray(shape, dtype="int64")))
+    const.attribute.append(a)
+    ctx.add(const)
+    ctx.add(_node("Reshape", [ins[0], shp_name], [out], n.name))
+
+
+def _c_concat(n, ins, out, ctx):
+    ctx.add(_node("Concat", ins, [out], n.name,
+                  axis=int(n.attrs.get("dim", 1))))
+
+
+def _c_dropout(n, ins, out, ctx):
+    ctx.add(_node("Dropout", ins[:1], [out], n.name))
+
+
+def _c_cast(n, ins, out, ctx):
+    ctx.add(_node("Cast", ins, [out], n.name,
+                  to=int(_DTYPE_TO_ONNX[str(n.attrs["dtype"])])))
+
+
+def _c_softmax_output(n, ins, out, ctx):
+    # inference semantics: softmax over the trailing axis (the label
+    # input is dropped, like the reference converter)
+    ctx.add(_node("Softmax", ins[:1], [out], n.name, axis=-1))
+
+
+def _c_softmax(n, ins, out, ctx):
+    ctx.add(_node("Softmax", ins[:1], [out], n.name,
+                  axis=int(n.attrs.get("axis", -1))))
+
+
+def _c_add(n, ins, out, ctx):
+    ctx.add(_node("Add", ins, [out], n.name))
+
+
+def _c_sub(n, ins, out, ctx):
+    ctx.add(_node("Sub", ins, [out], n.name))
+
+
+def _c_mul(n, ins, out, ctx):
+    ctx.add(_node("Mul", ins, [out], n.name))
+
+
+def _c_layer_norm(n, ins, out, ctx):
+    ctx.add(_node("LayerNormalization", ins, [out], n.name,
+                  axis=int(n.attrs.get("axis", -1)),
+                  epsilon=float(n.attrs.get("eps", 1e-5))))
+
+
+_CONVERTERS = {
+    "Convolution": _c_convolution,
+    "BatchNorm": _c_batchnorm,
+    "FullyConnected": _c_fully_connected,
+    "Activation": _c_activation,
+    "LeakyReLU": _c_leaky_relu,
+    "Pooling": _c_pooling,
+    "Flatten": _c_flatten,
+    "Reshape": _c_reshape,
+    "Concat": _c_concat,
+    "Dropout": _c_dropout,
+    "Cast": _c_cast,
+    "SoftmaxOutput": _c_softmax_output,
+    "softmax": _c_softmax,
+    "elemwise_add": _c_add,
+    "_plus": _c_add,
+    "_Plus": _c_add,
+    "broadcast_add": _c_add,
+    "elemwise_sub": _c_sub,
+    "broadcast_sub": _c_sub,
+    "elemwise_mul": _c_mul,
+    "broadcast_mul": _c_mul,
+    "LayerNorm": _c_layer_norm,
+}
+
+
+def export_model(sym, params, input_shapes, input_dtype="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Translate (symbol, params) to an ONNX file; returns the path.
+    ``input_shapes`` is a dict name -> shape for the data inputs (label
+    inputs are dropped, reference mx2onnx behavior). ``params`` may mix
+    ``arg:``/``aux:`` prefixed keys (checkpoint layout) or be plain."""
+    from ...ndarray.ndarray import NDArray
+
+    flat_params = {}
+    for k, v in (params or {}).items():
+        name = k.split(":", 1)[1] if ":" in k else k
+        flat_params[name] = v.asnumpy() if isinstance(v, NDArray) else \
+            _np.asarray(v)
+
+    topo = sym._topo()
+    entries = list(sym._entries)
+    label_names = {n for n in sym.list_arguments()
+                   if n.endswith("_label") or n == "label"}
+
+    ctx = _Ctx()
+    names = {}           # (id(node), out_idx) -> onnx value name
+    graph = O.GraphProto(name="mxnet_tpu")
+    used_inputs = []
+
+    for node in topo:
+        if node.is_var:
+            names[(id(node), 0)] = node.name
+            if node.name in flat_params:
+                graph.initializer.append(
+                    _tensor(node.name, flat_params[node.name]))
+            elif node.name in input_shapes:
+                vi = graph.input.add()
+                vi.name = node.name
+                vi.type.tensor_type.elem_type = _DTYPE_TO_ONNX[input_dtype]
+                for d in input_shapes[node.name]:
+                    vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+                used_inputs.append(node.name)
+            elif node.name in label_names:
+                names[(id(node), 0)] = None   # dropped (inference graph)
+            else:
+                raise MXNetError(
+                    "onnx export: variable '%s' has no param value and no "
+                    "input shape" % node.name)
+            continue
+        conv = _CONVERTERS.get(node.op.name)
+        if conv is None:
+            raise MXNetError(
+                "onnx export: operator '%s' (node '%s') has no converter; "
+                "supported: %s"
+                % (node.op.name, node.name, sorted(_CONVERTERS)))
+        ins = [names[(id(inp), oi)] for inp, oi in node.inputs]
+        ins = [i for i in ins if i is not None]
+        out = node.output_name(0) if node.visible_out_count() == 1 \
+            else node.name + "_output0"
+        conv(node, ins, out, ctx)
+        for i in range(node.out_count()):
+            names[(id(node), i)] = out if i == 0 else \
+                node.name + "_output%d" % i
+
+    graph.node.extend(ctx.nodes)
+    for head, oi in entries:
+        out_name = names[(id(head), oi)]
+        vo = graph.output.add()
+        vo.name = out_name
+        vo.type.tensor_type.elem_type = _DTYPE_TO_ONNX[input_dtype]
+
+    model = O.ModelProto(ir_version=7, producer_name="mxnet_tpu",
+                         producer_version="0.3")
+    ops = model.opset_import.add()
+    ops.domain = ""
+    ops.version = _OPSET
+    model.graph.CopyFrom(graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print("exported %d nodes, %d initializers -> %s"
+              % (len(graph.node), len(graph.initializer), onnx_file_path))
+    return onnx_file_path

@@ -1,0 +1,287 @@
+"""ONNX ModelProto -> Symbol graph translation.
+
+Reference parity: python/mxnet/contrib/onnx/onnx2mx/import_model.py +
+import_onnx.py + _op_translations.py. Reads the vendored minimal ONNX
+IR protobuf (field-compatible with upstream onnx.proto3, so files
+produced by stock onnx/pytorch exporters parse — unknown fields are
+skipped by protobuf). Covers the inverse of the mx2onnx converter set:
+Conv, BatchNormalization, Gemm, MatMul, Add/Sub/Mul, Relu/Sigmoid/
+Tanh/Softplus/Softsign/LeakyRelu/Elu/PRelu, MaxPool/AveragePool/
+Global*Pool, Flatten, Reshape, Concat, Dropout, Cast, Softmax,
+LayerNormalization, Constant.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import onnx_pb2 as O
+
+_ONNX_TO_DTYPE = {O.TensorProto.FLOAT: "float32",
+                  O.TensorProto.DOUBLE: "float64",
+                  O.TensorProto.FLOAT16: "float16",
+                  O.TensorProto.BFLOAT16: "bfloat16",
+                  O.TensorProto.UINT8: "uint8",
+                  O.TensorProto.INT8: "int8",
+                  O.TensorProto.INT32: "int32",
+                  O.TensorProto.INT64: "int64",
+                  O.TensorProto.BOOL: "bool"}
+
+
+def _tensor_to_np(t):
+    dtype = _ONNX_TO_DTYPE.get(t.data_type)
+    if dtype is None:
+        raise MXNetError("onnx import: unsupported tensor dtype %d"
+                         % t.data_type)
+    shape = tuple(t.dims)
+    if t.raw_data:
+        arr = _np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = _np.asarray(list(t.float_data), dtype=dtype)
+    elif t.int64_data:
+        arr = _np.asarray(list(t.int64_data), dtype=dtype)
+    elif t.int32_data:
+        arr = _np.asarray(list(t.int32_data), dtype=dtype)
+    elif t.double_data:
+        arr = _np.asarray(list(t.double_data), dtype=dtype)
+    else:
+        arr = _np.zeros(shape, dtype=dtype)
+    return arr.reshape(shape).copy()
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == O.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == O.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == O.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == O.AttributeProto.INTS:
+            out[a.name] = tuple(int(v) for v in a.ints)
+        elif a.type == O.AttributeProto.FLOATS:
+            out[a.name] = tuple(float(v) for v in a.floats)
+        elif a.type == O.AttributeProto.TENSOR:
+            out[a.name] = _tensor_to_np(a.t)
+    return out
+
+
+def _sym_pads(pads, nd):
+    """ONNX pads [b0,b1,...,e0,e1,...] -> symmetric MXNet pad tuple."""
+    if not pads:
+        return (0,) * nd
+    begin, end = pads[:nd], pads[nd:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("onnx import: asymmetric pads %s" % (pads,))
+    return tuple(int(p) for p in begin)
+
+
+class _Importer:
+    def __init__(self, graph):
+        from ... import symbol as sym
+        self.sym = sym
+        self.graph = graph
+        self.env = {}          # value name -> Symbol
+        self.params = {}       # param name -> np array
+        for init in graph.initializer:
+            self.params[init.name] = _tensor_to_np(init)
+        for vi in graph.input:
+            if vi.name not in self.params:
+                self.env[vi.name] = sym.Variable(vi.name)
+        for name in self.params:
+            self.env[name] = sym.Variable(name)
+
+    # -- converters ----------------------------------------------------
+    def _conv(self, n, a):
+        w_shape = self.params[n.input[1]].shape
+        kernel = a.get("kernel_shape", w_shape[2:])
+        nd = len(kernel)
+        return self.sym.Convolution(
+            *[self.env[i] for i in n.input],
+            kernel=tuple(kernel), num_filter=w_shape[0],
+            stride=a.get("strides", (1,) * nd),
+            pad=_sym_pads(a.get("pads", ()), nd),
+            dilate=a.get("dilations", (1,) * nd),
+            num_group=a.get("group", 1),
+            no_bias=(len(n.input) < 3), name=n.name or n.output[0])
+
+    def _bn(self, n, a):
+        return self.sym.BatchNorm(
+            *[self.env[i] for i in n.input],
+            eps=a.get("epsilon", 1e-5), momentum=a.get("momentum", 0.9),
+            fix_gamma=False, use_global_stats=True,
+            name=n.name or n.output[0])
+
+    def _gemm(self, n, a):
+        if a.get("transA") or not a.get("transB", 0):
+            raise MXNetError("onnx import: Gemm with transA/transB=0")
+        num_hidden = self.params[n.input[1]].shape[0]
+        return self.sym.FullyConnected(
+            *[self.env[i] for i in n.input], num_hidden=num_hidden,
+            no_bias=(len(n.input) < 3), name=n.name or n.output[0])
+
+    def _matmul(self, n, a):
+        return self.sym.dot(self.env[n.input[0]], self.env[n.input[1]],
+                            name=n.name or n.output[0])
+
+    def _pool(self, n, a, ptype, global_pool=False):
+        if global_pool:
+            return self.sym.Pooling(self.env[n.input[0]], global_pool=True,
+                                    kernel=(1, 1), pool_type=ptype,
+                                    name=n.name or n.output[0])
+        kernel = a["kernel_shape"]
+        nd = len(kernel)
+        return self.sym.Pooling(
+            self.env[n.input[0]], kernel=tuple(kernel), pool_type=ptype,
+            stride=a.get("strides", (1,) * nd),
+            pad=_sym_pads(a.get("pads", ()), nd),
+            count_include_pad=bool(a.get("count_include_pad", 1)),
+            name=n.name or n.output[0])
+
+    def _act(self, n, a, act_type):
+        return self.sym.Activation(self.env[n.input[0]], act_type=act_type,
+                                   name=n.name or n.output[0])
+
+    def _reshape(self, n, a):
+        if len(n.input) > 1:
+            shape_src = n.input[1]
+            if shape_src in self.params:
+                shape = tuple(int(s) for s in self.params[shape_src])
+                # consumed as a constant, not a runtime input
+                self.params.pop(shape_src, None)
+            elif shape_src in self.constants:
+                shape = tuple(int(s) for s in self.constants[shape_src])
+            else:
+                raise MXNetError("onnx import: dynamic Reshape shape")
+        else:
+            shape = tuple(a.get("shape", ()))
+        return self.sym.Reshape(self.env[n.input[0]], shape=shape,
+                                name=n.name or n.output[0])
+
+    def convert(self):
+        sym = self.sym
+        self.constants = {}
+        for n in self.graph.node:
+            a = _attrs(n)
+            op = n.op_type
+            name = n.name or n.output[0]
+            if op == "Constant":
+                self.constants[n.output[0]] = a["value"]
+                continue
+            if op == "Conv":
+                out = self._conv(n, a)
+            elif op == "BatchNormalization":
+                out = self._bn(n, a)
+            elif op == "Gemm":
+                out = self._gemm(n, a)
+            elif op == "MatMul":
+                out = self._matmul(n, a)
+            elif op == "Add":
+                out = sym.broadcast_add(self.env[n.input[0]],
+                                        self.env[n.input[1]], name=name)
+            elif op == "Sub":
+                out = sym.broadcast_sub(self.env[n.input[0]],
+                                        self.env[n.input[1]], name=name)
+            elif op == "Mul":
+                out = sym.broadcast_mul(self.env[n.input[0]],
+                                        self.env[n.input[1]], name=name)
+            elif op == "Relu":
+                out = self._act(n, a, "relu")
+            elif op == "Sigmoid":
+                out = self._act(n, a, "sigmoid")
+            elif op == "Tanh":
+                out = self._act(n, a, "tanh")
+            elif op == "Softplus":
+                out = self._act(n, a, "softrelu")
+            elif op == "Softsign":
+                out = self._act(n, a, "softsign")
+            elif op == "LeakyRelu":
+                out = sym.LeakyReLU(self.env[n.input[0]], act_type="leaky",
+                                    slope=a.get("alpha", 0.01), name=name)
+            elif op == "Elu":
+                out = sym.LeakyReLU(self.env[n.input[0]], act_type="elu",
+                                    slope=a.get("alpha", 1.0), name=name)
+            elif op == "PRelu":
+                out = sym.LeakyReLU(self.env[n.input[0]],
+                                    self.env[n.input[1]],
+                                    act_type="prelu", name=name)
+            elif op == "MaxPool":
+                out = self._pool(n, a, "max")
+            elif op == "AveragePool":
+                out = self._pool(n, a, "avg")
+            elif op == "GlobalMaxPool":
+                out = self._pool(n, a, "max", global_pool=True)
+            elif op == "GlobalAveragePool":
+                out = self._pool(n, a, "avg", global_pool=True)
+            elif op == "Flatten":
+                out = sym.Flatten(self.env[n.input[0]], name=name)
+            elif op == "Reshape":
+                out = self._reshape(n, a)
+            elif op == "Concat":
+                out = sym.Concat(*[self.env[i] for i in n.input],
+                                 dim=a.get("axis", 1), name=name)
+            elif op == "Dropout":
+                out = sym.Dropout(self.env[n.input[0]],
+                                  p=a.get("ratio", 0.5), name=name)
+            elif op == "Cast":
+                dt = _ONNX_TO_DTYPE[a["to"]]
+                out = sym.Cast(self.env[n.input[0]], dtype=dt, name=name)
+            elif op == "Softmax":
+                out = sym.softmax(self.env[n.input[0]],
+                                  axis=a.get("axis", -1), name=name)
+            elif op == "LayerNormalization":
+                out = sym.LayerNorm(*[self.env[i] for i in n.input],
+                                    axis=a.get("axis", -1),
+                                    eps=a.get("epsilon", 1e-5), name=name)
+            elif op == "Identity":
+                out = self.env[n.input[0]]
+            else:
+                raise MXNetError(
+                    "onnx import: operator '%s' has no converter" % op)
+            for o in n.output[:1]:
+                self.env[o] = out
+        outs = [self.env[vo.name] for vo in self.graph.output]
+        return outs[0] if len(outs) == 1 else sym.Group(outs)
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params)
+    (ref onnx2mx/import_model.py:32)."""
+    from ...ndarray.ndarray import array as nd_array
+
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    imp = _Importer(model.graph)
+    out_sym = imp.convert()
+    aux_names = set(out_sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name, arr in imp.params.items():
+        (aux_params if name in aux_names else arg_params)[name] = \
+            nd_array(arr)
+    # drop params consumed as constants that no longer appear in the graph
+    arg_names = set(out_sym.list_arguments())
+    arg_params = {k: v for k, v in arg_params.items() if k in arg_names}
+    return out_sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output names and shapes (ref onnx2mx/import_model.py:66)."""
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    init = {t.name for t in g.initializer}
+
+    def shapes(vis):
+        out = []
+        for vi in vis:
+            if vi.name in init:
+                continue
+            dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": shapes(g.input),
+            "output_tensor_data": shapes(g.output)}

@@ -30,16 +30,33 @@ from .ops import registry as _reg
 __all__ = ["Executor"]
 
 
-def _build_graph_fn(symbol, collect_taps=False, monitor_all=False):
+def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
+                    group_devices=None):
     """Build a pure function (args, auxs, seed, is_train) ->
     (outputs, new_auxs) interpreting the DAG with registered op impls.
     With ``collect_taps`` the function also returns {tap_name: value} for
     every op output (and every variable when ``monitor_all``) — the debug
     program behind executor monitor callbacks (reference
-    graph_executor.cc SetMonitorCallback)."""
+    graph_executor.cc SetMonitorCallback).
+
+    ``group_devices`` maps a ctx_group name (``with AttrScope(
+    ctx_group='dev1')``) to a ``jax.Device``: nodes carrying that attr
+    have their outputs placed on the group's device via ``jax.device_put``
+    **inside the traced program** — the TPU-native realization of the
+    reference's PlaceDevice pass + _CrossDeviceCopy insertion
+    (graph_executor.cc:408): one XLA program spanning the devices, with
+    transfers exactly at group boundaries, and gradients transferring
+    back through the transposed copies."""
     topo = symbol._topo()
     entries = list(symbol._entries)
     aux_names = set(symbol.list_auxiliary_states())
+
+    def _place(node, v):
+        if not group_devices:
+            return v
+        grp = node.str_attrs.get("ctx_group")
+        dev = group_devices.get(grp)
+        return jax.device_put(v, dev) if dev is not None else v
 
     def graph_fn(args, auxs, seed, is_train):
         rng = jax.random.key(seed)
@@ -50,9 +67,10 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False):
             for node in topo:
                 if node.is_var:
                     if node.name in args:
-                        env[(id(node), 0)] = args[node.name]
+                        env[(id(node), 0)] = _place(node, args[node.name])
                     elif node.name in auxs:
-                        env[(id(node), 0)] = jax.lax.stop_gradient(auxs[node.name])
+                        env[(id(node), 0)] = _place(
+                            node, jax.lax.stop_gradient(auxs[node.name]))
                     else:
                         raise MXNetError("unbound variable '%s'" % node.name)
                     if collect_taps and monitor_all:
@@ -60,6 +78,10 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False):
                     continue
                 ins = [env[(id(inp), oi)] for inp, oi in node.inputs]
                 raw = node.op.fn(*ins, **node.attrs)
+                if group_devices:
+                    raw = (tuple(_place(node, r) for r in raw)
+                           if isinstance(raw, (tuple, list))
+                           else _place(node, raw))
                 outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
                 for i, v in enumerate(outs):
                     env[(id(node), i)] = v
@@ -165,21 +187,19 @@ class Executor:
         self.aux_dict = aux_dict
         self._grad_req = grad_req_dict
         # group2ctx is the reference's manual model-parallel placement
-        # (graph_executor.cc PlaceDevice). On TPU, cross-device placement
-        # inside one XLA program is expressed with mesh shardings, which
-        # TrainStep's tp axis provides; a per-group device map cannot be
-        # honored here, so reject it loudly rather than silently ignore.
+        # (graph_executor.cc PlaceDevice + _CrossDeviceCopy insertion).
+        # TPU-native realization: each ctx_group's jax device is honored
+        # by jax.device_put at group boundaries INSIDE the one traced
+        # program (_build_graph_fn group_devices) — XLA compiles a single
+        # multi-device program with transfers exactly where the reference
+        # inserted copy nodes, and gradients ride the transposed copies.
+        self._group2ctx = group2ctx
+        self._group_devices = None
         if group2ctx:
             base = ctx if ctx is not None else current_context()
-            for grp, gctx in group2ctx.items():
-                if gctx != base:
-                    raise NotImplementedError(
-                        "group2ctx[%r]=%s differs from the bind context %s: "
-                        "per-group device placement is not supported in one "
-                        "XLA program. Use parallel.TrainStep's tensor-"
-                        "parallel mesh axis for model parallelism instead."
-                        % (grp, gctx, base))
-        self._group2ctx = group2ctx
+            gd = {g: c.jax_device for g, c in group2ctx.items()}
+            if any(c != base for c in group2ctx.values()):
+                self._group_devices = gd
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
@@ -195,14 +215,48 @@ class Executor:
         from . import random as _rand
         self._base_seed = _rand.next_seed()
 
-        cache = _compiled_cache(symbol)
-        self._graph_fn = cache["graph_fn"]
-        self._jit_fwd_train = cache["fwd_train"]
-        self._jit_fwd_eval = cache["fwd_eval"]
-        key = tuple(sorted(self._diff_names))
-        if key not in cache["fwd_bwd"]:
-            cache["fwd_bwd"][key] = _make_fwd_bwd(cache["graph_fn"], key)
-        self._jit_fwd_bwd = cache["fwd_bwd"][key]
+        if self._group_devices is None:
+            cache = _compiled_cache(symbol)
+            self._graph_fn = cache["graph_fn"]
+            self._jit_fwd_train = cache["fwd_train"]
+            self._jit_fwd_eval = cache["fwd_eval"]
+            key = tuple(sorted(self._diff_names))
+            if key not in cache["fwd_bwd"]:
+                cache["fwd_bwd"][key] = _make_fwd_bwd(cache["graph_fn"], key)
+            self._jit_fwd_bwd = cache["fwd_bwd"][key]
+        else:
+            # model-parallel bind: the placed program is specific to this
+            # group->device map, so it gets its own jitted callables
+            # (cached per symbol+placement)
+            gkey = tuple(sorted((g, str(d))
+                                for g, d in self._group_devices.items()))
+            placed = getattr(symbol, "_exec_cache_placed", None)
+            if placed is None:
+                placed = symbol._exec_cache_placed = {}
+            entry = placed.get(gkey)
+            if entry is None:
+                graph_fn = _build_graph_fn(
+                    symbol, group_devices=self._group_devices)
+
+                @jax.jit
+                def _fwd_train(args, auxs, seed):
+                    return graph_fn(args, auxs, seed, True)
+
+                @jax.jit
+                def _fwd_eval(args, auxs, seed):
+                    outs, _ = graph_fn(args, auxs, seed, False)
+                    return outs
+
+                entry = {"graph_fn": graph_fn, "fwd_train": _fwd_train,
+                         "fwd_eval": _fwd_eval, "fwd_bwd": {}}
+                placed[gkey] = entry
+            self._graph_fn = entry["graph_fn"]
+            self._jit_fwd_train = entry["fwd_train"]
+            self._jit_fwd_eval = entry["fwd_eval"]
+            key = tuple(sorted(self._diff_names))
+            if key not in entry["fwd_bwd"]:
+                entry["fwd_bwd"][key] = _make_fwd_bwd(entry["graph_fn"], key)
+            self._jit_fwd_bwd = entry["fwd_bwd"][key]
 
     # ------------------------------------------------------------------
     @property

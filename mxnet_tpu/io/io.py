@@ -290,10 +290,18 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference io.py:349 + C++
-    iter_prefetcher.h): overlaps host-side batch prep with device compute."""
+    iter_prefetcher.h): overlaps host-side batch prep with device compute.
+
+    With ``ctx`` set to an accelerator context, the worker ALSO starts
+    the host->device transfer (``jax.device_put``) for each prefetched
+    batch, double-buffered by ``prefetch_depth``: while the device runs
+    step N, batch N+1 is already decoding AND transferring — the
+    TPU-native analog of the reference's pinned-memory staging in
+    iter_prefetcher.h (transfers are async in jax; dispatching them from
+    the worker overlaps them with compute)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, ctx=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
@@ -301,10 +309,33 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self._depth = prefetch_depth
+        self._ctx = ctx
         self._queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
         self._start()
+
+    def _to_device(self, batches):
+        if self._ctx is None:
+            return batches
+        import jax
+        from ..ndarray.ndarray import NDArray
+        dev = self._ctx.jax_device
+
+        def place(nd):
+            return NDArray(jax.device_put(nd._data, dev), self._ctx)
+
+        out = []
+        for b in batches:
+            out.append(DataBatch([place(d) for d in b.data],
+                                 ([place(l) for l in b.label]
+                                  if b.label is not None else None),
+                                 b.pad, b.index,
+                                 bucket_key=getattr(b, "bucket_key", None),
+                                 provide_data=getattr(b, "provide_data", None),
+                                 provide_label=getattr(b, "provide_label",
+                                                       None)))
+        return out
 
     @property
     def provide_data(self):
@@ -332,7 +363,7 @@ class PrefetchingIter(DataIter):
                 except StopIteration:
                     self._queue.put(None)
                     return
-                self._queue.put(batches)
+                self._queue.put(self._to_device(batches))
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 

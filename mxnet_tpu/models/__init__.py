@@ -21,8 +21,10 @@ from . import inception_bn
 from . import googlenet
 from . import squeezenet
 from . import densenet
+from . import transformer
 
 _NETWORKS = {
+    "transformer": transformer,
     "mlp": mlp,
     "lenet": lenet,
     "alexnet": alexnet,

@@ -1,0 +1,70 @@
+"""Decoder-only transformer language model (GPT-style, pre-LN).
+
+New TPU-native capability: the reference (MXNet ~1.2) predates
+transformers entirely (SURVEY.md §5.7 maps its sequence stack to
+RNN/BucketingModule), so this is not a ported symbol — it is the
+arithmetic-intensity-dense model family that demonstrates the framework
+reaches MXU-bound MFU when the model is not HBM-bandwidth-bound the way
+ResNet/BatchNorm is (docs/PERF.md). Attention is the fused
+``sym.contrib.CausalSelfAttention`` op (rematerialized backward, fp32
+softmax statistics); sequence/context-parallel training of the same
+architecture runs through ``parallel.ring_attention``.
+
+Builds a Symbol ending in SoftmaxOutput, so it drops into ``Module.fit``
+/ ``parallel.TrainStep`` / ``bench.py`` exactly like the CNN zoo:
+``data`` is (batch, seq_len) token ids and ``softmax_label`` is
+(batch*seq_len,) next-token targets.
+"""
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
+               ffn_dim=None, seq_len=1024, dtype="float32", dropout=0.0,
+               **kwargs):
+    """``num_classes`` is the vocabulary size (factory-signature parity
+    with the CNN zoo's get_symbol)."""
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    lp = float(dropout)
+
+    data = sym.Variable("data")                      # (B, S) token ids
+    tok = sym.Embedding(data, input_dim=vocab, output_dim=d,
+                        name="tok_embed")
+    pos = sym.Variable("pos_embed_weight", shape=(1, int(seq_len), d))
+    x = sym.broadcast_add(tok, pos, name="embed_add")
+    if dtype in ("float16", "bfloat16"):
+        x = sym.Cast(data=x, dtype=dtype, name="cast_embed")
+    if lp > 0:
+        x = sym.Dropout(data=x, p=lp, name="embed_drop")
+
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        ln1 = sym.LayerNorm(data=x, name=pre + "ln1")
+        qkv = sym.FullyConnected(data=ln1, num_hidden=3 * d, flatten=False,
+                                 name=pre + "qkv")
+        att = sym.contrib.CausalSelfAttention(qkv, num_heads=int(num_heads),
+                                              name=pre + "attn")
+        proj = sym.FullyConnected(data=att, num_hidden=d, flatten=False,
+                                  name=pre + "proj")
+        if lp > 0:
+            proj = sym.Dropout(data=proj, p=lp, name=pre + "drop1")
+        x = x + proj
+        ln2 = sym.LayerNorm(data=x, name=pre + "ln2")
+        h = sym.FullyConnected(data=ln2, num_hidden=ffn, flatten=False,
+                               name=pre + "ffn_up")
+        h = sym.LeakyReLU(data=h, act_type="gelu", name=pre + "gelu")
+        h = sym.FullyConnected(data=h, num_hidden=d, flatten=False,
+                               name=pre + "ffn_down")
+        if lp > 0:
+            h = sym.Dropout(data=h, p=lp, name=pre + "drop2")
+        x = x + h
+
+    x = sym.LayerNorm(data=x, name="ln_f")
+    logits = sym.FullyConnected(data=x, num_hidden=vocab, flatten=False,
+                                name="lm_head")
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
+    flat = sym.Reshape(data=logits, shape=(-1, vocab), name="logits_2d")
+    return sym.SoftmaxOutput(data=flat, name="softmax",
+                             normalization="batch")

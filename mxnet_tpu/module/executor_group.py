@@ -27,7 +27,7 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, group2ctxs=None):
         self.symbol = symbol
         self.contexts = contexts
         self.param_names = param_names
@@ -68,8 +68,15 @@ class DataParallelExecutorGroup:
                 else (d[0], d[1])
             shapes[name] = shp
         shared_exec = shared_group.execs[0] if shared_group else None
+        # the reference takes one group2ctx dict per device (executor_
+        # group.py:143 group2ctxs); with ONE sharded executor the first
+        # entry is the placement map (ctx_group -> device, honored by
+        # Executor via in-program jax.device_put)
+        g2c = group2ctxs[0] if isinstance(group2ctxs, (list, tuple)) \
+            and group2ctxs else group2ctxs
         self.execs = [symbol.simple_bind(contexts[0], req,
-                                         shared_exec=shared_exec, **shapes)]
+                                         shared_exec=shared_exec,
+                                         group2ctx=g2c, **shapes)]
         self._exec = self.execs[0]
         if self._mesh is not None:
             self._install_shardings()

@@ -247,7 +247,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req, state_names=self._state_names)
+            grad_req=grad_req, state_names=self._state_names,
+            group2ctxs=self._group2ctxs)
         self._total_exec_bytes = 0
         if shared_module is not None:
             self.params_initialized = True

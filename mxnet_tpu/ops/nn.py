@@ -54,7 +54,18 @@ def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False,
 # ----------------------------------------------------------------------
 # Convolution / Deconvolution
 # ----------------------------------------------------------------------
-def _conv_dnums(ndim):
+def _conv_dnums(ndim, layout=None):
+    """(lhs, rhs, out) layout strings. ``layout`` is the MXNet layout
+    attr for the DATA tensor; channel-last layouts pair with
+    channel-last weights (num_filter, *kernel, in_ch/g), matching the
+    reference's NHWC contract (convolution.cc layout param)."""
+    if layout:
+        layout = str(layout)
+        if layout.endswith("C"):            # NWC / NHWC / NDHWC
+            rhs = "O" + layout[1:-1] + "I"
+            return (layout, rhs, layout)
+        rhs = "OI" + layout[2:]             # NCW / NCHW / NCDHW
+        return (layout, rhs, layout)
     if ndim == 3:
         return ("NCH", "OIH", "NCH")
     if ndim == 4:
@@ -62,18 +73,24 @@ def _conv_dnums(ndim):
     return ("NCDHW", "OIDHW", "NCDHW")
 
 
+def _channel_axis(ndim, layout=None):
+    return (ndim - 1) if (layout and str(layout).endswith("C")) else 1
+
+
 @register("Convolution", aliases=("convolution",))
 def convolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
                 dilate=(), pad=(), num_group=1, no_bias=False, cudnn_tune=None,
                 cudnn_off=False, workspace=1024, layout=None):
     """N-D convolution (ref src/operator/nn/convolution.cc). Lowers to a
-    single conv HLO on the MXU; groups via feature_group_count."""
+    single conv HLO on the MXU; groups via feature_group_count. TPU-first:
+    ``layout='NHWC'`` (channel-last data AND weights) avoids every
+    relayout copy around the conv — the preferred training layout."""
     nd = len(kernel)
     stride = _pair(stride, nd) if stride else (1,) * nd
     dilate = _pair(dilate, nd) if dilate else (1,) * nd
     pad = _pair(pad, nd) if pad else (0,) * nd
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                    _conv_dnums(data.ndim))
+                                    _conv_dnums(data.ndim, layout))
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -83,7 +100,9 @@ def convolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
         feature_group_count=int(num_group),
     ).astype(data.dtype)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * (data.ndim - 2))
+        ax = _channel_axis(data.ndim, layout)
+        bshape = tuple(-1 if i == ax else 1 for i in range(data.ndim))
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -131,6 +150,76 @@ def deconvolution(data, weight, bias=None, *, kernel, num_filter, stride=(),
 # ----------------------------------------------------------------------
 # BatchNorm
 # ----------------------------------------------------------------------
+def _bn_train_fused(red, bshape, eps, fix_gamma, n):
+    """Training-mode batch norm as ONE fused stats pass + ONE apply pass,
+    with a hand-derived backward (ONE reduction pass + ONE elementwise
+    pass). The HBM-bandwidth-optimal schedule (docs/PERF.md):
+
+    * stats: sum(x) and sum(x^2) are independent reductions over the same
+      operand, so XLA multi-output-fuses them into a single read of the
+      activation with fp32 accumulators (vs the naive mean-then-var
+      serial double pass). var = E[x^2] - E[x]^2, the cuDNN "persistent"
+      formulation.
+    * apply/backward passes read and write the activation dtype (bf16 on
+      TPU); fp32 math happens in registers inside the fusion, so no fp32
+      copy of any activation ever hits HBM.
+
+    Gradients for save_mean/save_var outputs are intentionally dropped
+    (reference semantics: batch_norm.cc differentiates only through out).
+    """
+    f32 = jnp.float32
+
+    def _stats(x):
+        s = jnp.sum(x, axis=red, dtype=f32)
+        s2 = jnp.sum(jnp.square(x.astype(f32)), axis=red)
+        mean = s / n
+        var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+        return mean, var
+
+    @jax.custom_vjp
+    def f(x, gamma, beta):
+        mean, var = _stats(x)
+        inv_std = lax.rsqrt(var + eps)
+        g32 = jnp.ones_like(inv_std) if fix_gamma else gamma.astype(f32)
+        scale = g32 * inv_std
+        shift = beta.astype(f32) - mean * scale
+        out = (x.astype(f32) * scale.reshape(bshape)
+               + shift.reshape(bshape)).astype(x.dtype)
+        return out, mean, var
+
+    def f_fwd(x, gamma, beta):
+        mean, var = _stats(x)
+        inv_std = lax.rsqrt(var + eps)
+        g32 = jnp.ones_like(inv_std) if fix_gamma else gamma.astype(f32)
+        scale = g32 * inv_std
+        shift = beta.astype(f32) - mean * scale
+        out = (x.astype(f32) * scale.reshape(bshape)
+               + shift.reshape(bshape)).astype(x.dtype)
+        return (out, mean, var), (x, gamma, mean, inv_std, g32)
+
+    def f_bwd(res, cts):
+        x, gamma, mean, inv_std, g32 = res
+        dy = cts[0]                     # cotangents of mean/var dropped
+        t1 = jnp.sum(dy, axis=red, dtype=f32)
+        t2 = jnp.sum(dy.astype(f32) * x.astype(f32), axis=red)
+        dgamma = (t2 - mean * t1) * inv_std
+        dbeta = t1
+        # dx = scale*(dy - dbeta/n - xhat*dgamma/n) expanded to a single
+        # a*dy + b*x + c per-channel affine pass
+        scale = g32 * inv_std
+        bcoef = -scale * inv_std * dgamma / n
+        ccoef = (scale * inv_std * dgamma * mean - scale * dbeta) / n
+        dx = (dy.astype(f32) * scale.reshape(bshape)
+              + x.astype(f32) * bcoef.reshape(bshape)
+              + ccoef.reshape(bshape)).astype(x.dtype)
+        if fix_gamma:
+            dgamma = jnp.zeros_like(dgamma)
+        return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
 @register("BatchNorm", aliases=("batch_norm", "CuDNNBatchNorm"), num_outputs=5,
           num_visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
           mutate_inputs=(("moving_mean", 3), ("moving_var", 4)))
@@ -139,12 +228,12 @@ def batch_norm(data, gamma, beta, moving_mean=None, moving_var=None, *,
                output_mean_var=False, axis=1, cudnn_off=False):
     """Batch normalization (ref src/operator/nn/batch_norm.cc).
     Returns (out, save_mean, save_inv_var, new_moving_mean, new_moving_var);
-    the last two update the aux states (reference mutates them in place)."""
+    the last two update the aux states (reference mutates them in place).
+    Training mode runs the fused one-pass schedule (_bn_train_fused)."""
     ctx = current_op_context()
     ax = int(axis) % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
 
     if moving_mean is None:
         moving_mean = jnp.zeros(data.shape[ax], dtype=jnp.float32)
@@ -152,21 +241,33 @@ def batch_norm(data, gamma, beta, moving_mean=None, moving_var=None, *,
         moving_var = jnp.ones(data.shape[ax], dtype=jnp.float32)
 
     use_batch_stats = ctx.is_train and not use_global_stats
-    xf = data.astype(jnp.float32)
     if use_batch_stats:
-        mean = jnp.mean(xf, axis=red)
-        var = jnp.var(xf, axis=red)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        out, mean, var = _bn_train_fused(red, bshape, float(eps),
+                                         bool(fix_gamma), float(n))(
+            data, gamma, beta)
+        inv_std = lax.rsqrt(var + eps)
+        # keep the aux dtype: fp32 math, cast back so the moving stats
+        # never drift dtype step-over-step (which would silently retrace
+        # the jitted step after the first update)
+        new_mm = (moving_mean.astype(jnp.float32) * momentum
+                  + mean * (1 - momentum)).astype(moving_mean.dtype)
+        new_mv = (moving_var.astype(jnp.float32) * momentum
+                  + var * (1 - momentum)).astype(moving_var.dtype)
     else:
-        mean = lax.stop_gradient(moving_mean)
-        var = lax.stop_gradient(moving_var)
+        mean = lax.stop_gradient(moving_mean.astype(jnp.float32))
+        var = lax.stop_gradient(moving_var.astype(jnp.float32))
         new_mm, new_mv = moving_mean, moving_var
-
-    inv_std = lax.rsqrt(var + eps)
-    out = (xf - mean.reshape(bshape)) * inv_std.reshape(bshape)
-    out = out * g.reshape(bshape) + beta.reshape(bshape)
-    return (out.astype(data.dtype), mean, inv_std,
+        inv_std = lax.rsqrt(var + eps)
+        g32 = (jnp.ones_like(inv_std) if fix_gamma
+               else gamma.astype(jnp.float32))
+        scale = g32 * inv_std
+        shift = beta.astype(jnp.float32) - mean * scale
+        out = (data.astype(jnp.float32) * scale.reshape(bshape)
+               + shift.reshape(bshape)).astype(data.dtype)
+    return (out, mean, inv_std,
             lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))
 
 
@@ -224,11 +325,15 @@ def lrn(data, *, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
 @register("Pooling", aliases=("pooling",))
 def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
             pad=(), pooling_convention="valid", cudnn_off=False,
-            count_include_pad=True, p_value=2):
-    """Max/avg/sum/lp pooling (ref src/operator/nn/pooling.cc)."""
+            count_include_pad=True, p_value=2, layout=None):
+    """Max/avg/sum/lp pooling (ref src/operator/nn/pooling.cc).
+    ``layout`` accepts channel-last strings (NWC/NHWC/NDHWC) so pooling
+    composes with NHWC convolutions without relayouts."""
     nd = data.ndim - 2
+    chlast = bool(layout) and str(layout).endswith("C")
+    sp0 = 1 if chlast else 2            # first spatial axis
     if global_pool:
-        red = tuple(range(2, data.ndim))
+        red = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             out = jnp.max(data, axis=red, keepdims=True)
         elif pool_type == "sum":
@@ -239,16 +344,21 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False, stride=(),
     kernel = _pair(kernel, nd)
     stride = _pair(stride, nd) if stride else (1,) * nd
     pad = _pair(pad, nd) if pad else (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if chlast:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        base_pad = [(0, 0)] + [(p, p) for p in pad] + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pooling_convention == "full":
         # ceil semantics: add extra right-pad so the last window fits
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             out_sz = -(-(size - kernel[i]) // stride[i]) + 1  # ceil
             need = (out_sz - 1) * stride[i] + kernel[i] - size
-            base_pad[2 + i] = (pad[i], pad[i] + max(0, need))
+            base_pad[sp0 + i] = (pad[i], pad[i] + max(0, need))
     if pool_type == "max":
         init = (-jnp.inf if jnp.issubdtype(data.dtype, jnp.floating)
                 else jnp.iinfo(data.dtype).min)
@@ -482,6 +592,46 @@ def softmax_activation(data, *, mode="instance"):
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+# ----------------------------------------------------------------------
+# Attention (new TPU-native capability — the reference predates
+# attention entirely, SURVEY.md §5.7; sequence-parallel forms live in
+# parallel/ring_attention.py)
+# ----------------------------------------------------------------------
+@register("_contrib_CausalSelfAttention", aliases=("CausalSelfAttention",))
+def causal_self_attention(qkv, *, num_heads, scale=None):
+    """Fused causal multi-head self-attention over a packed QKV tensor:
+    (B, S, 3*d_model) -> (B, S, d_model).
+
+    TPU-first schedule: QK^T and PV are two MXU einsums (bf16 inputs,
+    fp32 accumulation on the MXU); softmax statistics run in fp32 inside
+    the fusion; the whole op is rematerialized in backward
+    (``jax.checkpoint``) so no (S, S) attention matrix is ever saved as
+    a residual — live memory stays O(S·d) per layer.
+    """
+    B, S, d3 = qkv.shape
+    d = d3 // 3
+    H = int(num_heads)
+    if d % H:
+        raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
+    D = d // H
+    sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+
+    @jax.checkpoint
+    def attn(qkv):
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sc
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(qkv.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return o.reshape(B, S, d)
+
+    return attn(qkv)
 
 
 # ----------------------------------------------------------------------

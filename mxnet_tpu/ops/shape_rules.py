@@ -52,8 +52,13 @@ def _conv_shapes(known, attrs):
     nf = int(attrs["num_filter"])
     kernel = tuple(int(k) for k in attrs["kernel"])
     g = int(attrs.get("num_group", 1))
+    layout = attrs.get("layout")
     if data is not None:
-        out["weight"] = (nf, data[1] // g) + kernel
+        if layout and str(layout).endswith("C"):
+            # channel-last data pairs with channel-last weights
+            out["weight"] = (nf,) + kernel + (data[-1] // g,)
+        else:
+            out["weight"] = (nf, data[1] // g) + kernel
     out["bias"] = (nf,)
     return out
 

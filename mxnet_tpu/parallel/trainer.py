@@ -149,6 +149,72 @@ def _init_state(optimizer, weight):
     return ()
 
 
+def _device_init_rule(initializer, name, attrs, shape, dtype):
+    """Device-side analog of Initializer.__call__'s name dispatch
+    (initializer.py:55): returns fn(key) -> jax array, or None when the
+    (initializer, name) pair has no closed-form device rule (custom
+    per-param __init__ attrs, Orthogonal/Bilinear/..., packed RNN vecs).
+
+    TPU-first: the reference initializes on the host and copies every
+    parameter to the device; generating with XLA's on-chip RNG instead
+    means a multi-GB model materializes in HBM without a single
+    host->device weight transfer."""
+    from .. import initializer as _init
+
+    if attrs and attrs.get("__init__"):
+        return None
+    cls = type(initializer)
+    # any overridden dispatch or rule method means the initializer has
+    # custom semantics (Mixed, Load, user subclasses) — host path only
+    if cls.__call__ is not _init.Initializer.__call__:
+        return None
+    base = _init.Initializer
+    for meth in ("_init_bias", "_init_gamma", "_init_beta", "_init_zero",
+                 "_init_one", "_init_default"):
+        if getattr(cls, meth) is not getattr(base, meth):
+            return None
+    lname = name.lower()
+    if lname.endswith(("_bias", "_beta", "_moving_mean", "_running_mean",
+                       "_moving_avg", "_min", "_max")):
+        return lambda key: jnp.zeros(shape, dtype)
+    if lname.endswith(("_gamma", "_moving_var", "_running_var")):
+        return lambda key: jnp.ones(shape, dtype)
+    if lname.endswith("_parameters"):
+        return None
+    if isinstance(initializer, _init.Zero):
+        return lambda key: jnp.zeros(shape, dtype)
+    if isinstance(initializer, _init.One):
+        return lambda key: jnp.ones(shape, dtype)
+    if isinstance(initializer, _init.Constant):
+        return lambda key: jnp.full(shape, initializer.value, dtype)
+    cls = type(initializer)
+    if isinstance(initializer, _init.Xavier) \
+            and cls._init_weight is _init.Xavier._init_weight:
+        if len(shape) < 2:
+            return None
+        hw = 1.0
+        for s in shape[2:]:
+            hw *= s
+        fan_in, fan_out = shape[1] * hw, shape[0] * hw
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[initializer.factor_type]
+        scale = float(_np.sqrt(initializer.magnitude / factor))
+        if initializer.rnd_type == "uniform":
+            return lambda key: jax.random.uniform(
+                key, shape, jnp.float32, -scale, scale).astype(dtype)
+        return lambda key: (jax.random.normal(key, shape, jnp.float32)
+                            * scale).astype(dtype)
+    if cls is _init.Normal:
+        s = float(initializer.sigma)
+        return lambda key: (jax.random.normal(key, shape, jnp.float32)
+                            * s).astype(dtype)
+    if cls is _init.Uniform:
+        s = float(initializer.scale)
+        return lambda key: jax.random.uniform(
+            key, shape, jnp.float32, -s, s).astype(dtype)
+    return None
+
+
 class TrainStep:
     """symbol + optimizer + mesh → one compiled training step.
 
@@ -222,38 +288,49 @@ class TrainStep:
             return None
         return NamedSharding(self._mesh, P())
 
-    def init_params(self, initializer, arg_params=None, aux_params=None):
-        """Initialize on host then place with the parameter shardings
-        (reference Module.init_params, module.py:270)."""
+    def init_params(self, initializer, arg_params=None, aux_params=None,
+                    device_init=True):
+        """Initialize parameters. With ``device_init`` (default), params
+        whose initializer rule has a closed form (Xavier/Normal/Uniform/
+        Zero/One/Constant + the standard name-suffix rules) generate
+        directly on the accelerator with XLA's RNG — no host->device
+        weight transfer at all (the reference always inits on cpu and
+        copies, module.py:270; for multi-GB models over PCIe/tunnel the
+        device path is the difference between seconds and minutes).
+        Everything else falls back to the host initializer."""
         from ..initializer import InitDesc
         from ..ndarray.ndarray import NDArray
 
         attrs = self._symbol.attr_dict()
+        key = jax.random.key(self._base_seed)
+
+        def materialize(name, shp, dt, provided, sharding):
+            nonlocal key
+            if provided is not None:
+                host = provided.asnumpy() \
+                    if isinstance(provided, NDArray) else provided
+                return jax.device_put(jnp.asarray(host, dt), sharding)
+            if device_init:
+                rule = _device_init_rule(initializer, name,
+                                         attrs.get(name), shp, dt)
+                if rule is not None:
+                    key, sub = jax.random.split(key)
+                    return jax.device_put(rule(sub), sharding)
+            nd_host = NDArray(jnp.zeros(shp, dt))
+            initializer(InitDesc(name, attrs.get(name)), nd_host)
+            return jax.device_put(jnp.asarray(nd_host.asnumpy(), dt),
+                                  sharding)
+
         params = {}
         for name in self._param_names:
-            shp, dt = self._arg_shapes[name], self._arg_types[name]
-            if arg_params and name in arg_params:
-                host = arg_params[name].asnumpy() \
-                    if isinstance(arg_params[name], NDArray) else arg_params[name]
-            else:
-                nd_host = NDArray(jnp.zeros(shp, dt))
-                initializer(InitDesc(name, attrs.get(name)), nd_host)
-                host = nd_host.asnumpy()
-            params[name] = jax.device_put(
-                jnp.asarray(host, dt), self._param_sharding(name))
+            params[name] = materialize(
+                name, self._arg_shapes[name], self._arg_types[name],
+                (arg_params or {}).get(name), self._param_sharding(name))
         auxs = {}
         for name in self._aux_names:
-            shp, dt = self._aux_shapes[name], self._aux_types[name]
-            if aux_params and name in aux_params:
-                host = aux_params[name].asnumpy() \
-                    if isinstance(aux_params[name], NDArray) else aux_params[name]
-                auxs[name] = jax.device_put(jnp.asarray(host, dt),
-                                            self._repl_sharding())
-            else:
-                nd_host = NDArray(jnp.zeros(shp, dt))
-                initializer(InitDesc(name, attrs.get(name)), nd_host)
-                auxs[name] = jax.device_put(jnp.asarray(nd_host.asnumpy(), dt),
-                                            self._repl_sharding())
+            auxs[name] = materialize(
+                name, self._aux_shapes[name], self._aux_types[name],
+                (aux_params or {}).get(name), self._repl_sharding())
         states = {n: tuple(
             jax.device_put(s, self._param_sharding(n))
             for s in _init_state(self._optimizer, params[n]))

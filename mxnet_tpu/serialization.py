@@ -1,12 +1,18 @@
-"""NDArray file serialization.
+"""NDArray file serialization — reference binary format + npz.
 
-Reference parity: NDArray::Save/Load (src/ndarray/ndarray.cc) used by
-mx.nd.save/load and checkpointing. The container here is NumPy ``.npz``
-(self-describing, portable) rather than the reference's dmlc binary stream;
-the API-level semantics (list or str-keyed dict of NDArrays, ``arg:``/
-``aux:`` prefixes for checkpoints) are identical.
+Reference parity: NDArray::Save/Load (src/ndarray/ndarray.cc:1537-1760)
+used by mx.nd.save/load and checkpointing. ``save_ndarray_file`` writes
+the reference's exact dmlc binary stream (list magic 0x112, per-array
+V2 magic 0xF993fac9, int64 TShape, cpu Context, mshadow type flags,
+row_sparse/CSR aux blocks), so ``.params`` files round-trip with real
+MXNet 1.x artifacts in both directions. ``load_ndarray_file`` sniffs the
+container: reference binary (including the V1 0xF993fac8 and pre-V1
+"magic is ndim" legacy layouts, ndarray.cc:1603-1645) or the ``.npz``
+container earlier versions of this package wrote.
 """
 from __future__ import annotations
+
+import struct
 
 import numpy as _np
 
@@ -14,36 +20,276 @@ __all__ = ["save_ndarray_file", "load_ndarray_file", "load_ndarray_bytes"]
 
 _LIST_KEY = "__mx_list_%d"
 
+_NDLIST_MAGIC = 0x112                 # kMXAPINDArrayListMagic
+_ND_V2_MAGIC = 0xF993FAC9             # NDARRAY_V2_MAGIC (storage types)
+_ND_V1_MAGIC = 0xF993FAC8             # NDARRAY_V1_MAGIC (int64 TShape)
 
-def save_ndarray_file(fname, data):
+# mshadow type flags (3rdparty mshadow base.h, used by ndarray.cc Save)
+_FLAG_OF_DTYPE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "int8": 5, "int64": 6}
+_DTYPE_OF_FLAG = {v: k for k, v in _FLAG_OF_DTYPE.items()}
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+def _write_shape(f, shape):
+    f.write(struct.pack("<I", len(shape)))
+    f.write(_np.asarray(shape, dtype="<i8").tobytes())
+
+
+def _write_dense(f, arr):
+    arr = _np.ascontiguousarray(arr)
+    if str(arr.dtype) not in _FLAG_OF_DTYPE:
+        arr = arr.astype("float32")
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", _STYPE_DEFAULT))
+    _write_shape(f, arr.shape)
+    f.write(struct.pack("<ii", 1, 0))          # Context: kCPU(=1), dev 0
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE[str(arr.dtype)]))
+    f.write(arr.tobytes())
+
+
+def _write_row_sparse(f, nd):
+    """nd is an mxnet_tpu row_sparse NDArray (data + int64 indices)."""
+    values = _np.ascontiguousarray(nd.data.asnumpy())
+    idx = _np.ascontiguousarray(nd.indices.asnumpy().astype("int64"))
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", _STYPE_ROW_SPARSE))
+    _write_shape(f, values.shape)              # storage shape
+    _write_shape(f, nd.shape)
+    f.write(struct.pack("<ii", 1, 0))
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE[str(values.dtype)]))
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE["int64"]))   # aux type kIdx
+    _write_shape(f, idx.shape)
+    f.write(values.tobytes())
+    f.write(idx.tobytes())
+
+
+def _write_csr(f, nd):
+    values = _np.ascontiguousarray(nd.data.asnumpy())
+    indptr = _np.ascontiguousarray(nd.indptr.asnumpy().astype("int64"))
+    indices = _np.ascontiguousarray(nd.indices.asnumpy().astype("int64"))
+    f.write(struct.pack("<I", _ND_V2_MAGIC))
+    f.write(struct.pack("<i", _STYPE_CSR))
+    _write_shape(f, values.shape)
+    _write_shape(f, nd.shape)
+    f.write(struct.pack("<ii", 1, 0))
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE[str(values.dtype)]))
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE["int64"]))   # kIndPtr
+    _write_shape(f, indptr.shape)
+    f.write(struct.pack("<i", _FLAG_OF_DTYPE["int64"]))   # kIdx
+    _write_shape(f, indices.shape)
+    f.write(values.tobytes())
+    f.write(indptr.tobytes())
+    f.write(indices.tobytes())
+
+
+def _write_ndarray(f, nd):
+    stype = getattr(nd, "stype", "default")
+    if stype == "row_sparse":
+        _write_row_sparse(f, nd)
+    elif stype == "csr":
+        _write_csr(f, nd)
+    else:
+        _write_dense(f, nd.asnumpy())
+
+
+def save_ndarray_file(fname, data, fmt="mxnet"):
+    """Save NDArray / list / str-keyed dict. ``fmt='mxnet'`` (default)
+    writes the reference dmlc binary; ``fmt='npz'`` the numpy container.
+    Arrays whose dtype has no mshadow flag (bfloat16 — MXNet 1.x
+    predates it) force the npz container so the dtype round-trips
+    exactly instead of being silently cast to float32."""
     from .ndarray.ndarray import NDArray
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        arrays = {_LIST_KEY % i: d.asnumpy() for i, d in enumerate(data)}
+        keys, arrays = [], list(data)
     elif isinstance(data, dict):
-        arrays = {k: v.asnumpy() for k, v in data.items()}
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
     else:
         raise TypeError("save expects NDArray, list, or dict")
+
+    if fmt == "mxnet" and any(
+            getattr(a, "stype", "default") == "default"
+            and str(a.dtype) not in _FLAG_OF_DTYPE for a in arrays):
+        fmt = "npz"
+
+    if fmt == "npz":
+        raw = ({k: v.asnumpy() for k, v in zip(keys, arrays)} if keys
+               else {_LIST_KEY % i: d.asnumpy()
+                     for i, d in enumerate(arrays)})
+        named = {}
+        for k, a in raw.items():
+            if str(a.dtype) == "bfloat16":
+                # npz has no bf16 descr: store the bits, mark the key
+                named["__bf16__" + k] = a.view(_np.uint16)
+            else:
+                named[k] = a
+        with open(fname, "wb") as f:
+            _np.savez(f, **named)
+        return
+
     with open(fname, "wb") as f:
-        _np.savez(f, **arrays)
+        f.write(struct.pack("<QQ", _NDLIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for nd in arrays:
+            _write_ndarray(f, nd)
+        f.write(struct.pack("<Q", len(keys)))
+        for k in keys:
+            kb = k.encode("utf-8")
+            f.write(struct.pack("<Q", len(kb)))
+            f.write(kb)
 
 
-def load_ndarray_file(fname):
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n):
+        if self.pos + n > len(self.buf):
+            raise ValueError("Invalid NDArray file format (truncated)")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def shape64(self):
+        ndim = self.u32()
+        return tuple(_np.frombuffer(self.read(8 * ndim), "<i8").tolist())
+
+    def array(self, shape, flag):
+        dt = _np.dtype(_DTYPE_OF_FLAG[flag])
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return _np.frombuffer(self.read(dt.itemsize * n),
+                              dt).reshape(shape).copy()
+
+
+def _read_ndarray(r):
+    """One NDArray from the stream (reference NDArray::Load incl. both
+    legacy layouts, ndarray.cc:1650/1619). Returns a host numpy array or
+    a ('row_sparse'/'csr', ...) tuple for sparse storage."""
+    magic = r.u32()
+    if magic == _ND_V2_MAGIC:
+        stype = r.i32()
+        sshape = r.shape64() if stype != _STYPE_DEFAULT else None
+        shape = r.shape64()
+        if len(shape) == 0:
+            return _np.zeros((), "float32")
+        r.i32(); r.i32()                       # Context (ignored: host)
+        flag = r.i32()
+        if stype == _STYPE_DEFAULT:
+            return r.array(shape, flag)
+        if stype == _STYPE_ROW_SPARSE:
+            idx_flag = r.i32()
+            idx_shape = r.shape64()
+            values = r.array(sshape, flag)
+            idx = r.array(idx_shape, idx_flag)
+            return ("row_sparse", shape, values, idx)
+        if stype == _STYPE_CSR:
+            indptr_flag = r.i32()
+            indptr_shape = r.shape64()
+            idx_flag = r.i32()
+            idx_shape = r.shape64()
+            values = r.array(sshape, flag)
+            indptr = r.array(indptr_shape, indptr_flag)
+            idx = r.array(idx_shape, idx_flag)
+            return ("csr", shape, values, indptr, idx)
+        raise ValueError("unknown storage type %d" % stype)
+    if magic == _ND_V1_MAGIC:
+        shape = r.shape64()
+    else:
+        # pre-V1: the magic word IS ndim, with uint32 dims following
+        ndim = magic
+        if ndim > 32:
+            raise ValueError("Invalid NDArray file format (bad magic)")
+        shape = tuple(_np.frombuffer(r.read(4 * ndim), "<u4").tolist())
+    if len(shape) == 0:
+        return _np.zeros((), "float32")
+    r.i32(); r.i32()                           # Context
+    flag = r.i32()
+    return r.array(shape, flag)
+
+
+def _load_reference_binary(buf):
     from .ndarray.ndarray import array
-    with _np.load(fname, allow_pickle=False) as npz:
-        keys = list(npz.keys())
+    from .ndarray import sparse as _sp
+    r = _Reader(buf)
+    header, _reserved = r.u64(), r.u64()
+    if header != _NDLIST_MAGIC:
+        raise ValueError("Invalid NDArray file format (bad list magic)")
+    n = r.u64()
+    raw = [_read_ndarray(r) for _ in range(n)]
+    nkeys = r.u64()
+    keys = [r.read(r.u64()).decode("utf-8") for _ in range(nkeys)]
+
+    def wrap(x):
+        if isinstance(x, tuple) and x and x[0] == "row_sparse":
+            _, shape, values, idx = x
+            return _sp.row_sparse_array((values, idx), shape=shape,
+                                        dtype=str(values.dtype))
+        if isinstance(x, tuple) and x and x[0] == "csr":
+            _, shape, values, indptr, idx = x
+            return _sp.csr_matrix((values, idx, indptr), shape=shape,
+                                  dtype=str(values.dtype))
+        return array(x)
+
+    out = [wrap(x) for x in raw]
+    if nkeys == 0:
+        return out
+    if nkeys != n:
+        raise ValueError("Invalid NDArray file format (key count)")
+    return dict(zip(keys, out))
+
+
+def _load_npz(fobj):
+    from .ndarray.ndarray import array
+
+    def _decode(key, a):
+        if key.startswith("__bf16__"):
+            import ml_dtypes
+            return key[len("__bf16__"):], array(a.view(ml_dtypes.bfloat16))
+        return key, array(a)
+
+    with _np.load(fobj, allow_pickle=False) as npz:
+        decoded = dict(_decode(k, npz[k]) for k in npz.keys())
+        keys = list(decoded)
         if keys and all(k.startswith("__mx_list_") for k in keys):
             out = [None] * len(keys)
             for k in keys:
-                out[int(k[len("__mx_list_"):])] = array(npz[k])
+                out[int(k[len("__mx_list_"):])] = decoded[k]
             return out
-        return {k: array(npz[k]) for k in keys}
+        return decoded
+
+
+def load_ndarray_file(fname):
+    if hasattr(fname, "read"):
+        return load_ndarray_bytes(fname.read())
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        f.seek(0)
+        if len(head) >= 8 and struct.unpack("<Q", head)[0] == _NDLIST_MAGIC:
+            return _load_reference_binary(f.read())
+        return _load_npz(f)
 
 
 def load_ndarray_bytes(buf):
     """Load a serialized params blob from memory (the reference C predict
-    API takes the params file as a buffer; same .npz container here,
-    same list/dict semantics as load_ndarray_file)."""
+    API takes the params file as a buffer). Accepts the reference dmlc
+    binary or the npz container."""
     import io as _io
-    return load_ndarray_file(_io.BytesIO(buf))
+    if len(buf) >= 8 and struct.unpack("<Q", buf[:8])[0] == _NDLIST_MAGIC:
+        return _load_reference_binary(buf)
+    return _load_npz(_io.BytesIO(buf))

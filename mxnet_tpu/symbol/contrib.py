@@ -8,10 +8,11 @@ node lowering to ``jax.lax.scan`` / ``lax.cond`` — exactly the
 compiler-friendly control flow XLA wants (no Python loop in the compiled
 step, gradients ride jax's scan/cond rules).
 
-Note: graphs containing control-flow nodes execute and differentiate
-like any other (bind/simple_bind/Module), but ``tojson`` serialization
-of the subgraph node is not supported — matching the reference's 1.2-era
-contrib status where control flow predated stable serialization.
+Graphs containing control-flow nodes execute, differentiate AND
+serialize like any other: ``tojson`` emits the reference's nested
+"subgraphs" field per node plus a ``cf_meta`` rebuild recipe, and
+``load_json`` reconstructs the identical lax.scan/lax.cond lowering
+(_rebuild_cf).
 """
 from __future__ import annotations
 
@@ -59,7 +60,9 @@ def _free_vars(sub, bound_names):
     return [n for n in names if n not in bound_names]
 
 
-def _make_node(opname, fn, n_outputs, input_syms, name_hint):
+def _make_node(opname, fn, n_outputs, input_syms, name_hint, cf_meta=None):
+    from .symbol import AttrScope
+
     opdef = OpDef(opname, fn, num_outputs=n_outputs,
                   num_visible_outputs=n_outputs)
     nm = current_name_manager().get(None, name_hint)
@@ -69,8 +72,121 @@ def _make_node(opname, fn, n_outputs, input_syms, name_hint):
             raise MXNetError("control-flow inputs must be single-output "
                              "symbols")
         entries.append(s._entries[0])
-    node = _Node(opdef, nm, {}, entries)
+    node = _Node(opdef, nm, {}, entries,
+                 str_attrs=AttrScope.current_attrs(), cf_meta=cf_meta)
     return [Symbol([(node, i)]) for i in range(n_outputs)]
+
+
+# ----------------------------------------------------------------------
+# lowering builders — pure functions of (subgraph symbols + meta), so a
+# node loaded from JSON rebuilds the exact same lax.scan/cond program
+# ----------------------------------------------------------------------
+def _foreach_lowering(sub, meta):
+    import jax
+
+    run = _subgraph_eval(sub)
+    data_names = meta["data_names"]
+    state_names = meta["state_names"]
+    params = meta["params"]
+    n_out, n_state = meta["n_out"], meta["n_state"]
+    n_data = len(data_names)
+
+    def fn(*inputs):
+        xs = inputs[:n_data]
+        carry0 = tuple(inputs[n_data:n_data + n_state])
+        pvals = dict(zip(params, inputs[n_data + n_state:]))
+
+        def step(carry, x_slices):
+            env = dict(zip(data_names, x_slices))
+            env.update(zip(state_names, carry))
+            env.update(pvals)
+            vals = run(env)
+            return tuple(vals[n_out:]), tuple(vals[:n_out])
+
+        final, ys = jax.lax.scan(step, carry0, tuple(xs))
+        return tuple(ys) + tuple(final)
+
+    return fn
+
+
+def _while_lowering(sub, meta):
+    import jax
+    import jax.numpy as jnp
+
+    run = _subgraph_eval(sub)
+    var_names = meta["var_names"]
+    params = meta["params"]
+    n_out, n_var = meta["n_out"], meta["n_var"]
+    max_iterations = meta["max_iterations"]
+
+    def fn(*inputs):
+        vars0 = tuple(inputs[:n_var])
+        pvals = dict(zip(params, inputs[n_var:]))
+
+        def body_all(vars_):
+            env = dict(zip(var_names, vars_))
+            env.update(pvals)
+            vals = run(env)
+            pred = jnp.squeeze(vals[0]).astype(bool)
+            return pred, tuple(vals[1:1 + n_out]), tuple(vals[1 + n_out:])
+
+        def step(carry, _):
+            alive, vars_ = carry
+            pred, outs, nvars = body_all(vars_)
+            take = jnp.logical_and(alive, pred)
+            new_vars = tuple(jnp.where(take, nv, v)
+                             for nv, v in zip(nvars, vars_))
+            outs = tuple(jnp.where(take, o, jnp.zeros_like(o))
+                         for o in outs)
+            return (take, new_vars), outs
+
+        (alive, final_vars), ys = jax.lax.scan(
+            step, (jnp.asarray(True), vars0), None, length=max_iterations)
+        return tuple(ys) + tuple(final_vars)
+
+    return fn
+
+
+def _cond_lowering(t_sub, e_sub, meta):
+    import jax
+    import jax.numpy as jnp
+
+    t_run = _subgraph_eval(t_sub)
+    e_run = _subgraph_eval(e_sub)
+    t_params, e_params = meta["t_params"], meta["e_params"]
+    all_params = meta["all_params"]
+
+    def fn(pred_v, *inputs):
+        pvals = dict(zip(all_params, inputs))
+
+        def t_branch(_):
+            return tuple(t_run({n: pvals[n] for n in t_params}))
+
+        def e_branch(_):
+            return tuple(e_run({n: pvals[n] for n in e_params}))
+
+        p = jnp.squeeze(pred_v).astype(bool)
+        return jax.lax.cond(p, t_branch, e_branch, operand=None)
+
+    return fn
+
+
+def _rebuild_cf(opname, meta):
+    """Rebuild (OpDef, n_outputs) for a control-flow node loaded from
+    JSON (symbol._load_graph_dict)."""
+    subs = meta["subgraphs"]
+    if opname == "_foreach":
+        n = meta["n_out"] + meta["n_state"]
+        fn = _foreach_lowering(subs[0], meta)
+    elif opname == "_while_loop":
+        n = meta["n_out"] + meta["n_var"]
+        fn = _while_lowering(subs[0], meta)
+    elif opname == "_cond":
+        n = meta["n_out"]
+        fn = _cond_lowering(subs[0], subs[1], meta)
+    else:
+        raise MXNetError("unknown control-flow op '%s'" % opname)
+    return OpDef(opname, fn, num_outputs=n, num_visible_outputs=n), n
 
 
 def foreach(body, data, init_states, name="foreach"):
@@ -98,27 +214,15 @@ def foreach(body, data, init_states, name="foreach"):
     data_names = [v.name for v in data_vars]
     state_names = [v.name for v in state_vars]
     params = _free_vars(sub, set(data_names + state_names))
-    run = _subgraph_eval(sub)
     n_out, n_state = len(out_syms), len(ostate_syms)
-    n_data = len(datas)
 
-    def fn(*inputs):
-        xs = inputs[:n_data]
-        carry0 = tuple(inputs[n_data:n_data + len(states)])
-        pvals = dict(zip(params, inputs[n_data + len(states):]))
-
-        def step(carry, x_slices):
-            env = dict(zip(data_names, x_slices))
-            env.update(zip(state_names, carry))
-            env.update(pvals)
-            vals = run(env)
-            return tuple(vals[n_out:]), tuple(vals[:n_out])
-
-        final, ys = jax.lax.scan(step, carry0, tuple(xs))
-        return tuple(ys) + tuple(final)
-
+    meta = {"subgraphs": [sub], "data_names": data_names,
+            "state_names": state_names, "params": params,
+            "n_out": n_out, "n_state": n_state}
+    fn = _foreach_lowering(sub, meta)
     out_all = _make_node("_foreach", fn, n_out + n_state,
-                         datas + states + list(map(Variable, params)), name)
+                         datas + states + list(map(Variable, params)), name,
+                         cf_meta=meta)
     outputs = out_all[:n_out]
     fstates = out_all[n_out:]
     return (outputs[0] if single_out else outputs,
@@ -152,36 +256,15 @@ def while_loop(cond, func, loop_vars, max_iterations=None,
                   for e in s._entries])
     var_names = [v.name for v in var_vars]
     params = _free_vars(sub, set(var_names))
-    run = _subgraph_eval(sub)
     n_out, n_var = len(out_syms), len(nvar_syms)
 
-    def fn(*inputs):
-        vars0 = tuple(inputs[:n_var])
-        pvals = dict(zip(params, inputs[n_var:]))
-
-        def body_all(vars_):
-            env = dict(zip(var_names, vars_))
-            env.update(pvals)
-            vals = run(env)
-            pred = jnp.squeeze(vals[0]).astype(bool)
-            return pred, tuple(vals[1:1 + n_out]), tuple(vals[1 + n_out:])
-
-        def step(carry, _):
-            alive, vars_ = carry
-            pred, outs, nvars = body_all(vars_)
-            take = jnp.logical_and(alive, pred)
-            new_vars = tuple(jnp.where(take, nv, v)
-                             for nv, v in zip(nvars, vars_))
-            outs = tuple(jnp.where(take, o, jnp.zeros_like(o))
-                         for o in outs)
-            return (take, new_vars), outs
-
-        (alive, final_vars), ys = jax.lax.scan(
-            step, (jnp.asarray(True), vars0), None, length=max_iterations)
-        return tuple(ys) + tuple(final_vars)
-
+    meta = {"subgraphs": [sub], "var_names": var_names, "params": params,
+            "n_out": n_out, "n_var": n_var,
+            "max_iterations": int(max_iterations)}
+    fn = _while_lowering(sub, meta)
     out_all = _make_node("_while_loop", fn, n_out + n_var,
-                         lvars + list(map(Variable, params)), name)
+                         lvars + list(map(Variable, params)), name,
+                         cf_meta=meta)
     outputs = out_all[:n_out]
     fvars = out_all[n_out:]
     return (outputs[0] if single_out and outputs else outputs,
@@ -209,23 +292,14 @@ def cond(pred, then_func, else_func, name="cond"):
     t_params = _free_vars(t_sub, set())
     e_params = _free_vars(e_sub, set())
     all_params = list(dict.fromkeys(t_params + e_params))
-    t_run = _subgraph_eval(t_sub)
-    e_run = _subgraph_eval(e_sub)
 
-    def fn(pred_v, *inputs):
-        pvals = dict(zip(all_params, inputs))
-
-        def t_branch(_):
-            return tuple(t_run({n: pvals[n] for n in t_params}))
-
-        def e_branch(_):
-            return tuple(e_run({n: pvals[n] for n in e_params}))
-
-        p = jnp.squeeze(pred_v).astype(bool)
-        return jax.lax.cond(p, t_branch, e_branch, operand=None)
-
+    meta = {"subgraphs": [t_sub, e_sub], "t_params": t_params,
+            "e_params": e_params, "all_params": all_params,
+            "n_out": n_out}
+    fn = _cond_lowering(t_sub, e_sub, meta)
     out_all = _make_node("_cond", fn, n_out,
-                         [pred] + list(map(Variable, all_params)), name)
+                         [pred] + list(map(Variable, all_params)), name,
+                         cf_meta=meta)
     return out_all[0] if single else out_all
 
 

@@ -50,15 +50,21 @@ class AttrScope:
 
 
 class _Node:
-    __slots__ = ("op", "name", "attrs", "str_attrs", "inputs")
+    __slots__ = ("op", "name", "attrs", "str_attrs", "inputs", "cf_meta")
     _uid = [0]
 
-    def __init__(self, op, name, attrs, inputs, str_attrs=None):
+    def __init__(self, op, name, attrs, inputs, str_attrs=None,
+                 cf_meta=None):
         self.op = op            # OpDef or None for variables
         self.name = name
         self.attrs = attrs      # typed op attrs
         self.str_attrs = dict(str_attrs or {})  # user attrs (ctx_group, __shape__…)
         self.inputs = inputs    # list[(Node, out_idx)]
+        # control-flow metadata: {"kind", "subgraphs": [Symbol, ...],
+        # **json-able fields} — lets foreach/while_loop/cond nodes
+        # serialize (tojson emits the reference's nested "subgraphs"
+        # field; load_json rebuilds the lax.scan/cond lowering)
+        self.cf_meta = cf_meta
 
     @property
     def is_var(self):
@@ -389,6 +395,15 @@ class Symbol:
             jn = {"op": "null" if n.is_var else n.op.name,
                   "name": n.name,
                   "inputs": [[nid[id(inp)], oi, 0] for inp, oi in n.inputs]}
+            if n.cf_meta is not None:
+                # control-flow node: nested graphs ride the reference's
+                # "subgraphs" field; the rebuild recipe rides one JSON
+                # attr (merged with user attrs like ctx_group)
+                meta = dict(n.cf_meta)
+                subs = meta.pop("subgraphs")
+                jn["subgraphs"] = [json.loads(s.tojson()) for s in subs]
+                attrs = dict(n.str_attrs)
+                attrs["cf_meta"] = json.dumps(meta)
             if attrs:
                 jn["attrs"] = attrs
             jnodes.append(jn)
@@ -564,14 +579,37 @@ def load(fname):
 
 
 def load_json(json_str):
-    data = json.loads(json_str)
+    """Parse a graph JSON, including every legacy layout the reference
+    upgrades in src/nnvm/legacy_json_util.cc:43 (UpgradeJSON_*): op
+    params under "param" (pre-0.9), user attrs under "attr" (0.9-1.1),
+    and the merged "attrs" dict (1.2+) whose values are MXNet-style
+    strings like "(3, 3)" / "True" (coerced per-op by
+    OpDef.normalize_attrs)."""
+    return _load_graph_dict(json.loads(json_str))
+
+
+def _load_graph_dict(data):
     jnodes = data["nodes"]
     nodes = []
     for jn in jnodes:
-        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        attrs = dict(jn.get("param", {}) or {})
+        attrs.update(jn.get("attr", {}) or {})
+        attrs.update(jn.get("attrs", {}) or {})
         inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
         if jn["op"] == "null":
             nodes.append(_Node(None, jn["name"], {}, [], attrs))
+        elif jn.get("subgraphs"):
+            # control-flow node: rebuild the lax lowering from the
+            # nested graphs + the cf_meta recipe (contrib._rebuild_cf);
+            # user attrs (ctx_group, ...) pass through
+            from . import contrib as _cf
+            subs = [_load_graph_dict(g) for g in jn["subgraphs"]]
+            meta = json.loads(attrs["cf_meta"])
+            meta["subgraphs"] = subs
+            opdef, n_out = _cf._rebuild_cf(jn["op"], meta)
+            user = {k: v for k, v in attrs.items() if k != "cf_meta"}
+            nodes.append(_Node(opdef, jn["name"], {}, inputs,
+                               str_attrs=user, cf_meta=meta))
         else:
             opdef = _reg.get_op(jn["op"])
             typed = opdef.normalize_attrs(

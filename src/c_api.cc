@@ -1,0 +1,182 @@
+// C NDArray + imperative API over the embedded CPython runtime.
+//
+// Reference parity: the NDArray/imperative slice of src/c_api/c_api.cc
+// (MXNDArrayCreateEx, MXNDArraySyncCopyFromCPU/ToCPU,
+// MXImperativeInvokeEx — include/mxnet/c_api.h:529,887). Handles are
+// PyObject* of mxnet_tpu NDArrays; the Python half lives in
+// mxnet_tpu/_c_api_impl.py. Shares interpreter init, GIL helpers and
+// error reporting with c_predict_api.cc (compiled into the same .so).
+#include "../include/mxnet_tpu/c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// helpers defined in c_predict_api.cc (same shared library)
+namespace mxtpu_capi {
+void SetError(const std::string &msg);
+void SetPyError(const char *what);
+bool EnsurePython();
+PyObject *ImportAttr(const char *module, const char *attr);
+}  // namespace mxtpu_capi
+
+namespace {
+
+using mxtpu_capi::EnsurePython;
+using mxtpu_capi::ImportAttr;
+using mxtpu_capi::SetError;
+using mxtpu_capi::SetPyError;
+
+struct GILGuard {
+  PyGILState_STATE state;
+  GILGuard() { state = PyGILState_Ensure(); }
+  ~GILGuard() { PyGILState_Release(state); }
+};
+
+// per-handle cached shape buffer for MXNDArrayGetShape
+std::unordered_map<void *, std::vector<mx_uint>> *ShapeCache() {
+  static auto *cache = new std::unordered_map<void *, std::vector<mx_uint>>();
+  return cache;
+}
+
+PyObject *CallImpl(const char *fn_name, PyObject *args) {
+  PyObject *fn = ImportAttr("mxnet_tpu._c_api_impl", fn_name);
+  if (fn == nullptr) {
+    Py_XDECREF(args);
+    SetPyError("mxnet_tpu._c_api_impl import failed");
+    return nullptr;
+  }
+  PyObject *out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (out == nullptr) SetPyError(fn_name);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject *nd = CallImpl("create_ndarray", Py_BuildValue("(O)", shp));
+  Py_DECREF(shp);
+  if (nd == nullptr) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  GILGuard gil;
+  ShapeCache()->erase(handle);
+  Py_DECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const mx_float *data,
+                             size_t size) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      static_cast<Py_ssize_t>(size * sizeof(mx_float)), PyBUF_READ);
+  PyObject *r = CallImpl("copy_from",
+                         Py_BuildValue("(ON)", handle, mem));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, mx_float *data,
+                           size_t size) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *arr = CallImpl("copy_to", Py_BuildValue("(O)", handle));
+  if (arr == nullptr) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+    Py_DECREF(arr);
+    SetPyError("SyncCopyToCPU buffer");
+    return -1;
+  }
+  size_t nbytes = size * sizeof(mx_float);
+  if (static_cast<size_t>(view.len) != nbytes) {
+    PyBuffer_Release(&view);
+    Py_DECREF(arr);
+    SetError("SyncCopyToCPU: size mismatch");
+    return -1;
+  }
+  std::memcpy(data, view.buf, nbytes);
+  PyBuffer_Release(&view);
+  Py_DECREF(arr);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_shape) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *shp = CallImpl("get_shape", Py_BuildValue("(O)", handle));
+  if (shp == nullptr) return -1;
+  std::vector<mx_uint> dims;
+  Py_ssize_t n = PyList_Size(shp);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    dims.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyList_GetItem(shp, i))));
+  }
+  Py_DECREF(shp);
+  auto &slot = (*ShapeCache())[handle];
+  slot = std::move(dims);
+  *out_ndim = static_cast<mx_uint>(slot.size());
+  *out_shape = slot.data();
+  return 0;
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle *outputs, int num_params,
+                       const char **keys, const char **vals) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<PyObject *>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject *pkeys = PyList_New(num_params);
+  PyObject *pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *res = CallImpl(
+      "imperative_invoke",
+      Py_BuildValue("(sNNN)", op_name, ins, pkeys, pvals));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  if (n > *num_outputs) {
+    Py_DECREF(res);
+    SetError("MXImperativeInvoke: output capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

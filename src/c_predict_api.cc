@@ -231,6 +231,24 @@ int CreateImpl(const char *symbol_json_str, const void *param_bytes,
 
 }  // namespace
 
+// Shared runtime helpers for the sibling c_api.cc translation unit
+// (same .so): interpreter init, error reporting, module lookup.
+namespace mxtpu_capi {
+void SetError(const std::string &msg) { ::SetError(msg); }
+void SetPyError(const char *what) { ::SetPyError(what); }
+bool EnsurePython() {
+  ::InitPython();
+  if (!g_init_ok) {
+    ::SetError("embedded Python initialization failed");
+    return false;
+  }
+  return true;
+}
+PyObject *ImportAttr(const char *module, const char *attr) {
+  return ::ImportAttr(module, attr);
+}
+}  // namespace mxtpu_capi
+
 extern "C" {
 
 const char *MXGetLastError() { return g_last_error.c_str(); }

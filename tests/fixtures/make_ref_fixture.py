@@ -1,0 +1,143 @@
+"""Generate reference-format checkpoint fixtures BY HAND.
+
+Packs the exact byte layout of MXNet 1.x artifacts independently of
+mxnet_tpu.serialization (so tests/test_interop.py cross-checks two
+implementations of the format rather than round-tripping one):
+
+* ``ref_convnet-symbol.json``   — graph JSON in the 1.2 on-disk style:
+  all attr values are strings ("(3, 3)", "True"), nodes carry the
+  legacy "attr" key (upgraded by the reference's
+  src/nnvm/legacy_json_util.cc:43), plus node_row_ptr/heads/attrs
+  metadata exactly as nnvm::pass::SaveJSON emits.
+* ``ref_convnet-0001.params``   — dmlc binary NDArray list
+  (src/ndarray/ndarray.cc:1733 kMXAPINDArrayListMagic 0x112; per-array
+  NDARRAY_V2_MAGIC layout from ndarray.cc:1537).
+* ``ref_legacy.params``         — the same container holding arrays in
+  the two LEGACY per-array layouts the reference still loads
+  (ndarray.cc:1603-1645): V1 magic 0xF993fac8 with int64 shape, and
+  pre-V1 where the magic word is ndim with uint32 dims.
+
+Run from the repo root:  python tests/fixtures/make_ref_fixture.py
+"""
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+ND_V2 = 0xF993FAC9
+ND_V1 = 0xF993FAC8
+LIST_MAGIC = 0x112
+
+
+def shape64(shape):
+    return struct.pack("<I", len(shape)) + \
+        np.asarray(shape, "<i8").tobytes()
+
+
+def nd_v2(arr):
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    return (struct.pack("<I", ND_V2) + struct.pack("<i", 0)   # dense
+            + shape64(arr.shape)
+            + struct.pack("<ii", 1, 0)                        # cpu:0
+            + struct.pack("<i", 0)                            # float32
+            + arr.tobytes())
+
+
+def nd_v1(arr):
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    return (struct.pack("<I", ND_V1) + shape64(arr.shape)
+            + struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+            + arr.tobytes())
+
+
+def nd_pre_v1(arr):
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    return (struct.pack("<I", len(arr.shape))                 # magic = ndim
+            + np.asarray(arr.shape, "<u4").tobytes()
+            + struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+            + arr.tobytes())
+
+
+def nd_list(named, packer=nd_v2):
+    out = struct.pack("<QQ", LIST_MAGIC, 0)
+    out += struct.pack("<Q", len(named))
+    for _, arr in named:
+        out += packer(arr)
+    out += struct.pack("<Q", len(named))
+    for key, _ in named:
+        kb = key.encode()
+        out += struct.pack("<Q", len(kb)) + kb
+    return out
+
+
+def make_symbol_json():
+    """ConvNet in the reference on-disk JSON style. Node 4 (pooling) uses
+    the legacy "attr" key; the rest use 1.2's "attrs"."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "conv0_weight", "inputs": []},
+        {"op": "null", "name": "conv0_bias", "inputs": []},
+        {"op": "Convolution", "name": "conv0",
+         "attrs": {"kernel": "(3, 3)", "num_filter": "8", "stride": "(1, 1)",
+                   "pad": "(1, 1)", "no_bias": "False"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "relu0",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "Pooling", "name": "pool0",
+         "attr": {"kernel": "(2, 2)", "pool_type": "max",
+                  "stride": "(2, 2)"},
+         "inputs": [[4, 0, 0]]},
+        {"op": "Flatten", "name": "flatten0", "inputs": [[5, 0, 0]]},
+        {"op": "null", "name": "fc0_weight", "inputs": []},
+        {"op": "null", "name": "fc0_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc0",
+         "attrs": {"num_hidden": "10", "no_bias": "False"},
+         "inputs": [[6, 0, 0], [7, 0, 0], [8, 0, 0]]},
+        {"op": "null", "name": "softmax_label", "inputs": []},
+        {"op": "SoftmaxOutput", "name": "softmax",
+         "inputs": [[9, 0, 0], [10, 0, 0]]},
+    ]
+    return json.dumps({
+        "nodes": nodes,
+        "arg_nodes": [0, 1, 2, 7, 8, 10],
+        "node_row_ptr": list(range(len(nodes) + 1)),
+        "heads": [[11, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10200]},
+    }, indent=2)
+
+
+def main():
+    rng = np.random.RandomState(42)
+    params = [
+        ("arg:conv0_weight", rng.randn(8, 1, 3, 3).astype("float32") * 0.2),
+        ("arg:conv0_bias", rng.randn(8).astype("float32") * 0.1),
+        ("arg:fc0_weight", rng.randn(10, 8 * 8 * 8).astype("float32") * 0.05),
+        ("arg:fc0_bias", rng.randn(10).astype("float32") * 0.1),
+    ]
+    with open(os.path.join(HERE, "ref_convnet-symbol.json"), "w") as f:
+        f.write(make_symbol_json())
+    with open(os.path.join(HERE, "ref_convnet-0001.params"), "wb") as f:
+        f.write(nd_list(params))
+    # legacy per-array layouts in one list file
+    legacy = [("v1_arr", rng.randn(3, 4).astype("float32")),
+              ("pre_v1_arr", rng.randn(2, 5).astype("float32"))]
+    buf = struct.pack("<QQ", LIST_MAGIC, 0)
+    buf += struct.pack("<Q", 2)
+    buf += nd_v1(legacy[0][1])
+    buf += nd_pre_v1(legacy[1][1])
+    buf += struct.pack("<Q", 2)
+    for key, _ in legacy:
+        kb = key.encode()
+        buf += struct.pack("<Q", len(kb)) + kb
+    with open(os.path.join(HERE, "ref_legacy.params"), "wb") as f:
+        f.write(buf)
+    np.save(os.path.join(HERE, "ref_legacy_expected.npy"),
+            {k: v for k, v in legacy}, allow_pickle=True)
+    print("fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

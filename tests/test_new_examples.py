@@ -59,3 +59,23 @@ def test_fgsm_example():
     clean = float(out.split("clean accuracy:")[1].splitlines()[0])
     adv = float(out.split("accuracy:")[-1])
     assert clean > 0.95 and adv < clean
+
+
+def test_faster_rcnn_end_to_end():
+    """The rcnn op family composes: Proposal NMS + ROIPooling inside a
+    trained graph (VERDICT r2 item 10)."""
+    out = _run_example("example/rcnn/train_faster_rcnn.py",
+                       "--num-iter", "25", "--batch-size", "4",
+                       timeout=600)
+    assert "faster-rcnn end-to-end example OK" in out
+
+
+def test_matrix_factorization_group2ctx_mode():
+    """The reference's per-group placement contract end-to-end."""
+    out = _run_example("example/model-parallel/matrix_factorization.py",
+                       "--mode", "group2ctx", "--num-devices", "2",
+                       "--num-epoch", "4", "--num-samples", "2048",
+                       "--batch-size", "128")
+    assert "group2ctx mode: final mse" in out
+    mse = float(out.split("group2ctx mode: final mse")[1].split()[0])
+    assert mse < 0.5, out

@@ -1,0 +1,148 @@
+"""ONNX translation round-trips (VERDICT r2 item 4).
+
+Reference: python/mxnet/contrib/onnx/ (mx2onnx/export_model.py:1,
+onnx2mx/import_model.py:1). Uses the vendored minimal ONNX protobuf —
+tests check (a) the emitted file is structurally valid ONNX (magic
+fields, opset, graph topology), (b) export -> import -> forward equals
+the original forward for mlp and resnet-18, (c) golden-file stability
+for the Conv/BN/FC/Pool/Activation subset.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import onnx as onnx_mx
+from mxnet_tpu.contrib.onnx import onnx_pb2 as O
+
+
+def _init_params(symb, shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = symb.infer_shape(**shapes)
+    args = {}
+    for name, shp in zip(symb.list_arguments(), arg_shapes):
+        if name in shapes or name.endswith("_label"):
+            continue
+        args[name] = nd.array(rng.randn(*shp).astype("float32") * 0.1)
+    auxs = {}
+    for name, shp in zip(symb.list_auxiliary_states(), aux_shapes):
+        if name.endswith("_mean"):
+            auxs[name] = nd.zeros(shp)
+        else:
+            auxs[name] = nd.ones(shp)
+    return args, auxs
+
+
+def _forward(symb, args, auxs, feeds):
+    ex = symb.bind(mx.cpu(), {**args, **feeds}, aux_states=dict(auxs))
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _mlp():
+    x = sym.Variable("data")
+    h = sym.FullyConnected(x, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_export_structure(tmp_path):
+    symb = _mlp()
+    shapes = {"data": (2, 8)}
+    args, auxs = _init_params(symb, shapes)
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mx.export_model(symb, args, shapes, onnx_file_path=path)
+    model = O.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    assert model.ir_version == 7
+    assert model.opset_import[0].version == 13
+    ops = [n.op_type for n in model.graph.node]
+    assert "Gemm" in ops and "Relu" in ops and "Softmax" in ops
+    names = {i.name for i in model.graph.initializer}
+    assert names == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    assert model.graph.input[0].name == "data"
+    dims = [d.dim_value
+            for d in model.graph.input[0].type.tensor_type.shape.dim]
+    assert dims == [2, 8]
+
+
+def test_mlp_roundtrip(tmp_path):
+    symb = _mlp()
+    shapes = {"data": (4, 8)}
+    args, auxs = _init_params(symb, shapes)
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.rand(4, 8).astype("float32"))
+    want = _forward(symb, args, auxs,
+                    {"data": x, "softmax_label": nd.zeros(4)})
+
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mx.export_model(symb, args, shapes, onnx_file_path=path)
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    got = _forward(sym2, args2, auxs2, {"data": x})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_resnet18_roundtrip(tmp_path):
+    from mxnet_tpu import models
+    symb = models.get_symbol("resnet", num_classes=10, num_layers=18,
+                             image_shape=(3, 32, 32))
+    shapes = {"data": (2, 3, 32, 32)}
+    args, auxs = _init_params(symb, shapes, seed=3)
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.rand(2, 3, 32, 32).astype("float32"))
+    want = _forward(symb, args, auxs,
+                    {"data": x, "softmax_label": nd.zeros(2)})
+
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mx.export_model(symb, {**args, **auxs}, shapes,
+                         onnx_file_path=path)
+    meta = onnx_mx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 3, 32, 32))]
+    sym2, args2, auxs2 = onnx_mx.import_model(path)
+    got = _forward(sym2, args2, auxs2, {"data": x})
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_golden_file(tmp_path):
+    """Conv/BN/FC/Pool/Activation subset: the serialized graph topology
+    is stable (golden check on ops + initializer names + attrs)."""
+    x = sym.Variable("data")
+    h = sym.Convolution(x, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                        name="conv0")
+    h = sym.BatchNorm(h, name="bn0", fix_gamma=False)
+    h = sym.Activation(h, act_type="relu", name="relu0")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    h = sym.FullyConnected(h, num_hidden=3, name="fc0")
+    symb = sym.SoftmaxOutput(h, name="softmax")
+    shapes = {"data": (1, 2, 8, 8)}
+    args, auxs = _init_params(symb, shapes)
+    path = str(tmp_path / "golden.onnx")
+    onnx_mx.export_model(symb, {**args, **auxs}, shapes,
+                         onnx_file_path=path)
+    model = O.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    got = [(n.op_type, tuple(n.input), tuple(n.output))
+           for n in model.graph.node]
+    ops = [g[0] for g in got]
+    assert ops == ["Conv", "BatchNormalization", "Relu", "MaxPool",
+                   "Flatten", "Gemm", "Softmax"]
+    conv = model.graph.node[0]
+    at = {a.name: a for a in conv.attribute}
+    assert list(at["kernel_shape"].ints) == [3, 3]
+    assert list(at["pads"].ints) == [1, 1, 1, 1]
+    bn_ins = tuple(model.graph.node[1].input)
+    assert bn_ins[1:] == ("bn0_gamma", "bn0_beta", "bn0_moving_mean",
+                          "bn0_moving_var")
+
+
+def test_unsupported_op_raises(tmp_path):
+    x = sym.Variable("data")
+    h = sym.LRN(x, nsize=3, name="lrn0")
+    with pytest.raises(mx.MXNetError, match="no converter"):
+        onnx_mx.export_model(h, {}, {"data": (1, 4, 8, 8)},
+                             onnx_file_path=str(tmp_path / "x.onnx"))

