@@ -38,6 +38,16 @@ _PEAK_TFLOPS = [
 ]
 
 
+def _check_sane(achieved, peak):
+    """Refuse to report throughput above the chip's physical peak — a
+    wedged tunnel/OOM can make the timing loop "complete" instantly."""
+    if achieved and peak and achieved > peak:
+        raise SystemExit(
+            "bench: achieved %.1f TFLOP/s exceeds the %.0f TF peak — "
+            "the timing loop did not actually execute (tunnel/OOM "
+            "failure); refusing to report garbage" % (achieved, peak))
+
+
 def _peak_tflops(device_kind):
     kind = device_kind.lower()
     for key, peak in _PEAK_TFLOPS:
@@ -114,6 +124,14 @@ def _timed_steps(ts, next_batch, warmup, iters, flops_probe=None):
         ts.step(next_batch(i))
     jax.block_until_ready(ts.params)
     dt = time.perf_counter() - t0
+
+    # liveness guard: force a real readback; a wedged tunnel/OOM can
+    # otherwise report instant "completion" and absurd throughput
+    import jax.numpy as jnp
+    probe_w = float(jnp.asarray(
+        next(iter(ts.params.values())).ravel()[0]))
+    if not np.isfinite(probe_w):
+        raise SystemExit("bench: non-finite weights after timing loop")
     return dt, flops_per_step
 
 
@@ -212,6 +230,7 @@ def bench_resnet(args):
     peak = _peak_tflops(dev.device_kind)
     achieved = (flops_per_step * args.iters / dt / 1e12
                 if flops_per_step else None)
+    _check_sane(achieved, peak)
     return {
         "metric": ("resnet50_train_img_per_sec_pipeline" if args.pipeline
                    else "resnet50_train_img_per_sec"),
@@ -270,6 +289,7 @@ def bench_transformer(args):
     peak = _peak_tflops(dev.device_kind)
     achieved = (flops_per_step * args.iters / dt / 1e12
                 if flops_per_step else None)
+    _check_sane(achieved, peak)
     return {
         "metric": "transformer_lm_tokens_per_sec",
         "value": round(tok_per_sec, 1),

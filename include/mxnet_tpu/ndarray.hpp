@@ -1,0 +1,144 @@
+/*
+ * Header-only C++ NDArray + imperative-op wrapper over the C API — the
+ * cpp-package training analog (reference
+ * cpp-package/include/mxnet-cpp/ndarray.h:1 and operator.h wrap
+ * MXNDArray* / MXImperativeInvokeEx exactly this way). Link against
+ * libmxtpu_predict.so.
+ *
+ *   using mxnet_tpu::cpp::NDArray;
+ *   NDArray x({64, 8});                 // zero-filled float32
+ *   x.SyncCopyFromCPU(host_data);
+ *   auto h = NDArray::Invoke("FullyConnected", {x, w, b},
+ *                            {{"num_hidden", "16"}});
+ *   auto relu = NDArray::Invoke("Activation", {h[0]},
+ *                               {{"act_type", "relu"}});
+ *   std::vector<float> out = relu[0].CopyToVector();
+ *
+ * See tests/cpp_train_demo.cc for a full training loop (forward,
+ * manual backprop, sgd_update) in C++.
+ */
+#ifndef MXNET_TPU_NDARRAY_HPP_
+#define MXNET_TPU_NDARRAY_HPP_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  /* Zero-filled float32 array of the given shape. */
+  explicit NDArray(const std::vector<mx_uint> &shape) {
+    NDArrayHandle h = nullptr;
+    if (MXNDArrayCreate(shape.data(),
+                        static_cast<mx_uint>(shape.size()), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    reset(h);
+  }
+
+  NDArray(const std::vector<mx_uint> &shape,
+          const std::vector<mx_float> &data)
+      : NDArray(shape) {
+    SyncCopyFromCPU(data);
+  }
+
+  /* Adopt an existing handle (takes ownership). */
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  bool IsNone() const { return handle_ == nullptr; }
+  NDArrayHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+  void SyncCopyFromCPU(const std::vector<mx_float> &data) {
+    if (MXNDArraySyncCopyFromCPU(handle(), data.data(), data.size())
+        != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  std::vector<mx_float> CopyToVector() const {
+    size_t n = Size();
+    std::vector<mx_float> out(n);
+    if (MXNDArraySyncCopyToCPU(handle(), out.data(), n) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return out;
+  }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *dims = nullptr;
+    if (MXNDArrayGetShape(handle(), &ndim, &dims) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  /* Imperative operator invocation (reference mxnet-cpp Operator::
+   * Invoke). Attribute values use MXNet string syntax. */
+  static std::vector<NDArray> Invoke(
+      const std::string &op,
+      const std::vector<NDArray> &inputs,
+      const std::map<std::string, std::string> &attrs = {},
+      int max_outputs = 8) {
+    std::vector<NDArrayHandle> in;
+    for (const auto &a : inputs) in.push_back(a.handle());
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : attrs) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    std::vector<NDArrayHandle> out(max_outputs, nullptr);
+    int n_out = max_outputs;
+    if (MXImperativeInvoke(op.c_str(), static_cast<int>(in.size()),
+                           in.data(), &n_out, out.data(),
+                           static_cast<int>(keys.size()), keys.data(),
+                           vals.data()) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    std::vector<NDArray> res;
+    for (int i = 0; i < n_out; ++i) res.push_back(FromHandle(out[i]));
+    return res;
+  }
+
+ private:
+  /* shared_ptr owner so NDArray copies share the handle like the
+   * reference cpp-package's NDArray (blob semantics) */
+  struct Owner {
+    NDArrayHandle h;
+    explicit Owner(NDArrayHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h != nullptr) MXNDArrayFree(h);
+    }
+  };
+
+  void reset(NDArrayHandle h) {
+    handle_ = std::shared_ptr<Owner>(new Owner(h));
+  }
+
+  std::shared_ptr<Owner> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_NDARRAY_HPP_

@@ -31,3 +31,21 @@ def test_c_train_demo_runs_and_converges(demo_exe):
     assert "c_train_demo OK" in r.stdout
     # the demo prints first/final loss; pin the 10x drop it asserts
     assert "first loss" in r.stdout
+
+
+@pytest.fixture(scope="module")
+def cpp_demo_exe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cpp_train")
+    return compile_against_predict_lib(
+        [os.path.join(ROOT, "tests", "cpp_train_demo.cc")],
+        str(tmp / "cpp_train_demo"), lang="cpp")
+
+
+def test_cpp_train_demo_runs_and_converges(cpp_demo_exe):
+    """The header-only C++ NDArray wrapper (include/mxnet_tpu/
+    ndarray.hpp — reference cpp-package/include/mxnet-cpp/ndarray.h:1)
+    trains the same MLP in idiomatic C++."""
+    r = subprocess.run([cpp_demo_exe], capture_output=True, text=True,
+                      env=predict_subprocess_env(), timeout=600)
+    assert r.returncode == 0, "stdout:%s\nstderr:%s" % (r.stdout, r.stderr)
+    assert "cpp_train_demo OK" in r.stdout

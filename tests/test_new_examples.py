@@ -79,3 +79,27 @@ def test_matrix_factorization_group2ctx_mode():
     assert "group2ctx mode: final mse" in out
     mse = float(out.split("group2ctx mode: final mse")[1].split()[0])
     assert mse < 0.5, out
+
+
+def test_dcgan_example():
+    """Adversarial module-pair training (reference example/gan/dcgan.py
+    flow: modG fwd -> modD fwd/bwd on fake+real -> modG bwd with modD's
+    input grad)."""
+    out = _run_example("example/gan/dcgan.py", "--num-iter", "80",
+                       timeout=600)
+    assert "dcgan example OK" in out
+
+
+def test_text_cnn_example():
+    """Kim-2014 text CNN (reference example/cnn_text_classification/)."""
+    out = _run_example("example/cnn_text_classification/text_cnn.py",
+                       "--num-epoch", "5", timeout=600)
+    assert "text-cnn example OK" in out
+
+
+def test_custom_softmax_example():
+    """Pure-numpy CustomOp inside a trained graph (reference
+    example/numpy-ops/custom_softmax.py)."""
+    out = _run_example("example/numpy-ops/custom_softmax.py",
+                       "--num-epoch", "6", timeout=600)
+    assert "custom_softmax example OK" in out
