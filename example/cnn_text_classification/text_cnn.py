@@ -65,6 +65,10 @@ def main():
 
     import mxnet_tpu as mx
 
+    # deterministic init: Module's host-side initializer draws from the
+    # global numpy RNG
+    np.random.seed(11)
+    mx.random.seed(11)
     rng = np.random.RandomState(0)
     X, y = synthetic_corpus(rng, 1024, args.seq_len, args.vocab)
     Xv, yv = synthetic_corpus(rng, 256, args.seq_len, args.vocab)

@@ -77,6 +77,11 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import nd
 
+    # deterministic init: Module's host-side initializer draws from the
+    # global numpy RNG
+    np.random.seed(11)
+    mx.random.seed(11)
+
     B = args.batch_size
     gen = make_generator(code_dim=args.code_dim)
     dis = make_discriminator()

@@ -8,10 +8,12 @@ ICI/DCN with XLA collectives instead of NCCL/ZMQ.
 from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
                    replicated_sharding, shard_batch, current_mesh)
 from .trainer import TrainStep, default_tp_rule
+from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import (attention_reference, ring_attention,
                              ulysses_attention)
 
 __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "current_mesh",
            "TrainStep", "default_tp_rule", "attention_reference",
-           "ring_attention", "ulysses_attention"]
+           "ring_attention", "ulysses_attention",
+           "pipeline_apply", "stack_stage_params"]

@@ -1,0 +1,153 @@
+"""GPipe-style pipeline parallelism over a pp mesh axis
+(parallel/pipeline.py — new TPU-native capability; the reference has
+none, SURVEY.md §2.3). Validated on the virtual CPU mesh like the rest
+of the multi-chip suite: forward equals the sequential stack, gradients
+ride the ppermutes, training descends, and it composes with dp."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from mxnet_tpu.parallel import pipeline_apply, stack_stage_params
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >=4 virtual devices")
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_params(rng, n_stages, d):
+    return [{"w": jnp.asarray(rng.randn(d, d).astype("float32") * 0.4),
+             "b": jnp.asarray(rng.randn(d).astype("float32") * 0.1)}
+            for _ in range(n_stages)]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_pipeline_forward_matches_sequential():
+    S, d, B, M = 4, 8, 16, 4
+    rng = np.random.RandomState(0)
+    stages = _make_params(rng, S, d)
+    x = jnp.asarray(rng.randn(B, d).astype("float32"))
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    out = pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                         n_microbatches=M)
+    want = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("M", [1, 2, 8])
+def test_pipeline_microbatch_counts(M):
+    S, d, B = 2, 4, 8
+    rng = np.random.RandomState(1)
+    stages = _make_params(rng, S, d)
+    x = jnp.asarray(rng.randn(B, d).astype("float32"))
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    out = pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                         n_microbatches=M)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_sequential(stages, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grads_match_sequential():
+    S, d, B, M = 4, 6, 12, 3
+    rng = np.random.RandomState(2)
+    stages = _make_params(rng, S, d)
+    x = jnp.asarray(rng.randn(B, d).astype("float32"))
+    y = jnp.asarray(rng.randn(B, d).astype("float32"))
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(stages)
+
+    def loss_pp(sp):
+        out = pipeline_apply(_stage_fn, sp, x, mesh, n_microbatches=M)
+        return jnp.mean((out - y) ** 2)
+
+    def loss_seq(stage_list):
+        return jnp.mean((_sequential(stage_list, x) - y) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(stages)
+    g_seq_stacked = stack_stage_params(g_seq)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                   np.asarray(g_seq_stacked[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_training_descends():
+    S, d, B, M = 4, 6, 24, 6
+    rng = np.random.RandomState(3)
+    stages = _make_params(rng, S, d)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    params = stack_stage_params(stages)
+    x = jnp.asarray(rng.randn(B, d).astype("float32"))
+    y = jnp.asarray((rng.randn(B, d) * 0.3).astype("float32"))
+
+    @jax.jit
+    def step(p):
+        def loss(p):
+            out = pipeline_apply(_stage_fn, p, x, mesh, n_microbatches=M)
+            return jnp.mean((out - y) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        return l, jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+    l0, params = step(params)
+    for _ in range(40):
+        l1, params = step(params)
+    assert float(l1) < float(l0) * 0.6, (float(l0), float(l1))
+
+
+def test_pipeline_composes_with_dp():
+    S, d, B, M = 2, 4, 16, 2
+    rng = np.random.RandomState(4)
+    stages = _make_params(rng, S, d)
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "pp"))
+    x = jnp.asarray(rng.randn(B, d).astype("float32"))
+    out = pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                         n_microbatches=M, batch_axis="dp")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_sequential(stages, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_validation_errors():
+    S, d = 2, 4
+    rng = np.random.RandomState(5)
+    stages = _make_params(rng, S, d)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    x = jnp.asarray(rng.randn(6, d).astype("float32"))
+    with pytest.raises(ValueError, match="microbatch"):
+        pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                       n_microbatches=4)   # 6 % 4 != 0
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    rng = np.random.RandomState(6)
+    stages = _make_params(rng, 4, 4)          # 4 stages on a 2-dev mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    x = jnp.asarray(rng.randn(4, 4).astype("float32"))
+    with pytest.raises(ValueError, match="stages"):
+        pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                       n_microbatches=2)
+
+
+def test_pipeline_per_shard_microbatch_check():
+    rng = np.random.RandomState(7)
+    stages = _make_params(rng, 2, 4)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+    x = jnp.asarray(rng.randn(4, 4).astype("float32"))
+    # global 4 % 4 == 0, but per-dp-shard batch is 2
+    with pytest.raises(ValueError, match="per-shard"):
+        pipeline_apply(_stage_fn, stack_stage_params(stages), x, mesh,
+                       n_microbatches=4, batch_axis="dp")
