@@ -99,25 +99,15 @@ def _make_pipeline_stream(args, image_shape):
     return stream()
 
 
-def _timed_steps(ts, next_batch, warmup, iters, flops_probe=None):
-    """Warm up, time ``iters`` steps, return (img_or_tok_per_call_dt,
-    flops_per_step). flops from XLA cost analysis of the compiled step."""
+def _timed_steps(ts, next_batch, warmup, iters):
+    """Host-fed timing loop (pipeline mode): warm up, time ``iters``
+    python-dispatched steps. The synthetic benches use _fori_timed
+    instead (see there for why)."""
     import jax
 
     for i in range(warmup):
         ts.step(next_batch(i))
     jax.block_until_ready(ts.params)
-
-    flops_per_step = None
-    try:
-        cost = ts._step_fn.lower(*flops_probe).compile().cost_analysis() \
-            if flops_probe else None
-        if cost is not None:
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0]
-            flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
 
     t0 = time.perf_counter()
     for i in range(iters):
@@ -132,7 +122,85 @@ def _timed_steps(ts, next_batch, warmup, iters, flops_probe=None):
         next(iter(ts.params.values())).ravel()[0]))
     if not np.isfinite(probe_w):
         raise SystemExit("bench: non-finite weights after timing loop")
-    return dt, flops_per_step
+    return dt
+
+
+def _cost_flops(ts, flops_probe):
+    """Per-step FLOPs from XLA cost analysis (abstract-probe lowering,
+    run after timing — a second live executable alongside the timing
+    loop has been seen to wedge tunneled harnesses)."""
+    if flops_probe is None:
+        return None
+    try:
+        cost = ts._step_fn.lower(*flops_probe).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _fori_timed(ts, batches, iters, lr):
+    """Time ``iters`` training steps as the DIFFERENCE between one
+    (n0+iters)-step and one n0-step program, each a single launch with
+    the step chain inside ``lax.fori_loop``.
+
+    Why not a python dispatch loop: on tunneled dev harnesses the
+    client has been observed to coalesce per-step launches whose donated
+    buffer handles repeat, reporting instant completion and absurd
+    throughput (docs/PERF.md). One launch per measurement with a forced
+    scalar readback is immune, and the differential cancels the launch +
+    readback round trip. On a direct-attached TPU both methods agree.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if ts._step_fn is None:
+        ts._step_fn = ts._build_step()
+    step = ts._step_fn
+    lr = jnp.float32(lr)
+
+    def make(n):
+        # batches ride as arguments (closure constants would be baked
+        # into the program body — hundreds of MB at ImageNet shapes)
+        @jax.jit
+        def run(params, states, auxs, b0, b1):
+            def body(i, carry):
+                p, s, a = carry
+                batch = jax.tree.map(
+                    lambda x, y: jnp.where(i % 2 == 0, x, y), b0, b1)
+                p, s, a, _outs = step(p, s, a, batch, lr,
+                                      (i + 1).astype(jnp.uint32))
+                return (p, s, a)
+            return lax.fori_loop(0, n, body, (params, states, auxs))
+        return run
+
+    n0 = 2
+    short = make(n0)
+    long_ = make(n0 + iters)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        p, s, a = fn(ts.params, ts.states, ts.auxs, batches[0],
+                     batches[1])
+        w = float(jnp.asarray(next(iter(p.values())).ravel()[0]))
+        if not np.isfinite(w):
+            raise SystemExit("bench: non-finite weights in timing loop")
+        return time.perf_counter() - t0
+
+    # compile + warm both programs, then measure
+    timed(short)
+    timed(long_)
+    t_short = min(timed(short) for _ in range(2))
+    t_long = min(timed(long_) for _ in range(2))
+    dt = t_long - t_short
+    if dt <= 0:
+        raise SystemExit(
+            "bench: non-positive timing differential (%.4fs long vs "
+            "%.4fs short) — wall-clock noise exceeded the measured "
+            "work; rerun with more --iters" % (t_long, t_short))
+    return dt
 
 
 def bench_pipeline_scaling(args):
@@ -201,7 +269,8 @@ def bench_resnet(args):
             if args.layout == "NHWC":
                 d = np.transpose(d, (0, 2, 3, 1))
             return {"data": d, "softmax_label": b.label[0].asnumpy()}
-        probe = None
+        dt = _timed_steps(ts, next_batch, args.warmup, args.iters)
+        flops_per_step = None
     else:
         # Synthetic device-resident batches (the reference's perf.md
         # numbers are synthetic-data benchmarks of the training step).
@@ -214,13 +283,13 @@ def bench_resnet(args):
             batches.append({"data": data, "softmax_label": label})
         jax.block_until_ready(batches)
 
-        def next_batch(i):
-            return batches[i % 2]
-        probe = (ts.params, ts.states, ts.auxs, batches[0],
-                 jnp.float32(0.1), np.uint32(0))
-
-    dt, flops_per_step = _timed_steps(ts, next_batch, args.warmup,
-                                      args.iters, probe)
+        dt = _fori_timed(ts, batches, args.iters, lr=0.1)
+        # abstract probe: lowering must not touch live (donated) buffers
+        probe = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (ts.params, ts.states, ts.auxs, batches[0],
+             jnp.float32(0.1), jnp.uint32(0)))
+        flops_per_step = _cost_flops(ts, probe)
     if flops_per_step is None and args.num_layers == 50:
         # ResNet-50 fwd ≈ 4.1 GMACs = 8.2 GFLOP/img; training ≈ 3x fwd
         flops_per_step = 24.6e9 * args.batch
@@ -278,11 +347,13 @@ def bench_transformer(args):
                           .astype(np.float32))
         batches.append({"data": tok, "softmax_label": lab})
     jax.block_until_ready(batches)
-    probe = (ts.params, ts.states, ts.auxs, batches[0],
-             jnp.float32(0.01), np.uint32(0))
+    probe = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (ts.params, ts.states, ts.auxs, batches[0],
+         jnp.float32(0.01), jnp.uint32(0)))
 
-    dt, flops_per_step = _timed_steps(
-        ts, lambda i: batches[i % 2], args.warmup, args.iters, probe)
+    dt = _fori_timed(ts, batches, args.iters, lr=0.01)
+    flops_per_step = _cost_flops(ts, probe)
 
     tok_per_sec = B * S * args.iters / dt
     dev = jax.devices()[0]

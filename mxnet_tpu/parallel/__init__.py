@@ -8,6 +8,7 @@ ICI/DCN with XLA collectives instead of NCCL/ZMQ.
 from .mesh import (make_mesh, data_parallel_mesh, batch_sharding,
                    replicated_sharding, shard_batch, current_mesh)
 from .trainer import TrainStep, default_tp_rule
+from .moe import switch_moe, moe_reference, init_moe_params
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import (attention_reference, ring_attention,
                              ulysses_attention)
@@ -16,4 +17,5 @@ __all__ = ["make_mesh", "data_parallel_mesh", "batch_sharding",
            "replicated_sharding", "shard_batch", "current_mesh",
            "TrainStep", "default_tp_rule", "attention_reference",
            "ring_attention", "ulysses_attention",
-           "pipeline_apply", "stack_stage_params"]
+           "pipeline_apply", "stack_stage_params",
+           "switch_moe", "moe_reference", "init_moe_params"]

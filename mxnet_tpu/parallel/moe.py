@@ -1,0 +1,137 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+The reference (MXNet ~1.2) predates MoE entirely (SURVEY.md §2.3 lists
+expert parallelism among the absent modern strategies), so — like ring
+attention and the GPipe pipeline — this is a new TPU-native capability:
+Switch/top-k routing in the Mesh-TensorFlow einsum formulation (static
+shapes, no data-dependent gather loops — exactly what XLA wants), with
+the expert-stacked parameters and the dispatched token blocks sharded
+over ``ep`` via ``with_sharding_constraint`` so GSPMD inserts the
+all-to-alls that move token blocks to their experts over ICI.
+
+* ``switch_moe``      — routed expert-FFN layer: returns (y, aux_loss)
+  where aux_loss is the standard load-balancing loss (Switch
+  Transformer eq. 4: E * Σ_e f_e · P_e).
+* ``moe_reference``   — dense oracle: every token through every
+  expert, combined by the same gates — equals switch_moe whenever no
+  token overflows capacity (the tests pin this).
+
+Capacity semantics: each expert processes at most
+``ceil(k·N/E · capacity_factor)`` tokens; overflowing tokens are
+dropped from that expert (their combine weight is zero), the standard
+Switch behavior.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["switch_moe", "moe_reference", "init_moe_params"]
+
+
+def init_moe_params(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
+    """Router + expert-stacked FFN parameters (leading axis E — the one
+    that shards over ``ep``)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_hidden)
+    return {
+        "router": (jax.random.normal(k1, (d_model, n_experts),
+                                     jnp.float32) * s1).astype(dtype),
+        "w1": (jax.random.normal(k2, (n_experts, d_model, d_hidden),
+                                 jnp.float32) * s1).astype(dtype),
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "w2": (jax.random.normal(k3, (n_experts, d_hidden, d_model),
+                                 jnp.float32) * s2).astype(dtype),
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def _expert_ffn(params, xe):
+    """xe: (E, C, d) — each expert's token block through its own FFN."""
+    h = jnp.einsum("ecd,edh->ech", xe, params["w1"]) \
+        + params["b1"][:, None, :]
+    h = jax.nn.relu(h)
+    return jnp.einsum("ech,ehd->ecd", h, params["w2"]) \
+        + params["b2"][:, None, :]
+
+
+def moe_reference(params, x):
+    """Dense oracle: every token through every expert, weighted by the
+    full softmax gate — the no-capacity-limit ideal."""
+    probs = jax.nn.softmax(x @ params["router"], axis=-1)      # (N, E)
+    h = jnp.einsum("nd,edh->neh", x, params["w1"]) \
+        + params["b1"][None]
+    h = jax.nn.relu(h)
+    y = jnp.einsum("neh,ehd->ned", h, params["w2"]) \
+        + params["b2"][None]
+    return jnp.einsum("ne,ned->nd", probs, y)
+
+
+def switch_moe(params, x, k=1, capacity_factor=1.25, mesh=None,
+               axis="ep"):
+    """Top-k routed MoE layer. x: (N, d_model) tokens (flatten (B, T)
+    outside). Returns (y, aux_loss).
+
+    With ``mesh``, the expert-stacked tensors are sharding-constrained
+    to P(axis) on their leading E dim — under jit over that mesh, GSPMD
+    partitions the expert FFNs across ``ep`` and inserts the
+    all-to-alls for the dispatch/combine einsums.
+    """
+    N, d = x.shape
+    E = params["router"].shape[1]
+    k = int(k)
+    C = max(1, int(math.ceil(k * N / E * float(capacity_factor))))
+
+    def constrain(v):
+        """Pin the leading (expert) axis to the ep mesh axis."""
+        if mesh is None:
+            return v
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        return lax.with_sharding_constraint(
+            v, NamedSharding(mesh, spec))
+
+    logits = x @ params["router"]                               # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)                   # (N, k)
+
+    # position of each (token, choice) in its expert's queue: running
+    # count of earlier assignments to the same expert (einsum-style
+    # cumsum dispatch — static shapes, no sorting)
+    assign = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)       # (N,k,E)
+    flat = assign.reshape(N * k, E)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat             # (N*k, E)
+    pos = (pos_in_expert * flat).sum(-1).reshape(N, k)          # (N, k)
+    keep = pos < C
+    gate_vals = gate_vals * keep                                # drop overflow
+
+    # dispatch (N, k, E, C) one-hots contracted on the fly
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C,
+                            dtype=x.dtype)                      # (N,k,C)
+    disp = jnp.einsum("nke,nkc->nec", assign.astype(x.dtype),
+                      pos_oh * keep[..., None])                 # (N,E,C)
+    xe = jnp.einsum("nec,nd->ecd", disp, x)                     # (E,C,d)
+    xe = constrain(xe)
+
+    # expert-parallel FFN: the expert-stacked params (by NAME — a shape
+    # test would misfire when d_model == n_experts) shard over ep
+    eparams = {kk: (constrain(v) if kk in ("w1", "b1", "w2", "b2")
+                    else v)
+               for kk, v in params.items()}
+    ye = _expert_ffn(eparams, xe)                               # (E,C,d)
+    ye = constrain(ye)
+
+    # combine: weight each fetched expert output by its gate
+    combine = jnp.einsum("nec,nke,nk->nec", disp,
+                         assign.astype(x.dtype), gate_vals)     # (N,E,C)
+    y = jnp.einsum("nec,ecd->nd", combine, ye)
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e
+    f = (assign[:, 0].astype(jnp.float32)).mean(0)              # (E,)
+    p = probs.astype(jnp.float32).mean(0)
+    aux = E * jnp.sum(f * p)
+    return y, aux

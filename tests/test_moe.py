@@ -1,0 +1,160 @@
+"""Mixture-of-Experts with expert parallelism (parallel/moe.py — new
+TPU-native capability; the reference predates MoE, SURVEY.md §2.3).
+Pins: switch_moe equals the dense oracle when capacity is ample,
+capacity overflow drops tokens, gradients reach router AND experts,
+training descends, and the ep-sharded jit matches the unsharded run."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mxnet_tpu.parallel import (switch_moe, moe_reference,
+                                init_moe_params)
+
+
+def _params(seed=0, d=8, h=16, E=4):
+    return init_moe_params(jax.random.key(seed), d, h, E)
+
+
+def test_top1_matches_reference_with_ample_capacity():
+    """top-1 with capacity >= N: every token reaches its argmax expert,
+    so switch_moe equals the dense oracle restricted to the top gate."""
+    params = _params()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8).astype("float32"))
+    y, aux = switch_moe(params, x, k=1, capacity_factor=16.0)
+    # oracle: route each token to argmax expert with its softmax weight
+    probs = jax.nn.softmax(x @ params["router"], axis=-1)
+    top = jnp.argmax(probs, axis=-1)
+    h = jnp.einsum("nd,edh->neh", x, params["w1"]) + params["b1"][None]
+    h = jax.nn.relu(h)
+    ye = jnp.einsum("neh,ehd->ned", h, params["w2"]) + params["b2"][None]
+    want = ye[jnp.arange(16), top] * probs[jnp.arange(16), top][:, None]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    assert float(aux) > 0
+
+
+def test_topk_full_capacity_matches_dense_reference():
+    """k = E with ample capacity = every token through every expert =
+    the dense mixture oracle."""
+    params = _params(seed=1)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(12, 8).astype("float32"))
+    y, _ = switch_moe(params, x, k=4, capacity_factor=16.0)
+    want = moe_reference(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    """With capacity 1 and all tokens forced to one expert, only the
+    first token per expert survives (standard Switch dropping)."""
+    params = _params(seed=2)
+    # router that sends everything to expert 0
+    params = dict(params)
+    router = np.zeros((8, 4), "float32")
+    router[:, 0] = 10.0
+    params["router"] = jnp.asarray(router)
+    rng = np.random.RandomState(2)
+    # all-positive tokens: x @ router puts every token's expert-0 logit
+    # at +10*sum(x) >> others, so routing really is all-to-expert-0
+    x = jnp.asarray((np.abs(rng.randn(6, 8)) + 0.1).astype("float32"))
+    y, _ = switch_moe(params, x, k=1, capacity_factor=1.0 / 6 + 1e-6)
+    out = np.asarray(y)
+    # capacity C=1: token 0 processed, tokens 1.. dropped to zeros
+    assert np.abs(out[0]).sum() > 0
+    np.testing.assert_allclose(out[1:], 0.0, atol=1e-6)
+
+
+def test_gradients_reach_router_and_experts():
+    params = _params(seed=3)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(16, 8).astype("float32"))
+    tgt = jnp.asarray(rng.randn(16, 8).astype("float32"))
+
+    def loss(p):
+        y, aux = switch_moe(p, x, k=2, capacity_factor=2.0)
+        return jnp.mean((y - tgt) ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for name in ("router", "w1", "w2"):
+        gn = float(jnp.abs(g[name]).sum())
+        assert gn > 0, name
+
+
+def test_moe_training_descends_and_specializes():
+    params = _params(seed=4, d=8, h=16, E=4)
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(64, 8).astype("float32"))
+    tgt = jnp.asarray(np.tanh(rng.randn(8, 8)).astype("float32"))
+    y_true = jnp.tanh(x @ tgt)
+
+    @jax.jit
+    def step(p):
+        def loss(p):
+            y, aux = switch_moe(p, x, k=2, capacity_factor=2.0)
+            return jnp.mean((y - y_true) ** 2) + 0.01 * aux
+        l, g = jax.value_and_grad(loss)(p)
+        return l, jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+    l0, params = step(params)
+    for _ in range(60):
+        l1, params = step(params)
+    assert float(l1) < float(l0) * 0.6, (float(l0), float(l1))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs >=4 devices")
+def test_ep_sharded_matches_unsharded():
+    """jit over an ep mesh with the expert axis sharded produces the
+    same numbers as the single-device run (GSPMD inserts the
+    all-to-alls; results must be placement-invariant)."""
+    params = _params(seed=5)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(32, 8).astype("float32"))
+    want, aux_want = switch_moe(params, x, k=2, capacity_factor=2.0)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
+    eshard = NamedSharding(mesh, P("ep"))
+    repl = NamedSharding(mesh, P())
+    placed = {
+        k: jax.device_put(v, eshard if v.shape[0] == 4 and v.ndim >= 2
+                          else repl)
+        for k, v in params.items()}
+    xs = jax.device_put(x, repl)
+
+    @jax.jit
+    def f(p, x):
+        return switch_moe(p, x, k=2, capacity_factor=2.0, mesh=mesh)
+
+    got, aux_got = f(placed, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux_got), float(aux_want),
+                               rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs >=4 devices")
+def test_ep_sharded_training_descends():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
+    params = _params(seed=6)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(32, 8).astype("float32"))
+    y_true = jnp.tanh(x @ jnp.asarray(
+        np.tanh(rng.randn(8, 8)).astype("float32")))
+
+    @jax.jit
+    def step(p):
+        def loss(p):
+            y, aux = switch_moe(p, x, k=1, capacity_factor=2.0,
+                                mesh=mesh)
+            return jnp.mean((y - y_true) ** 2) + 0.01 * aux
+        l, g = jax.value_and_grad(loss)(p)
+        return l, jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+    l0, params = step(params)
+    for _ in range(40):
+        l1, params = step(params)
+    assert float(l1) < float(l0), (float(l0), float(l1))
