@@ -140,7 +140,7 @@ def _cost_flops(ts, flops_probe):
         return None
 
 
-def _fori_timed(ts, batches, iters, lr):
+def _fori_timed(ts, batches, iters, lr, warmup=1):
     """Time ``iters`` training steps as the DIFFERENCE between one
     (n0+iters)-step and one n0-step program, each a single launch with
     the step chain inside ``lax.fori_loop``.
@@ -161,15 +161,22 @@ def _fori_timed(ts, batches, iters, lr):
     step = ts._step_fn
     lr = jnp.float32(lr)
 
+    # the two batches stack into one argument; each step gathers only
+    # its slice (a per-step jnp.where select would read both batches
+    # and write a copy — measurable extra HBM traffic in an HBM-bound
+    # loop). Arguments, not closure constants: baked-in ImageNet
+    # batches blow the remote-compile size limit.
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                           batches[0], batches[1])
+
     def make(n):
-        # batches ride as arguments (closure constants would be baked
-        # into the program body — hundreds of MB at ImageNet shapes)
         @jax.jit
-        def run(params, states, auxs, b0, b1):
+        def run(params, states, auxs, bstack):
             def body(i, carry):
                 p, s, a = carry
                 batch = jax.tree.map(
-                    lambda x, y: jnp.where(i % 2 == 0, x, y), b0, b1)
+                    lambda v: lax.dynamic_index_in_dim(
+                        v, i % 2, 0, keepdims=False), bstack)
                 p, s, a, _outs = step(p, s, a, batch, lr,
                                       (i + 1).astype(jnp.uint32))
                 return (p, s, a)
@@ -182,16 +189,16 @@ def _fori_timed(ts, batches, iters, lr):
 
     def timed(fn):
         t0 = time.perf_counter()
-        p, s, a = fn(ts.params, ts.states, ts.auxs, batches[0],
-                     batches[1])
+        p, s, a = fn(ts.params, ts.states, ts.auxs, stacked)
         w = float(jnp.asarray(next(iter(p.values())).ravel()[0]))
         if not np.isfinite(w):
             raise SystemExit("bench: non-finite weights in timing loop")
         return time.perf_counter() - t0
 
-    # compile + warm both programs, then measure
-    timed(short)
-    timed(long_)
+    # compile + warm both programs (>= --warmup repetitions), measure
+    for _ in range(max(1, warmup)):
+        timed(short)
+        timed(long_)
     t_short = min(timed(short) for _ in range(2))
     t_long = min(timed(long_) for _ in range(2))
     dt = t_long - t_short
@@ -283,7 +290,8 @@ def bench_resnet(args):
             batches.append({"data": data, "softmax_label": label})
         jax.block_until_ready(batches)
 
-        dt = _fori_timed(ts, batches, args.iters, lr=0.1)
+        dt = _fori_timed(ts, batches, args.iters, lr=0.1,
+                         warmup=args.warmup)
         # abstract probe: lowering must not touch live (donated) buffers
         probe = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -352,7 +360,8 @@ def bench_transformer(args):
         (ts.params, ts.states, ts.auxs, batches[0],
          jnp.float32(0.01), jnp.uint32(0)))
 
-    dt = _fori_timed(ts, batches, args.iters, lr=0.01)
+    dt = _fori_timed(ts, batches, args.iters, lr=0.01,
+                     warmup=args.warmup)
     flops_per_step = _cost_flops(ts, probe)
 
     tok_per_sec = B * S * args.iters / dt

@@ -21,12 +21,17 @@ def create_ndarray(shape, dtype="float32"):
 
 
 def copy_from(nd, buf):
-    """Fill ``nd`` from a C float32 buffer (memoryview/bytes)."""
+    """Fill ``nd`` from a C float32 buffer (memoryview/bytes).
+
+    The buffer is OWNED BY THE C CALLER and may be freed the moment
+    this returns (the cpp demo passes stack temporaries), while jax on
+    CPU can zero-copy-alias numpy arrays — so the bytes must be copied
+    into Python-owned memory here, not wrapped."""
     arr = _np.frombuffer(buf, dtype=_np.float32)
     if arr.size != nd.size:
         raise ValueError("SyncCopyFromCPU: buffer has %d floats, NDArray "
                          "has %d elements" % (arr.size, nd.size))
-    nd._sync_copyfrom(arr.reshape(nd.shape))
+    nd._sync_copyfrom(arr.reshape(nd.shape).copy())
     return None
 
 

@@ -20,13 +20,19 @@ from .. import symbol as sym
 
 def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
                ffn_dim=None, seq_len=1024, dtype="float32", dropout=0.0,
-               **kwargs):
+               moe_experts=0, moe_every=2, moe_aux_coeff=0.01, **kwargs):
     """``num_classes`` is the vocabulary size (factory-signature parity
-    with the CNN zoo's get_symbol)."""
+    with the CNN zoo's get_symbol). With ``moe_experts`` > 0 every
+    ``moe_every``-th layer's FFN becomes a Switch-MoE
+    (sym.contrib.SwitchMoE, num_experts experts, top-1 routing) and the
+    load-balancing aux losses join the heads through MakeLoss scaled by
+    ``moe_aux_coeff`` — a sparse-expert LM end-to-end in the symbolic
+    API."""
     vocab = int(num_classes)
     d = int(d_model)
     ffn = int(ffn_dim) if ffn_dim else 4 * d
     lp = float(dropout)
+    aux_losses = []
 
     data = sym.Variable("data")                      # (B, S) token ids
     tok = sym.Embedding(data, input_dim=vocab, output_dim=d,
@@ -51,11 +57,18 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
             proj = sym.Dropout(data=proj, p=lp, name=pre + "drop1")
         x = x + proj
         ln2 = sym.LayerNorm(data=x, name=pre + "ln2")
-        h = sym.FullyConnected(data=ln2, num_hidden=ffn, flatten=False,
-                               name=pre + "ffn_up")
-        h = sym.LeakyReLU(data=h, act_type="gelu", name=pre + "gelu")
-        h = sym.FullyConnected(data=h, num_hidden=d, flatten=False,
-                               name=pre + "ffn_down")
+        if moe_experts and (i + 1) % max(int(moe_every), 1) == 0:
+            moe = sym.contrib.SwitchMoE(
+                ln2, num_experts=int(moe_experts), num_hidden=ffn,
+                k=1, name=pre + "moe")
+            h = moe[0]
+            aux_losses.append(moe[1])
+        else:
+            h = sym.FullyConnected(data=ln2, num_hidden=ffn,
+                                   flatten=False, name=pre + "ffn_up")
+            h = sym.LeakyReLU(data=h, act_type="gelu", name=pre + "gelu")
+            h = sym.FullyConnected(data=h, num_hidden=d, flatten=False,
+                                   name=pre + "ffn_down")
         if lp > 0:
             h = sym.Dropout(data=h, p=lp, name=pre + "drop2")
         x = x + h
@@ -66,5 +79,14 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
     if dtype in ("float16", "bfloat16"):
         logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
     flat = sym.Reshape(data=logits, shape=(-1, vocab), name="logits_2d")
-    return sym.SoftmaxOutput(data=flat, name="softmax",
-                             normalization="batch")
+    out = sym.SoftmaxOutput(data=flat, name="softmax",
+                            normalization="batch")
+    if aux_losses:
+        total_aux = aux_losses[0]
+        for a in aux_losses[1:]:
+            total_aux = total_aux + a
+        aux_head = sym.MakeLoss(
+            sym.Cast(total_aux, dtype="float32", name="cast_aux")
+            * float(moe_aux_coeff), name="moe_aux_loss")
+        return sym.Group([out, aux_head])
+    return out

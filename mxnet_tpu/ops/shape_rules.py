@@ -96,6 +96,20 @@ _set("BatchNorm", _channel_shapes)
 _set("_contrib_SyncBatchNorm", _channel_shapes)
 
 
+def _switch_moe_shapes(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return {}
+    d = data[-1]
+    E = int(attrs["num_experts"])
+    h = int(attrs["num_hidden"])
+    return {"router": (d, E), "w1": (E, d, h), "b1": (E, h),
+            "w2": (E, h, d), "b2": (E, d)}
+
+
+_set("_contrib_SwitchMoE", _switch_moe_shapes)
+
+
 def _ln_shapes(known, attrs):
     data = known.get("data")
     if data is None:
