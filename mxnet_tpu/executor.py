@@ -284,7 +284,14 @@ class Executor:
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a (name, NDArray) callback fired with every node output
         (and every variable when ``monitor_all``) after each forward
-        (reference graph_executor.cc SetMonitorCallback)."""
+        (reference graph_executor.cc SetMonitorCallback).
+
+        Cost note: the reference streams callbacks from the engine's
+        in-flight execution; here taps come from a SECOND jitted
+        program (the tapped forward) run on monitored batches, so a
+        monitored step costs ~2x a plain one. Monitor's interval gate
+        (``Monitor(interval=N)``) limits this to every N-th batch —
+        un-monitored batches pay nothing."""
         self._monitor_callback = callback
         self._monitor_all = bool(monitor_all)
 

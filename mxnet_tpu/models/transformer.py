@@ -15,6 +15,7 @@ Builds a Symbol ending in SoftmaxOutput, so it drops into ``Module.fit``
 ``data`` is (batch, seq_len) token ids and ``softmax_label`` is
 (batch*seq_len,) next-token targets.
 """
+from .. import initializer as _init
 from .. import symbol as sym
 
 
@@ -58,8 +59,16 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
         x = x + proj
         ln2 = sym.LayerNorm(data=x, name=pre + "ln2")
         if moe_experts and (i + 1) % max(int(moe_every), 1) == 0:
+            # explicit expert-stack variables with per-expert-fan Normal
+            # inits (Xavier misreads 3-D stacks: it would treat the
+            # trailing dims as conv extents and under-scale ~sqrt(ffn)x)
+            w_up = sym.Variable(pre + "moe_expert_up_weight",
+                                init=_init.Normal(d ** -0.5))
+            w_down = sym.Variable(pre + "moe_expert_down_weight",
+                                  init=_init.Normal(ffn ** -0.5))
             moe = sym.contrib.SwitchMoE(
-                ln2, num_experts=int(moe_experts), num_hidden=ffn,
+                ln2, expert_up_weight=w_up, expert_down_weight=w_down,
+                num_experts=int(moe_experts), num_hidden=ffn,
                 k=1, name=pre + "moe")
             h = moe[0]
             aux_losses.append(moe[1])
@@ -82,9 +91,8 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
     out = sym.SoftmaxOutput(data=flat, name="softmax",
                             normalization="batch")
     if aux_losses:
-        total_aux = aux_losses[0]
-        for a in aux_losses[1:]:
-            total_aux = total_aux + a
+        total_aux = aux_losses[0] if len(aux_losses) == 1 else \
+            sym.add_n(*aux_losses, name="moe_aux_sum")
         aux_head = sym.MakeLoss(
             sym.Cast(total_aux, dtype="float32", name="cast_aux")
             * float(moe_aux_coeff), name="moe_aux_loss")

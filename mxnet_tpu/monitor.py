@@ -22,6 +22,10 @@ class Monitor:
     """Collect statistics of intermediate outputs every ``interval``
     batches (reference monitor.py Monitor).
 
+    Monitored batches run an extra tapped forward program (~2x step
+    cost; see Executor.set_monitor_callback) — pick ``interval``
+    accordingly; batches the interval gate skips pay nothing.
+
     Parameters
     ----------
     interval : int

@@ -636,22 +636,30 @@ def causal_self_attention(qkv, *, num_heads, scale=None):
 
 @register("_contrib_SwitchMoE", aliases=("SwitchMoE",), num_outputs=2,
           num_visible_outputs=2)
-def switch_moe_op(data, router, w1, b1, w2, b2, *, num_experts,
+def switch_moe_op(data, router_weight, expert_up_weight, expert_up_bias,
+                  expert_down_weight, expert_down_bias, *, num_experts,
                   num_hidden, k=1, capacity_factor=1.25):
     """Switch/top-k Mixture-of-Experts FFN as a graph operator (new
     TPU-native capability — the reference predates MoE, SURVEY.md
     §2.3). data (..., d_model) routes per-token to ``num_experts``
-    expert FFNs (router (d, E); expert-stacked w1 (E, d, h), b1 (E, h),
-    w2 (E, h, d), b2 (E, d)). Outputs: (y, aux_loss) — aux_loss is the
-    Switch load-balancing loss, typically wired through ``MakeLoss``
-    with a small coefficient. Expert parallelism: shard the leading E
-    axis of w1/b1/w2/b2 over an ``ep`` mesh axis (TrainStep tp_rule or
-    parallel.moe.switch_moe directly)."""
+    expert FFNs (router_weight (d, E); expert-stacked up (E, d, h) /
+    down (E, h, d) weights with (E, h) / (E, d) biases — the _weight/
+    _bias name suffixes keep the framework's init and weight-decay
+    conventions). Outputs: (y, aux_loss) — aux_loss is the Switch
+    load-balancing loss, typically wired through ``MakeLoss`` with a
+    small coefficient. Expert parallelism: shard the leading E axis of
+    the expert-stacked params over an ``ep`` mesh axis (TrainStep
+    tp_rule or parallel.moe.switch_moe directly). NOTE: default Xavier
+    init misreads the 3-D expert stacks' fans (it treats trailing dims
+    as conv extents); the transformer builder attaches per-variable
+    Normal inits sized to the per-expert fan."""
     from ..parallel.moe import switch_moe as _switch
 
     d = data.shape[-1]
     tokens = data.reshape(-1, d)
-    params = {"router": router, "w1": w1, "b1": b1, "w2": w2, "b2": b2}
+    params = {"router": router_weight, "w1": expert_up_weight,
+              "b1": expert_up_bias, "w2": expert_down_weight,
+              "b2": expert_down_bias}
     y, aux = _switch(params, tokens, k=int(k),
                      capacity_factor=float(capacity_factor))
     return y.reshape(data.shape), aux

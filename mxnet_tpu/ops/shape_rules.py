@@ -103,8 +103,9 @@ def _switch_moe_shapes(known, attrs):
     d = data[-1]
     E = int(attrs["num_experts"])
     h = int(attrs["num_hidden"])
-    return {"router": (d, E), "w1": (E, d, h), "b1": (E, h),
-            "w2": (E, h, d), "b2": (E, d)}
+    return {"router_weight": (d, E),
+            "expert_up_weight": (E, d, h), "expert_up_bias": (E, h),
+            "expert_down_weight": (E, h, d), "expert_down_bias": (E, d)}
 
 
 _set("_contrib_SwitchMoE", _switch_moe_shapes)

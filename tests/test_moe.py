@@ -176,6 +176,9 @@ def test_switch_moe_symbol_op_and_moe_transformer():
     b2 = nd.zeros((4, 8))
     y, aux = nd.contrib.SwitchMoE(x, router, w1, b1, w2, b2,
                                   num_experts=4, num_hidden=16)
+    # (positional inputs bind in declaration order: router_weight,
+    # expert_up_weight, expert_up_bias, expert_down_weight,
+    # expert_down_bias)
     assert y.shape == (6, 8)
     assert float(aux.asnumpy()) > 0
 
@@ -186,7 +189,8 @@ def test_switch_moe_symbol_op_and_moe_transformer():
     args = dict(zip(symb.list_arguments(),
                     symb.infer_shape(data=(4, 12),
                                      softmax_label=(48,))[0]))
-    assert args["layer1_moe_w1"] == (4, 32, 128)
+    assert args["layer1_moe_expert_up_weight"] == (4, 32, 128)
+    assert args["layer1_moe_expert_up_bias"] == (4, 128)
     ts = TrainStep(symb, mx.optimizer.Adam(learning_rate=2e-3),
                    data_shapes={"data": (4, 12)},
                    label_shapes={"softmax_label": (48,)})

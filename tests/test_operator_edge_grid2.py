@@ -1,0 +1,195 @@
+"""Second dtype × shape edge-grid tranche: activations, batch_dot,
+ordering ops, indexing, shape manipulators — numpy oracles per case
+(reference test_operator.py coverage style)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_TOL = {"float32": (1e-5, 1e-6), "float16": (2e-2, 2e-3)}
+
+
+def _assert(got, want, dtype="float32"):
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(np.asarray(got.asnumpy(), "float64"),
+                               np.asarray(want, "float64"),
+                               rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16"])
+@pytest.mark.parametrize("act,ref", [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("softrelu", lambda x: np.log1p(np.exp(-np.abs(x)))
+     + np.maximum(x, 0)),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+])
+def test_activation_grid(dtype, act, ref):
+    rng = np.random.RandomState(0)
+    for shape in [(1,), (1, 1), (3, 4, 5)]:
+        x = (rng.randn(*shape) * 2).astype(dtype)
+        got = nd.Activation(nd.array(x, dtype=dtype), act_type=act)
+        _assert(got, ref(x.astype("float64")), dtype)
+
+
+@pytest.mark.parametrize("act,kw,ref", [
+    ("leaky", {"slope": 0.1}, lambda x: np.where(x > 0, x, 0.1 * x)),
+    ("elu", {"slope": 0.3}, lambda x: np.where(x > 0, x,
+                                               0.3 * np.expm1(x))),
+    ("gelu", {}, None),
+    ("selu", {}, None),
+])
+def test_leaky_family_grid(act, kw, ref):
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32")
+    got = nd.LeakyReLU(nd.array(x), act_type=act, **kw).asnumpy()
+    if ref is not None:
+        np.testing.assert_allclose(got, ref(x), rtol=1e-5, atol=1e-6)
+    else:
+        assert np.isfinite(got).all()
+        # gelu/selu preserve sign of large positives, squash negatives
+        assert (got[x > 2] > 0).all()
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (False, True),
+                                   (True, False), (True, True)])
+def test_batch_dot_grid(ta, tb):
+    rng = np.random.RandomState(2)
+    B, m, k, n = 3, 4, 5, 6
+    a = rng.randn(B, k, m).astype("float32") if ta else \
+        rng.randn(B, m, k).astype("float32")
+    b = rng.randn(B, n, k).astype("float32") if tb else \
+        rng.randn(B, k, n).astype("float32")
+    want = np.einsum("bij,bjk->bik",
+                     a.transpose(0, 2, 1) if ta else a,
+                     b.transpose(0, 2, 1) if tb else b)
+    got = nd.batch_dot(nd.array(a), nd.array(b), transpose_a=ta,
+                       transpose_b=tb)
+    _assert(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_topk_grid(k):
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 5).astype("float32")
+    got = nd.topk(nd.array(x), k=k, ret_typ="value").asnumpy()
+    want = -np.sort(-x, axis=-1)[:, :k]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_sort_argsort_argmax():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 7).astype("float32")
+    np.testing.assert_allclose(nd.sort(nd.array(x)).asnumpy(),
+                               np.sort(x, axis=-1), rtol=1e-6)
+    np.testing.assert_array_equal(
+        nd.argsort(nd.array(x)).asnumpy().astype(int),
+        np.argsort(x, axis=-1, kind="stable"))
+    np.testing.assert_array_equal(
+        nd.argmax(nd.array(x), axis=1).asnumpy().astype(int),
+        np.argmax(x, axis=1))
+
+
+def test_clip_where_abs_sign():
+    rng = np.random.RandomState(5)
+    x = (rng.randn(4, 4) * 3).astype("float32")
+    np.testing.assert_allclose(
+        nd.clip(nd.array(x), a_min=-1.0, a_max=1.0).asnumpy(),
+        np.clip(x, -1, 1))
+    cond = (x > 0).astype("float32")
+    got = nd.where(nd.array(cond), nd.array(x), nd.array(-x)).asnumpy()
+    np.testing.assert_allclose(got, np.abs(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.abs(nd.array(x)).asnumpy(), np.abs(x))
+    np.testing.assert_allclose(nd.sign(nd.array(x)).asnumpy(),
+                               np.sign(x))
+
+
+@pytest.mark.parametrize("reps", [(2,), (2, 1), (1, 3), (2, 2)])
+def test_tile_grid(reps):
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    got = nd.tile(nd.array(x), reps=reps).asnumpy()
+    np.testing.assert_array_equal(got, np.tile(x, reps))
+
+
+@pytest.mark.parametrize("axis", [0, 1, None])
+def test_flip_reverse(axis):
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    if axis is None:
+        return
+    got = nd.reverse(nd.array(x), axis=axis).asnumpy()
+    np.testing.assert_array_equal(got, np.flip(x, axis=axis))
+
+
+def test_take_gather_grid():
+    rng = np.random.RandomState(6)
+    w = rng.randn(10, 4).astype("float32")
+    idx = np.array([[0, 9], [3, 3]], dtype="float32")
+    got = nd.take(nd.array(w), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(got, w[idx.astype(int)], rtol=1e-6)
+
+
+def test_one_hot_grid():
+    idx = np.array([0, 2, 1, 2], dtype="float32")
+    got = nd.one_hot(nd.array(idx), depth=3).asnumpy()
+    want = np.eye(3, dtype="float32")[idx.astype(int)]
+    np.testing.assert_array_equal(got, want)
+    # on/off values
+    got2 = nd.one_hot(nd.array(idx), depth=3, on_value=5.0,
+                      off_value=-1.0).asnumpy()
+    np.testing.assert_array_equal(got2, want * 6.0 - 1.0)
+
+
+@pytest.mark.parametrize("ord_", [1, 2])
+def test_norm_grid(ord_):
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 5).astype("float32")
+    got = nd.norm(nd.array(x), ord=ord_, axis=1).asnumpy()
+    want = np.linalg.norm(x, ord=ord_, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_log_softmax_matches_softmax_log():
+    rng = np.random.RandomState(8)
+    x = (rng.randn(4, 9) * 3).astype("float32")
+    ls = nd.log_softmax(nd.array(x), axis=-1).asnumpy()
+    s = nd.softmax(nd.array(x), axis=-1).asnumpy()
+    np.testing.assert_allclose(ls, np.log(s + 1e-30), rtol=1e-4,
+                               atol=1e-5)
+    # rows sum to 1 in prob space even for large logits
+    np.testing.assert_allclose(np.exp(ls).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_expand_squeeze_stack():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    e = nd.expand_dims(nd.array(x), axis=1)
+    assert e.shape == (2, 1, 3)
+    sq = nd.squeeze(e, axis=1)
+    assert sq.shape == (2, 3)
+    st = nd.stack(nd.array(x), nd.array(x + 1), axis=0).asnumpy()
+    np.testing.assert_array_equal(st, np.stack([x, x + 1]))
+
+
+def test_pad_grid():
+    x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+    got = nd.pad(nd.array(x), mode="constant",
+                 pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                 constant_value=7.0).asnumpy()
+    want = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                  constant_values=7.0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_broadcast_ops_edge_shapes():
+    rng = np.random.RandomState(9)
+    a = rng.randn(3, 1, 5).astype("float32")
+    b = rng.randn(1, 4, 1).astype("float32")
+    np.testing.assert_allclose(
+        nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(), a + b,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(),
+        np.maximum(a, b), rtol=1e-6)
+    got = nd.broadcast_greater(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_array_equal(got, (a > b).astype("float32"))
