@@ -51,8 +51,11 @@ def get_rec_iter(args, kv=None):
     """(reference common/data.py get_rec_iter) — falls back to synthetic
     batches when --benchmark 1 or no --data-train is given."""
     image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    chlast = getattr(args, "layout", "NCHW") == "NHWC"
     if getattr(args, "benchmark", 0) or not args.data_train:
-        data_shape = (args.batch_size,) + image_shape
+        c, h, w = image_shape
+        data_shape = (args.batch_size, h, w, c) if chlast \
+            else (args.batch_size,) + image_shape
         train = SyntheticDataIter(args.num_classes, data_shape,
                                   max_iter=max(args.num_examples
                                                // args.batch_size, 1),
@@ -96,4 +99,38 @@ def get_rec_iter(args, kv=None):
             preprocess_threads=args.data_nthreads,
             num_parts=nworker, part_index=rank,
             dtype=args.dtype)
+    if chlast:
+        train = ChannelLastIter(train)
+        if val is not None:
+            val = ChannelLastIter(val)
     return train, val
+
+
+class ChannelLastIter:
+    """Wrap an NCHW iterator to yield NHWC batches — the TPU-preferred
+    layout (docs/PERF.md). The decode pipeline stays NCHW per the
+    reference iterator contract; the relayout happens host-side here."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.batch_size = inner.batch_size
+        d = inner.provide_data[0]
+        n, c, h, w = d.shape
+        self.provide_data = [mx.io.DataDesc(d.name, (n, h, w, c), d.dtype,
+                                            layout="NHWC")]
+        self.provide_label = inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        b = self._inner.next()
+        data = [mx.nd.transpose(x, axes=(0, 2, 3, 1)) for x in b.data]
+        return mx.io.DataBatch(data=data, label=b.label, pad=b.pad,
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+    __next__ = next

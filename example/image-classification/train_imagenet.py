@@ -26,6 +26,11 @@ def main():
     _fit.add_fit_args(parser)
     _data.add_data_args(parser)
     _data.add_data_aug_args(parser)
+    parser.add_argument("--layout", type=str, default="NCHW",
+                        choices=["NCHW", "NHWC"],
+                        help="NHWC = channel-last end-to-end (the "
+                             "TPU-preferred layout, resnet only; "
+                             "docs/PERF.md)")
     parser.set_defaults(network="resnet", num_layers=50,
                         image_shape="3,224,224", num_classes=1000,
                         num_epochs=80, lr_step_epochs="30,60,90",
@@ -33,9 +38,16 @@ def main():
     args = parser.parse_args()
 
     image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    kwargs = {}
+    if args.layout != "NCHW":
+        if args.network != "resnet":
+            raise SystemExit("--layout NHWC is supported by the resnet "
+                             "builder only")
+        kwargs["layout"] = args.layout
     net = models.get_symbol(args.network, num_classes=args.num_classes,
                             num_layers=args.num_layers,
-                            image_shape=image_shape, dtype=args.dtype)
+                            image_shape=image_shape, dtype=args.dtype,
+                            **kwargs)
     _fit.fit(args, net, _data.get_rec_iter)
 
 

@@ -149,45 +149,18 @@ def _init_state(optimizer, weight):
     return ()
 
 
-def _device_init_rule(initializer, name, attrs, shape, dtype):
-    """Device-side analog of Initializer.__call__'s name dispatch
-    (initializer.py:55): returns fn(key) -> jax array, or None when the
-    (initializer, name) pair has no closed-form device rule (custom
-    per-param __init__ attrs, Orthogonal/Bilinear/..., packed RNN vecs).
-
-    TPU-first: the reference initializes on the host and copies every
-    parameter to the device; generating with XLA's on-chip RNG instead
-    means a multi-GB model materializes in HBM without a single
-    host->device weight transfer."""
+def _device_weight_rule(initializer, shape, dtype):
+    """fn(key) -> device array applying ``initializer``'s WEIGHT rule
+    (Xavier/Normal/Uniform/Zero/One/Constant), or None."""
     from .. import initializer as _init
 
-    if attrs and attrs.get("__init__"):
-        return None
     cls = type(initializer)
-    # any overridden dispatch or rule method means the initializer has
-    # custom semantics (Mixed, Load, user subclasses) — host path only
-    if cls.__call__ is not _init.Initializer.__call__:
-        return None
-    base = _init.Initializer
-    for meth in ("_init_bias", "_init_gamma", "_init_beta", "_init_zero",
-                 "_init_one", "_init_default"):
-        if getattr(cls, meth) is not getattr(base, meth):
-            return None
-    lname = name.lower()
-    if lname.endswith(("_bias", "_beta", "_moving_mean", "_running_mean",
-                       "_moving_avg", "_min", "_max")):
-        return lambda key: jnp.zeros(shape, dtype)
-    if lname.endswith(("_gamma", "_moving_var", "_running_var")):
-        return lambda key: jnp.ones(shape, dtype)
-    if lname.endswith("_parameters"):
-        return None
     if isinstance(initializer, _init.Zero):
         return lambda key: jnp.zeros(shape, dtype)
     if isinstance(initializer, _init.One):
         return lambda key: jnp.ones(shape, dtype)
     if isinstance(initializer, _init.Constant):
         return lambda key: jnp.full(shape, initializer.value, dtype)
-    cls = type(initializer)
     if isinstance(initializer, _init.Xavier) \
             and cls._init_weight is _init.Xavier._init_weight:
         if len(shape) < 2:
@@ -213,6 +186,52 @@ def _device_init_rule(initializer, name, attrs, shape, dtype):
         return lambda key: jax.random.uniform(
             key, shape, jnp.float32, -s, s).astype(dtype)
     return None
+
+
+def _device_init_rule(initializer, name, attrs, shape, dtype):
+    """Device-side analog of Initializer.__call__'s name dispatch
+    (initializer.py:55): returns fn(key) -> jax array, or None when the
+    (initializer, name) pair has no closed-form device rule
+    (Orthogonal/Bilinear/..., packed RNN vecs, custom subclasses).
+
+    TPU-first: the reference initializes on the host and copies every
+    parameter to the device; generating with XLA's on-chip RNG instead
+    means a multi-GB model materializes in HBM without a single
+    host->device weight transfer."""
+    import json as _json
+
+    from .. import initializer as _init
+
+    if attrs and attrs.get("__init__"):
+        # per-variable init attr (Variable(init=...)): the host path
+        # applies that initializer's WEIGHT rule — mirror it on device
+        # (bailing here would force e.g. multi-GB MoE expert stacks
+        # through host RAM)
+        try:
+            klass, kw = _json.loads(attrs["__init__"])
+            inst = _init.get(klass, **kw)
+        except Exception:
+            return None
+        return _device_weight_rule(inst, shape, dtype)
+    cls = type(initializer)
+    # any overridden dispatch or rule method means the initializer has
+    # custom semantics (Mixed, Load, user subclasses) — host path only
+    if cls.__call__ is not _init.Initializer.__call__:
+        return None
+    base = _init.Initializer
+    for meth in ("_init_bias", "_init_gamma", "_init_beta", "_init_zero",
+                 "_init_one", "_init_default"):
+        if getattr(cls, meth) is not getattr(base, meth):
+            return None
+    lname = name.lower()
+    if lname.endswith(("_bias", "_beta", "_moving_mean", "_running_mean",
+                       "_moving_avg", "_min", "_max")):
+        return lambda key: jnp.zeros(shape, dtype)
+    if lname.endswith(("_gamma", "_moving_var", "_running_var")):
+        return lambda key: jnp.ones(shape, dtype)
+    if lname.endswith("_parameters"):
+        return None
+    return _device_weight_rule(initializer, shape, dtype)
 
 
 class TrainStep:

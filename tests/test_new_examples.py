@@ -103,3 +103,16 @@ def test_custom_softmax_example():
     out = _run_example("example/numpy-ops/custom_softmax.py",
                        "--num-epoch", "6", timeout=600)
     assert "custom_softmax example OK" in out
+
+
+def test_train_imagenet_nhwc_synthetic():
+    """The north-star CLI runs channel-last end-to-end (--layout NHWC,
+    synthetic benchmark mode; record batches relayout via
+    common/data.ChannelLastIter)."""
+    out = _run_example("example/image-classification/train_imagenet.py",
+                       "--benchmark", "1", "--layout", "NHWC",
+                       "--image-shape", "3,64,64", "--num-layers", "18",
+                       "--num-classes", "16", "--batch-size", "16",
+                       "--num-examples", "64", "--num-epochs", "2",
+                       "--disp-batches", "2", timeout=600)
+    assert "Train-accuracy" in out

@@ -113,11 +113,9 @@ def test_tile_grid(reps):
     np.testing.assert_array_equal(got, np.tile(x, reps))
 
 
-@pytest.mark.parametrize("axis", [0, 1, None])
+@pytest.mark.parametrize("axis", [0, 1])
 def test_flip_reverse(axis):
     x = np.arange(12, dtype="float32").reshape(3, 4)
-    if axis is None:
-        return
     got = nd.reverse(nd.array(x), axis=axis).asnumpy()
     np.testing.assert_array_equal(got, np.flip(x, axis=axis))
 
