@@ -103,6 +103,36 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         v._grad_req = req
 
 
+def _replay_records(nodes, env, skip_ids, heads):
+    """Replay tape records under an id→value environment; inputs absent
+    from env are captured as stop_gradient constants. Outputs whose id is
+    in ``skip_ids`` keep their env value (marked-leaf semantics). Returns
+    the head values. Shared by backward() and the create_graph path so
+    replay semantics cannot diverge."""
+    def val(nd):
+        if nd is None:
+            return None
+        got = env.get(id(nd))
+        return got if got is not None else jax.lax.stop_gradient(nd._data)
+
+    for rec in nodes:
+        ins = [val(x) for x in rec.inputs]
+        if rec.custom is not None:
+            raw = rec.custom(*ins)
+        else:
+            with _reg._OpCtxScope(rec.is_train, rec.rng):
+                raw = rec.opdef.fn(*ins, **rec.attrs)
+        outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+        for o_nd, v in zip(rec.outputs, outs):
+            if id(o_nd) not in skip_ids:
+                env[id(o_nd)] = v
+    res = []
+    for o in heads:
+        got = env.get(id(o))
+        res.append(got if got is not None else o._data)
+    return res
+
+
 def _collect_subgraph(outputs):
     """Topo-ordered tape records reachable from outputs + leaf variables."""
     tape = _state.tape
@@ -149,32 +179,10 @@ def backward(outputs, out_grads=None, retain_graph=False, train_mode=True,
     leaf_id_set = set(leaf_ids)
 
     def replay(leaf_vals):
+        # a marked variable that is itself a record output stays a
+        # leaf: keep the vjp input value so its gradient flows
         env = dict(zip(leaf_ids, leaf_vals))
-
-        def val(nd):
-            if nd is None:
-                return None
-            got = env.get(id(nd))
-            return got if got is not None else jax.lax.stop_gradient(nd._data)
-
-        for rec in nodes:
-            ins = [val(x) for x in rec.inputs]
-            if rec.custom is not None:
-                raw = rec.custom(*ins)
-            else:
-                with _reg._OpCtxScope(rec.is_train, rec.rng):
-                    raw = rec.opdef.fn(*ins, **rec.attrs)
-            outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
-            for o_nd, v in zip(rec.outputs, outs):
-                # a marked variable that is itself a record output stays a
-                # leaf: keep the vjp input value so its gradient flows
-                if id(o_nd) not in leaf_id_set:
-                    env[id(o_nd)] = v
-        res = []
-        for o in outputs:
-            got = env.get(id(o))
-            res.append(got if got is not None else o._data)
-        return res
+        return _replay_records(nodes, env, leaf_id_set, outputs)
 
     leaf_vals = [v._data for v in leaves]
     with _Scope(recording=False, training=train_mode):
@@ -203,18 +211,104 @@ def backward(outputs, out_grads=None, retain_graph=False, train_mode=True,
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Return gradients instead of writing .grad (parity: autograd.grad)."""
+    """Return gradients instead of writing .grad (parity: autograd.grad,
+    python/mxnet/autograd.py:270-307 including ``create_graph=True``).
+
+    With ``create_graph=True`` the returned gradients are themselves
+    recorded: the whole first-order computation (replay + ``jax.vjp``)
+    is re-entered as one custom tape op whose inputs are every external
+    input of the differentiated subgraph, so a later ``backward`` through
+    the returned gradients nests a second ``jax.vjp`` around the first —
+    gradient-of-gradient, including paths through inputs that were *not*
+    in ``variables`` (needed for gradient penalties, where the penalty is
+    d loss/d x but the training gradient is w.r.t. the weights)."""
     from .ndarray.ndarray import NDArray
     if isinstance(heads, NDArray):
         heads = [heads]
     if isinstance(variables, NDArray):
         variables = [variables]
     if create_graph:
-        raise NotImplementedError("higher-order autograd.grad lands with the "
-                                  "symbolic higher-order pass")
+        return _grad_create_graph(heads, variables, head_grads, train_mode)
     retain = retain_graph if retain_graph is not None else create_graph
     return backward(heads, out_grads=head_grads, retain_graph=retain,
                     train_mode=train_mode, variables=variables)
+
+
+def _grad_create_graph(heads, variables, head_grads, train_mode):
+    """First-order grads that stay on the tape (nested-vjp higher order)."""
+    from .ndarray.ndarray import NDArray
+
+    nodes, _ = _collect_subgraph(heads)
+    for rec in nodes:
+        if rec.custom is not None and getattr(rec.custom, "_mx_function",
+                                              False):
+            raise MXNetError(
+                "create_graph=True through an autograd.Function is not "
+                "supported: Function.backward closes over concrete forward "
+                "state, so differentiating the returned gradient again "
+                "would silently treat that state as constant. Express the "
+                "op with recorded NDArray ops (or jax.custom_jvp) instead.")
+    var_ids = [id(v) for v in variables]
+    var_id_set = set(var_ids)
+
+    # External inputs of the subgraph: every record input not produced by
+    # an earlier record, variables first (a marked variable that is itself
+    # a record output stays a leaf, mirroring backward()).
+    produced = set()
+    for rec in nodes:
+        for o in rec.outputs:
+            if id(o) not in var_id_set:
+                produced.add(id(o))
+    ext = list(variables)
+    ext_ids = set(var_ids)
+    for rec in nodes:
+        for inp in rec.inputs:
+            if (inp is not None and id(inp) not in produced
+                    and id(inp) not in ext_ids):
+                ext_ids.add(id(inp))
+                ext.append(inp)
+
+    # Head gradients that are NDArrays become ext inputs too: a recorded
+    # head_grad (e.g. itself a function of x) must contribute to the
+    # second-order gradient, not be frozen as a constant.
+    if head_grads is None:
+        hg_list = None
+    else:
+        hg_list = list(head_grads) if isinstance(head_grads, (list, tuple)) \
+            else [head_grads]
+        for g in hg_list:
+            if isinstance(g, NDArray) and id(g) not in ext_ids:
+                ext_ids.add(id(g))
+                ext.append(g)
+    ext_id_list = [id(x) for x in ext]
+
+    def g_fn(*ext_vals):
+        ext_env = dict(zip(ext_id_list, ext_vals))
+
+        def run(var_vals):
+            env = dict(ext_env)
+            env.update(zip(var_ids, var_vals))
+            return _replay_records(nodes, env, var_id_set, heads)
+
+        var_vals = [ext_env[i] for i in var_ids]
+        with _Scope(recording=False, training=train_mode):
+            out_vals, vjp_fn = jax.vjp(run, var_vals)
+            if hg_list is None:
+                cts = [jnp.ones_like(v) for v in out_vals]
+            else:
+                cts = [ext_env[id(g)] if isinstance(g, NDArray)
+                       else jnp.asarray(g) for g in hg_list]
+            (gvals,) = vjp_fn(cts)
+        return tuple(g.astype(v._data.dtype)
+                     for v, g in zip(variables, gvals))
+
+    ext_vals = [x._data for x in ext]
+    gvals = g_fn(*ext_vals)
+    grads = [NDArray(g, v._ctx) for v, g in zip(variables, gvals)]
+    if is_recording():
+        _record_op(None, {}, is_training(), None, list(ext), grads,
+                   custom=g_fn)
+    return grads
 
 
 def _clear_tape():
@@ -270,6 +364,7 @@ class Function:
             return tuple(x._data for x in igrads)
 
         _f.defvjp(_fwd, _bwd)
+        _f._mx_function = True
         arrs = [x._data for x in inputs]
         raw = _f(*arrs)
         outs_raw = list(raw) if isinstance(raw, tuple) else [raw]

@@ -13,8 +13,12 @@ all-to-alls that move token blocks to their experts over ICI.
   where aux_loss is the standard load-balancing loss (Switch
   Transformer eq. 4: E * Σ_e f_e · P_e).
 * ``moe_reference``   — dense oracle: every token through every
-  expert, combined by the same gates — equals switch_moe whenever no
-  token overflows capacity (the tests pin this).
+  expert, mixed by the FULL softmax over all experts. It equals
+  switch_moe only when ``k == n_experts`` and no token overflows
+  capacity (the tests pin exactly that case, plus a separate top-1
+  oracle); for ``k < n_experts`` switch_moe combines with the
+  un-renormalized top-k probabilities, so the two differ even with
+  infinite capacity.
 
 Capacity semantics: each expert processes at most
 ``ceil(k·N/E · capacity_factor)`` tokens; overflowing tokens are

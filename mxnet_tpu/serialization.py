@@ -111,10 +111,34 @@ def save_ndarray_file(fname, data, fmt="mxnet"):
     else:
         raise TypeError("save expects NDArray, list, or dict")
 
-    if fmt == "mxnet" and any(
-            getattr(a, "stype", "default") == "default"
-            and str(a.dtype) not in _FLAG_OF_DTYPE for a in arrays):
-        fmt = "npz"
+    if fmt == "mxnet":
+        stypes = [getattr(a, "stype", "default") for a in arrays]
+        needs_npz = False
+        for a, stype in zip(arrays, stypes):
+            payload_dtype = str(a.dtype if stype == "default"
+                                else a.data.dtype)
+            if payload_dtype in _FLAG_OF_DTYPE:
+                continue
+            if stype == "default":
+                needs_npz = True
+            else:
+                # npz fallback densifies, silently changing stype — refuse.
+                raise ValueError(
+                    "cannot save %s NDArray with dtype %s in fmt='mxnet': "
+                    "MXNet 1.x has no mshadow flag for it and the npz "
+                    "fallback would densify the array; cast to float32 "
+                    "(nd.astype) or save the components separately"
+                    % (stype, payload_dtype))
+        if needs_npz:
+            if any(s != "default" for s in stypes):
+                # a bf16 dense array must not silently densify a sparse
+                # array that happens to ride in the same file
+                raise ValueError(
+                    "cannot save sparse NDArrays together with a dtype "
+                    "that forces the npz fallback (npz would densify "
+                    "them); save them in separate files or cast the "
+                    "dense array to a flagged dtype")
+            fmt = "npz"
 
     if fmt == "npz":
         raw = ({k: v.asnumpy() for k, v in zip(keys, arrays)} if keys
