@@ -254,6 +254,14 @@ def bench_resnet(args):
                             num_layers=args.num_layers,
                             image_shape=image_shape, dtype=args.dtype,
                             layout=args.layout)
+    n_fused = 0
+    if args.fuse:
+        # BN→ReLU→Conv1×1 Pallas fusion (symbol/fuse.py); matches only
+        # channel-last 1×1 sites, so it no-ops on NCHW — n_fused is
+        # reported so a silent no-op can't masquerade as an A/B arm
+        from mxnet_tpu.symbol.fuse import count_fused, fuse_conv_bn
+        sym = fuse_conv_bn(sym)
+        n_fused = count_fused(sym)
     opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
                            multi_precision=(args.dtype != "float32"),
                            rescale_grad=1.0 / args.batch)
@@ -316,6 +324,7 @@ def bench_resnet(args):
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "device_kind": dev.device_kind,
         "layout": args.layout,
+        "fused": n_fused,
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "peak_bf16_tflops": peak,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,
@@ -513,6 +522,11 @@ def main():
                          "decode + augment + prefetch) instead of "
                          "device-resident synthetic batches")
     ap.add_argument("--decode-threads", type=int, default=8)
+    ap.add_argument("--fuse", dest="fuse", action="store_true", default=False,
+                    help="apply the BN→ReLU→Conv1×1 Pallas fusion pass "
+                         "(NHWC only; A/B flag — see docs/PERF.md for the "
+                         "measured result)")
+    ap.add_argument("--no-fuse", dest="fuse", action="store_false")
     ap.add_argument("--pipeline-scaling", action="store_true",
                     help="measure host decode throughput at 1/2/4/8 "
                          "threads (iterator only, no device)")

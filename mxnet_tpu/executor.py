@@ -31,13 +31,20 @@ __all__ = ["Executor"]
 
 
 def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
-                    group_devices=None):
+                    group_devices=None, tap_cb=None, tap_stat=None):
     """Build a pure function (args, auxs, seed, is_train) ->
     (outputs, new_auxs) interpreting the DAG with registered op impls.
     With ``collect_taps`` the function also returns {tap_name: value} for
     every op output (and every variable when ``monitor_all``) — the debug
     program behind executor monitor callbacks (reference
     graph_executor.cc SetMonitorCallback).
+
+    With ``tap_cb`` the taps instead STREAM out of the compiled program
+    via ``jax.debug.callback`` — the TPU-native analog of the reference
+    engine firing the monitor callback per executed op: ONE program, no
+    second tapped launch. ``tap_stat`` (a jnp function) is applied to
+    each tap inside the program, so only the small statistic crosses to
+    the host, not the full intermediate tensor.
 
     ``group_devices`` maps a ctx_group name (``with AttrScope(
     ctx_group='dev1')``) to a ``jax.Device``: nodes carrying that attr
@@ -58,6 +65,17 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
         dev = group_devices.get(grp)
         return jax.device_put(v, dev) if dev is not None else v
 
+    def _emit_tap(name, v):
+        import functools
+        val = tap_stat(v) if tap_stat is not None else v
+        jax.debug.callback(functools.partial(tap_cb, name), val)
+
+    def _tap_count(node):
+        # taps follow the user-visible monitor contract: one entry per
+        # visible output (invisible aux outputs like BatchNorm's
+        # moving-stat updates would appear as duplicate same-named taps)
+        return node.visible_out_count()
+
     def graph_fn(args, auxs, seed, is_train):
         rng = jax.random.key(seed)
         new_auxs = {}
@@ -75,6 +93,8 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
                         raise MXNetError("unbound variable '%s'" % node.name)
                     if collect_taps and monitor_all:
                         taps[node.name] = env[(id(node), 0)]
+                    if tap_cb is not None and monitor_all:
+                        _emit_tap(node.name, env[(id(node), 0)])
                     continue
                 ins = [env[(id(inp), oi)] for inp, oi in node.inputs]
                 raw = node.op.fn(*ins, **node.attrs)
@@ -83,10 +103,13 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
                            if isinstance(raw, (tuple, list))
                            else _place(node, raw))
                 outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+                n_vis = _tap_count(node)
                 for i, v in enumerate(outs):
                     env[(id(node), i)] = v
-                    if collect_taps:
+                    if collect_taps and i < n_vis:
                         taps[node.output_name(i)] = v
+                    if tap_cb is not None and i < n_vis:
+                        _emit_tap(node.output_name(i), v)
                 # aux-state updates (reference FMutateInputs)
                 if node.op.mutate_inputs and is_train:
                     in_names = node.op.input_names
@@ -126,6 +149,35 @@ def _compiled_cache(symbol):
                  "fwd_eval": _fwd_eval, "fwd_bwd": {}, "fwd_monitor": {}}
         symbol._exec_cache = cache
     return cache
+
+
+class _StreamTarget:
+    """Indirection for in-stream tap callbacks: the compiled stream
+    program calls the module-level dispatcher, which forwards to
+    whichever executor is currently running it — so the compiled program
+    is executor-independent and can be cached per SYMBOL (like
+    _compiled_cache), not per executor. A plain attribute, NOT
+    thread-local: jax delivers debug callbacks on a runtime thread, so
+    the running executor is published globally for the duration of the
+    monitored launch (which ends with an effects barrier). Concurrent
+    monitored launches from multiple host threads would interleave taps
+    — a debug-path limitation the reference's engine callbacks share."""
+    exe = None
+
+
+_STREAM_TARGET = _StreamTarget()
+
+# the stable default on-device statistic (mean |x|, the reference
+# Monitor default); Monitor.install passes this same object so the
+# stream-program cache key is stable across installs
+def DEFAULT_STREAM_STAT(a):
+    return jnp.mean(jnp.abs(a.astype(jnp.float32)))
+
+
+def _stream_dispatch(name, value):
+    exe = _STREAM_TARGET.exe
+    if exe is not None:
+        exe._stream_tap(name, value)
 
 
 def _monitor_fn(symbol, is_train, monitor_all):
@@ -207,6 +259,8 @@ class Executor:
                             if grad_req_dict.get(n, "null") != "null"]
         self._monitor_callback = None
         self._monitor_all = False
+        self._monitor_mode = "stream"
+        self._monitor_stat = None
         self._outputs = None
         self._pending_train_fwd = False
         self._train_seed = None
@@ -281,19 +335,76 @@ class Executor:
     def aux_arrays(self):
         return [self.aux_dict[n] for n in self._aux_names]
 
-    def set_monitor_callback(self, callback, monitor_all=False):
+    def set_monitor_callback(self, callback, monitor_all=False,
+                             mode="stream", stat_fn=None):
         """Install a (name, NDArray) callback fired with every node output
         (and every variable when ``monitor_all``) after each forward
         (reference graph_executor.cc SetMonitorCallback).
 
-        Cost note: the reference streams callbacks from the engine's
-        in-flight execution; here taps come from a SECOND jitted
-        program (the tapped forward) run on monitored batches, so a
-        monitored step costs ~2x a plain one. Monitor's interval gate
-        (``Monitor(interval=N)``) limits this to every N-th batch —
-        un-monitored batches pay nothing."""
+        ``mode='stream'`` (default) fires the taps from INSIDE the one
+        compiled step via ``jax.debug.callback`` — the analog of the
+        reference engine streaming callbacks from in-flight execution.
+        ``stat_fn`` (a jnp function) runs on-device per tap so only the
+        statistic crosses to the host; without it the full tensors
+        stream out. Monitored batches cost ~the plain step plus the
+        stats (timed in tests/test_monitor_stream.py).
+
+        ``mode='tapped'`` keeps the previous behavior — a SECOND jitted
+        program returning every intermediate (full-tensor dumps without
+        per-tap host callbacks) at ~2x step cost on monitored batches.
+        Monitor's interval gate (``Monitor(interval=N)``) limits either
+        cost to every N-th batch."""
         self._monitor_callback = callback
         self._monitor_all = bool(monitor_all)
+        self._monitor_mode = mode
+        self._monitor_stat = stat_fn
+
+    def _stream_tap(self, name, value):
+        cb = self._monitor_callback
+        if cb is not None:
+            cb(name, NDArray(jnp.asarray(value), self._ctx))
+
+    def _stream_fns(self):
+        """Jitted in-stream-tapped programs. Cached per SYMBOL (sharing
+        XLA executables across executors and re-installs exactly like
+        _compiled_cache) — the compiled program calls the module-level
+        _stream_dispatch, which forwards to the currently-running
+        executor. Keyed by (monitor_all, stat id, diff set); Monitor
+        passes the stable DEFAULT_STREAM_STAT object, so repeat installs
+        hit the cache. group2ctx (placed) binds keep a per-executor
+        cache since their programs embed the device map."""
+        key = (self._monitor_all, id(self._monitor_stat))
+        if self._group_devices is None:
+            store = _compiled_cache(self._symbol).setdefault("stream", {})
+        else:
+            store = self.__dict__.setdefault("_placed_stream_cache", {})
+        fns = store.get(key)
+        if fns is None:
+            tapped = _build_graph_fn(
+                self._symbol, group_devices=self._group_devices,
+                monitor_all=self._monitor_all, tap_cb=_stream_dispatch,
+                tap_stat=self._monitor_stat)
+
+            @jax.jit
+            def fwd_train(args, auxs, seed):
+                return tapped(args, auxs, seed, True)
+
+            @jax.jit
+            def fwd_eval(args, auxs, seed):
+                outs, _ = tapped(args, auxs, seed, False)
+                return outs
+
+            fns = {"graph_fn": tapped, "fwd_train": fwd_train,
+                   "fwd_eval": fwd_eval, "fwd_bwd": {}}
+            store[key] = fns
+        # forward programs are diff-set independent; only the fused
+        # fwd+bwd needs a per-diff-set variant
+        diff_key = tuple(sorted(self._diff_names))
+        if diff_key not in fns["fwd_bwd"]:
+            fns["fwd_bwd"][diff_key] = _make_fwd_bwd(fns["graph_fn"],
+                                                     diff_key)
+        return {"fwd_train": fns["fwd_train"], "fwd_eval": fns["fwd_eval"],
+                "fwd_bwd": fns["fwd_bwd"][diff_key]}
 
     def _monitor_active(self):
         if self._monitor_callback is None:
@@ -306,7 +417,14 @@ class Executor:
     def _fire_monitor(self, is_train, seed, auxs):
         fn = _monitor_fn(self._symbol, is_train, self._monitor_all)
         _, _, taps = fn(self._args_values(), auxs, seed)
+        # a stream-installed callback expects the on-device statistic,
+        # not the raw tensor (Monitor.stream_helper skips stat_func) —
+        # apply it here when the tapped program is used as a fallback
+        # (e.g. MXNET_BACKWARD_DO_MIRROR)
+        stat = self._monitor_stat if self._monitor_mode == "stream" else None
         for name, val in taps.items():
+            if stat is not None:
+                val = stat(val)
             self._monitor_callback(name, NDArray(val, self._ctx))
 
     # ------------------------------------------------------------------
@@ -367,24 +485,37 @@ class Executor:
         return contextlib.nullcontext()
 
     def _run_fwd(self, is_train):
-        if is_train:
-            seed = self._train_seed if self._train_seed is not None \
-                else self._next_seed()
-            auxs = self._train_auxs if self._train_auxs is not None \
-                else self._auxs_values()
-            if self._monitor_active():
-                self._fire_monitor(True, seed, auxs)
-            with self._prof_scope("Executor::forward"):
-                outs, new_auxs = self._jit_fwd_train(
-                    self._args_values(), auxs, seed)
-            self._write_auxs(new_auxs)
-        else:
-            seed = self._next_seed()
-            if self._monitor_active():
-                self._fire_monitor(False, seed, self._auxs_values())
-            with self._prof_scope("Executor::forward"):
-                outs = self._jit_fwd_eval(self._args_values(),
-                                          self._auxs_values(), seed)
+        monitored = self._monitor_active()
+        stream = monitored and self._monitor_mode == "stream"
+        if stream:
+            _STREAM_TARGET.exe = self
+        try:
+            if is_train:
+                seed = self._train_seed if self._train_seed is not None \
+                    else self._next_seed()
+                auxs = self._train_auxs if self._train_auxs is not None \
+                    else self._auxs_values()
+                if monitored and not stream:
+                    self._fire_monitor(True, seed, auxs)
+                fwd = (self._stream_fns()["fwd_train"] if stream
+                       else self._jit_fwd_train)
+                with self._prof_scope("Executor::forward"):
+                    outs, new_auxs = fwd(self._args_values(), auxs, seed)
+                self._write_auxs(new_auxs)
+            else:
+                seed = self._next_seed()
+                if monitored and not stream:
+                    self._fire_monitor(False, seed, self._auxs_values())
+                fwd = (self._stream_fns()["fwd_eval"] if stream
+                       else self._jit_fwd_eval)
+                with self._prof_scope("Executor::forward"):
+                    outs = fwd(self._args_values(), self._auxs_values(),
+                               seed)
+            if stream:
+                jax.effects_barrier()   # flush in-flight tap callbacks
+        finally:
+            if stream:
+                _STREAM_TARGET.exe = None
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False
         return self._outputs
@@ -410,13 +541,32 @@ class Executor:
             else self._auxs_values()
         self._train_seed = None
         self._train_auxs = None
-        if self._monitor_active() and self._pending_train_fwd:
-            # fire taps with the same seed/aux snapshot the fused program
-            # will consume, so the monitored values match what executes
+        monitored = self._monitor_active() and self._pending_train_fwd
+        from . import config as _config
+        # MXNET_BACKWARD_DO_MIRROR rematerializes the forward inside the
+        # fused fwd+bwd (jax.checkpoint) — the re-run would fire every
+        # stream tap twice, so monitored mirror steps use the tapped
+        # program instead
+        stream = (monitored and self._monitor_mode == "stream"
+                  and not _config.backward_do_mirror())
+        if monitored and not stream:
+            # tapped mode: fire taps with the same seed/aux snapshot the
+            # fused program will consume, so the monitored values match
+            # what executes
             self._fire_monitor(True, seed, auxs)
-        with self._prof_scope("Executor::forward_backward"):
-            outs, new_auxs, grads = self._jit_fwd_bwd(
-                self._args_values(), auxs, seed, ograds)
+        if stream:
+            _STREAM_TARGET.exe = self
+        try:
+            fwd_bwd = (self._stream_fns()["fwd_bwd"] if stream
+                       else self._jit_fwd_bwd)
+            with self._prof_scope("Executor::forward_backward"):
+                outs, new_auxs, grads = fwd_bwd(
+                    self._args_values(), auxs, seed, ograds)
+            if stream:
+                jax.effects_barrier()   # flush in-flight tap callbacks
+        finally:
+            if stream:
+                _STREAM_TARGET.exe = None
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False
         self._write_auxs(new_auxs)

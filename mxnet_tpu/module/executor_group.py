@@ -186,5 +186,9 @@ class DataParallelExecutorGroup:
     def install_monitor(self, mon):
         monitor_all = getattr(mon, "monitor_all", False)
         for exe in self.execs:
-            exe.set_monitor_callback(mon.stat_helper if hasattr(mon, "stat_helper")
-                                     else mon, monitor_all)
+            if hasattr(mon, "install"):
+                # Monitor picks stream vs tapped mode (on-device stat vs
+                # full-tensor second program) — don't bypass that choice
+                mon.install(exe)
+            else:   # bare (name, NDArray) callable
+                exe.set_monitor_callback(mon, monitor_all, mode="tapped")

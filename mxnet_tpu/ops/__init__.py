@@ -17,6 +17,7 @@ from . import quantization_ops  # noqa: F401
 from . import extra         # noqa: F401
 from . import tail_ops      # noqa: F401
 from . import rcnn          # noqa: F401
+from . import fused         # noqa: F401
 from . import shape_rules   # noqa: F401
 
 __all__ = ["registry", "register", "get_op", "list_ops", "OpDef"]

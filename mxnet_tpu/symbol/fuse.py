@@ -1,0 +1,185 @@
+"""Graph fusion pass: BatchNorm → ReLU → Convolution(1×1) → _FusedBNReluConv.
+
+The TPU-native analog of a graph-executor rewrite pass (the reference
+runs nnvm passes over the bound graph, graph_executor.cc:905; XLA already
+does memory planning and elementwise fusion, but it will not fuse an
+elementwise producer into a convolution *input*, so this pass rewrites
+the Symbol DAG to hand XLA a primitive that does — ops/fused.py).
+
+Matched pattern (all conditions required):
+
+* ``Convolution`` with 1×1 kernel, stride 1, no padding, no groups,
+  ``no_bias=True``, channel-last layout;
+* fed by ``Activation(act_type='relu')`` whose output has no other
+  consumer;
+* fed by ``BatchNorm`` on the channel axis whose primary output has no
+  other consumer (and whose mean/var outputs are unused);
+* optionally, when the conv's only consumer is an elementwise add, the
+  add is folded in as the kernel's residual epilogue
+  (``fuse_residual=True``).
+
+Anything unmatched is left untouched, so the pass is always safe to
+apply; numerics are identical up to float reassociation (tested in
+tests/test_fused_conv.py).
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _Node
+
+__all__ = ["fuse_conv_bn", "count_fused"]
+
+
+def count_fused(symbol):
+    """Number of ``_FusedBNReluConv`` nodes in ``symbol`` — callers use
+    this to report whether a rewrite actually fused anything (the pass
+    silently no-ops on graphs with no channel-last 1×1 sites, e.g.
+    NCHW)."""
+    return sum(1 for n in symbol._topo()
+               if not n.is_var and n.op.name == "_FusedBNReluConv")
+
+_ADD_OPS = ("broadcast_add", "elemwise_add", "_plus", "_add")
+
+
+def _conv_matches(node):
+    if node.is_var or node.op.name != "Convolution":
+        return False
+    a = node.attrs
+    kernel = tuple(a.get("kernel", ()))
+    if any(int(k) != 1 for k in kernel) or not kernel:
+        return False
+    stride = tuple(a.get("stride", ()) or ())
+    if any(int(s) != 1 for s in stride):
+        return False
+    pad = tuple(a.get("pad", ()) or ())
+    if any(int(p) != 0 for p in pad):
+        return False
+    if int(a.get("num_group", 1)) != 1 or not a.get("no_bias", False):
+        return False
+    layout = a.get("layout")
+    return bool(layout) and str(layout).endswith("C")
+
+
+def _bn_matches(node, ndim_channel_axis):
+    if node.is_var or node.op.name != "BatchNorm":
+        return False
+    a = node.attrs
+    if a.get("use_global_stats", False):
+        return False
+    return int(a.get("axis", 1)) == ndim_channel_axis
+
+
+def fuse_conv_bn(symbol, fuse_residual=True):
+    """Return a new Symbol with every matched BN→ReLU→Conv1×1 triple
+    replaced by one ``_FusedBNReluConv`` node. ``fuse_residual`` also
+    folds a following elementwise add into the kernel's epilogue."""
+    topo = symbol._topo()
+
+    consumers = {}          # (id(node), out_idx) -> count
+    for node in topo:
+        for inp, oi in node.inputs:
+            consumers[(id(inp), oi)] = consumers.get((id(inp), oi), 0) + 1
+    for node, oi in symbol._entries:
+        consumers[(id(node), oi)] = consumers.get((id(node), oi), 0) + 1
+
+    fused_op = _reg.get_op("_FusedBNReluConv")
+
+    # conv node id -> (bn_node, act_node, conv_node)
+    matches = {}
+    for node in topo:
+        if not _conv_matches(node):
+            continue
+        (act, act_oi) = node.inputs[0]
+        if act.is_var or act_oi != 0 or act.op.name != "Activation" \
+                or act.attrs.get("act_type") != "relu":
+            continue
+        if consumers.get((id(act), 0), 0) != 1:
+            continue
+        (bn, bn_oi) = act.inputs[0]
+        if bn_oi != 0 or not _bn_matches(bn, len(tuple(
+                node.attrs.get("kernel", ()))) + 1):
+            continue
+        if consumers.get((id(bn), 0), 0) != 1:
+            continue
+        if any(consumers.get((id(bn), i), 0) for i in range(1, 5)):
+            continue
+        matches[id(node)] = (bn, act, node)
+
+    if not matches:
+        return symbol
+
+    # add node id -> (conv_node, residual_entry, conv_input_position)
+    add_folds = {}
+    fused_convs_in_adds = set()
+    if fuse_residual:
+        for node in topo:
+            if node.is_var or node.op.name not in _ADD_OPS:
+                continue
+            for pos in (0, 1):
+                src, oi = node.inputs[pos]
+                if oi == 0 and id(src) in matches \
+                        and consumers.get((id(src), 0), 0) == 1 \
+                        and id(src) not in fused_convs_in_adds:
+                    add_folds[id(node)] = (src, node.inputs[1 - pos], pos)
+                    fused_convs_in_adds.add(id(src))
+                    break
+
+    memo = {}
+
+    def _fused_attrs(bn, conv, with_residual):
+        a = dict(conv.attrs)
+        return {
+            "num_filter": int(a["num_filter"]),
+            "eps": bn.attrs.get("eps", 1e-3),
+            "momentum": bn.attrs.get("momentum", 0.9),
+            "fix_gamma": bn.attrs.get("fix_gamma", True),
+            "use_global_stats": False,
+            "layout": a.get("layout"),
+            "with_residual": bool(with_residual),
+        }
+
+    def _fused_inputs(bn, conv):
+        # BatchNorm inputs: data, gamma, beta, moving_mean, moving_var
+        data_e, gamma_e, beta_e, mm_e, mv_e = bn.inputs
+        weight_e = conv.inputs[1]
+        return [data_e, gamma_e, beta_e, mm_e, mv_e, weight_e]
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_var:
+            memo[id(node)] = (node, {})
+            return memo[id(node)]
+
+        if id(node) in add_folds:
+            conv, res_entry, _pos = add_folds[id(node)]
+            bn, act, _ = matches[id(conv)]
+            ins = [_entry(e) for e in _fused_inputs(bn, conv)]
+            ins.append(_entry(res_entry))
+            new = _Node(fused_op, conv.name,
+                        _fused_attrs(bn, conv, True), ins,
+                        dict(conv.str_attrs))
+            memo[id(node)] = (new, {0: 0})
+            return memo[id(node)]
+
+        if id(node) in matches and id(node) not in fused_convs_in_adds:
+            bn, act, conv = matches[id(node)]
+            ins = [_entry(e) for e in _fused_inputs(bn, conv)]
+            new = _Node(fused_op, conv.name,
+                        _fused_attrs(bn, conv, False), ins,
+                        dict(conv.str_attrs))
+            memo[id(node)] = (new, {0: 0})
+            return memo[id(node)]
+
+        ins = [_entry(e) for e in node.inputs]
+        new = _Node(node.op, node.name, dict(node.attrs), ins,
+                    dict(node.str_attrs), node.cf_meta)
+        memo[id(node)] = (new, {})
+        return memo[id(node)]
+
+    def _entry(e):
+        node, oi = e
+        new, remap = rebuild(node)
+        return (new, remap.get(oi, oi))
+
+    return Symbol([_entry(e) for e in symbol._entries])

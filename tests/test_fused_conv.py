@@ -1,0 +1,275 @@
+"""Fused BN→ReLU→Conv1×1 operator + graph pass (ops/fused.py,
+symbol/fuse.py).
+
+Validates (reference composition: src/operator/nn/batch_norm.cc +
+activation.cc + convolution.cc):
+* the fused op equals the composed BatchNorm→ReLU→Conv graph in train
+  and eval modes, including moving-stat updates and all gradients;
+* the Pallas kernel (interpret mode on CPU) equals the jnp fallback;
+* the graph rewrite fuses the expected ResNet-50 sites, leaves
+  arguments/auxs/shapes unchanged, and preserves numerics end-to-end
+  through executor backward;
+* fused symbols JSON-round-trip.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.executor import _build_graph_fn
+from mxnet_tpu.ops import registry as reg
+from mxnet_tpu.ops.fused import (fused_bn_relu_conv, fused_scale_relu_matmul,
+                                 _jnp_fwd)
+from mxnet_tpu.ops.nn import activation, batch_norm, convolution
+from mxnet_tpu.symbol.fuse import fuse_conv_bn
+
+
+def _composed(x, gamma, beta, mm, mv, wt, O, fix_gamma=False):
+    out = batch_norm(x, gamma, beta, mm, mv, eps=2e-5, momentum=0.9,
+                     fix_gamma=fix_gamma, axis=3)
+    a = activation(out[0], act_type="relu")
+    y = convolution(a, wt, None, kernel=(1, 1), num_filter=O, no_bias=True,
+                    layout="NHWC")
+    return y, out[3], out[4]
+
+
+@pytest.mark.parametrize("fix_gamma", [False, True])
+@pytest.mark.parametrize("is_train", [True, False])
+def test_fused_op_matches_composition(fix_gamma, is_train):
+    rng = np.random.RandomState(0)
+    B, H, W, K, O = 2, 4, 4, 8, 16
+    x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(K).astype(np.float32))
+    mm = jnp.asarray(rng.randn(K).astype(np.float32) * 0.1)
+    mv = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    wt = jnp.asarray(rng.randn(O, 1, 1, K).astype(np.float32) * 0.1)
+
+    with reg._OpCtxScope(is_train, jax.random.key(0)):
+        yc, mmc, mvc = _composed(x, gamma, beta, mm, mv, wt, O, fix_gamma)
+        yf, mmf, mvf = fused_bn_relu_conv(
+            x, gamma, beta, mm, mv, wt, num_filter=O, eps=2e-5,
+            momentum=0.9, fix_gamma=fix_gamma, layout="NHWC")
+    np.testing.assert_allclose(np.asarray(yc), np.asarray(yf),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mmc), np.asarray(mmf), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(mvc), np.asarray(mvf), rtol=1e-6)
+
+
+def test_fused_op_gradients_match():
+    rng = np.random.RandomState(1)
+    B, H, W, K, O = 2, 3, 3, 8, 16
+    x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(K).astype(np.float32))
+    mm = jnp.zeros(K)
+    mv = jnp.ones(K)
+    wt = jnp.asarray(rng.randn(O, 1, 1, K).astype(np.float32) * 0.1)
+    cot = jnp.asarray(rng.randn(B, H, W, O).astype(np.float32))
+
+    def loss_c(args):
+        with reg._OpCtxScope(True, jax.random.key(0)):
+            y, _, _ = _composed(args[0], args[1], args[2], mm, mv,
+                                args[3], O)
+        return jnp.sum(y * cot)
+
+    def loss_f(args):
+        with reg._OpCtxScope(True, jax.random.key(0)):
+            y, _, _ = fused_bn_relu_conv(
+                args[0], args[1], args[2], mm, mv, args[3], num_filter=O,
+                eps=2e-5, fix_gamma=False, layout="NHWC")
+        return jnp.sum(y * cot)
+
+    gc = jax.grad(loss_c)((x, gamma, beta, wt))
+    gf = jax.grad(loss_f)((x, gamma, beta, wt))
+    for a, b, name in zip(gc, gf, ["dx", "dgamma", "dbeta", "dW"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_fused_op_residual():
+    rng = np.random.RandomState(2)
+    B, H, W, K, O = 2, 3, 3, 8, 16
+    x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(K).astype(np.float32))
+    mm = jnp.zeros(K)
+    mv = jnp.ones(K)
+    wt = jnp.asarray(rng.randn(O, 1, 1, K).astype(np.float32) * 0.1)
+    res = jnp.asarray(rng.randn(B, H, W, O).astype(np.float32))
+
+    with reg._OpCtxScope(True, jax.random.key(0)):
+        yc, _, _ = _composed(x, gamma, beta, mm, mv, wt, O)
+        yc = yc + res
+        yf, _, _ = fused_bn_relu_conv(
+            x, gamma, beta, mm, mv, wt, res, num_filter=O, eps=2e-5,
+            fix_gamma=False, layout="NHWC", with_residual=True)
+    np.testing.assert_allclose(np.asarray(yc), np.asarray(yf),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(use_fused, xx, rr):
+        with reg._OpCtxScope(True, jax.random.key(0)):
+            if use_fused:
+                y, _, _ = fused_bn_relu_conv(
+                    xx, gamma, beta, mm, mv, wt, rr, num_filter=O, eps=2e-5,
+                    fix_gamma=False, layout="NHWC", with_residual=True)
+            else:
+                y, _, _ = _composed(xx, gamma, beta, mm, mv, wt, O)
+                y = y + rr
+        return jnp.sum(y * y)
+
+    gc = jax.grad(lambda a: loss(False, *a))((x, res))
+    gf = jax.grad(lambda a: loss(True, *a))((x, res))
+    np.testing.assert_allclose(np.asarray(gc[0]), np.asarray(gf[0]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gc[1]), np.asarray(gf[1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("with_res", [False, True])
+def test_pallas_kernel_interpret_matches_jnp(dtype, with_res):
+    """The Pallas kernel body (interpret mode on CPU) vs the jnp path."""
+    rng = np.random.RandomState(3)
+    M, K, O = 256, 128, 64
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32)).astype(dtype)
+    scale = jnp.asarray(rng.rand(K).astype(np.float32))
+    shift = jnp.asarray(rng.randn(K).astype(np.float32))
+    w = (jnp.asarray(rng.randn(K, O).astype(np.float32)) * 0.1).astype(dtype)
+    res = (jnp.asarray(rng.randn(M, O).astype(np.float32)).astype(dtype)
+           if with_res else None)
+
+    ref = _jnp_fwd(x, scale, shift, w, res)
+    os.environ["MXTPU_FUSED_PALLAS"] = "interpret"
+    try:
+        out = fused_scale_relu_matmul(x, scale, shift, w, res)
+    finally:
+        os.environ.pop("MXTPU_FUSED_PALLAS", None)
+    tol = 1e-6 if dtype == "float32" else 5e-2
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+    assert out.dtype == ref.dtype
+
+
+def _tiny_bottleneck_symbol(with_shortcut=True):
+    """data → BN→ReLU→Conv1×1 → BN→ReLU→Conv1×1 (+shortcut Conv) → out."""
+    data = sym.Variable("data")
+    bn1 = sym.BatchNorm(data=data, name="bn1", fix_gamma=False, eps=2e-5,
+                        momentum=0.9, axis=3)
+    act1 = sym.Activation(data=bn1, act_type="relu", name="relu1")
+    conv1 = sym.Convolution(data=act1, num_filter=16, kernel=(1, 1),
+                            stride=(1, 1), no_bias=True, layout="NHWC",
+                            name="conv1")
+    bn2 = sym.BatchNorm(data=conv1, name="bn2", fix_gamma=False, eps=2e-5,
+                        momentum=0.9, axis=3)
+    act2 = sym.Activation(data=bn2, act_type="relu", name="relu2")
+    conv2 = sym.Convolution(data=act2, num_filter=8, kernel=(1, 1),
+                            stride=(1, 1), no_bias=True, layout="NHWC",
+                            name="conv2")
+    body = (conv2 + data) if with_shortcut else conv2
+    pool = sym.Pooling(data=body, global_pool=True, kernel=(2, 2),
+                       pool_type="avg", name="pool", layout="NHWC")
+    fc = sym.FullyConnected(data=sym.Flatten(data=pool), num_hidden=4,
+                            name="fc")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def test_fuse_pass_counts_and_interfaces():
+    s = _tiny_bottleneck_symbol()
+    f = fuse_conv_bn(s)
+    fused = [n for n in f._topo() if not n.is_var
+             and n.op.name == "_FusedBNReluConv"]
+    assert len(fused) == 2
+    assert sum(1 for n in fused if n.attrs["with_residual"]) == 1
+    assert sum(1 for n in f._topo()
+               if not n.is_var and n.op.name == "Convolution") == 0
+    assert s.list_arguments() == f.list_arguments()
+    assert s.list_auxiliary_states() == f.list_auxiliary_states()
+    shapes = {"data": (2, 4, 4, 8), "softmax_label": (2,)}
+    a1, _, x1 = s.infer_shape(**shapes)
+    a2, _, x2 = f.infer_shape(**shapes)
+    assert [tuple(v) for v in a1] == [tuple(v) for v in a2]
+    assert [tuple(v) for v in x1] == [tuple(v) for v in x2]
+
+
+def test_fuse_pass_preserves_numerics_and_grads():
+    s = _tiny_bottleneck_symbol()
+    f = fuse_conv_bn(s)
+    shapes = {"data": (2, 4, 4, 8), "softmax_label": (2,)}
+    data = np.random.RandomState(0).rand(2, 4, 4, 8).astype(np.float32)
+
+    def run(symbol):
+        ex = symbol.simple_bind(ctx=mx.cpu(), grad_req="write", **shapes)
+        r = np.random.RandomState(7)
+        for name, arr in sorted(ex.arg_dict.items()):
+            if name in shapes:
+                continue
+            arr[:] = r.randn(*arr.shape).astype(np.float32) * 0.3
+        ex.forward(is_train=True, data=data,
+                   softmax_label=np.array([1.0, 2.0], np.float32))
+        ex.backward()
+        outs = [o.asnumpy() for o in ex.outputs]
+        grads = {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                 if v is not None}
+        auxs = {k: v.asnumpy() for k, v in ex.aux_dict.items()}
+        return outs, grads, auxs
+
+    o1, g1, x1 = run(s)
+    o2, g2, x2 = run(f)
+    np.testing.assert_allclose(o1[0], o2[0], rtol=1e-5, atol=1e-5)
+    assert set(g1) == set(g2)
+    for k in g1:
+        np.testing.assert_allclose(g1[k], g2[k], rtol=2e-4, atol=2e-4,
+                                   err_msg=k)
+    for k in x1:
+        np.testing.assert_allclose(x1[k], x2[k], rtol=1e-5, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_fuse_pass_skips_shared_activations():
+    """An activation with two consumers must not be fused away."""
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", fix_gamma=False, axis=3)
+    act = sym.Activation(data=bn, act_type="relu", name="relu")
+    c1 = sym.Convolution(data=act, num_filter=8, kernel=(1, 1),
+                         no_bias=True, layout="NHWC", name="c1")
+    c2 = sym.Convolution(data=act, num_filter=8, kernel=(1, 1),
+                         no_bias=True, layout="NHWC", name="c2")
+    out = c1 + c2
+    f = fuse_conv_bn(out)
+    assert not any((not n.is_var) and n.op.name == "_FusedBNReluConv"
+                   for n in f._topo())
+
+
+def test_fused_symbol_json_roundtrip():
+    f = fuse_conv_bn(_tiny_bottleneck_symbol())
+    j = f.tojson()
+    f2 = sym.load_json(j)
+    assert any((not n.is_var) and n.op.name == "_FusedBNReluConv"
+               for n in f2._topo())
+    shapes = {"data": (2, 4, 4, 8), "softmax_label": (2,)}
+    a1, _, _ = f.infer_shape(**shapes)
+    a2, _, _ = f2.infer_shape(**shapes)
+    assert [tuple(v) for v in a1] == [tuple(v) for v in a2]
+
+
+def test_resnet50_fusion_sites():
+    """ResNet-50 NHWC: 28 of 53 convs fuse (12 conv1 + 16 conv3, the
+    16 conv3 sites absorbing the shortcut add as residual epilogue)."""
+    from mxnet_tpu import models
+    s = models.get_symbol("resnet", num_classes=10, num_layers=50,
+                          image_shape=(3, 224, 224), dtype="float32",
+                          layout="NHWC")
+    f = fuse_conv_bn(s)
+    fused = [n for n in f._topo() if not n.is_var
+             and n.op.name == "_FusedBNReluConv"]
+    assert len(fused) == 28
+    assert sum(1 for n in fused if n.attrs["with_residual"]) == 16
+    assert s.list_arguments() == f.list_arguments()
+    assert s.list_auxiliary_states() == f.list_auxiliary_states()

@@ -1,0 +1,145 @@
+"""Sandwich microbench behind docs/PERF.md's round-4 negative result.
+
+Times conv3x3 → [BN-apply+ReLU+1×1 candidate c→C] → [candidate C→c] →
+conv3x3 (forward, bf16, stage-3-like shapes) three ways:
+
+* ``xla``   — plain jnp (affine+relu elementwise, einsum matmul): XLA's
+  own fusion + layout assignment;
+* ``pal2d`` — the Pallas kernel in ops/fused.py (2-D row-tiled view);
+* ``pal4d`` — a 4-D-native Pallas variant (blocks over B×H tiles, no
+  host-visible reshape) to test whether the relayout around the
+  custom-call boundary, rather than the reshape, is the cost.
+
+Differential fori-loop timing (bench.py methodology). Run on a TPU from
+/root/repo:  ``python tools/fused_sandwich_bench.py``. Measured v5e
+result (2026-07, docs/PERF.md): xla ≈ 0 ms (sub-noise), pal2d ≈ +1.1 ms,
+pal4d ≈ +3.8 ms per iteration — the custom-call boundary loses to XLA's
+layout-aware fusion regardless of how the kernel is tiled.
+"""
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+B, H, W_, c, C = 256, 28, 28, 128, 512
+ITERS = 60
+N0 = 2
+
+
+def conv3(x, w):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "OHWI", "NHWC"))
+    return lax.conv_general_dilated(x, w, (1, 1), [(1, 1), (1, 1)],
+                                    dimension_numbers=dn).astype(x.dtype)
+
+
+def xla_band(x, s, t, w2d):
+    z = x.astype(jnp.float32) * s + t
+    a = jnp.maximum(z, 0.0).astype(x.dtype)
+    y = jnp.einsum("bhwk,kn->bhwn", a, w2d)
+    return y.astype(x.dtype)
+
+
+def pal2d_band(x, s, t, w2d):
+    from mxnet_tpu.ops.fused import _pallas_fwd
+    b, h, w, k = x.shape
+    y = _pallas_fwd(x.reshape(-1, k), s, t, w2d, None)
+    return y.reshape(b, h, w, w2d.shape[1])
+
+
+def _kern4d(x_ref, s_ref, t_ref, w_ref, o_ref, *, th, w_sp, k, n):
+    xf = x_ref[:].reshape(th * w_sp, k).astype(jnp.float32)
+    z = xf * s_ref[:] + t_ref[:]
+    a = jnp.maximum(z, 0.0).astype(w_ref.dtype)
+    acc = jnp.dot(a, w_ref[:], preferred_element_type=jnp.float32)
+    o_ref[:] = acc.reshape(1, th, w_sp, n).astype(o_ref.dtype)
+
+
+def pal4d_band(x, s, t, w2d):
+    b, h, w, k = x.shape
+    n = w2d.shape[1]
+    th = 1
+    for cand in (16, 8, 4, 2):
+        if h % cand == 0 and cand * w >= 128:
+            th = cand
+            break
+    grid = (b, h // th)
+    return pl.pallas_call(
+        partial(_kern4d, th=th, w_sp=w, k=k, n=n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, th, w, k), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, k), lambda i, j: (0, 0)),
+            pl.BlockSpec((k, n), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, th, w, n), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, n), x.dtype),
+    )(x, s.reshape(1, k).astype(jnp.float32),
+      t.reshape(1, k).astype(jnp.float32), w2d)
+
+
+def make_net(band):
+    def net(x, wc1, s1, t1, wu, s2, t2, wd, wc2):
+        h = conv3(x, wc1)
+        h = band(h, s1, t1, wu)          # c -> C
+        h = band(h, s2, t2, wd)          # C -> c
+        h = conv3(h, wc2)
+        return h
+    return net
+
+
+def bench(net, args):
+    def make_run(n):
+        @jax.jit
+        def run(x, *rest):
+            def body(i, x):
+                y = net(x, *rest)
+                patch = (jnp.sum(y[0, 0, 0, :8].astype(jnp.float32))
+                         * 1e-30).astype(x.dtype).reshape(1, 1, 1, 1)
+                return lax.dynamic_update_slice(x, patch, (0, 0, 0, 0))
+            return lax.fori_loop(0, n, body, x)
+        return run
+
+    short, long_ = make_run(N0), make_run(N0 + ITERS)
+    for fn in (short, long_):
+        jax.block_until_ready(fn(*args))
+
+    def t(fn):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = fn(*args)
+            float(jnp.asarray(r[0, 0, 0, 0], jnp.float32))
+            best = min(best, time.perf_counter() - t0)
+        return best
+    return (t(long_) - t(short)) / ITERS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    bf = jnp.bfloat16
+    x = jnp.asarray(rng.randn(B, H, W_, c).astype(np.float32)).astype(bf)
+    wc1 = (jnp.asarray(rng.randn(c, 3, 3, c).astype(np.float32)) * 0.05
+           ).astype(bf)
+    wc2 = (jnp.asarray(rng.randn(c, 3, 3, c).astype(np.float32)) * 0.05
+           ).astype(bf)
+    s1 = jnp.asarray(rng.rand(c).astype(np.float32) + 0.5)
+    t1 = jnp.asarray(rng.randn(c).astype(np.float32))
+    wu = (jnp.asarray(rng.randn(c, C).astype(np.float32)) * 0.05).astype(bf)
+    s2 = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    t2 = jnp.asarray(rng.randn(C).astype(np.float32))
+    wd = (jnp.asarray(rng.randn(C, c).astype(np.float32)) * 0.05).astype(bf)
+    args = (x, wc1, s1, t1, wu, s2, t2, wd, wc2)
+
+    for name, band in [("xla", xla_band), ("pal2d", pal2d_band),
+                       ("pal4d", pal4d_band)]:
+        dt = bench(make_net(band), args)
+        print("%-6s %8.3f ms/iter" % (name, dt * 1e3))
+
+
+if __name__ == "__main__":
+    main()

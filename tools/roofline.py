@@ -148,6 +148,11 @@ def _build_step(args):
         sym = models.get_symbol("resnet", num_classes=1000, num_layers=50,
                                 image_shape=image_shape, dtype=args.dtype,
                                 layout=args.layout)
+        if getattr(args, "fuse", False):
+            from mxnet_tpu.symbol.fuse import count_fused, fuse_conv_bn
+            sym = fuse_conv_bn(sym)
+            print("# fuse: %d _FusedBNReluConv sites (0 = pass no-oped, "
+                  "e.g. NCHW layout)" % count_fused(sym))
         ts = TrainStep(
             sym,
             mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
@@ -224,6 +229,9 @@ def main():
     ap.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"])
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--iters", type=int, default=4)
+    ap.add_argument("--fuse", action="store_true",
+                    help="apply the BN→ReLU→Conv1×1 fusion pass "
+                         "(symbol/fuse.py) to the resnet step")
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -302,6 +310,24 @@ def main():
             ("%.1f" % (flops / 1e9)) if flops else "-",
             ("%.1f" % inten) if inten else "-"))
         shown += 1
+
+    # aggregate device time by opcode family — the "where did the step
+    # go" summary (total device ms/step and share per kind)
+    by_kind = collections.defaultdict(float)
+    for name, (dur_ps, _cnt, _ev) in agg.items():
+        if name in hlo.instr:
+            kind = hlo.instr[name][0]
+        else:
+            kind = re.sub(r"[.\d]+$", "", name)
+        by_kind[kind] += dur_ps
+    print("\n# by-kind totals (device): step = %.1f ms"
+          % (total_ps / 1e9 / args.iters))
+    for kind, ps in sorted(by_kind.items(), key=lambda kv: -kv[1]):
+        ms = ps / 1e9 / args.iters
+        if ms < 0.05:
+            continue
+        print("#   %-28s %8.2f ms  %5.1f%%"
+              % (kind, ms, 100.0 * ps / total_ps))
 
 
 if __name__ == "__main__":
