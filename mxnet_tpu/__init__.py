@@ -48,10 +48,6 @@ def _maybe_init_distributed():
 
 _maybe_init_distributed()
 
-# server/scheduler-role processes exit idle here (reference wires
-# kvstore_server the same way: python/mxnet/__init__.py:57)
-from . import kvstore_server  # noqa: E402,F401
-
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)
@@ -97,3 +93,11 @@ from . import profiler
 from . import monitor
 from .monitor import Monitor
 from . import test_utils
+
+# server/scheduler-role processes enter their loop here, at the END of
+# the package import (reference wires kvstore_server the same way,
+# python/mxnet/__init__.py:57). It must NOT run mid-import: the serve
+# loop would hold the package's import lock forever and any handler
+# thread importing a submodule (optimizer, compression) would deadlock.
+from . import kvstore_server  # noqa: E402,F401
+kvstore_server._init_kvstore_server_module()

@@ -394,8 +394,11 @@ class Executor:
                 outs, _ = tapped(args, auxs, seed, False)
                 return outs
 
+            # "stat" pins the stat function alive so its id() (the cache
+            # key) can never be recycled onto a different function
             fns = {"graph_fn": tapped, "fwd_train": fwd_train,
-                   "fwd_eval": fwd_eval, "fwd_bwd": {}}
+                   "fwd_eval": fwd_eval, "fwd_bwd": {},
+                   "stat": self._monitor_stat}
             store[key] = fns
         # forward programs are diff-set independent; only the fused
         # fwd+bwd needs a per-diff-set variant

@@ -1,11 +1,13 @@
 """DataLoader (reference python/mxnet/gluon/data/dataloader.py:26-96).
 
-TPU-native worker model: the reference forks worker *processes* and ships
-batches through CPU shared memory because Python-side decode contends with
-the GIL-bound training loop. Here decode/augment is numpy (releases the
-GIL in practice) and device transfer is jax's async host→HBM copy, so
-``num_workers`` maps to a thread pool prefetching whole batches — no
-pickle/shared-memory round-trip, same overlap.
+Worker model: ``num_workers > 0`` forks worker PROCESSES (reference
+parity: dataloader.py:26-96 + cpu_shared_storage_manager.h) — each
+worker batchifies on its own interpreter (no GIL contention with the
+training loop) and ships the batch back through POSIX shared memory
+(multiprocessing.shared_memory), one copy host-side; the parent's
+``nd.array`` wrap is the same host→HBM copy every batch pays. Pure-numpy
+augmentation that releases the GIL can instead use ``thread_pool=True``
+(the round-3 thread-pool prefetcher — cheaper startup, no pickling).
 """
 from __future__ import annotations
 
@@ -14,6 +16,129 @@ import numpy as np
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def _np_batchify(data):
+    """Worker-side batchify to plain numpy (device arrays cannot cross a
+    process boundary; the parent wraps to NDArray after reassembly)."""
+    first = data[0]
+    if isinstance(first, tuple):
+        return tuple(_np_batchify(list(x)) for x in zip(*data))
+    if isinstance(first, (list,)):
+        return [_np_batchify(list(x)) for x in zip(*data)]
+    arr = np.stack([np.asarray(
+        x.asnumpy() if hasattr(x, "asnumpy") else x) for x in data])
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+class _NdLeaf:
+    """Marks a transported array that must rebuild as an NDArray (vs a
+    user batchify_fn's plain numpy, which must stay numpy)."""
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
+def _shm_export(obj, shms):
+    """Replace array leaves with shared-memory descriptors."""
+    from multiprocessing import shared_memory
+    was_nd = isinstance(obj, _NdLeaf)
+    if was_nd:
+        obj = obj.arr
+    if isinstance(obj, np.ndarray):
+        shm = shared_memory.SharedMemory(create=True,
+                                         size=max(obj.nbytes, 1))
+        view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        view[...] = obj
+        shms.append(shm)
+        return ("__shm__", shm.name, obj.shape, obj.dtype.str, was_nd)
+    if isinstance(obj, tuple):
+        return tuple(_shm_export(x, shms) for x in obj)
+    if isinstance(obj, list):
+        return [_shm_export(x, shms) for x in obj]
+    return obj
+
+
+def _shm_import(obj):
+    """Rebuild array leaves from shared-memory descriptors (copying out,
+    then releasing the segment); _NdLeaf-tagged ones become NDArrays."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple) and len(obj) == 5 and obj[0] == "__shm__":
+        _, name, shape, dtype, was_nd = obj
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        if was_nd:
+            from ... import ndarray as nd
+            return nd.array(arr, dtype=arr.dtype)
+        return arr
+    if isinstance(obj, tuple):
+        return tuple(_shm_import(x) for x in obj)
+    if isinstance(obj, list):
+        return [_shm_import(x) for x in obj]
+    return obj
+
+
+def _worker_loop(dataset, batchify_fn, task_q, res_q):
+    """Worker process body: pull (seq, indices), push (seq, shm batch).
+    The dataset rides the fork — no per-batch pickling of samples."""
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        seq, indices = task
+        try:
+            if batchify_fn is None:
+                # default batchify yields NDArrays — tag every leaf
+                batch = _tag_nd(_np_batchify([dataset[i] for i in indices]))
+            else:
+                batch = batchify_fn([dataset[i] for i in indices])
+                batch = _to_numpy_tree(batch)
+            shms = []
+            desc = _shm_export(batch, shms)
+            res_q.put((seq, desc, None))
+            for shm in shms:       # parent owns the segments now
+                shm.close()
+                # the PARENT unlinks after copying out; drop this
+                # process' resource-tracker claim or its exit handler
+                # warns about the already-removed segment
+                try:
+                    from multiprocessing import resource_tracker
+                    resource_tracker.unregister(shm._name, "shared_memory")
+                except Exception:
+                    pass
+        except Exception as e:     # surface worker errors in the parent
+            import traceback
+            res_q.put((seq, None, "%s\n%s" % (e, traceback.format_exc())))
+
+
+def _to_numpy_tree(obj):
+    """Device arrays can't cross the process boundary: NDArray leaves
+    become _NdLeaf-tagged numpy (rebuilt as NDArray in the parent); a
+    user batchify's plain numpy stays numpy on both sides."""
+    if hasattr(obj, "asnumpy"):
+        return _NdLeaf(np.asarray(obj.asnumpy()))
+    if isinstance(obj, tuple):
+        return tuple(_to_numpy_tree(x) for x in obj)
+    if isinstance(obj, list):
+        return [_to_numpy_tree(x) for x in obj]
+    return obj
+
+
+def _tag_nd(obj):
+    if isinstance(obj, np.ndarray):
+        return _NdLeaf(obj)
+    if isinstance(obj, tuple):
+        return tuple(_tag_nd(x) for x in obj)
+    if isinstance(obj, list):
+        return [_tag_nd(x) for x in obj]
+    return obj
 
 
 def default_batchify_fn(data):
@@ -32,12 +157,21 @@ def default_batchify_fn(data):
 
 
 class DataLoader:
-    """Loads batches from a Dataset (reference dataloader.py:26)."""
+    """Loads batches from a Dataset (reference dataloader.py:26).
+
+    ``num_workers > 0`` forks worker processes (shared-memory batch
+    transport, reference parity). Worker code must stay host-side
+    (numpy/PIL): forking a process whose accelerator runtime is
+    initialized is safe only as long as the children never touch the
+    device — the same constraint the reference has with CUDA. Datasets
+    whose __getitem__ runs device ops should use ``thread_pool=True``
+    instead."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0):
+                 num_workers=0, thread_pool=False):
         self._dataset = dataset
+        self._thread_pool = bool(thread_pool)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
@@ -68,6 +202,12 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
+        if self._thread_pool:
+            yield from self._iter_threads()
+        else:
+            yield from self._iter_processes()
+
+    def _iter_threads(self):
         # thread-pool prefetch: keep num_workers batches in flight
         from concurrent.futures import ThreadPoolExecutor
         import collections
@@ -85,6 +225,72 @@ class DataLoader:
                     pending.append(pool.submit(self._make_batch, next(it)))
                 except StopIteration:
                     pass
+
+    def _iter_processes(self):
+        """Fork num_workers processes; batches return through shared
+        memory, yielded strictly in sampler order (reference
+        dataloader.py _MultiWorkerIter)."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        task_q = ctx.Queue()
+        res_q = ctx.Queue()
+        user_bfn = (None if self._batchify_fn is default_batchify_fn
+                    else self._batchify_fn)
+        workers = [ctx.Process(target=_worker_loop,
+                               args=(self._dataset, user_bfn, task_q, res_q),
+                               daemon=True)
+                   for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        try:
+            it = iter(self._batch_sampler)
+            sent = recvd = 0
+            buffered = {}
+            for _ in range(self._num_workers * 2):
+                try:
+                    task_q.put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    break
+            while recvd < sent:
+                while recvd not in buffered:
+                    seq, desc, err = res_q.get()
+                    if err is not None:
+                        raise RuntimeError("DataLoader worker failed: %s"
+                                           % err)
+                    buffered[seq] = desc
+                desc = buffered.pop(recvd)
+                recvd += 1
+                try:
+                    task_q.put((sent, next(it)))
+                    sent += 1
+                except StopIteration:
+                    pass
+                yield _shm_import(desc)
+        finally:
+            for _ in workers:
+                task_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+            # release every undelivered shm segment (out-of-order ones
+            # buffered locally AND stragglers still in the queue) so an
+            # error or early generator close leaks nothing in /dev/shm
+            for desc in buffered.values():
+                try:
+                    _shm_import(desc)
+                except Exception:
+                    pass
+            buffered.clear()
+            try:
+                while True:
+                    _, desc, _err = res_q.get_nowait()
+                    if desc is not None:
+                        _shm_import(desc)
+            except Exception:
+                pass
 
     def __len__(self):
         return len(self._batch_sampler)

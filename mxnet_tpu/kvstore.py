@@ -9,10 +9,14 @@ TPU-native mapping:
   are sharded over a mesh the same add lowers to an ICI all-reduce.
 * ``tpu`` (alias ``nccl``) — same API; values that live sharded on a
   ``jax.sharding.Mesh`` reduce over ICI (replaces KVStoreNCCL).
-* ``dist_sync``/``dist_async`` — multi-process over ``jax.distributed``
-  (kvstore_dist.py), replacing ps-lite ZPush/ZPull. The optimizer-on-server
-  mode maps to running the updater on the reduced value (sync by
+* ``dist_sync`` — multi-process over ``jax.distributed`` collectives
+  (kvstore_dist.py), replacing ps-lite ZPush/ZPull; optimizer-on-server
+  maps to running the updater on the reduced value (sync by
   construction).
+* ``dist_async`` — REAL Hogwild-style parameter servers
+  (kvstore_async.py, reference kvstore_dist_server.h async mode):
+  launch with ``tools/launch.py -n W -s S``; every push applies
+  immediately on the server, workers run free.
 
 2-bit gradient compression (rahul003's signature feature,
 src/kvstore/gradient_compression.h) is preserved as an optional transform
@@ -41,6 +45,11 @@ def create(name="local"):
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl", "tpu"):
         return KVStore(name)
+    if "async" in name and name.startswith("dist"):
+        # real Hogwild-style parameter servers (kvstore_async.py):
+        # immediate per-push applies, free-running workers
+        from .kvstore_async import KVStoreDistAsync
+        return KVStoreDistAsync(name)
     if name.startswith("dist"):
         from .kvstore_dist import KVStoreDist
         return KVStoreDist(name)

@@ -13,9 +13,11 @@ observes the value. The "server state" (weights + optimizer state) is
 replicated deterministically on every worker — same reduced gradient,
 same updater, same result — so pull never needs a wire transfer at all.
 
-``dist_async`` is accepted but runs with sync semantics: Hogwild-style
-async applies make no sense when the transport is a collective (and sync
-is strictly more reproducible). ``get_num_dead_node``/``is_recovery``
+``dist_async`` does NOT live here: Hogwild-style async applies make no
+sense on a collective transport (collectives are barriers by
+construction), so ``mx.kv.create('dist_async')`` dispatches to the real
+parameter-server implementation in kvstore_async.py (immediate per-push
+applies, free-running workers). ``get_num_dead_node``/``is_recovery``
 map to the jax coordination service's own failure model: a dead process
 fails the job, so the live view is always "0 dead".
 
@@ -64,15 +66,10 @@ class KVStoreDist(KVStore):
     def __init__(self, name="dist_sync"):
         super().__init__(name)
         if "async" in name:
-            import warnings
-            warnings.warn(
-                "kvstore '%s': async (Hogwild-style) application is not "
-                "supported on the collective transport; running with "
-                "dist_sync semantics instead. This diverges from the "
-                "reference's kvstore_dist_server.h async mode (updates "
-                "there apply immediately per-push); results here are the "
-                "deterministic sync ones." % name,
-                UserWarning, stacklevel=3)
+            raise MXNetError(
+                "KVStoreDist is the collective (sync) transport; "
+                "'%s' must be created via mx.kv.create, which dispatches "
+                "async names to kvstore_async.KVStoreDistAsync" % name)
         _ensure_dist()
         import jax
         self._rank = jax.process_index()

@@ -1,12 +1,15 @@
-"""kvstore_server — parameter-server bootstrap (reference parity shim).
+"""kvstore_server — parameter-server bootstrap.
 
-Reference: python/mxnet/kvstore_server.py enters the ps-lite server loop
-when a process is launched with DMLC_ROLE=server. The TPU-native
-distributed kvstore has **no server processes** — ps-lite is replaced by
-jax.distributed collectives with the server state replicated on every
-worker (kvstore_dist.py) — so a process launched in the server role has
-nothing to do and this module documents exactly that. tools/launch.py
-accordingly spawns workers only.
+Reference parity: python/mxnet/kvstore_server.py enters the ps-lite
+server loop when a process is launched with DMLC_ROLE=server. Here that
+role is REAL for ``dist_async``: the process runs the threaded TCP
+parameter server from kvstore_async.py (immediate Hogwild-style applies,
+optimizer-on-server). ``dist_sync`` still needs no servers — it rides
+jax.distributed collectives with replicated state (kvstore_dist.py) — so
+tools/launch.py spawns servers only when ``-s`` is given.
+
+Server i of S listens on DMLC_PS_ROOT_PORT + i (workers shard keys
+across servers by stable hash, kvstore_async.py _server_of).
 """
 from __future__ import annotations
 
@@ -17,14 +20,55 @@ __all__ = ["_init_kvstore_server_module"]
 
 
 def _init_kvstore_server_module():
+    """Called from mxnet_tpu/__init__.py AFTER the package is fully
+    imported (serving mid-import would deadlock handler threads on the
+    import lock)."""
     role = os.environ.get("DMLC_ROLE", "worker")
-    if role in ("server", "scheduler"):
+    if role == "scheduler":
         logging.warning(
-            "process launched with DMLC_ROLE=%s: the TPU-native kvstore "
-            "has no %s processes (collectives replace ps-lite; see "
-            "kvstore_dist.py). Exiting idle.", role, role)
+            "DMLC_ROLE=scheduler: the TPU-native kvstore has no scheduler "
+            "process (jax.distributed / the launcher own the topology). "
+            "Exiting idle.")
         raise SystemExit(0)
+    if role == "server":
+        host = os.environ.get("DMLC_PS_BIND", "0.0.0.0")
+        port = (int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+                + int(os.environ.get("MXTPU_SERVER_RANK", "0")))
+        nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+        def _serve_when_ready():
+            # Serving must not start while ``import mxnet_tpu`` is still
+            # in progress: the importing main thread holds the package
+            # import lock, and anything a handler does that resolves
+            # ``mxnet_tpu.*`` (even pickle.loads of an optimizer) would
+            # deadlock in _lock_unlock_module. Wait for the package spec
+            # to finish initializing, then serve. The thread is
+            # NON-daemon: it keeps the server process alive after the
+            # ``python -c 'import mxnet_tpu'`` main thread exits
+            # (reference: ps-lite RunServer blocks the process).
+            import sys
+            import time
+            while True:
+                spec = getattr(sys.modules.get("mxnet_tpu"), "__spec__",
+                               None)
+                if spec is None or not getattr(spec, "_initializing",
+                                               False):
+                    break
+                time.sleep(0.01)
+            from .kvstore_async import serve_forever
+            logging.info("parameter server listening on %s:%d (%d workers)",
+                         host, port, nworkers)
+            serve_forever(host, port, nworkers)
+
+        import threading
+        threading.Thread(target=_serve_when_ready, daemon=False,
+                         name="mxtpu-kvstore-server").start()
 
 
-if os.environ.get("DMLC_ROLE") in ("server", "scheduler"):
-    _init_kvstore_server_module()
+if __name__ == "__main__":
+    # ``python -m mxnet_tpu.kvstore_server``: if DMLC_ROLE=server was
+    # already set, the package import above has started the serve thread
+    # — starting a second one would fight over the port.
+    if os.environ.get("DMLC_ROLE") != "server":
+        os.environ["DMLC_ROLE"] = "server"
+        _init_kvstore_server_module()

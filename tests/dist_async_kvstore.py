@@ -1,0 +1,155 @@
+"""Worker script for the ASYNC parameter-server test.
+
+Run via:  python tools/launch.py -n 4 -s 2 python tests/dist_async_kvstore.py
+
+Reference semantics under test (src/kvstore/kvstore_dist_server.h:262-300
+async mode): every push applies IMMEDIATELY on the server; workers run
+free at deliberately different speeds; pulls observe whatever has landed
+— unsynchronized interleaving — and a small model still converges
+despite the staleness.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+SHAPE = (4, 5)
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    n, rank = kv.num_workers, kv.rank
+    assert kv.type == "dist_async"
+    assert int(os.environ["DMLC_NUM_SERVER"]) >= 1
+
+    # ------------------------------------------------------------------
+    # 1. Immediate apply + free-running interleave.
+    #    Worker r sleeps r*0.4s, then pushes (r+1) exactly (r+1) times.
+    #    Rank 0 pushes FIRST and immediately observes a partial sum —
+    #    later pulls observe strictly more pushes, with no barrier
+    #    anywhere until the final fence.
+    kv.init("a", nd.zeros(SHAPE))
+    kv.barrier()                       # fence init only
+    time.sleep(0.4 * rank)
+    for _ in range(rank + 1):
+        kv.push("a", nd.full(SHAPE, float(rank + 1)))
+
+    val, pushes_seen = kv.pull_with_meta("a")
+    my_contrib = (rank + 1) ** 2
+    assert val[0, 0] >= my_contrib - 1e-5, (rank, val[0, 0])
+    if rank == 0:
+        # by now only the fast workers can have pushed; the slowest
+        # worker (sleeping 0.4*(n-1)s) cannot have finished
+        total = sum((r + 1) ** 2 for r in range(n))
+        assert pushes_seen < sum(r + 1 for r in range(n)), \
+            "rank0 pull observed ALL pushes — workers were not free-running"
+        assert val[0, 0] < total, \
+            "rank0 saw the final value immediately — not async"
+        # watch later pushes land WITHOUT pushing again ourselves
+        seen = [pushes_seen]
+        deadline = time.time() + 60
+        while seen[-1] < sum(r + 1 for r in range(n)):
+            if time.time() > deadline:
+                raise AssertionError("other workers' pushes never landed")
+            time.sleep(0.1)
+            _, p = kv.pull_with_meta("a")
+            if p != seen[-1]:
+                seen.append(p)
+        # ≥2 distinct counts = other workers' pushes landed while this
+        # worker did nothing (free-running); combined with the partial
+        # observation above this is the interleave evidence (a loaded
+        # 1-core CI box can merge the per-worker bursts, so requiring
+        # one burst per worker would flake)
+        assert len(seen) >= 2, \
+            "pushes landed in one burst (%s) — no interleaving" % seen
+    kv.barrier()                       # fence: all pushes landed
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    expect = sum((r + 1) ** 2 for r in range(n))
+    assert np.allclose(out.asnumpy(), expect), (out.asnumpy()[0, 0], expect)
+
+    # ------------------------------------------------------------------
+    # 2. Optimizer-on-server (set_optimizer pickles it over) with
+    #    unsynchronized push counts: total applied updates must equal the
+    #    total number of pushes, in whatever order they landed.
+    kv2 = mx.kv.create("dist_async")
+    if rank == 0:
+        kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, wd=0.0,
+                                           rescale_grad=1.0))
+    kv2.init("w", nd.zeros(SHAPE))
+    kv2.barrier()                      # optimizer + init visible
+    for _ in range(2 * (rank + 1)):    # deliberately unequal counts
+        kv2.push("w", nd.ones(SHAPE))  # each push: w -= 0.5 * 1
+    kv2.barrier()
+    kv2.pull("w", out=out)
+    total_pushes = sum(2 * (r + 1) for r in range(n))
+    assert np.allclose(out.asnumpy(), -0.5 * total_pushes), out.asnumpy()[0, 0]
+
+    # ------------------------------------------------------------------
+    # 3. Convergence under async staleness: logistic regression, each
+    #    worker pushes gradients from its own shard at its own pace.
+    rng = np.random.RandomState(0)
+    N, D = 512, 8
+    X = rng.randn(N, D).astype(np.float32)
+    w_true = rng.randn(D).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    Xs, ys = X[rank::n], y[rank::n]
+
+    kv3 = mx.kv.create("dist_async")
+    if rank == 0:
+        kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.3, wd=0.0,
+                                           rescale_grad=1.0 / len(Xs)))
+    kv3.init("lw", nd.zeros((D,)))
+    kv3.barrier()
+    w = nd.zeros((D,))
+    for step in range(60):
+        kv3.pull("lw", out=w)          # whatever is current — maybe stale
+        wv = w.asnumpy()
+        p = 1.0 / (1.0 + np.exp(-(Xs @ wv)))
+        grad = Xs.T @ (p - ys)
+        kv3.push("lw", nd.array(grad))
+        if rank == 0:
+            time.sleep(0.002)          # rate skew between workers
+    kv3.barrier()
+    kv3.pull("lw", out=w)
+    pred = (X @ w.asnumpy() > 0).astype(np.float32)
+    acc = float((pred == y).mean())
+    assert acc > 0.9, "async training did not converge: acc=%.3f" % acc
+
+    # ------------------------------------------------------------------
+    # 4. 2-bit compression over the async wire (error feedback local).
+    kv4 = mx.kv.create("dist_async")
+    kv4.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kv4.init("c", nd.zeros(SHAPE))
+    kv4.barrier()
+    for _ in range(3):
+        kv4.push("c", nd.full(SHAPE, float(rank + 1)))
+    kv4.barrier()
+    kv4.pull("c", out=out)
+    # replay the error-feedback recurrence per worker for the expectation
+    residuals = np.zeros((n,) + SHAPE, np.float32)
+    total = np.zeros(SHAPE, np.float32)
+    for _ in range(3):
+        grads = np.stack([np.full(SHAPE, r + 1.0, np.float32)
+                          for r in range(n)])
+        acc_r = residuals + grads
+        q = np.where(acc_r > 2.0, 2.0, np.where(acc_r < -2.0, -2.0, 0.0))
+        residuals = acc_r - q
+        total += q.sum(axis=0)
+    assert np.allclose(out.asnumpy(), total), (out.asnumpy()[0, 0],
+                                               total[0, 0])
+
+    # liveness surface
+    assert kv.get_num_dead_node() == 0
+    assert kv.is_recovery is False
+    print("worker %d/%d: all dist_async checks passed" % (rank, n))
+
+
+if __name__ == "__main__":
+    main()

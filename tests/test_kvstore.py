@@ -143,22 +143,41 @@ def test_pull_uninitialized_raises():
         kv.pull("missing", out=nd.zeros(SHAPE))
 
 
-def test_dist_async_warns_and_runs_sync():
-    """dist_async diverges from the reference (async server applies) —
-    the divergence must be loud: a UserWarning at create time, and the
-    store must behave exactly like dist_sync (single-process here)."""
-    import warnings as _warnings
-    with _warnings.catch_warnings(record=True) as caught:
-        _warnings.simplefilter("always")
-        kv = mx.kv.create("dist_async")
-    assert any("dist_sync semantics" in str(w.message) for w in caught), \
-        "creating dist_async must warn about the sync-semantics divergence"
+def test_dist_async_real_server_semantics():
+    """dist_async is a REAL parameter server now (kvstore_async.py;
+    reference kvstore_dist_server.h async mode): every push applies
+    immediately to live server state, the optimizer runs on the server,
+    and pulls observe the current value. Single process here (in-process
+    daemon server); the free-running 4-worker interleave is
+    tests/dist_async_kvstore.py via launch.py."""
+    kv = mx.kv.create("dist_async")
     assert kv.type == "dist_async"
-    # both dist_sync and dist_async dispatch to the same KVStoreDist by
-    # design — the discriminating assertion is the warning above; here we
-    # just pin that the store is functional after the divergence warning
+    assert type(kv).__name__ == "KVStoreDistAsync"
+
+    # default server behavior: accumulate per push, immediately
     kv.init("w", nd.ones(SHAPE))
-    kv.push("w", nd.ones(SHAPE) * 2)
     out = nd.zeros(SHAPE)
-    kv.pull("w", out=out)
-    assert np.isfinite(out.asnumpy()).all()
+    for step in range(3):
+        kv.push("w", nd.ones(SHAPE) * 2)
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0 + 2.0 * (step + 1))
+        _, pushes = kv.pull_with_meta("w")
+        assert pushes == step + 1
+
+    # optimizer-on-server: each push applies one SGD step NOW
+    kv2 = mx.kv.create("dist_async")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, wd=0.0,
+                                       rescale_grad=1.0))
+    kv2.init("p", nd.zeros(SHAPE))
+    kv2.push("p", nd.ones(SHAPE))
+    kv2.pull("p", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -0.5)
+    kv2.push("p", nd.ones(SHAPE))
+    kv2.pull("p", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -1.0)
+
+    # host-side updaters cannot cross the wire — loud error, not silence
+    with pytest.raises(mx.MXNetError):
+        kv.set_updater(lambda k, g, w: None)
+
+    assert kv.get_num_dead_node() == 0

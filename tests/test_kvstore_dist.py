@@ -28,6 +28,24 @@ def test_dist_sync_kvstore_4_workers():
     assert proc.stdout.count("all dist_sync checks passed") == 4
 
 
+def test_dist_async_4_workers_2_servers():
+    """Real async parameter servers (VERDICT r3 item 3): 4 free-running
+    workers at deliberately different rates + 2 server processes;
+    interleaved unsynchronized pushes, optimizer-on-server, async
+    convergence, 2-bit wire compression (tests/dist_async_kvstore.py)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "4", "-s", "2", sys.executable,
+         os.path.join(ROOT, "tests", "dist_async_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=280)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("all dist_async checks passed") == 4
+
+
 def test_dist_training_2_workers():
     """Data-parallel Module.fit over dist_sync: params stay identical
     across workers and the model converges (dist_lenet.py analog)."""

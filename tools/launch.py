@@ -3,10 +3,16 @@
 
 Reference parity: tools/launch.py:21-120 (dmlc-tracker). The reference
 launches W worker + S server + 1 scheduler processes and lets ps-lite
-wire them up; the TPU-native stack has no servers or scheduler — workers
-form a collective world via jax.distributed (kvstore_dist.py), so
-``launch.py -n W`` spawns exactly W worker processes. ``-s`` is accepted
-for CLI parity and ignored with a note.
+wire them up. Here:
+
+* ``dist_sync`` needs NO servers — workers form a collective world via
+  jax.distributed (kvstore_dist.py); ``launch.py -n W`` spawns exactly
+  W workers.
+* ``dist_async`` needs real parameter servers (immediate Hogwild
+  applies, kvstore_async.py): ``launch.py -n W -s S`` additionally
+  spawns S server processes (DMLC_ROLE=server → kvstore_server.py
+  serve loop) on DMLC_PS_ROOT_PORT..+S-1; keys shard across them.
+  There is still no scheduler — the launcher itself owns the topology.
 
 Launchers:
 
@@ -52,6 +58,27 @@ def _free_port():
     return port
 
 
+def _free_port_range(n):
+    """A base port with n consecutive free ports (servers bind
+    base..base+n-1; verifying only base would let rank>0 servers die on
+    EADDRINUSE)."""
+    for _ in range(50):
+        base = _free_port()
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise SystemExit("launch.py: no free port range of %d found" % n)
+
+
 def _worker_env(rank, num_workers, root_uri, root_port, extra):
     env = {
         "DMLC_ROLE": "worker",
@@ -66,26 +93,33 @@ def _worker_env(rank, num_workers, root_uri, root_port, extra):
     return env
 
 
-def _wait_all(procs):
+def _wait_all(procs, daemons=()):
     """Kill the job on first failure (one dead worker leaves the rest
-    blocked in collectives — dmlc-tracker does the same). On Ctrl-C /
-    SIGINT, SIGTERM every worker before propagating."""
+    blocked in collectives — dmlc-tracker does the same). ``daemons``
+    (server processes) must outlive the workers: one EXITING early, with
+    any code, is a failure. On Ctrl-C / SIGINT, SIGTERM everything
+    before propagating."""
     try:
-        return _wait_all_inner(procs)
+        return _wait_all_inner(procs, daemons)
     except KeyboardInterrupt:
-        for p in procs:
+        for p in list(procs) + list(daemons):
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
         raise
 
 
-def _wait_all_inner(procs):
+def _wait_all_inner(procs, daemons=()):
     rc = None
     while rc is None:
         time.sleep(0.2)
         codes = [p.poll() for p in procs]
-        if any(c not in (None, 0) for c in codes):
-            rc = next(c for c in codes if c not in (None, 0))
+        dead_daemon = any(p.poll() is not None for p in daemons)
+        if any(c not in (None, 0) for c in codes) or dead_daemon:
+            rc = next((c for c in codes if c not in (None, 0)), None)
+            if rc is None:
+                rc = 1
+                print("launch.py: a server process died while workers "
+                      "were running — failing the job", file=sys.stderr)
             for p in procs:
                 if p.poll() is None:
                     p.terminate()
@@ -101,16 +135,52 @@ def _wait_all_inner(procs):
 
 def launch_local(args):
     port = _free_port()
+    server_port = (_free_port_range(args.num_servers)
+                   if args.num_servers else port)
     procs = []
+    server_procs = []
+    for srank in range(args.num_servers):
+        # parameter-server processes for dist_async (kvstore_server.py
+        # enters the serve loop at import; reference: ps-lite RunServer)
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "server",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(server_port),
+            "MXTPU_SERVER_RANK": str(srank),
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        for kv in args.env:
+            name, _, value = kv.partition("=")
+            env[name] = value
+        server_procs.append(subprocess.Popen(
+            [sys.executable, "-c", "import mxnet_tpu"], env=env))
     for rank in range(args.num_workers):
         env = dict(os.environ)
-        env.update(_worker_env(rank, args.num_workers, "127.0.0.1", port,
-                               args.env))
+        env.update(_worker_env(rank, args.num_workers, "127.0.0.1",
+                               server_port, args.env))
+        if args.num_servers:
+            # the collective coordinator must not collide with server 0's
+            # listen port; workers reach servers via DMLC_PS_ROOT_PORT
+            env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
+            env["DMLC_NUM_SERVER"] = str(args.num_servers)
         # worker collectives run on CPU devices locally
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["PALLAS_AXON_POOL_IPS"] = ""
         procs.append(subprocess.Popen(args.command, env=env))
-    return _wait_all(procs)
+    rc = _wait_all(procs, daemons=server_procs)
+    for p in server_procs:      # servers are job-scoped daemons
+        if p.poll() is None:
+            p.terminate()
+    for p in server_procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return rc
 
 
 def launch_ssh(args):
@@ -124,19 +194,46 @@ def launch_ssh(args):
                          % (len(hosts), args.num_workers))
     root_uri = hosts[0]
     port = args.port or _free_port()
+    server_port = port + 1000 if args.num_servers else port
     cwd = os.getcwd()
-    procs = []
-    for rank in range(args.num_workers):
-        env = _worker_env(rank, args.num_workers, root_uri, port, args.env)
+
+    def _ssh(host, env, command):
         envstr = " ".join("%s=%s" % (k, shlex.quote(v))
                           for k, v in env.items())
         remote = "cd %s && env %s %s" % (
             shlex.quote(cwd), envstr,
-            " ".join(shlex.quote(c) for c in args.command))
-        cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
-               "-o", "BatchMode=yes", hosts[rank], remote]
-        procs.append(subprocess.Popen(cmd))
-    return _wait_all(procs)
+            " ".join(shlex.quote(c) for c in command))
+        return subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                                 "-o", "BatchMode=yes", host, remote])
+
+    server_procs = []
+    for srank in range(args.num_servers):
+        # dist_async servers run on host 0 (srank -> port server_port+srank)
+        env = {"DMLC_ROLE": "server",
+               "DMLC_NUM_WORKER": str(args.num_workers),
+               "DMLC_NUM_SERVER": str(args.num_servers),
+               "DMLC_PS_ROOT_URI": root_uri,
+               "DMLC_PS_ROOT_PORT": str(server_port),
+               "MXTPU_SERVER_RANK": str(srank)}
+        for kv in args.env:
+            name, _, value = kv.partition("=")
+            env[name] = value
+        server_procs.append(_ssh(hosts[0], env,
+                                 [sys.executable, "-c",
+                                  "import mxnet_tpu"]))
+    procs = []
+    for rank in range(args.num_workers):
+        env = _worker_env(rank, args.num_workers, root_uri, server_port,
+                          args.env)
+        if args.num_servers:
+            env["MXTPU_COORDINATOR"] = "%s:%d" % (root_uri, port)
+            env["DMLC_NUM_SERVER"] = str(args.num_servers)
+        procs.append(_ssh(hosts[rank], env, args.command))
+    rc = _wait_all(procs, daemons=server_procs)
+    for p in server_procs:
+        if p.poll() is None:
+            p.terminate()
+    return rc
 
 
 def main():
@@ -145,7 +242,9 @@ def main():
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int, default=0,
-                        help="ignored: servers are replaced by collectives")
+                        help="parameter-server processes (needed by "
+                             "dist_async; dist_sync uses collectives and "
+                             "needs none)")
     parser.add_argument("--launcher", type=str, default="local",
                         choices=["local", "ssh"],
                         help="'local' (one host) or 'ssh' (one worker per "
@@ -165,9 +264,9 @@ def main():
         args.command = args.command[1:]
     if not args.command:
         parser.error("no command given")
-    if args.num_servers:
-        print("launch.py: -s/--num-servers ignored (no server processes; "
-              "kvstore_dist uses collectives)", file=sys.stderr)
+    if args.num_servers and args.launcher == "ssh":
+        print("launch.py: ssh mode runs servers only on host 0 "
+              "(one per -s)", file=sys.stderr)
 
     try:
         if args.launcher == "ssh":
