@@ -393,6 +393,119 @@ def bench_transformer(args):
     }
 
 
+def bench_quantized_inference(args):
+    """Calibrated 8-bit ResNet-50 inference (VERDICT r3 item 5): the
+    conv/FC stack runs int8(/uint8)×int8 with int32 accumulation
+    (ops/quantization_ops.py), ranges pre-calibrated so no online max
+    pass remains. Accuracy-delta vs fp32 is pinned by
+    tests/test_quantization.py (agreement >= 99% on the trained
+    fixture); this measures throughput on the chip."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.executor import _build_graph_fn
+    from mxnet_tpu.contrib.quantization import quantize_symbol
+
+    rng = np.random.RandomState(0)
+    dev = jax.devices()[0]
+    table = {}
+    for qdtype in ("int8", "auto"):
+        for batch in (32, 128):
+            image_shape = (3, 224, 224)
+            sym = models.get_symbol("resnet", num_classes=1000,
+                                    image_shape=image_shape,
+                                    dtype="float32")
+            dshape = (batch,) + image_shape
+            input_shapes = {"data": dshape, "softmax_label": (batch,)}
+            arg_shapes, arg_types, aux_shapes, aux_types = \
+                sym.infer_shape_type(input_shapes)
+            arg_names = sym.list_arguments()
+            shape_of = dict(zip(arg_names, arg_shapes))
+            params = {}
+            key = jax.random.key(0)
+            for name, shp, dt in zip(arg_names, arg_shapes, arg_types):
+                if name in input_shapes:
+                    continue
+                key, sub = jax.random.split(key)
+                params[name] = (jax.random.normal(sub, shp, jnp.float32)
+                                * 0.05).astype(dt)
+            auxs = {}
+            for name, shp, dt in zip(sym.list_auxiliary_states(),
+                                     aux_shapes, aux_types):
+                auxs[name] = (jnp.zeros(shp, dt) if name.endswith("_mean")
+                              else jnp.ones(shp, dt))
+            # pre-calibrated ranges for every conv/FC -> no online max
+            calib = {n.name: (-4.0, 4.0) for n in sym._topo()
+                     if not n.is_var
+                     and n.op.name in ("Convolution", "FullyConnected")}
+            offline = [n for n in arg_names
+                       if n.endswith("_weight") and ("conv" in n
+                                                     or "fc" in n
+                                                     or "sc" in n)]
+            qsym = quantize_symbol(
+                sym, offline_params=offline, calib_ranges=calib,
+                param_shapes={n: shape_of[n] for n in arg_names
+                              if n not in input_shapes},
+                quantized_dtype=qdtype)
+            for name in offline:
+                w = params.pop(name)
+                lo = float(jnp.min(w))
+                hi = float(jnp.max(w))
+                from mxnet_tpu import nd as _nd
+                qw, qlo, qhi = _nd.quantize(
+                    _nd.NDArray(w), _nd.array(np.float32(lo)),
+                    _nd.array(np.float32(hi)), out_type="int8")
+                params[name + "_quantize"] = qw._data
+                params[name + "_quantize_min"] = qlo._data
+                params[name + "_quantize_max"] = qhi._data
+            graph_fn = _build_graph_fn(qsym)
+
+            def make_loop(n_iters):
+                @jax.jit
+                def fwd_loop(params, auxs, data):
+                    def body(_, carry):
+                        d, acc = carry
+                        outs, _ = graph_fn(
+                            {**params, "data": d,
+                             "softmax_label": jnp.zeros((dshape[0],),
+                                                        jnp.float32)},
+                            auxs, np.uint32(0), False)
+                        s = outs[0].sum()
+                        patch = (s * 1e-30).astype(d.dtype).reshape(
+                            (1,) * d.ndim)
+                        d = jax.lax.dynamic_update_slice(
+                            d, patch, (0,) * d.ndim)
+                        return (d, acc + s)
+                    _, acc = jax.lax.fori_loop(
+                        0, n_iters, body, (data, jnp.float32(0)))
+                    return acc
+                return fwd_loop
+
+            data = jnp.asarray(rng.uniform(-1, 1, dshape)
+                               .astype(np.float32))
+            n0 = 2
+            short = make_loop(n0)
+            long_ = make_loop(n0 + args.iters)
+            float(short(params, auxs, data))
+            float(long_(params, auxs, data))
+
+            def timed(fn):
+                t0 = time.perf_counter()
+                float(fn(params, auxs, data))
+                return time.perf_counter() - t0
+
+            t_short = min(timed(short) for _ in range(2))
+            t_long = min(timed(long_) for _ in range(2))
+            dt_s = max(t_long - t_short, 1e-9)
+            table["resnet50-%s-b%d" % (qdtype, batch)] = round(
+                batch * args.iters / dt_s, 1)
+    return {"metric": "quantized_inference_img_per_sec",
+            "value": table.get("resnet50-int8-b128"),
+            "unit": "img/s", "device_kind": dev.device_kind,
+            "table": table}
+
+
 def bench_inference(args):
     """Inference scoring (reference example/image-classification/
     benchmark_score.py + BASELINE.md inference tables): forward-only
@@ -530,6 +643,9 @@ def main():
     ap.add_argument("--pipeline-scaling", action="store_true",
                     help="measure host decode throughput at 1/2/4/8 "
                          "threads (iterator only, no device)")
+    ap.add_argument("--quantized", action="store_true",
+                    help="with --mode inference: calibrated int8/uint8 "
+                         "ResNet-50 scoring (ops/quantization_ops.py)")
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -543,6 +659,9 @@ def main():
         print(json.dumps(bench_pipeline_scaling(args)))
         return
     if args.mode == "inference":
+        if args.quantized:
+            print(json.dumps(bench_quantized_inference(args)))
+            return
         print(json.dumps(bench_inference(args)))
         return
     if args.pipeline and args.model == "transformer":

@@ -36,14 +36,19 @@ _QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
 
 
 def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
-                    calib_ranges=None, param_shapes=None):
-    """Rewrite ``sym`` with int8 conv/FC (see module docstring).
+                    calib_ranges=None, param_shapes=None,
+                    quantized_dtype="int8"):
+    """Rewrite ``sym`` with 8-bit conv/FC (see module docstring).
     ``calib_ranges``: {node_name: (min, max)} output ranges from
     calibration; nodes without a range requantize on the fly.
     ``param_shapes``: {name: shape} stamped as ``__shape__`` on the
     parameter variables — the quantize chain between a weight var and
     its consumer blocks backward shape inference, so the shapes the
-    caller already knows (from arg_params) ride along explicitly."""
+    caller already knows (from arg_params) ride along explicitly.
+    ``quantized_dtype``: 'int8' (zero-centered), 'uint8' (affine
+    activations; weights stay int8 like the reference's deployed
+    combination), or 'auto' (uint8 where the activation is provably
+    non-negative — fed by a ReLU — else int8)."""
     from ..symbol import Symbol
     from ..symbol.symbol import _Node
     from ..ops import registry as _reg
@@ -64,14 +69,41 @@ def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
         node, oi = entry
         return (mapping[id(node)], oi)
 
-    def _quantize_chain(entry, name):
+    # ops that preserve non-negativity (sign-transparent), for 'auto'
+    _SIGN_TRANSPARENT = {"Flatten", "Reshape", "reshape", "transpose",
+                         "squeeze", "expand_dims", "Pooling", "UpSampling",
+                         "slice", "slice_axis", "Dropout"}
+
+    def _act_dtype(entry):
+        """Activation dtype under the requested mode ('auto': uint8 only
+        when the value is provably non-negative — produced by a ReLU,
+        possibly through shape/pooling ops that cannot change sign)."""
+        if quantized_dtype == "uint8":
+            return "uint8"
+        if quantized_dtype == "auto":
+            node, oi = entry
+            for _ in range(16):             # bounded walk to the producer
+                if node.is_var:
+                    break
+                name = node.op.name
+                if (name == "Activation"
+                        and node.attrs.get("act_type") == "relu") \
+                        or name in ("relu", "sigmoid", "softmax", "abs"):
+                    return "uint8"
+                if name in _SIGN_TRANSPARENT and node.inputs:
+                    node, oi = node.inputs[0]
+                    continue
+                break
+        return "int8"
+
+    def _quantize_chain(entry, name, out_type="int8"):
         """fp32 entry -> (q_entry, min_entry, max_entry) via online
         min/max + quantize (reference inserts _contrib_quantize the same
         way; ranges for activations are computed on the fly)."""
         src = _fp32_entry(entry)
         mn = _Node(op_min, name + "_min", {}, [src])
         mx_ = _Node(op_max, name + "_max", {}, [src])
-        q = _Node(op_quantize, name + "_quantize", {"out_type": "int8"},
+        q = _Node(op_quantize, name + "_quantize", {"out_type": out_type},
                   [src, (mn, 0), (mx_, 0)])
         return (q, 0), (q, 1), (q, 2)
 
@@ -96,8 +128,9 @@ def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
         # pre-quantized as int8 vars when listed in offline_params
         # (reference quantize_model bakes them into qarg_params), else
         # they quantize online like activations
-        data_q, data_min, data_max = _quantize_chain(node.inputs[0],
-                                                     node.name + "_data")
+        data_q, data_min, data_max = _quantize_chain(
+            node.inputs[0], node.name + "_data",
+            out_type=_act_dtype(node.inputs[0]))
         w_node = node.inputs[1][0]
         if w_node.is_var and w_node.name in offline_params:
             wshape = param_shapes.get(w_node.name)
@@ -252,8 +285,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     """Quantize a trained fp32 model (reference
     contrib/quantization.py:412 quantize_model). Returns
     (qsym, qarg_params, aux_params)."""
-    if quantized_dtype != "int8":
-        raise MXNetError("only quantized_dtype='int8' is supported")
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError("quantized_dtype must be int8/uint8/auto "
+                         "(reference quantize-inl.h out_type)")
     if calib_mode not in ("none", "naive", "entropy"):
         raise MXNetError("calib_mode must be none/naive/entropy")
 
@@ -310,7 +344,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     qsym = quantize_symbol(
         sym, excluded_sym_names=excluded_sym_names,
         offline_params=offline, calib_ranges=calib_ranges,
-        param_shapes={k: tuple(v.shape) for k, v in arg_params.items()})
+        param_shapes={k: tuple(v.shape) for k, v in arg_params.items()},
+        quantized_dtype=quantized_dtype)
 
     from .. import ndarray as _nd
     qarg_params = dict(arg_params)
@@ -319,7 +354,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         wn = w.asnumpy()
         lo = _nd.array(_np.float32(float(wn.min())))
         hi = _nd.array(_np.float32(float(wn.max())))
-        qw, qlo, qhi = _nd.quantize(w, lo, hi, out_type=quantized_dtype)
+        # weights are ALWAYS zero-centered int8 (the reference's deployed
+        # combination: uint8 activations x int8 weights)
+        qw, qlo, qhi = _nd.quantize(w, lo, hi, out_type="int8")
         qarg_params[name + "_quantize"] = qw
         qarg_params[name + "_quantize_min"] = qlo
         qarg_params[name + "_quantize_max"] = qhi

@@ -1,0 +1,49 @@
+"""Local pretrained-weight store.
+
+Reference parity: python/mxnet/gluon/model_zoo/model_store.py:1 — the
+reference resolves ``pretrained=True`` to a ``.params`` file in
+``~/.mxnet/models``, downloading on miss. This environment has no
+network egress, so the store is LOCAL-ONLY: the same root layout
+(``{root}/{name}.params``), populated by converting reference model-zoo
+checkpoints with ``tools/convert_params.py`` (which maps the reference's
+gluon parameter naming onto this framework's and rewrites the file in
+the interoperable reference byte format).
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "default_root"]
+
+
+def default_root():
+    return os.environ.get(
+        "MXNET_HOME",
+        os.path.join(os.path.expanduser("~"), ".mxnet")) + "/models"
+
+
+def get_model_file(name, root=None):
+    """Path of the local weight file for ``name`` (reference
+    model_store.get_model_file, minus the download)."""
+    root = os.path.expanduser(root or default_root())
+    path = os.path.join(root, "%s.params" % name)
+    if os.path.exists(path):
+        return path
+    raise MXNetError(
+        "pretrained weights for '%s' not found at %s. This store is "
+        "local-only (no network egress): convert a reference model-zoo "
+        "checkpoint with\n"
+        "  python tools/convert_params.py --model %s "
+        "--in <reference>.params --root %s\n"
+        "or place a compatible .params file there yourself."
+        % (name, path, name, root))
+
+
+def load_pretrained(net, name, root=None, ctx=None):
+    """Load ``{root}/{name}.params`` into ``net`` (the tail of the
+    reference's ``get_model_file`` + ``load_params`` flow)."""
+    path = get_model_file(name, root)
+    net.load_parameters(path, ctx=ctx)
+    return net

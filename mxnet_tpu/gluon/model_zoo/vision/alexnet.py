@@ -40,8 +40,9 @@ class AlexNet(HybridBlock):
         return x
 
 
-def alexnet(pretrained=False, ctx=None, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
-    return AlexNet(**kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root=root, ctx=ctx)
+    return net

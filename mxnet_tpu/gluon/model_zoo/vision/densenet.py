@@ -83,12 +83,14 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                 **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, "densenet%d" % num_layers, root=root, ctx=ctx)
+    return net
 
 
 def densenet121(**kwargs):

@@ -165,8 +165,9 @@ class Inception3(HybridBlock):
         return x
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
-    return Inception3(**kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", root=root, ctx=ctx)
+    return net

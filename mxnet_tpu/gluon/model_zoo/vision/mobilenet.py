@@ -116,18 +116,30 @@ class MobileNetV2(HybridBlock):
         return x
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
-    return MobileNet(multiplier, **kwargs)
+        from ..model_store import load_pretrained
+        version_suffix = "%.2f" % multiplier
+        if version_suffix in ("1.00", "0.50"):   # reference model_store names
+            version_suffix = version_suffix[:-1]
+        load_pretrained(net, "mobilenet%s" % version_suffix, root=root,
+                        ctx=ctx)
+    return net
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
-    return MobileNetV2(multiplier, **kwargs)
+        from ..model_store import load_pretrained
+        version_suffix = "%.2f" % multiplier
+        if version_suffix in ("1.00", "0.50"):
+            version_suffix = version_suffix[:-1]
+        load_pretrained(net, "mobilenetv2_%s" % version_suffix, root=root,
+                        ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):

@@ -237,11 +237,12 @@ resnet_block_versions = [{"basic_block": BasicBlockV1,
                           "bottle_neck": BottleneckV2}]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    """(reference model_zoo/vision/resnet.py get_resnet)"""
-    if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
+def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
+               **kwargs):
+    """(reference model_zoo/vision/resnet.py get_resnet).
+    ``pretrained=True`` loads ``{root}/resnet{N}_v{V}.params`` from the
+    LOCAL model store (model_store.py; populate it with
+    tools/convert_params.py — no network egress here)."""
     assert num_layers in resnet_spec, \
         "Invalid number of layers: %d. Options are %s" % (
             num_layers, str(resnet_spec.keys()))
@@ -249,7 +250,12 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     assert version in (1, 2), "Invalid resnet version: %d." % version
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, "resnet%d_v%d" % (num_layers, version),
+                        root=root, ctx=ctx)
+    return net
 
 
 def resnet18_v1(**kwargs):

@@ -87,11 +87,13 @@ class SqueezeNet(HybridBlock):
         return x
 
 
-def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+def get_squeezenet(version, pretrained=False, ctx=None, root=None,
+                   **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet%s" % version, root=root, ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

@@ -56,12 +56,16 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights unavailable (no network); use "
-                         "load_parameters")
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        batch_norm = kwargs.get("batch_norm", False)
+        load_pretrained(net, "vgg%d%s" % (num_layers,
+                                          "_bn" if batch_norm else ""),
+                        root=root, ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

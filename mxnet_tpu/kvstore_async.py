@@ -305,7 +305,14 @@ class KVStoreDistAsync(KVStore):
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "0")) or 9091
         self._own_server = None
         if nserv <= 0:
-            # standalone/dev mode: one in-process daemon server
+            if self._nworkers > 1:
+                raise MXNetError(
+                    "dist_async with %d workers needs parameter-server "
+                    "processes: launch with tools/launch.py -n %d -s <S> "
+                    "(an in-process fallback server would give every "
+                    "worker its own isolated store)"
+                    % (self._nworkers, self._nworkers))
+            # standalone/dev mode (single worker): in-process daemon server
             import socket as _socket
             s = _socket.socket()
             s.bind(("127.0.0.1", 0))

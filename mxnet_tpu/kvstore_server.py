@@ -31,7 +31,11 @@ def _init_kvstore_server_module():
             "Exiting idle.")
         raise SystemExit(0)
     if role == "server":
-        host = os.environ.get("DMLC_PS_BIND", "0.0.0.0")
+        # SECURITY: the wire protocol is pickle (like the reference's
+        # ps-lite, it assumes a trusted cluster network) — bind
+        # localhost unless the launcher explicitly widens it
+        # (launch_ssh sets DMLC_PS_BIND=0.0.0.0 for cross-host jobs)
+        host = os.environ.get("DMLC_PS_BIND", "127.0.0.1")
         port = (int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
                 + int(os.environ.get("MXTPU_SERVER_RANK", "0")))
         nworkers = int(os.environ.get("DMLC_NUM_WORKER", "1"))

@@ -190,5 +190,8 @@ class DataParallelExecutorGroup:
                 # Monitor picks stream vs tapped mode (on-device stat vs
                 # full-tensor second program) — don't bypass that choice
                 mon.install(exe)
-            else:   # bare (name, NDArray) callable
-                exe.set_monitor_callback(mon, monitor_all, mode="tapped")
+            else:
+                # duck-typed monitor (stat_helper attr) or a bare
+                # (name, NDArray) callable: full-tensor tapped mode
+                cb = getattr(mon, "stat_helper", mon)
+                exe.set_monitor_callback(cb, monitor_all, mode="tapped")

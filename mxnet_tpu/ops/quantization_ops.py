@@ -1,14 +1,17 @@
-"""INT8 quantization operators.
+"""INT8/UINT8 quantization operators.
 
-Reference parity: src/operator/quantization/ (quantize.cc,
+Reference parity: src/operator/quantization/ (quantize-inl.h:44-99,
 dequantize.cc, requantize.cc, quantized_conv.cc,
 quantized_fully_connected.cc, quantized_pooling.cc,
-quantized_flatten.cc). TPU-native: int8 tensors with explicit
+quantized_flatten.cc). TPU-native: 8-bit tensors with explicit
 (min, max) range companions; quantized conv/FC accumulate in int32 via
-``preferred_element_type`` so the MXU runs the 8-bit multiplies. The
-range calculus matches the reference: int8 is symmetric around 0
-(scale = 127 / max|range|), int32 accumulators carry the product of the
-input scales.
+``preferred_element_type`` so the MXU runs the 8-bit multiplies. Range
+calculus matches the reference: int8 is zero-centered symmetric
+(quantize_zero_centered, scale = 127 / max|range|); uint8 is AFFINE
+(quantize_unsigned: [min,max] -> [0,255], zero point = -min·scale).
+Mixed uint8-activation × int8-weight conv/FC (the reference's deployed
+combination) fold the activation zero point back in as an exact int32
+correction term computed from a ones-conv of the weights.
 """
 from __future__ import annotations
 
@@ -19,31 +22,45 @@ from jax import lax
 from .registry import register
 
 _INT8_MAX = 127.0
+_UINT8_MAX = 255.0
 _INT32_MAX = 2147483647.0
 
 
 def _range_scale(min_r, max_r):
-    # symmetric int8 quantization (reference quantize.cc int8 branch)
+    # symmetric int8 quantization (reference quantize_zero_centered)
     abs_max = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
     return _INT8_MAX / jnp.maximum(abs_max, 1e-30)
 
 
 @register("_contrib_quantize", aliases=("quantize",), num_outputs=3)
 def quantize(data, min_range, max_range, *, out_type="int8"):
-    """fp32 -> int8 with the given range; returns (q, min, max)
-    (reference quantize.cc)."""
-    if out_type != "int8":
-        raise NotImplementedError("only int8 quantization is supported "
-                                  "(reference also has uint8)")
-    scale = _range_scale(min_range, max_range)
-    q = jnp.clip(jnp.rint(data * scale), -_INT8_MAX, _INT8_MAX)
-    abs_max = _INT8_MAX / scale
-    return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+    """fp32 -> int8/uint8 with the given range; returns (q, min, max)
+    (reference quantize-inl.h:44-99; uint8 keeps the ASYMMETRIC input
+    range, int8 re-centers it symmetrically)."""
+    if out_type == "int8":
+        scale = _range_scale(min_range, max_range)
+        q = jnp.clip(jnp.rint(data * scale), -_INT8_MAX, _INT8_MAX)
+        abs_max = _INT8_MAX / scale
+        return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+    if out_type == "uint8":
+        lo = jnp.asarray(min_range, jnp.float32).reshape(())
+        hi = jnp.asarray(max_range, jnp.float32).reshape(())
+        scale = _UINT8_MAX / jnp.maximum(hi - lo, 1e-30)
+        q = jnp.clip(jnp.rint((data - lo) * scale), 0.0, _UINT8_MAX)
+        return q.astype(jnp.uint8), lo, hi
+    raise ValueError("quantize: out_type must be 'int8' or 'uint8', "
+                     "got %r (reference quantize-inl.h)" % (out_type,))
 
 
 @register("_contrib_dequantize", aliases=("dequantize",))
 def dequantize(data, min_range, max_range, *, out_type="float32"):
-    """int8/int32 -> fp32 (reference dequantize.cc)."""
+    """int8/uint8/int32 -> fp32 (reference dequantize.cc). uint8 is
+    affine (q/scale + min); int8/int32 symmetric."""
+    if data.dtype == jnp.uint8:
+        lo = jnp.asarray(min_range, jnp.float32)
+        hi = jnp.asarray(max_range, jnp.float32)
+        scale = _UINT8_MAX / jnp.maximum(hi - lo, 1e-30)
+        return data.astype(jnp.float32) / scale + lo
     imax = _INT8_MAX if data.dtype == jnp.int8 else _INT32_MAX
     abs_max = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     return data.astype(jnp.float32) * (abs_max / imax)
@@ -51,9 +68,10 @@ def dequantize(data, min_range, max_range, *, out_type="float32"):
 
 @register("_contrib_requantize", aliases=("requantize",), num_outputs=3)
 def requantize(data, min_range, max_range, *, min_calib_range=None,
-               max_calib_range=None):
-    """int32 -> int8, rescaling into the calibrated range (reference
-    requantize.cc; with no calib range the actual range is used)."""
+               max_calib_range=None, out_type="int8"):
+    """int32 -> int8/uint8, rescaling into the calibrated range
+    (reference requantize.cc; with no calib range the actual range is
+    used)."""
     f32 = data.astype(jnp.float32) * (
         jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / _INT32_MAX)
     if min_calib_range is not None and max_calib_range is not None:
@@ -62,14 +80,27 @@ def requantize(data, min_range, max_range, *, min_calib_range=None,
     else:
         hi = jnp.max(jnp.abs(f32))
         lo = -hi
+    if out_type == "uint8":
+        scale = _UINT8_MAX / jnp.maximum(hi - lo, 1e-30)
+        q = jnp.clip(jnp.rint((f32 - lo) * scale), 0.0, _UINT8_MAX)
+        return q.astype(jnp.uint8), lo.reshape(()), hi.reshape(())
     scale = _range_scale(lo, hi)
     q = jnp.clip(jnp.rint(f32 * scale), -_INT8_MAX, _INT8_MAX)
     abs_max = _INT8_MAX / scale
     return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
 
 
-def _in_scales(min_d, max_d, min_w, max_w):
-    sd = _range_scale(min_d, max_d)
+def _data_scale(data_dtype, min_d, max_d):
+    if data_dtype == jnp.uint8:
+        # affine uint8 activation scale (reference quantize_unsigned)
+        return _UINT8_MAX / jnp.maximum(
+            jnp.asarray(max_d, jnp.float32) - jnp.asarray(min_d, jnp.float32),
+            1e-30)
+    return _range_scale(min_d, max_d)
+
+
+def _in_scales(data_dtype, min_d, max_d, min_w, max_w):
+    sd = _data_scale(data_dtype, min_d, max_d)
     sw = _range_scale(min_w, max_w)
     # int32 accumulator range corresponds to INT32_MAX / (sd*sw)
     abs_out = _INT32_MAX / (sd * sw)
@@ -81,8 +112,12 @@ def _in_scales(min_d, max_d, min_w, max_w):
 def quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
                    *, kernel, num_filter, stride=(), dilate=(), pad=(),
                    num_group=1, no_bias=True, layout=None):
-    """int8 conv with int32 accumulation (reference quantized_conv.cc);
-    returns (int32 out, min_out, max_out)."""
+    """8-bit conv with int32 accumulation (reference quantized_conv.cc);
+    returns (int32 out, min_out, max_out). uint8 activations (affine,
+    zero point zp = -min·scale) fold back exactly: conv(q-zp, w) =
+    conv(q, w) + min·s_d·conv(1, w), where conv(1, w) is one batch-1
+    ones-convolution capturing the per-position weight sums (border
+    positions included)."""
     nd_ = len(kernel)
     stride = tuple(stride) if stride else (1,) * nd_
     dilate = tuple(dilate) if dilate else (1,) * nd_
@@ -90,15 +125,29 @@ def quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data.ndim == 4 else ("NCH", "OIH", "NCH"))
-    out = lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=int(num_group),
-        preferred_element_type=jnp.int32)
-    lo, hi = _in_scales(min_data, max_data, min_weight, max_weight)
+    kw = dict(window_strides=stride,
+              padding=[(p, p) for p in pad],
+              rhs_dilation=dilate,
+              dimension_numbers=dn,
+              feature_group_count=int(num_group))
+    if data.dtype == jnp.uint8:
+        # mixed uint8×int8 operands: XLA convs need one dtype — widen to
+        # int32 (exact; the int8-MXU fast path needs matching int8s)
+        out = lax.conv_general_dilated(
+            data.astype(jnp.int32), weight.astype(jnp.int32),
+            preferred_element_type=jnp.int32, **kw)
+        sd = _data_scale(jnp.uint8, min_data, max_data)
+        zp_f = jnp.asarray(min_data, jnp.float32) * sd   # q ≈ (x-min)·sd
+        ones = jnp.ones((1,) + data.shape[1:], jnp.float32)
+        wsum = lax.conv_general_dilated(ones, weight.astype(jnp.float32),
+                                        **kw)
+        out = out + jnp.rint(zp_f * wsum).astype(jnp.int32)
+    else:
+        out = lax.conv_general_dilated(data, weight,
+                                       preferred_element_type=jnp.int32,
+                                       **kw)
+    lo, hi = _in_scales(data.dtype, min_data, max_data, min_weight,
+                        max_weight)
     return out, lo, hi
 
 
@@ -107,13 +156,25 @@ def quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
 def quantized_fully_connected(data, weight, min_data, max_data, min_weight,
                               max_weight, *, num_hidden, no_bias=True,
                               flatten=True):
-    """int8 FC with int32 accumulation (reference
-    quantized_fully_connected.cc)."""
+    """8-bit FC with int32 accumulation (reference
+    quantized_fully_connected.cc); uint8 activations fold their zero
+    point back via the per-unit weight sums."""
     x = data.reshape(data.shape[0], -1) if flatten else data
-    out = lax.dot_general(
-        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    lo, hi = _in_scales(min_data, max_data, min_weight, max_weight)
+    if data.dtype == jnp.uint8:
+        out = lax.dot_general(
+            x.astype(jnp.int32), weight.astype(jnp.int32),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        sd = _data_scale(jnp.uint8, min_data, max_data)
+        zp_f = jnp.asarray(min_data, jnp.float32) * sd
+        wsum = jnp.sum(weight.astype(jnp.float32), axis=1)
+        out = out + jnp.rint(zp_f * wsum).astype(jnp.int32)
+    else:
+        out = lax.dot_general(
+            x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    lo, hi = _in_scales(data.dtype, min_data, max_data, min_weight,
+                        max_weight)
     return out, lo, hi
 
 

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""convert_params — map a reference model-zoo checkpoint into the local
+pretrained store.
+
+Reference gluon checkpoints (python/mxnet/gluon/model_zoo/model_store.py
+weight files, saved by gluon ``save_params``) name parameters with the
+1.x name-manager scheme (``resnetv10_conv0_weight``, ...). This
+framework's blocks derive aliases from class names
+(``resnetv10_conv2d0_weight``), so a converted file must be renamed
+before ``pretrained=True`` can consume it. The mapping is resolved in
+three passes per target parameter:
+
+1. exact name match;
+2. alias normalization (``conv2d<N>`` ↔ ``conv<N>``,
+   ``running_*`` ↔ ``moving_*`` aux spellings);
+3. order-preserving shape match over whatever is left (both files
+   enumerate parameters in declaration order, so equal-shape sequences
+   align positionally; leftovers = error, not a guess).
+
+Usage:
+  python tools/convert_params.py --model resnet18_v1 \
+      --in  resnet18_v1-xxxx.params  --root ~/.mxnet/models \
+      [--classes 1000]
+
+Writes ``{root}/{model}.params`` in the interoperable reference byte
+format (serialization.py). Verify with:
+  net = gluon.model_zoo.vision.get_model(model, pretrained=True, root=...)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..")))
+
+
+_ALIAS_RULES = [
+    (re.compile(r"conv2d(\d+)"), r"conv\1"),
+    (re.compile(r"running_mean$"), "moving_mean"),
+    (re.compile(r"running_var$"), "moving_var"),
+]
+
+
+def _alias_forms(name):
+    """All spellings a target name may take in a reference file."""
+    forms = {name}
+    for pat, rep in _ALIAS_RULES:
+        forms |= {pat.sub(rep, f) for f in list(forms)}
+    # and the reverse direction of the aux spelling
+    forms |= {f.replace("moving_mean", "running_mean")
+               .replace("moving_var", "running_var") for f in list(forms)}
+    return forms
+
+
+def map_params(src, target_names, target_shapes, logger=print):
+    """{target_name: src_array} using exact -> alias -> ordered-shape
+    matching. Raises on ambiguity or leftovers."""
+    src = dict(src)
+    out = {}
+    unmatched_targets = []
+    for tname in target_names:
+        hit = None
+        for form in _alias_forms(tname):
+            if form in src:
+                hit = form
+                break
+        if hit is not None:
+            out[tname] = src.pop(hit)
+        else:
+            unmatched_targets.append(tname)
+    # ordered shape matching over the remainder
+    src_left = list(src.items())
+    for tname in unmatched_targets:
+        want = tuple(target_shapes[tname])
+        idx = next((i for i, (_, arr) in enumerate(src_left)
+                    if tuple(arr.shape) == want), None)
+        if idx is None:
+            raise SystemExit("convert_params: no source parameter matches "
+                             "'%s' %s (left: %s)"
+                             % (tname, want,
+                                [(n, tuple(a.shape)) for n, a in
+                                 src_left[:5]]))
+        sname, arr = src_left.pop(idx)
+        logger("  shape-matched %-40s <- %s" % (tname, sname))
+        out[tname] = arr
+    if src_left:
+        raise SystemExit("convert_params: %d source parameters unused: %s"
+                         % (len(src_left), [n for n, _ in src_left[:8]]))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True,
+                    help="model-zoo name, e.g. resnet18_v1")
+    ap.add_argument("--in", dest="infile", required=True,
+                    help="reference .params file")
+    ap.add_argument("--root", default=None,
+                    help="store root (default ~/.mxnet/models)")
+    ap.add_argument("--classes", type=int, default=1000)
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.model_store import default_root
+
+    net = gluon.model_zoo.vision.get_model(args.model,
+                                           classes=args.classes)
+    net.initialize(mx.init.Zero())
+    net(mx.nd.zeros((1, 3, 224, 224)))      # materialize shapes
+    # load_parameters consumes the prefix-free HIERARCHICAL names
+    # (block.py _collect_params_with_prefix) — prefix-independent, so a
+    # converted file loads into any instance of the architecture
+    params = net._collect_params_with_prefix()
+    target_names = list(params.keys())
+    target_shapes = {k: tuple(v.shape) for k, v in params.items()}
+
+    loaded = mx.nd.load(args.infile)
+    src = {}
+    for k, v in loaded.items():
+        # gluon save_params may prefix 'arg:'/'aux:' (Module checkpoints do)
+        k = k.split(":", 1)[-1]
+        src[k] = v.asnumpy()
+
+    mapped = map_params(src, target_names, target_shapes)
+    for tname, arr in mapped.items():
+        want = target_shapes[tname]
+        if tuple(arr.shape) != want:
+            raise SystemExit("convert_params: shape mismatch for %s: "
+                             "%s vs %s" % (tname, arr.shape, want))
+
+    root = os.path.expanduser(args.root or default_root())
+    os.makedirs(root, exist_ok=True)
+    outpath = os.path.join(root, "%s.params" % args.model)
+    mx.nd.save(outpath, {k: mx.nd.array(v, dtype=v.dtype)
+                         for k, v in mapped.items()})
+    print("wrote %s (%d parameters)" % (outpath, len(mapped)))
+
+
+if __name__ == "__main__":
+    main()

@@ -197,30 +197,42 @@ def launch_ssh(args):
     server_port = port + 1000 if args.num_servers else port
     cwd = os.getcwd()
 
-    def _ssh(host, env, command):
+    def _ssh(host, env, command, stdin=None):
         envstr = " ".join("%s=%s" % (k, shlex.quote(v))
                           for k, v in env.items())
         remote = "cd %s && env %s %s" % (
             shlex.quote(cwd), envstr,
             " ".join(shlex.quote(c) for c in command))
         return subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
-                                 "-o", "BatchMode=yes", host, remote])
+                                 "-o", "BatchMode=yes", host, remote],
+                                stdin=stdin)
 
     server_procs = []
     for srank in range(args.num_servers):
-        # dist_async servers run on host 0 (srank -> port server_port+srank)
+        # dist_async servers run on host 0 (srank -> port server_port+srank;
+        # no remote availability probe — pick a known-free range with -p).
+        # Cross-host workers must reach them: bind wide, trusted-network
+        # assumption like the reference's ps-lite.
         env = {"DMLC_ROLE": "server",
                "DMLC_NUM_WORKER": str(args.num_workers),
                "DMLC_NUM_SERVER": str(args.num_servers),
                "DMLC_PS_ROOT_URI": root_uri,
                "DMLC_PS_ROOT_PORT": str(server_port),
+               "DMLC_PS_BIND": "0.0.0.0",
                "MXTPU_SERVER_RANK": str(srank)}
         for kv in args.env:
             name, _, value = kv.partition("=")
             env[name] = value
-        server_procs.append(_ssh(hosts[0], env,
-                                 [sys.executable, "-c",
-                                  "import mxnet_tpu"]))
+        # stdin-watchdog: when this ssh client dies (job end, Ctrl-C,
+        # terminate()), `cat` sees EOF and the remote server is killed —
+        # otherwise the non-daemon serve thread would orphan and poison
+        # the port for the next run
+        server_procs.append(_ssh(
+            hosts[0], env,
+            ["sh", "-c",
+             "%s -c 'import mxnet_tpu' & c=$!; cat >/dev/null; "
+             "kill $c 2>/dev/null" % shlex.quote(sys.executable)],
+            stdin=subprocess.PIPE))   # held open: EOF == job over
     procs = []
     for rank in range(args.num_workers):
         env = _worker_env(rank, args.num_workers, root_uri, server_port,
