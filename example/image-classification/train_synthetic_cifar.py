@@ -1,0 +1,124 @@
+"""Train ResNet-20 (CIFAR shape) on a synthetic-but-FIXED dataset to a
+reproducible accuracy curve, with checkpoint/resume fidelity.
+
+The committed curve artifact behind docs/CONVERGENCE.md (VERDICT r3
+item 8): the reference quotes per-network scores for its examples
+(example/image-classification/README.md:206, test_score.py); this
+environment has no dataset egress, so the dataset is a deterministic
+generator — 10 classes of noisy class-template images (fixed seed), a
+task hard enough that accuracy climbs over epochs rather than snapping
+to 1.0, and exactly reproducible anywhere.
+
+Usage:
+  python example/image-classification/train_synthetic_cifar.py \
+      [--num-layers 20] [--epochs 8] [--batch 64] [--resume EPOCH]
+
+``--resume N`` restarts from the epoch-N checkpoint and continues —
+the continued loss/accuracy curve is BIT-IDENTICAL to the
+uninterrupted run (tests/test_checkpoint_resume.py pins this).
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def synthetic_cifar(n_train=2048, n_val=512, num_classes=10, seed=7):
+    """Deterministic CIFAR-shaped (28x28, the reference's own train_cifar10 image_shape) dataset: each class is a fixed random
+    28x28x3 template; samples are template + strong noise + random
+    brightness — linearly separable only in aggregate, so the curve
+    climbs over several epochs."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(num_classes, 3, 28, 28).astype(np.float32)
+    templates /= np.sqrt((templates ** 2).mean(axis=(1, 2, 3),
+                                               keepdims=True))
+
+    def make(n, rng):
+        y = rng.randint(0, num_classes, n)
+        noise = rng.randn(n, 3, 28, 28).astype(np.float32)
+        gain = rng.uniform(0.25, 0.75, (n, 1, 1, 1)).astype(np.float32)
+        x = templates[y] * gain + noise
+        return x, y.astype(np.float32)
+
+    Xtr, ytr = make(n_train, rng)
+    Xva, yva = make(n_val, rng)
+    return (Xtr, ytr), (Xva, yva)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-layers", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--prefix", default="/tmp/syncifar")
+    ap.add_argument("--resume", type=int, default=0,
+                    help="resume from this epoch's checkpoint")
+    ap.add_argument("--curve-out", default=None,
+                    help="write the per-epoch metric curve as JSON")
+    args = ap.parse_args()
+
+    (Xtr, ytr), (Xva, yva) = synthetic_cifar()
+    train = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch,
+                              shuffle=False)   # deterministic order
+    val = mx.io.NDArrayIter(Xva, yva, batch_size=args.batch)
+
+    sym = models.get_symbol("resnet", num_classes=10,
+                            num_layers=args.num_layers,
+                            image_shape=(3, 28, 28))
+    curve = []
+
+    if args.resume:
+        mod = mx.Module.load(args.prefix, args.resume, context=mx.cpu(),
+                             load_optimizer_states=True)
+        begin = args.resume
+    else:
+        mod = mx.Module(sym, context=mx.cpu())
+        begin = 0
+
+    class CurveRecorder:
+        """Epoch-end eval recording (name, value) pairs."""
+
+        def __call__(self, epoch, sym_, arg, aux):
+            val.reset()
+            score = mod.score(val, "acc")[0][1]
+            curve.append({"epoch": epoch + 1, "val_acc": round(score, 6)})
+            print("epoch %d: val_acc=%.4f" % (epoch + 1, score),
+                  flush=True)
+
+    mod.fit(train,
+            num_epoch=args.epochs,
+            begin_epoch=begin,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in",
+                                              magnitude=2),
+            eval_metric="acc",
+            epoch_end_callback=[
+                mx.callback.module_checkpoint(
+                    mod, args.prefix, save_optimizer_states=True),
+                CurveRecorder()])
+
+    val.reset()
+    final = mod.score(val, "acc")[0][1]
+    print("final val_acc=%.4f over %d epochs" % (final, args.epochs))
+    if args.curve_out:
+        with open(args.curve_out, "w") as f:
+            json.dump({"num_layers": args.num_layers,
+                       "epochs": args.epochs, "batch": args.batch,
+                       "lr": args.lr, "curve": curve,
+                       "final_val_acc": round(final, 6)}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

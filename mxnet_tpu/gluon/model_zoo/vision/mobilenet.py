@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from ....base import MXNetError
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
@@ -116,16 +115,22 @@ class MobileNetV2(HybridBlock):
         return x
 
 
+def _store_suffix(multiplier):
+    """Reference model_store spelling of the width multiplier
+    ('1.0'/'0.5', else '%.2f' e.g. '0.75'/'0.25')."""
+    version_suffix = "%.2f" % multiplier
+    if version_suffix in ("1.00", "0.50"):
+        version_suffix = version_suffix[:-1]
+    return version_suffix
+
+
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
                   **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        version_suffix = "%.2f" % multiplier
-        if version_suffix in ("1.00", "0.50"):   # reference model_store names
-            version_suffix = version_suffix[:-1]
-        load_pretrained(net, "mobilenet%s" % version_suffix, root=root,
-                        ctx=ctx)
+        load_pretrained(net, "mobilenet%s" % _store_suffix(multiplier),
+                        root=root, ctx=ctx)
     return net
 
 
@@ -134,11 +139,9 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        version_suffix = "%.2f" % multiplier
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        load_pretrained(net, "mobilenetv2_%s" % version_suffix, root=root,
-                        ctx=ctx)
+        load_pretrained(net,
+                        "mobilenetv2_%s" % _store_suffix(multiplier),
+                        root=root, ctx=ctx)
     return net
 
 

@@ -3,7 +3,6 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from ....base import MXNetError
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 

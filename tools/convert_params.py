@@ -111,7 +111,9 @@ def main():
     net = gluon.model_zoo.vision.get_model(args.model,
                                            classes=args.classes)
     net.initialize(mx.init.Zero())
-    net(mx.nd.zeros((1, 3, 224, 224)))      # materialize shapes
+    # materialize shapes with the model's native input size
+    size = 299 if "inception" in args.model else 224
+    net(mx.nd.zeros((1, 3, size, size)))
     # load_parameters consumes the prefix-free HIERARCHICAL names
     # (block.py _collect_params_with_prefix) — prefix-independent, so a
     # converted file loads into any instance of the architecture

@@ -227,11 +227,15 @@ def launch_ssh(args):
         # terminate()), `cat` sees EOF and the remote server is killed —
         # otherwise the non-daemon serve thread would orphan and poison
         # the port for the next run
+        # watchdog: stdin-EOF (job over / launcher killed) kills the
+        # server, while `wait $c` keeps the ssh client's exit tied to the
+        # SERVER's (a crashed server must still fail _wait_all fast)
         server_procs.append(_ssh(
             hosts[0], env,
             ["sh", "-c",
-             "%s -c 'import mxnet_tpu' & c=$!; cat >/dev/null; "
-             "kill $c 2>/dev/null" % shlex.quote(sys.executable)],
+             "%s -c 'import mxnet_tpu' & c=$!; "
+             "(cat >/dev/null; kill $c 2>/dev/null) & wait $c"
+             % shlex.quote(sys.executable)],
             stdin=subprocess.PIPE))   # held open: EOF == job over
     procs = []
     for rank in range(args.num_workers):
