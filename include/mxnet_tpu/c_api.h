@@ -59,6 +59,70 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        NDArrayHandle *outputs, int num_params,
                        const char **keys, const char **vals);
 
+/* In-place dst <- src (shape-compatible); the writeback primitive for
+ * functional update ops (sgd_update returns a fresh array). */
+int MXNDArrayCopyFrom(NDArrayHandle dst, NDArrayHandle src);
+
+/*
+ * Symbol / Executor surface — build and TRAIN a graph loaded from
+ * symbol.json without any Python source in hand (reference
+ * MXSymbolCreateFromJSON include/mxnet/c_api.h:1111,
+ * MXExecutorSimpleBind src/c_api/c_api_executor.cc:220,
+ * MXExecutorForward/Backward/Outputs).
+ */
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolFree(SymbolHandle sym);
+
+/* Serialize back to symbol.json (reference MXSymbolSaveToJSON). The
+ * returned pointer stays valid until the next MXSymbolSaveToJSON call
+ * on the same handle or MXSymbolFree. */
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+
+/* Name lists. The returned pointers stay valid until the next
+ * MXSymbolList* call on the same handle or MXSymbolFree. */
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_names);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_names);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_names);
+
+/*
+ * Bind with input shapes; parameter shapes infer (the reference's
+ * 30-argument marshal reduced to its live core: shapes in CSR form —
+ * shape_ind has num_input_shapes+1 entries indexing into shape_data).
+ * grad_req: "write" | "add" | "null" applied to every argument.
+ * Parameters start zero-filled: initialize via MXExecutorArgArray +
+ * MXNDArraySyncCopyFromCPU.
+ */
+int MXExecutorSimpleBind(SymbolHandle sym, int num_input_shapes,
+                         const char **input_keys, const mx_uint *shape_data,
+                         const mx_uint *shape_ind, const char *grad_req,
+                         ExecutorHandle *out);
+int MXExecutorFree(ExecutorHandle exec);
+
+/* Borrowed-view accessors: each returns a NEW handle (caller frees)
+ * that aliases the executor's live array, so SyncCopyFromCPU into an
+ * arg handle feeds the next Forward. */
+int MXExecutorArgArray(ExecutorHandle exec, const char *name,
+                       NDArrayHandle *out);
+int MXExecutorGradArray(ExecutorHandle exec, const char *name,
+                        NDArrayHandle *out);
+int MXExecutorAuxArray(ExecutorHandle exec, const char *name,
+                       NDArrayHandle *out);
+
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+/* Backward with default head gradients (ones / loss-op semantics). */
+int MXExecutorBackward(ExecutorHandle exec);
+/* On input *num_outputs = capacity of `outputs`; on return the count
+ * written (fresh handles, caller frees). */
+int MXExecutorOutputs(ExecutorHandle exec, int *num_outputs,
+                      NDArrayHandle *outputs);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -69,6 +69,14 @@ class NDArray {
     }
   }
 
+  /* In-place contents update from another array (the writeback half of
+   * functional update ops like sgd_update). */
+  void CopyFrom(const NDArray &src) {
+    if (MXNDArrayCopyFrom(handle(), src.handle()) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
   std::vector<mx_float> CopyToVector() const {
     size_t n = Size();
     std::vector<mx_float> out(n);

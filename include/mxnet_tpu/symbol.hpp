@@ -1,0 +1,198 @@
+/*
+ * Header-only C++ Symbol + Executor wrapper over the C API — the
+ * cpp-package graph-training analog (reference
+ * cpp-package/include/mxnet-cpp/symbol.h + executor.h wrap
+ * MXSymbolCreateFromJSON / MXExecutorSimpleBind / Forward / Backward
+ * the same way). Link against libmxtpu_predict.so.
+ *
+ *   using namespace mxnet_tpu::cpp;
+ *   Symbol net = Symbol::FromFile("model-symbol.json");
+ *   Executor ex = net.SimpleBind({{"data", {64, 8}},
+ *                                 {"label", {64, 1}}});
+ *   ex.ArgArray("fc1_weight").SyncCopyFromCPU(w0);   // init params
+ *   ex.Forward(true);
+ *   ex.Backward();
+ *   NDArray grad = ex.GradArray("fc1_weight");
+ *
+ * See tests/cpp_train_demo.cc for a full training loop driven from a
+ * symbol.json with no Python source in hand.
+ */
+#ifndef MXNET_TPU_SYMBOL_HPP_
+#define MXNET_TPU_SYMBOL_HPP_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+#include "ndarray.hpp"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+namespace detail {
+inline std::vector<std::string> ToStrings(mx_uint n, const char **names) {
+  std::vector<std::string> out;
+  out.reserve(n);
+  for (mx_uint i = 0; i < n; ++i) out.emplace_back(names[i]);
+  return out;
+}
+}  // namespace detail
+
+class Executor;
+
+class Symbol {
+ public:
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    if (MXSymbolCreateFromJSON(json.c_str(), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return Symbol(h);
+  }
+
+  static Symbol FromFile(const std::string &fname) {
+    SymbolHandle h = nullptr;
+    if (MXSymbolCreateFromFile(fname.c_str(), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return Symbol(h);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    if (MXSymbolListArguments(handle(), &n, &names) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return detail::ToStrings(n, names);
+  }
+
+  std::vector<std::string> ListAuxiliaryStates() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    if (MXSymbolListAuxiliaryStates(handle(), &n, &names) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return detail::ToStrings(n, names);
+  }
+
+  std::vector<std::string> ListOutputs() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    if (MXSymbolListOutputs(handle(), &n, &names) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return detail::ToStrings(n, names);
+  }
+
+  inline Executor SimpleBind(
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+      const std::string &grad_req = "write") const;
+
+  SymbolHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Holder {
+    SymbolHandle h;
+    explicit Holder(SymbolHandle hh) : h(hh) {}
+    ~Holder() { MXSymbolFree(h); }
+  };
+
+  explicit Symbol(SymbolHandle h) : handle_(std::make_shared<Holder>(h)) {}
+  std::shared_ptr<Holder> handle_;
+};
+
+class Executor {
+ public:
+  void Forward(bool is_train) {
+    if (MXExecutorForward(handle(), is_train ? 1 : 0) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  void Backward() {
+    if (MXExecutorBackward(handle()) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+  }
+
+  NDArray ArgArray(const std::string &name) const {
+    NDArrayHandle h = nullptr;
+    if (MXExecutorArgArray(handle(), name.c_str(), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return NDArray::FromHandle(h);
+  }
+
+  NDArray GradArray(const std::string &name) const {
+    NDArrayHandle h = nullptr;
+    if (MXExecutorGradArray(handle(), name.c_str(), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return NDArray::FromHandle(h);
+  }
+
+  NDArray AuxArray(const std::string &name) const {
+    NDArrayHandle h = nullptr;
+    if (MXExecutorAuxArray(handle(), name.c_str(), &h) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    return NDArray::FromHandle(h);
+  }
+
+  std::vector<NDArray> Outputs(int max_outputs = 16) const {
+    std::vector<NDArrayHandle> hs(max_outputs, nullptr);
+    int n = max_outputs;
+    if (MXExecutorOutputs(handle(), &n, hs.data()) != 0) {
+      throw std::runtime_error(MXGetLastError());
+    }
+    std::vector<NDArray> out;
+    out.reserve(n);
+    for (int i = 0; i < n; ++i) out.push_back(NDArray::FromHandle(hs[i]));
+    return out;
+  }
+
+  ExecutorHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  friend class Symbol;
+
+  struct Holder {
+    ExecutorHandle h;
+    explicit Holder(ExecutorHandle hh) : h(hh) {}
+    ~Holder() { MXExecutorFree(h); }
+  };
+
+  explicit Executor(ExecutorHandle h)
+      : handle_(std::make_shared<Holder>(h)) {}
+  std::shared_ptr<Holder> handle_;
+};
+
+inline Executor Symbol::SimpleBind(
+    const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+    const std::string &grad_req) const {
+  std::vector<const char *> keys;
+  std::vector<mx_uint> shape_data;
+  std::vector<mx_uint> shape_ind{0};
+  for (const auto &kv : input_shapes) {
+    keys.push_back(kv.first.c_str());
+    shape_data.insert(shape_data.end(), kv.second.begin(), kv.second.end());
+    shape_ind.push_back(static_cast<mx_uint>(shape_data.size()));
+  }
+  ExecutorHandle h = nullptr;
+  if (MXExecutorSimpleBind(handle(), static_cast<int>(keys.size()),
+                           keys.data(), shape_data.data(), shape_ind.data(),
+                           grad_req.c_str(), &h) != 0) {
+    throw std::runtime_error(MXGetLastError());
+  }
+  return Executor(h);
+}
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  /* MXNET_TPU_SYMBOL_HPP_ */

@@ -57,3 +57,90 @@ def imperative_invoke(op_name, inputs, keys, vals):
     kwargs = dict(zip(list(keys), list(vals)))
     out = _dispatch.invoke(op, tuple(inputs), kwargs)
     return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# ----------------------------------------------------------------------
+# Symbol / Executor surface (reference c_api_symbolic.cc +
+# c_api_executor.cc:220 MXExecutorSimpleBind) — handles are PyObjects
+# of Symbol / Executor; src/c_api.cc marshals the C side.
+# ----------------------------------------------------------------------
+def symbol_from_json(json_str):
+    from .symbol import load_json
+    return load_json(json_str)
+
+
+def symbol_from_file(fname):
+    from .symbol import load
+    return load(fname)
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_auxiliary_states(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_tojson(sym):
+    return sym.tojson()
+
+
+def executor_simple_bind(sym, keys, shapes, grad_req="write"):
+    """simple_bind on the default (cpu in the embedded runtime) context;
+    ``keys``/``shapes`` give the input shapes, everything else infers
+    (reference MXExecutorSimpleBind's 30-arg marshal collapses to this)."""
+    from .context import cpu
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    return sym.simple_bind(ctx=cpu(), grad_req=grad_req, **kwargs)
+
+
+def executor_arg_array(ex, name):
+    arr = ex.arg_dict.get(name)
+    if arr is None:
+        raise KeyError("executor has no argument '%s' (args: %s)"
+                       % (name, list(ex.arg_dict)))
+    return arr
+
+
+def executor_grad_array(ex, name):
+    arr = ex.grad_dict.get(name)
+    if arr is None:
+        raise KeyError("executor has no gradient for '%s'" % name)
+    return arr
+
+
+def executor_aux_array(ex, name):
+    arr = ex.aux_dict.get(name)
+    if arr is None:
+        raise KeyError("executor has no aux state '%s'" % name)
+    return arr
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+    return None
+
+
+def executor_backward(ex):
+    ex.backward()
+    return None
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def ndarray_copy_from(dst, src):
+    """In-place dst <- src (the C trainer's functional-update writeback;
+    reference _copyto). Shapes must match exactly — silently adopting a
+    different shape would corrupt a bound executor's live argument."""
+    if tuple(src.shape) != tuple(dst.shape):
+        raise ValueError("MXNDArrayCopyFrom: shape mismatch %s vs %s"
+                         % (tuple(src.shape), tuple(dst.shape)))
+    dst._set_data(src._data.astype(dst._data.dtype))
+    return None

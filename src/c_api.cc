@@ -42,6 +42,17 @@ std::unordered_map<void *, std::vector<mx_uint>> *ShapeCache() {
   return cache;
 }
 
+// per-handle cached name lists for MXSymbolList* (strings + the
+// pointer array handed to the caller)
+struct NameList {
+  std::vector<std::string> strings;
+  std::vector<const char *> ptrs;
+};
+std::unordered_map<void *, NameList> *NameCache() {
+  static auto *cache = new std::unordered_map<void *, NameList>();
+  return cache;
+}
+
 PyObject *CallImpl(const char *fn_name, PyObject *args) {
   PyObject *fn = ImportAttr("mxnet_tpu._c_api_impl", fn_name);
   if (fn == nullptr) {
@@ -167,6 +178,208 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
   if (n > *num_outputs) {
     Py_DECREF(res);
     SetError("MXImperativeInvoke: output capacity too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayCopyFrom(NDArrayHandle dst, NDArrayHandle src) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl("ndarray_copy_from",
+                         Py_BuildValue("(OO)", dst, src));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Symbol / Executor surface
+// ---------------------------------------------------------------------
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *sym = CallImpl("symbol_from_json", Py_BuildValue("(s)", json));
+  if (sym == nullptr) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *sym = CallImpl("symbol_from_file", Py_BuildValue("(s)", fname));
+  if (sym == nullptr) return -1;
+  *out = sym;
+  return 0;
+}
+
+// per-handle cached JSON text for MXSymbolSaveToJSON
+std::unordered_map<void *, std::string> *JsonCache() {
+  static auto *cache = new std::unordered_map<void *, std::string>();
+  return cache;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *s = CallImpl("symbol_tojson", Py_BuildValue("(O)", sym));
+  if (s == nullptr) return -1;
+  auto &slot = (*JsonCache())[sym];
+  slot = PyUnicode_AsUTF8(s);
+  Py_DECREF(s);
+  *out_json = slot.c_str();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  if (sym == nullptr) return 0;
+  GILGuard gil;
+  NameCache()->erase(sym);
+  JsonCache()->erase(sym);
+  Py_DECREF(static_cast<PyObject *>(sym));
+  return 0;
+}
+
+namespace {
+
+int ListNames(const char *impl_fn, void *handle, mx_uint *out_size,
+              const char ***out_names) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *lst = CallImpl(impl_fn, Py_BuildValue("(O)", handle));
+  if (lst == nullptr) return -1;
+  NameList nl;
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    nl.strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+  }
+  Py_DECREF(lst);
+  for (const auto &s : nl.strings) nl.ptrs.push_back(s.c_str());
+  auto &slot = (*NameCache())[handle];
+  slot = std::move(nl);
+  *out_size = static_cast<mx_uint>(slot.ptrs.size());
+  *out_names = slot.ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_names) {
+  return ListNames("symbol_list_arguments", sym, out_size, out_names);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_names) {
+  return ListNames("symbol_list_auxiliary_states", sym, out_size,
+                   out_names);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_names) {
+  return ListNames("symbol_list_outputs", sym, out_size, out_names);
+}
+
+int MXExecutorSimpleBind(SymbolHandle sym, int num_input_shapes,
+                         const char **input_keys, const mx_uint *shape_data,
+                         const mx_uint *shape_ind, const char *grad_req,
+                         ExecutorHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *keys = PyList_New(num_input_shapes);
+  PyObject *shapes = PyList_New(num_input_shapes);
+  for (int i = 0; i < num_input_shapes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = shape_ind[i], hi = shape_ind[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *ex = CallImpl(
+      "executor_simple_bind",
+      Py_BuildValue("(ONNs)", sym, keys, shapes,
+                    grad_req ? grad_req : "write"));
+  if (ex == nullptr) return -1;
+  *out = ex;
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle exec) {
+  if (exec == nullptr) return 0;
+  GILGuard gil;
+  Py_DECREF(static_cast<PyObject *>(exec));
+  return 0;
+}
+
+namespace {
+
+int ExecArray(const char *impl_fn, void *exec, const char *name,
+              NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *nd = CallImpl(impl_fn, Py_BuildValue("(Os)", exec, name));
+  if (nd == nullptr) return -1;
+  *out = nd;
+  return 0;
+}
+
+}  // namespace
+
+int MXExecutorArgArray(ExecutorHandle exec, const char *name,
+                       NDArrayHandle *out) {
+  return ExecArray("executor_arg_array", exec, name, out);
+}
+
+int MXExecutorGradArray(ExecutorHandle exec, const char *name,
+                        NDArrayHandle *out) {
+  return ExecArray("executor_grad_array", exec, name, out);
+}
+
+int MXExecutorAuxArray(ExecutorHandle exec, const char *name,
+                       NDArrayHandle *out) {
+  return ExecArray("executor_aux_array", exec, name, out);
+}
+
+int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl("executor_forward",
+                         Py_BuildValue("(Oi)", exec, is_train));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle exec) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl("executor_backward", Py_BuildValue("(O)", exec));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle exec, int *num_outputs,
+                      NDArrayHandle *outputs) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *res = CallImpl("executor_outputs", Py_BuildValue("(O)", exec));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  if (n > *num_outputs) {
+    Py_DECREF(res);
+    SetError("MXExecutorOutputs: output capacity too small");
     return -1;
   }
   for (Py_ssize_t i = 0; i < n; ++i) {

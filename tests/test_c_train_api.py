@@ -41,11 +41,15 @@ def cpp_demo_exe(tmp_path_factory):
         str(tmp / "cpp_train_demo"), lang="cpp")
 
 
-def test_cpp_train_demo_runs_and_converges(cpp_demo_exe):
-    """The header-only C++ NDArray wrapper (include/mxnet_tpu/
-    ndarray.hpp — reference cpp-package/include/mxnet-cpp/ndarray.h:1)
-    trains the same MLP in idiomatic C++."""
+def test_cpp_train_demo_trains_from_symbol_json(cpp_demo_exe):
+    """The graph-level C API (MXSymbolCreateFromJSON +
+    MXExecutorSimpleBind/Forward/Backward — reference c_api.h:1111,
+    c_api_executor.cc:220) + header-only C++ wrappers
+    (include/mxnet_tpu/symbol.hpp, ndarray.hpp) train an MLP loaded
+    from a symbol.json with no Python source in hand."""
     r = subprocess.run([cpp_demo_exe], capture_output=True, text=True,
                       env=predict_subprocess_env(), timeout=600)
     assert r.returncode == 0, "stdout:%s\nstderr:%s" % (r.stdout, r.stderr)
-    assert "cpp_train_demo OK" in r.stdout
+    assert "cpp_train_demo OK (trained from symbol.json via C API)" \
+        in r.stdout
+    assert "6 arguments" in r.stdout

@@ -230,11 +230,14 @@ def launch_ssh(args):
         # watchdog: stdin-EOF (job over / launcher killed) kills the
         # server, while `wait $c` keeps the ssh client's exit tied to the
         # SERVER's (a crashed server must still fail _wait_all fast)
+        # the watcher subshell closes its own stdout/stderr (it would
+        # otherwise hold the ssh channel open after the server dies,
+        # hiding the crash from _wait_all's daemon poll)
         server_procs.append(_ssh(
             hosts[0], env,
             ["sh", "-c",
              "%s -c 'import mxnet_tpu' & c=$!; "
-             "(cat >/dev/null; kill $c 2>/dev/null) & wait $c"
+             "(cat; kill $c 2>/dev/null) >/dev/null 2>&1 & wait $c"
              % shlex.quote(sys.executable)],
             stdin=subprocess.PIPE))   # held open: EOF == job over
     procs = []
