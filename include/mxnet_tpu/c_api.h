@@ -123,6 +123,31 @@ int MXExecutorBackward(ExecutorHandle exec);
 int MXExecutorOutputs(ExecutorHandle exec, int *num_outputs,
                       NDArrayHandle *outputs);
 
+/*
+ * KVStore surface — parameter synchronization from C (reference
+ * MXKVStoreCreate/Init/Push/Pull/SetOptimizer, include/mxnet/c_api.h
+ * MXKVStore*). Types: "local"/"device"/"tpu" (in-process),
+ * "dist_sync" (collectives), "dist_async" (parameter servers).
+ */
+typedef void *KVStoreHandle;
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle kv);
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *outs, int priority);
+/* Run an SGD updater on the store (the C slice of the reference's
+ * MXKVStoreSetOptimizer, which pickles arbitrary optimizers). */
+int MXKVStoreSetOptimizerSGD(KVStoreHandle kv, mx_float lr,
+                             mx_float momentum, mx_float wd,
+                             mx_float rescale_grad);
+int MXKVStoreGetRank(KVStoreHandle kv, int *out);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
+int MXKVStoreBarrier(KVStoreHandle kv);
+
 #ifdef __cplusplus
 }  /* extern "C" */
 #endif

@@ -144,3 +144,51 @@ def ndarray_copy_from(dst, src):
                          % (tuple(src.shape), tuple(dst.shape)))
     dst._set_data(src._data.astype(dst._data.dtype))
     return None
+
+
+# ----------------------------------------------------------------------
+# KVStore surface (reference MXKVStoreCreate/Init/Push/Pull,
+# include/mxnet/c_api.h MXKVStore*) — handles are PyObjects of KVStore.
+# ----------------------------------------------------------------------
+def kvstore_create(name):
+    from . import kvstore
+    return kvstore.create(name)
+
+
+def kvstore_init(kv, keys, values):
+    kv.init(list(keys), list(values))
+    return None
+
+
+def kvstore_push(kv, keys, values, priority):
+    kv.push(list(keys), list(values), priority=int(priority))
+    return None
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return None
+
+
+def kvstore_set_optimizer_sgd(kv, lr, momentum, wd, rescale_grad):
+    """The C trainer's optimizer-on-store hook (reference
+    MXKVStoreSetOptimizer pickles arbitrary optimizers; the C surface
+    exposes the SGD family directly)."""
+    from . import optimizer as _opt
+    kv.set_optimizer(_opt.SGD(learning_rate=float(lr),
+                              momentum=float(momentum), wd=float(wd),
+                              rescale_grad=float(rescale_grad)))
+    return None
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_num_workers(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+    return None

@@ -233,8 +233,14 @@ int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
   GILGuard gil;
   PyObject *s = CallImpl("symbol_tojson", Py_BuildValue("(O)", sym));
   if (s == nullptr) return -1;
+  const char *text = PyUnicode_AsUTF8(s);
+  if (text == nullptr) {
+    Py_DECREF(s);
+    SetPyError("symbol_tojson");
+    return -1;
+  }
   auto &slot = (*JsonCache())[sym];
-  slot = PyUnicode_AsUTF8(s);
+  slot = text;
   Py_DECREF(s);
   *out_json = slot.c_str();
   return 0;
@@ -260,7 +266,13 @@ int ListNames(const char *impl_fn, void *handle, mx_uint *out_size,
   NameList nl;
   Py_ssize_t n = PyList_Size(lst);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    nl.strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    if (s == nullptr) {           // non-UTF8-encodable name
+      Py_DECREF(lst);
+      SetPyError(impl_fn);
+      return -1;
+    }
+    nl.strings.emplace_back(s);
   }
   Py_DECREF(lst);
   for (const auto &s : nl.strings) nl.ptrs.push_back(s.c_str());
@@ -389,6 +401,121 @@ int MXExecutorOutputs(ExecutorHandle exec, int *num_outputs,
   }
   *num_outputs = static_cast<int>(n);
   Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// KVStore surface
+// ---------------------------------------------------------------------
+
+namespace {
+
+// (keys, handles) -> (PyList[str], PyList[NDArray]) for kv ops
+int KVListArgs(mx_uint num, const char **keys, NDArrayHandle *vals,
+               PyObject **out_keys, PyObject **out_vals) {
+  PyObject *pk = PyList_New(num);
+  PyObject *pv = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SetItem(pk, i, PyUnicode_FromString(keys[i]));
+    PyObject *o = static_cast<PyObject *>(vals[i]);
+    Py_INCREF(o);
+    PyList_SetItem(pv, i, o);
+  }
+  *out_keys = pk;
+  *out_vals = pv;
+  return 0;
+}
+
+int KVCall(const char *fn, KVStoreHandle kv, mx_uint num, const char **keys,
+           NDArrayHandle *vals, int priority, bool with_priority) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *pk = nullptr, *pv = nullptr;
+  KVListArgs(num, keys, vals, &pk, &pv);
+  PyObject *r = with_priority
+      ? CallImpl(fn, Py_BuildValue("(ONNi)", kv, pk, pv, priority))
+      : CallImpl(fn, Py_BuildValue("(ONN)", kv, pk, pv));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *kv = CallImpl("kvstore_create", Py_BuildValue("(s)", type));
+  if (kv == nullptr) return -1;
+  *out = kv;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle kv) {
+  if (kv == nullptr) return 0;
+  GILGuard gil;
+  Py_DECREF(static_cast<PyObject *>(kv));
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *vals) {
+  return KVCall("kvstore_init", kv, num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *vals, int priority) {
+  return KVCall("kvstore_push", kv, num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const char **keys,
+                  NDArrayHandle *outs, int priority) {
+  return KVCall("kvstore_pull", kv, num, keys, outs, priority, true);
+}
+
+int MXKVStoreSetOptimizerSGD(KVStoreHandle kv, mx_float lr,
+                             mx_float momentum, mx_float wd,
+                             mx_float rescale_grad) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl(
+      "kvstore_set_optimizer_sgd",
+      Py_BuildValue("(Offff)", kv, static_cast<double>(lr),
+                    static_cast<double>(momentum), static_cast<double>(wd),
+                    static_cast<double>(rescale_grad)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+int KVScalar(const char *fn, KVStoreHandle kv, int *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", kv));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXKVStoreGetRank(KVStoreHandle kv, int *out) {
+  return KVScalar("kvstore_rank", kv, out);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out) {
+  return KVScalar("kvstore_num_workers", kv, out);
+}
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl("kvstore_barrier", Py_BuildValue("(O)", kv));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
   return 0;
 }
 

@@ -1,0 +1,60 @@
+/* C kvstore demo (reference MXKVStore* surface of include/mxnet/c_api.h):
+ * create a local store, init a key, install an SGD updater, push
+ * gradients, pull the updated weight — the _update_params_on_kvstore
+ * round (model.py:145) driven from C. */
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "../include/mxnet_tpu/c_api.h"
+
+#define CHECK(x)                                                     \
+  if ((x) != 0) {                                                    \
+    fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError());          \
+    return 1;                                                        \
+  }
+
+int main(void) {
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv));
+  int rank = -1, size = -1;
+  CHECK(MXKVStoreGetRank(kv, &rank));
+  CHECK(MXKVStoreGetGroupSize(kv, &size));
+  if (rank != 0 || size != 1) {
+    fprintf(stderr, "bad rank/size %d/%d\n", rank, size);
+    return 1;
+  }
+
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle w = NULL, g = NULL, out = NULL;
+  CHECK(MXNDArrayCreate(shape, 2, &w));
+  CHECK(MXNDArrayCreate(shape, 2, &g));
+  CHECK(MXNDArrayCreate(shape, 2, &out));
+  float ones[6] = {1, 1, 1, 1, 1, 1};
+  float grads[6] = {2, 2, 2, 2, 2, 2};
+  CHECK(MXNDArraySyncCopyFromCPU(w, ones, 6));
+  CHECK(MXNDArraySyncCopyFromCPU(g, grads, 6));
+
+  const char *key = "weight";
+  CHECK(MXKVStoreInit(kv, 1, &key, &w));
+  /* w <- w - 0.1 * grad  per push */
+  CHECK(MXKVStoreSetOptimizerSGD(kv, 0.1f, 0.0f, 0.0f, 1.0f));
+  CHECK(MXKVStorePush(kv, 1, &key, &g, 0));
+  CHECK(MXKVStorePull(kv, 1, &key, &out, 0));
+
+  float buf[6];
+  CHECK(MXNDArraySyncCopyToCPU(out, buf, 6));
+  for (int i = 0; i < 6; ++i) {
+    if (fabsf(buf[i] - 0.8f) > 1e-6f) {
+      fprintf(stderr, "expected 0.8, got %f\n", buf[i]);
+      return 1;
+    }
+  }
+  CHECK(MXKVStoreBarrier(kv));
+  CHECK(MXNDArrayFree(w));
+  CHECK(MXNDArrayFree(g));
+  CHECK(MXNDArrayFree(out));
+  CHECK(MXKVStoreFree(kv));
+  printf("c_kvstore_demo OK\n");
+  return 0;
+}

@@ -92,8 +92,13 @@ int main() {
     ex.ArgArray("label").SyncCopyFromCPU(yh);
 
     const std::map<std::string, std::string> lr{{"lr", "0.3"}};
-    const char *params[] = {"fc1_weight", "fc1_bias", "fc2_weight",
-                            "fc2_bias"};
+    // the accessors return aliases of the executor's LIVE arrays, so
+    // fetch each weight/grad pair once, outside the loop
+    std::vector<std::pair<NDArray, NDArray>> wg;
+    for (const char *p : {"fc1_weight", "fc1_bias", "fc2_weight",
+                          "fc2_bias"}) {
+      wg.emplace_back(ex.ArgArray(p), ex.GradArray(p));
+    }
 
     float first_loss = -1.f, loss = 0.f;
     for (int it = 0; it < 320; ++it) {
@@ -106,11 +111,10 @@ int main() {
         loss += e * e / N;
       }
       if (first_loss < 0) first_loss = loss;
-      for (const char *p : params) {
-        NDArray w = ex.ArgArray(p);
-        NDArray g = ex.GradArray(p);
-        NDArray updated = NDArray::Invoke("sgd_update", {w, g}, lr)[0];
-        w.CopyFrom(updated);         // functional update -> writeback
+      for (auto &p : wg) {
+        NDArray updated = NDArray::Invoke("sgd_update",
+                                          {p.first, p.second}, lr)[0];
+        p.first.CopyFrom(updated);   // functional update -> writeback
       }
     }
 

@@ -1,0 +1,65 @@
+"""Worker script: data-parallel Module.fit over the ASYNC parameter
+server (reference async dist training: kvstore_dist_server.h async mode
++ base_module fit with update_on_kvstore).
+
+Each worker trains on its own shard at its own pace; the optimizer runs
+ON THE SERVER (set_optimizer pickled over), every push applies
+immediately, and pulls fetch whatever has landed — Hogwild. Parameters
+are NOT bit-identical across workers mid-flight (that's the point);
+the model must still solve the task on every worker.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, n = kv.rank, kv.num_workers
+    assert type(kv).__name__ == "KVStoreDistAsync"
+
+    rng = np.random.RandomState(0)  # same dataset everywhere
+    N = 256
+    X = rng.rand(N, 8).astype(np.float32)
+    y = (X[:, :4].sum(axis=1) > X[:, 4:].sum(axis=1)).astype(np.float32)
+    Xs, ys = X[rank::n], y[rank::n]
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=2,
+                                               name="fc2"), name="softmax")
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+    mod = mx.Module(net, context=mx.cpu())
+
+    class RateSkew:
+        """Deliberate per-worker speed difference (free-running)."""
+
+        def __call__(self, param):
+            if rank == 0:
+                time.sleep(0.003)
+
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            kvstore=kv,
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              magnitude=1.0),
+            batch_end_callback=RateSkew())
+
+    full_it = mx.io.NDArrayIter(X, y, batch_size=16)
+    acc = mod.score(full_it, "acc")[0][1]
+    assert acc > 0.9, "accuracy %f too low" % acc
+    kv.barrier()
+    print("worker %d/%d: async dist training converged, acc=%.3f"
+          % (rank, n, acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,3 +53,16 @@ def test_cpp_train_demo_trains_from_symbol_json(cpp_demo_exe):
     assert "cpp_train_demo OK (trained from symbol.json via C API)" \
         in r.stdout
     assert "6 arguments" in r.stdout
+
+
+def test_c_kvstore_demo(tmp_path):
+    """The C kvstore surface (MXKVStoreCreate/Init/Push/Pull/
+    SetOptimizerSGD — reference MXKVStore* in include/mxnet/c_api.h)
+    runs the push-grad/pull-weight round from plain C."""
+    exe = compile_against_predict_lib(
+        [os.path.join(ROOT, "tests", "c_kvstore_demo.c")],
+        str(tmp_path / "c_kvstore_demo"), lang="c")
+    r = subprocess.run([exe], capture_output=True, text=True,
+                       env=predict_subprocess_env(), timeout=300)
+    assert r.returncode == 0, "stdout:%s\nstderr:%s" % (r.stdout, r.stderr)
+    assert "c_kvstore_demo OK" in r.stdout

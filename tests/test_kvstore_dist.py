@@ -46,6 +46,24 @@ def test_dist_async_4_workers_2_servers():
     assert proc.stdout.count("all dist_async checks passed") == 4
 
 
+def test_dist_async_training_2_workers():
+    """Module.fit over the ASYNC parameter server: optimizer-on-server,
+    free-running workers with deliberate rate skew, Hogwild updates —
+    and the model still converges on every worker
+    (tests/dist_async_train_worker.py; reference async dist training)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", "1", sys.executable,
+         os.path.join(ROOT, "tests", "dist_async_train_worker.py")],
+        env=env, capture_output=True, text=True, timeout=280)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("async dist training converged") == 2
+
+
 def test_dist_training_2_workers():
     """Data-parallel Module.fit over dist_sync: params stay identical
     across workers and the model converges (dist_lenet.py analog)."""

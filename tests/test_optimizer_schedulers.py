@@ -1,0 +1,211 @@
+"""Optimizer + LR-scheduler behavior (reference
+tests/python/unittest/test_optimizer.py strategy: exact first-step
+algebra for the core optimizers, descent sanity across the whole
+registry, updater state round-trips; lr_scheduler.py curves).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_sgd_momentum_exact():
+    """w/m recurrences of the fused sgd_mom_update
+    (reference optimizer_op.cc): m = mom*m - lr*(rescale*g + wd*w);
+    w += m."""
+    lr, mom, wd, rescale = 0.1, 0.9, 0.01, 0.5
+    opt = mx.optimizer.SGD(learning_rate=lr, momentum=mom, wd=wd,
+                           rescale_grad=rescale)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.array([1.0, -2.0], np.float32))
+    wn = w.asnumpy().copy()
+    mn = np.zeros_like(wn)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        g = rng.randn(2).astype(np.float32)
+        upd(0, nd.array(g), w)
+        mn = mom * mn - lr * (rescale * g + wd * wn)
+        wn = wn + mn
+    np.testing.assert_allclose(w.asnumpy(), wn, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_exact():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                            epsilon=eps, wd=0.0, rescale_grad=1.0)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.array([0.5, -0.5], np.float32))
+    wn = w.asnumpy().copy()
+    m = np.zeros_like(wn)
+    v = np.zeros_like(wn)
+    rng = np.random.RandomState(1)
+    for t in range(1, 4):
+        g = rng.randn(2).astype(np.float32)
+        upd(0, nd.array(g), w)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        wn = wn - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w.asnumpy(), wn, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+    ("adagrad", {"learning_rate": 0.3}),
+    ("adadelta", {}),
+    ("rmsprop", {"learning_rate": 0.05}),
+    ("adamax", {"learning_rate": 0.05}),
+    ("nadam", {"learning_rate": 0.05}),
+    ("ftrl", {"learning_rate": 0.3}),
+    ("ftml", {"learning_rate": 0.05}),
+    ("signum", {"learning_rate": 0.01}),
+    ("dcasgd", {"learning_rate": 0.1}),
+])
+def test_registry_descends_quadratic(name, kwargs):
+    """Every registered optimizer must reduce f(w) = ||w||^2 / 2 (the
+    reference suite's compare-and-descend sanity, minus the cross-device
+    comparison that TPU/CPU consistency tests already cover)."""
+    opt = mx.optimizer.create(name, wd=0.0, **kwargs)
+    upd = mx.optimizer.get_updater(opt)
+    rng = np.random.RandomState(0)
+    w = nd.array(rng.uniform(0.5, 1.5, (8,)).astype(np.float32))
+    f0 = float((w.asnumpy() ** 2).sum())
+    for _ in range(60):
+        grad = w.asnumpy()             # d/dw ||w||^2/2 = w
+        upd(0, nd.array(grad), w)
+    f1 = float((w.asnumpy() ** 2).sum())
+    assert f1 < 0.7 * f0, "%s did not descend: %.4f -> %.4f" % (name, f0,
+                                                                f1)
+
+
+def test_updater_states_roundtrip():
+    """get_states/set_states pickle round-trip (reference
+    Updater.get_states — the dist server checkpoint path). Uses SGD
+    momentum: its state is self-contained, which is what the round-trip
+    guarantees (Adam's bias-correction count lives on the OPTIMIZER in
+    the reference too — Module checkpoints pair states with the
+    optimizer for that reason)."""
+    def mk():
+        return mx.optimizer.get_updater(
+            mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0))
+
+    upd = mk()
+    w = nd.array(np.ones(4, np.float32))
+    for _ in range(3):
+        upd(3, nd.array(np.full(4, 0.5, np.float32)), w)
+    blob = upd.get_states()
+    w_snapshot = w.asnumpy().copy()
+
+    upd2 = mk()
+    upd2.set_states(blob)
+    w2 = nd.array(w_snapshot)
+    upd(3, nd.array(np.full(4, 0.5, np.float32)), w)
+    upd2(3, nd.array(np.full(4, 0.5, np.float32)), w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_sgld_injects_langevin_noise():
+    """SGLD adds N(0, lr) Langevin noise per step (reference
+    optimizer.py SGLD) — with zero gradient the weight random-walks
+    with the predicted scale instead of staying put."""
+    mx.random.seed(0)
+    opt = mx.optimizer.create("sgld", learning_rate=0.01, wd=0.0)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.zeros(4096, np.float32))
+    upd(0, nd.array(np.zeros(4096, np.float32)), w)
+    std = float(w.asnumpy().std())
+    assert 0.05 < std < 0.2, std      # ~sqrt(lr) = 0.1
+
+
+def test_lbsgd_trust_ratio_scales_update():
+    """LBSGD applies a LARS-style trust ratio, so its step on a unit
+    gradient is much smaller than plain SGD's but still descends."""
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, wd=0.0)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.ones(8, np.float32))
+    f0 = float((w.asnumpy() ** 2).sum())
+    for _ in range(200):
+        upd(0, nd.array(w.asnumpy()), w)
+    f1 = float((w.asnumpy() ** 2).sum())
+    assert f1 < f0
+
+
+def test_lr_wd_mult_name_rules():
+    """Default wd skips biases/gammas/betas; set_lr_mult/set_wd_mult
+    override by name (reference optimizer.py:330)."""
+    opt = mx.optimizer.SGD(learning_rate=1.0, wd=0.5, rescale_grad=1.0)
+    opt.idx2name = {0: "fc_weight", 1: "fc_bias"}
+    opt.set_lr_mult({"fc_bias": 0.0})
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.ones(2, np.float32))
+    b = nd.array(np.ones(2, np.float32))
+    upd(0, nd.array(np.zeros(2, np.float32)), w)   # only wd acts
+    upd(1, nd.array(np.ones(2, np.float32)), b)    # lr_mult 0: frozen
+    assert abs(float(w.asnumpy()[0]) - 0.5) < 1e-6   # w -= lr*wd*w
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+
+
+def test_factor_scheduler():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                        base_lr=1.0, stop_factor_lr=0.2)
+    assert s(1) == 1.0
+    assert abs(s(11) - 0.5) < 1e-9
+    assert abs(s(21) - 0.25) < 1e-9
+    assert abs(s(91) - 0.2) < 1e-9      # clamped at stop_factor_lr
+
+
+def test_multifactor_scheduler():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=2.0)
+    assert s(1) == 2.0
+    assert abs(s(6) - 0.2) < 1e-9
+    assert abs(s(16) - 0.02) < 1e-9
+    assert abs(s(100) - 0.02) < 1e-9
+
+
+def test_poly_scheduler_endpoints():
+    s = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                      pwr=2, final_lr=0.0)
+    assert abs(s(0) - 1.0) < 1e-9
+    assert abs(s(50) - 0.25) < 1e-6     # (1 - 0.5)^2
+    assert abs(s(100) - 0.0) < 1e-9
+    assert abs(s(1000) - 0.0) < 1e-9
+
+
+def test_cosine_scheduler_endpoints():
+    s = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                        final_lr=0.1)
+    assert abs(s(0) - 1.0) < 1e-9
+    mid = s(50)
+    assert abs(mid - (0.1 + 0.9 * 0.5)) < 1e-6
+    assert abs(s(100) - 0.1) < 1e-9
+
+
+def test_warmup_then_schedule():
+    base = mx.lr_scheduler.FactorScheduler(step=1000, factor=1.0,
+                                           base_lr=1.0)
+    s = mx.lr_scheduler.WarmupScheduler(base, warmup_steps=10,
+                                        warmup_begin_lr=0.0)
+    assert s(1) < 0.2
+    assert abs(s(10) - 1.0) < 1e-6
+    assert abs(s(500) - 1.0) < 1e-9
+
+
+def test_scheduler_drives_optimizer_through_module_path():
+    opt = mx.optimizer.SGD(
+        learning_rate=1.0,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                                     base_lr=1.0))
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(np.zeros(1, np.float32))
+    deltas = []
+    prev = 0.0
+    for _ in range(6):
+        upd(0, nd.array(np.ones(1, np.float32)), w)
+        cur = float(w.asnumpy()[0])
+        deltas.append(prev - cur)       # = effective lr this step
+        prev = cur
+    assert deltas[0] > deltas[2] > deltas[4]   # lr decayed along steps

@@ -1,0 +1,130 @@
+"""Statistical tests of the random-op corpus (reference
+tests/python/unittest/test_random.py's moment-checking strategy:
+sample, compare mean/var against the analytic distribution, verify
+seed determinism and sibling-call independence).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = (200, 200)          # 40k samples → se(mean) ~ sd/200
+
+
+def _mean_var(arr):
+    a = arr.asnumpy().astype(np.float64)
+    return a.mean(), a.var()
+
+
+def test_uniform_moments():
+    mx.random.seed(42)
+    m, v = _mean_var(nd.random.uniform(-2.0, 6.0, shape=N))
+    assert abs(m - 2.0) < 0.05
+    assert abs(v - (8.0 ** 2) / 12.0) < 0.15
+
+
+def test_normal_moments():
+    mx.random.seed(42)
+    m, v = _mean_var(nd.random.normal(1.5, 2.0, shape=N))
+    assert abs(m - 1.5) < 0.05
+    assert abs(v - 4.0) < 0.15
+
+
+def test_gamma_moments():
+    mx.random.seed(42)
+    alpha, beta = 3.0, 2.0
+    m, v = _mean_var(nd.random.gamma(alpha, beta, shape=N))
+    assert abs(m - alpha * beta) < 0.1            # mean = k·θ
+    assert abs(v - alpha * beta ** 2) < 0.5       # var = k·θ²
+
+
+def test_exponential_moments():
+    mx.random.seed(42)
+    # python frontend takes SCALE (mean), converting to the op's rate
+    # lam = 1/scale (reference python/mxnet/ndarray/random.py exponential)
+    scale = 4.0
+    m, v = _mean_var(nd.random.exponential(scale, shape=N))
+    assert abs(m - scale) < 0.15
+    assert abs(v - scale ** 2) < 1.0
+
+
+def test_poisson_moments():
+    mx.random.seed(42)
+    lam = 6.0
+    m, v = _mean_var(nd.random.poisson(lam, shape=N))
+    assert abs(m - lam) < 0.1
+    assert abs(v - lam) < 0.3
+
+
+def test_randint_range_and_coverage():
+    mx.random.seed(42)
+    a = nd.random.randint(-3, 5, shape=N).asnumpy()
+    assert a.min() >= -3 and a.max() <= 4
+    assert set(np.unique(a)) == set(range(-3, 5))
+
+
+def test_seed_determinism_and_stream_independence():
+    mx.random.seed(7)
+    a1 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    b1 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    mx.random.seed(7)
+    a2 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    b2 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a1, a2)     # same seed → same stream
+    np.testing.assert_array_equal(b1, b2)
+    assert not np.array_equal(a1, b1)         # sibling calls differ
+
+
+def test_sample_normal_per_row_params():
+    """sample_* draws one batch PER parameter row (reference
+    _sample_normal semantics)."""
+    mx.random.seed(0)
+    mu = nd.array(np.array([0.0, 100.0], np.float32))
+    sigma = nd.array(np.array([1.0, 1.0], np.float32))
+    s = nd.sample_normal(mu, sigma, shape=(4000,)).asnumpy()
+    assert s.shape == (2, 4000)
+    assert abs(s[0].mean() - 0.0) < 0.1
+    assert abs(s[1].mean() - 100.0) < 0.1
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(0)
+    probs = nd.array(np.array([[0.1, 0.2, 0.7]], np.float32))
+    draws = nd.sample_multinomial(probs, shape=(8000,)).asnumpy().ravel()
+    freq = np.bincount(draws, minlength=3) / draws.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(0)
+    x = nd.array(np.arange(257, dtype=np.float32))
+    y = nd.shuffle(x).asnumpy()
+    assert not np.array_equal(y, np.arange(257))
+    np.testing.assert_array_equal(np.sort(y), np.arange(257))
+
+
+def test_dropout_keep_fraction_and_scaling():
+    """Dropout keeps ~(1-p) of units scaled by 1/(1-p) in training
+    (reference dropout-inl.h)."""
+    from mxnet_tpu import autograd
+    mx.random.seed(0)
+    x = nd.ones((200, 200))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.3)
+    a = y.asnumpy()
+    kept = (a != 0).mean()
+    assert abs(kept - 0.7) < 0.03
+    np.testing.assert_allclose(a[a != 0], 1.0 / 0.7, rtol=1e-5)
+
+
+def test_rrelu_train_slope_range():
+    from mxnet_tpu import autograd
+    mx.random.seed(0)
+    x = nd.full((64, 64), -1.0)
+    with autograd.record(train_mode=True):
+        y = nd.LeakyReLU(x, act_type="rrelu", lower_bound=0.1,
+                         upper_bound=0.3)
+    a = -y.asnumpy()
+    assert a.min() >= 0.1 - 1e-6 and a.max() <= 0.3 + 1e-6
+    assert a.std() > 0.01                      # actually random per-elem
