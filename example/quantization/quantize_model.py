@@ -1,0 +1,115 @@
+"""Quantize a trained checkpoint to 8-bit and score it.
+
+Reference parity: example/quantization/imagenet_gen_qsym.py +
+imagenet_inference.py (generate a quantized symbol/params with
+calibration, then score). No dataset egress here, so the demo path
+trains a small model on the deterministic synthetic CIFAR generator,
+quantizes it with the chosen dtype/calibration, saves the quantized
+checkpoint in the reference layout, reloads it, and reports the fp32 vs
+8-bit accuracy delta.
+
+Usage (self-contained demo):
+  python example/quantization/quantize_model.py \
+      [--quantized-dtype int8|uint8|auto] [--calib-mode naive|entropy|none]
+
+Or quantize YOUR checkpoint:
+  python example/quantization/quantize_model.py \
+      --load-prefix model --load-epoch 7 \
+      --data-shape 3,28,28 --num-calib-examples 256
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.quantization import quantize_model
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "image-classification")))
+from train_synthetic_cifar import synthetic_cifar  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quantized-dtype", default="auto",
+                    choices=["int8", "uint8", "auto"])
+    ap.add_argument("--calib-mode", default="naive",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--num-calib-examples", type=int, default=256)
+    ap.add_argument("--load-prefix", default=None,
+                    help="existing checkpoint prefix (else the demo "
+                         "trains a small net first)")
+    ap.add_argument("--load-epoch", type=int, default=0)
+    ap.add_argument("--data-shape", default="3,28,28")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--out-prefix", default="/tmp/quantized_model")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    (Xtr, ytr), (Xva, yva) = synthetic_cifar()
+    val = mx.io.NDArrayIter(Xva, yva, batch_size=args.batch)
+    calib = mx.io.NDArrayIter(Xtr[:args.num_calib_examples],
+                              ytr[:args.num_calib_examples],
+                              batch_size=args.batch)
+
+    if args.load_prefix:
+        sym, arg_params, aux_params = mx.model.load_checkpoint(
+            args.load_prefix, args.load_epoch)
+        mod = mx.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (args.batch,) + shape)],
+                 label_shapes=[("softmax_label", (args.batch,))],
+                 for_training=False)
+        mod.set_params(arg_params, aux_params)
+    else:
+        from mxnet_tpu import models
+        sym = models.get_symbol("resnet", num_classes=10, num_layers=8,
+                                image_shape=shape)
+        train = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch)
+        mod = mx.Module(sym, context=mx.cpu())
+        mod.fit(train, num_epoch=4, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+                initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                                  factor_type="in",
+                                                  magnitude=2))
+        arg_params, aux_params = mod.get_params()
+
+    val.reset()
+    fp32_acc = mod.score(val, "acc")[0][1]
+
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, ctx=mx.cpu(),
+        calib_mode=args.calib_mode,
+        calib_data=None if args.calib_mode == "none" else calib,
+        num_calib_examples=args.num_calib_examples,
+        quantized_dtype=args.quantized_dtype)
+
+    # reference layout: prefix-symbol.json + prefix-0000.params
+    mx.model.save_checkpoint(args.out_prefix, 0, qsym, qarg, qaux)
+    logging.info("saved quantized checkpoint: %s-symbol.json",
+                 args.out_prefix)
+
+    qsym2, qarg2, qaux2 = mx.model.load_checkpoint(args.out_prefix, 0)
+    qmod = mx.Module(qsym2, context=mx.cpu())
+    qmod.bind(data_shapes=[("data", (args.batch,) + shape)],
+              label_shapes=[("softmax_label", (args.batch,))],
+              for_training=False)
+    qmod.set_params(qarg2, qaux2)
+    val.reset()
+    q_acc = qmod.score(val, "acc")[0][1]
+
+    print("fp32 acc=%.4f  %s acc=%.4f  delta=%.4f"
+          % (fp32_acc, args.quantized_dtype, q_acc, fp32_acc - q_acc))
+    if abs(fp32_acc - q_acc) > 0.01:
+        raise SystemExit("accuracy delta above the 1%% bar")
+    print("quantize_model example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -140,9 +140,9 @@ def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
                 sa["__shape__"] = str(tuple(wshape))
             qw_var = _Node(None, qname, {}, [], sa)
             lo_var = _Node(None, qname + "_min", {}, [],
-                           {"__shape__": "()"})
+                           {"__shape__": "(1,)"})
             hi_var = _Node(None, qname + "_max", {}, [],
-                           {"__shape__": "()"})
+                           {"__shape__": "(1,)"})
             w_q, w_min, w_max = (qw_var, 0), (lo_var, 0), (hi_var, 0)
         else:
             w_q, w_min, w_max = _quantize_chain(node.inputs[1],
@@ -352,8 +352,10 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     for name in offline:
         w = arg_params[name]
         wn = w.asnumpy()
-        lo = _nd.array(_np.float32(float(wn.min())))
-        hi = _nd.array(_np.float32(float(wn.max())))
+        # ranges must live with the weight (a cpu-context checkpoint on a
+        # TPU-default process would otherwise mix contexts)
+        lo = _nd.array(_np.float32(float(wn.min())), ctx=w.context)
+        hi = _nd.array(_np.float32(float(wn.max())), ctx=w.context)
         # weights are ALWAYS zero-centered int8 (the reference's deployed
         # combination: uint8 activations x int8 weights)
         qw, qlo, qhi = _nd.quantize(w, lo, hi, out_type="int8")

@@ -41,13 +41,14 @@ def quantize(data, min_range, max_range, *, out_type="int8"):
         scale = _range_scale(min_range, max_range)
         q = jnp.clip(jnp.rint(data * scale), -_INT8_MAX, _INT8_MAX)
         abs_max = _INT8_MAX / scale
-        return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+        return (q.astype(jnp.int8), -abs_max.reshape((1,)),
+            abs_max.reshape((1,)))
     if out_type == "uint8":
         lo = jnp.asarray(min_range, jnp.float32).reshape(())
         hi = jnp.asarray(max_range, jnp.float32).reshape(())
         scale = _UINT8_MAX / jnp.maximum(hi - lo, 1e-30)
         q = jnp.clip(jnp.rint((data - lo) * scale), 0.0, _UINT8_MAX)
-        return q.astype(jnp.uint8), lo, hi
+        return q.astype(jnp.uint8), lo.reshape((1,)), hi.reshape((1,))
     raise ValueError("quantize: out_type must be 'int8' or 'uint8', "
                      "got %r (reference quantize-inl.h)" % (out_type,))
 
@@ -83,11 +84,12 @@ def requantize(data, min_range, max_range, *, min_calib_range=None,
     if out_type == "uint8":
         scale = _UINT8_MAX / jnp.maximum(hi - lo, 1e-30)
         q = jnp.clip(jnp.rint((f32 - lo) * scale), 0.0, _UINT8_MAX)
-        return q.astype(jnp.uint8), lo.reshape(()), hi.reshape(())
+        return q.astype(jnp.uint8), lo.reshape((1,)), hi.reshape((1,))
     scale = _range_scale(lo, hi)
     q = jnp.clip(jnp.rint(f32 * scale), -_INT8_MAX, _INT8_MAX)
     abs_max = _INT8_MAX / scale
-    return q.astype(jnp.int8), -abs_max.reshape(()), abs_max.reshape(())
+    return (q.astype(jnp.int8), -abs_max.reshape((1,)),
+            abs_max.reshape((1,)))
 
 
 def _data_scale(data_dtype, min_d, max_d):
@@ -104,7 +106,7 @@ def _in_scales(data_dtype, min_d, max_d, min_w, max_w):
     sw = _range_scale(min_w, max_w)
     # int32 accumulator range corresponds to INT32_MAX / (sd*sw)
     abs_out = _INT32_MAX / (sd * sw)
-    return -abs_out.reshape(()), abs_out.reshape(())
+    return -abs_out.reshape((1,)), abs_out.reshape((1,))
 
 
 @register("_contrib_quantized_conv", aliases=("quantized_conv",),
@@ -190,11 +192,14 @@ def quantized_pooling(data, min_data, max_data, *, kernel, pool_type="max",
                   pool_type=pool_type, stride=stride, pad=pad,
                   global_pool=global_pool,
                   pooling_convention=pooling_convention)
-    return out.astype(data.dtype), min_data.reshape(()), max_data.reshape(())
+    return (out.astype(data.dtype),
+            jnp.asarray(min_data, jnp.float32).reshape((1,)),
+            jnp.asarray(max_data, jnp.float32).reshape((1,)))
 
 
 @register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
           num_outputs=3)
 def quantized_flatten(data, min_data, max_data):
-    return (data.reshape(data.shape[0], -1), min_data.reshape(()),
-            max_data.reshape(()))
+    return (data.reshape(data.shape[0], -1),
+            jnp.asarray(min_data, jnp.float32).reshape((1,)),
+            jnp.asarray(max_data, jnp.float32).reshape((1,)))

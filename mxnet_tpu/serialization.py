@@ -41,6 +41,12 @@ def _write_dense(f, arr):
     arr = _np.ascontiguousarray(arr)
     if str(arr.dtype) not in _FLAG_OF_DTYPE:
         arr = arr.astype("float32")
+    if arr.ndim == 0:
+        # the reference format has NO 0-d arrays: an ndim-0 record means
+        # an EMPTY placeholder NDArray and carries no context/dtype/
+        # payload (ndarray.cc NDArray::Load) — writing one here would
+        # desync the stream. Scalars save as shape (1,) like 1.x did.
+        arr = arr.reshape(1)
     f.write(struct.pack("<I", _ND_V2_MAGIC))
     f.write(struct.pack("<i", _STYPE_DEFAULT))
     _write_shape(f, arr.shape)

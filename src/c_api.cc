@@ -416,7 +416,14 @@ int KVListArgs(mx_uint num, const char **keys, NDArrayHandle *vals,
   PyObject *pk = PyList_New(num);
   PyObject *pv = PyList_New(num);
   for (mx_uint i = 0; i < num; ++i) {
-    PyList_SetItem(pk, i, PyUnicode_FromString(keys[i]));
+    PyObject *k = PyUnicode_FromString(keys[i]);
+    if (k == nullptr) {            // non-UTF8 key bytes
+      Py_DECREF(pk);
+      Py_DECREF(pv);
+      SetPyError("MXKVStore key");
+      return -1;
+    }
+    PyList_SetItem(pk, i, k);
     PyObject *o = static_cast<PyObject *>(vals[i]);
     Py_INCREF(o);
     PyList_SetItem(pv, i, o);
@@ -431,7 +438,7 @@ int KVCall(const char *fn, KVStoreHandle kv, mx_uint num, const char **keys,
   if (!EnsurePython()) return -1;
   GILGuard gil;
   PyObject *pk = nullptr, *pv = nullptr;
-  KVListArgs(num, keys, vals, &pk, &pv);
+  if (KVListArgs(num, keys, vals, &pk, &pv) != 0) return -1;
   PyObject *r = with_priority
       ? CallImpl(fn, Py_BuildValue("(ONNi)", kv, pk, pv, priority))
       : CallImpl(fn, Py_BuildValue("(ONN)", kv, pk, pv));

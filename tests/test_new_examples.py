@@ -116,3 +116,12 @@ def test_train_imagenet_nhwc_synthetic():
                        "--num-examples", "64", "--num-epochs", "2",
                        "--disp-batches", "2", timeout=600)
     assert "Train-accuracy" in out
+
+
+def test_quantization_example_runs():
+    """example/quantization/quantize_model.py end-to-end: train ->
+    quantize (auto) -> save/reload reference-layout checkpoint ->
+    accuracy delta <= 1% (reference example/quantization)."""
+    out = _run_example("example/quantization/quantize_model.py",
+                       "--calib-mode", "naive", timeout=500)
+    assert "quantize_model example OK" in out

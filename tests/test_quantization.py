@@ -12,10 +12,10 @@ def test_quantize_dequantize_roundtrip():
     q, lo, hi = nd.quantize(x, nd.array(np.float32(-3)),
                             nd.array(np.float32(5)))
     assert q.dtype == np.int8
-    assert float(lo.asnumpy()) == -float(hi.asnumpy())
+    assert lo.asnumpy().item() == -hi.asnumpy().item()
     back = nd.dequantize(q, lo, hi)
     np.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
-                               atol=float(hi.asnumpy()) / 127 + 1e-6)
+                               atol=hi.asnumpy().item() / 127 + 1e-6)
 
 
 def test_requantize_calibrated():
@@ -26,7 +26,7 @@ def test_requantize_calibrated():
                                 max_calib_range=1e-7)
     # 1000/2^31 = 4.7e-7 etc. all exceed the 1e-7 calib range -> clip
     assert set(np.abs(q.asnumpy()).ravel()) == {127}
-    np.testing.assert_allclose(float(qhi.asnumpy()), 1e-7, rtol=1e-5)
+    np.testing.assert_allclose(qhi.asnumpy().item(), 1e-7, rtol=1e-5)
 
 
 def test_quantized_fully_connected_matches_fp32():
@@ -136,7 +136,7 @@ def test_quantize_dequantize_uint8_roundtrip():
     q, qlo, qhi = nd.quantize(x, lo, hi, out_type="uint8")
     assert q.dtype == np.uint8
     # uint8 keeps the ASYMMETRIC range (reference stores imin/imax)
-    assert float(qlo.asnumpy()) == -1.0 and float(qhi.asnumpy()) == 3.0
+    assert qlo.asnumpy().item() == -1.0 and qhi.asnumpy().item() == 3.0
     back = nd.dequantize(q, qlo, qhi)
     step = 4.0 / 255
     assert np.abs(back.asnumpy() - x.asnumpy()).max() < step
@@ -156,7 +156,7 @@ def test_requantize_uint8():
     q, qlo, qhi = nd.requantize(data, lo, hi, min_calib_range=0.0,
                                 max_calib_range=1e-5, out_type="uint8")
     assert q.dtype == np.uint8
-    assert float(qlo.asnumpy()) == 0.0
+    assert qlo.asnumpy().item() == 0.0
 
 
 def test_quantized_conv_uint8_data_matches_fp32():
