@@ -54,6 +54,9 @@ def main():
     parser.add_argument("--kv-store", type=str, default="local")
     parser.add_argument("--buckets", type=str, default="6,8,10,12")
     parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--fused", action="store_true",
+                        help="use the fused sym.RNN op instead of the "
+                             "cell zoo's per-step unroll")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)-15s %(message)s")
@@ -68,19 +71,33 @@ def main():
     train = mx.rnn.BucketSentenceIter(encoded, args.batch_size,
                                       buckets=buckets, invalid_label=0)
 
+    # the reference example's construction: a stack of LSTMCells unrolled
+    # per bucket length (reference example/rnn/bucketing/lstm_bucketing.py);
+    # every bucket shares the cells' weights, and each unrolled graph
+    # compiles to its own cached XLA program
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix=f"lstm_l{i}_"))
+
     def sym_gen(seq_len):
         data = sym.Variable("data")
         label = sym.Variable("softmax_label")
         embed = sym.Embedding(data, input_dim=vocab_size,
                               output_dim=args.num_embed, name="embed")
-        # fused multi-layer LSTM over the bucket length (ops/rnn.py —
-        # one lax.scan; the cuDNN-RNN analog the reference's cells
-        # hand-unroll per bucket)
-        rnn_in = sym.transpose(embed, axes=(1, 0, 2))  # (T, N, C)
-        out = sym.RNN(rnn_in, mode="lstm", state_size=args.num_hidden,
-                      num_layers=args.num_layers, name="lstm")
-        out = sym.transpose(out, axes=(1, 0, 2))       # (N, T, C)
-        pred = sym.Reshape(out, shape=(-1, args.num_hidden))
+        if args.fused:
+            # fused multi-layer LSTM over the bucket length (ops/rnn.py —
+            # one lax.scan; the cuDNN-RNN analog)
+            rnn_in = sym.transpose(embed, axes=(1, 0, 2))  # (T, N, C)
+            out = sym.RNN(rnn_in, mode="lstm", state_size=args.num_hidden,
+                          num_layers=args.num_layers, name="lstm")
+            out = sym.transpose(out, axes=(1, 0, 2))       # (N, T, C)
+            pred = sym.Reshape(out, shape=(-1, args.num_hidden))
+        else:
+            stack.reset()
+            outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                      merge_outputs=True)
+            pred = sym.Reshape(outputs, shape=(-1, args.num_hidden))
         pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
         lab = sym.Reshape(label, shape=(-1,))
         pred = sym.SoftmaxOutput(pred, lab, use_ignore=True,

@@ -124,6 +124,75 @@ int MXExecutorOutputs(ExecutorHandle exec, int *num_outputs,
                       NDArrayHandle *outputs);
 
 /*
+ * Atom-level symbol composition — BUILD a graph from C, no JSON in hand
+ * (reference MXSymbolListAtomicSymbolCreators / MXSymbolCreateAtomicSymbol
+ * / MXSymbolCompose / MXSymbolCreateVariable, include/mxnet/c_api.h:1111).
+ * Creators are identified by name; MXSymbolCreateAtomicSymbol captures op
+ * attrs, MXSymbolCompose wires inputs (positional when keys==NULL).
+ */
+typedef void *AtomicSymbolCreator;
+
+/* Names of every registered operator. Pointers stay valid until the next
+ * call (process-global cache). */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     const char ***out_names);
+/* An un-composed op node with attrs; wire inputs with MXSymbolCompose. */
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+/* A named variable (argument) symbol. */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* Bind `args` as the node's inputs and give it `name`; keys==NULL means
+ * positional. After this the handle behaves like any bound Symbol. */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+
+/*
+ * Autograd — record imperative ops and differentiate from C (reference
+ * MXAutogradSetIsRecording / MXAutogradMarkVariables /
+ * MXAutogradBackwardEx, include/mxnet/c_api.h:963).
+ */
+
+/* Toggle recording/training; previous state lands in *prev. */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+/* Attach gradient buffers: grad_reqs per variable (0 null, 1 write,
+ * 2 add). */
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs, NDArrayHandle *grad_handles);
+/* Backprop from `output_handles` (ones as head grads when
+ * ograd_handles==NULL); fills the buffers given to MarkVariables. */
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int train_mode);
+/* The gradient buffer attached to `handle` (fresh handle, caller frees). */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/*
+ * Data iterators — feed batches from C (reference MXListDataIters /
+ * MXDataIterCreateIter / MXDataIterNext / MXDataIterGetData / GetLabel /
+ * GetPadNum).
+ */
+typedef void *DataIterHandle;
+typedef void *DataBatchHandle;
+
+int MXListDataIters(mx_uint *out_size, const char ***out_names);
+/* Instantiate by name with string kwargs (same value syntax as op attrs;
+ * NDArrayIter accepts data_gen_shape/label_gen_classes/seed to self-
+ * generate a learnable dataset for pure-C programs). */
+int MXDataIterCreateIter(const char *iter_name, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle it);
+/* *out = 1 and a fresh batch handle while data remains, else *out = 0. */
+int MXDataIterNext(DataIterHandle it, int *out, DataBatchHandle *out_batch);
+int MXDataIterBeforeFirst(DataIterHandle it);
+int MXDataIterGetData(DataBatchHandle batch, NDArrayHandle *out);
+int MXDataIterGetLabel(DataBatchHandle batch, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataBatchHandle batch, int *pad);
+int MXDataBatchFree(DataBatchHandle batch);
+
+/*
  * KVStore surface — parameter synchronization from C (reference
  * MXKVStoreCreate/Init/Push/Pull/SetOptimizer, include/mxnet/c_api.h
  * MXKVStore*). Types: "local"/"device"/"tpu" (in-process),

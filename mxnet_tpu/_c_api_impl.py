@@ -75,19 +75,19 @@ def symbol_from_file(fname):
 
 
 def symbol_list_arguments(sym):
-    return list(sym.list_arguments())
+    return list(symbol_resolve(sym).list_arguments())
 
 
 def symbol_list_auxiliary_states(sym):
-    return list(sym.list_auxiliary_states())
+    return list(symbol_resolve(sym).list_auxiliary_states())
 
 
 def symbol_list_outputs(sym):
-    return list(sym.list_outputs())
+    return list(symbol_resolve(sym).list_outputs())
 
 
 def symbol_tojson(sym):
-    return sym.tojson()
+    return symbol_resolve(sym).tojson()
 
 
 def executor_simple_bind(sym, keys, shapes, grad_req="write"):
@@ -96,7 +96,8 @@ def executor_simple_bind(sym, keys, shapes, grad_req="write"):
     (reference MXExecutorSimpleBind's 30-arg marshal collapses to this)."""
     from .context import cpu
     kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
-    return sym.simple_bind(ctx=cpu(), grad_req=grad_req, **kwargs)
+    return symbol_resolve(sym).simple_bind(ctx=cpu(), grad_req=grad_req,
+                                           **kwargs)
 
 
 def executor_arg_array(ex, name):
@@ -192,3 +193,176 @@ def kvstore_num_workers(kv):
 def kvstore_barrier(kv):
     kv.barrier()
     return None
+
+
+# ----------------------------------------------------------------------
+# atom-level symbol composition (reference c_api.h:1111
+# MXSymbolListAtomicSymbolCreators / MXSymbolCreateAtomicSymbol /
+# MXSymbolCompose / MXSymbolCreateVariable)
+# ----------------------------------------------------------------------
+def list_atomic_symbol_creators():
+    """Creator handles are the op names themselves (our registry is
+    name-keyed; the reference's AtomicSymbolCreator pointers are an
+    artifact of its C++ op registry)."""
+    from .ops import registry as _reg
+    return sorted(_reg.list_ops())
+
+
+def create_atomic_symbol(op_name, keys, vals):
+    """An un-composed op node: record op + attrs, inputs arrive at
+    MXSymbolCompose time (reference two-phase Create/Compose protocol)."""
+    from .ops import registry as _reg
+    _reg.get_op(op_name)  # raise early on unknown op
+    return {"op": op_name, "attrs": dict(zip(list(keys), list(vals))),
+            "composed": None}
+
+
+def create_variable(name):
+    from . import symbol as _sym
+    return _sym.Variable(name)
+
+
+def symbol_compose(atom, name, keys, args):
+    """Bind inputs to an atomic node IN PLACE (the reference mutates the
+    handle); positional when ``keys`` is empty, else keyword."""
+    from . import symbol as _sym
+    op = getattr(_sym, atom["op"])
+    attrs = dict(atom["attrs"])
+    if name:
+        attrs["name"] = name
+    inputs = [a["composed"] if isinstance(a, dict) else a for a in args]
+    if any(i is None for i in inputs):
+        raise ValueError("MXSymbolCompose: an input atom was never composed")
+    if keys:
+        attrs.update(zip(list(keys), inputs))
+        atom["composed"] = op(**attrs)
+    else:
+        atom["composed"] = op(*inputs, **attrs)
+    return None
+
+
+def symbol_resolve(handle):
+    """The Symbol behind a handle (atoms must be composed first)."""
+    if isinstance(handle, dict):
+        if handle["composed"] is None:
+            raise ValueError("atomic symbol used before MXSymbolCompose")
+        return handle["composed"]
+    return handle
+
+
+# ----------------------------------------------------------------------
+# autograd (reference c_api.h:963 MXAutogradMarkVariables /
+# MXAutogradBackwardEx / MXAutogradSetIsRecording|Training)
+# ----------------------------------------------------------------------
+def autograd_set_recording(flag):
+    from . import autograd as _ag
+    prev = _ag.is_recording()
+    _ag._state.recording = bool(flag)
+    return int(prev)
+
+
+def autograd_set_training(flag):
+    from . import autograd as _ag
+    prev = _ag.is_training()
+    _ag._state.training = bool(flag)
+    return int(prev)
+
+
+def autograd_mark_variables(variables, grad_reqs, gradients):
+    from . import autograd as _ag
+    reqs = [{0: "null", 1: "write", 2: "add"}.get(int(r), "write")
+            for r in grad_reqs]
+    _ag.mark_variables(list(variables), list(gradients), reqs)
+    return None
+
+
+def autograd_backward(outputs, out_grads, retain_graph, train_mode):
+    from . import autograd as _ag
+    heads = list(outputs)
+    ograds = None if not out_grads else list(out_grads)
+    _ag.backward(heads, ograds, retain_graph=bool(retain_graph),
+                 train_mode=bool(train_mode))
+    return None
+
+
+def ndarray_get_grad(nd):
+    grad = getattr(nd, "grad", None)
+    if grad is None:
+        raise ValueError("NDArray has no gradient buffer; call "
+                         "MXAutogradMarkVariables first")
+    return grad
+
+
+# ----------------------------------------------------------------------
+# data iterators (reference MXListDataIters / MXDataIterCreateIter /
+# Next / GetData / GetLabel / GetPadNum)
+# ----------------------------------------------------------------------
+_DATA_ITERS = ["MNISTIter", "ImageRecordIter", "NDArrayIter", "CSVIter"]
+
+
+def list_data_iters():
+    return list(_DATA_ITERS)
+
+
+def create_data_iter(iter_name, keys, vals):
+    """Instantiate a DataIter from string kwargs (the reference's
+    creator-handle + param-string protocol)."""
+    from . import io as _io
+    import ast
+    if iter_name not in _DATA_ITERS:
+        raise ValueError(f"unknown data iter {iter_name}")
+    kwargs = {}
+    for k, v in zip(list(keys), list(vals)):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    if iter_name == "NDArrayIter":
+        # C callers hand data/label via synthetic_* sizes or file paths;
+        # support shape-spec strings "(N, C)" filled from a seeded rng so
+        # a pure-C program can drive training without numpy
+        data_shape = kwargs.pop("data_gen_shape", None)
+        label_classes = kwargs.pop("label_gen_classes", None)
+        if data_shape is not None:
+            rng = _np.random.RandomState(int(kwargs.pop("seed", 0)))
+            data = rng.uniform(-1, 1, data_shape).astype(_np.float32)
+            n = data_shape[0]
+            # learnable rule so C demos can assert convergence: the label
+            # quantile-bins the first feature-half's sum minus the
+            # second's into `label_gen_classes` classes (2 -> the simple
+            # "first half outsums second" rule)
+            n_cls = int(label_classes or 2)
+            flat = data.reshape(n, -1)
+            half = flat.shape[1] // 2
+            margin = flat[:, :half].sum(1) - flat[:, half:].sum(1)
+            edges = _np.quantile(margin, _np.linspace(0, 1, n_cls + 1)[1:-1])
+            label = _np.digitize(margin, edges).astype(_np.float32)
+            kwargs["data"] = data
+            kwargs["label"] = label
+        return _io.NDArrayIter(**kwargs)
+    return getattr(_io, iter_name)(**kwargs)
+
+
+def data_iter_next(it):
+    try:
+        batch = next(it)
+    except StopIteration:
+        return None
+    return batch
+
+
+def data_iter_reset(it):
+    it.reset()
+    return None
+
+
+def data_iter_get_data(batch):
+    return batch.data[0]
+
+
+def data_iter_get_label(batch):
+    return batch.label[0]
+
+
+def data_iter_get_pad(batch):
+    return int(batch.pad or 0)

@@ -1,99 +1,138 @@
-"""Training callbacks (reference parity: python/mxnet/callback.py)."""
+"""Training callbacks.
+
+Behavioral parity with the reference's ``python/mxnet/callback.py`` (same
+constructor signatures, same log-line shapes so ``parse_log.py`` works), but
+re-derived: throughput is computed by a small monotonic-clock ``_RateMeter``
+instead of inline tic/count bookkeeping, and log formatting is centralised.
+Batch callbacks receive the ``BatchEndParam`` namedtuple emitted by
+``module.base_module``; epoch callbacks receive ``(epoch, symbol, arg, aux)``.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "module_checkpoint",
            "log_train_metric", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint at epoch end (reference callback.py:27)."""
-    period = int(max(1, period))
+class _RateMeter:
+    """Samples/sec over a sliding window of batch-end events.
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-    return _callback
+    ``tick(count)`` returns a rate once ``frequent`` batches have elapsed
+    since the last emission, else None.  Detects epoch restarts (count going
+    backwards) and re-arms.
+    """
+
+    def __init__(self, unit_per_tick: int, frequent: int):
+        self.unit = unit_per_tick
+        self.frequent = frequent
+        self._mark: float | None = None
+        self._mark_count = 0
+
+    def tick(self, count: int) -> float | None:
+        now = time.monotonic()
+        if self._mark is None or count < self._mark_count:
+            self._mark, self._mark_count = now, count
+            return None
+        if count - self._mark_count < self.frequent or count % self.frequent:
+            return None
+        elapsed = max(now - self._mark, 1e-9)
+        rate = (count - self._mark_count) * self.unit / elapsed
+        self._mark, self._mark_count = now, count
+        return rate
 
 
-def do_checkpoint(prefix, period=1):
-    """Checkpoint params every `period` epochs (reference callback.py:55)."""
-    from .model import save_checkpoint
-    period = int(max(1, period))
-
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-    return _callback
-
-
-def log_train_metric(period, auto_reset=False):
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
-    return _callback
+def _metric_pairs(metric) -> list[tuple[str, float]]:
+    return [] if metric is None else list(metric.get_name_value())
 
 
 class Speedometer:
-    """samples/sec logging (reference callback.py:130)."""
+    """Log throughput (and current train metrics) every ``frequent`` batches.
+
+    Log-line format matches the reference so log-parsing tools keep working:
+    ``Epoch[e] Batch [n]\\tSpeed: r samples/sec\\tname=value...``
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._meter = _RateMeter(batch_size, frequent)
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        rate = self._meter.tick(param.nbatch)
+        if rate is None:
+            return
+        pairs = _metric_pairs(param.eval_metric)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            tail = "".join(f"\t{n}={v:f}" for n, v in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, param.nbatch, rate, tail)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, rate)
 
 
 class ProgressBar:
+    """Render ``[===---] pct%`` for the current epoch at each batch end."""
+
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.bar_len = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        n_fill = round(self.bar_len * frac)
+        bar = "=" * n_fill + "-" * (self.bar_len - n_fill)
+        logging.info("[%s] %s%s\r", bar, -(-int(frac * 1000) // 10), "%")
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving ``prefix-symbol.json`` + ``prefix-NNNN.params``
+    every ``period`` epochs via :func:`mxnet_tpu.model.save_checkpoint`."""
+    from .model import save_checkpoint
+    stride = max(int(period), 1)
+
+    def _on_epoch_end(epoch, sym, arg, aux):
+        done = epoch + 1
+        if done % stride == 0:
+            save_checkpoint(prefix, done, sym, arg, aux)
+    return _on_epoch_end
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback delegating to ``mod.save_checkpoint`` (optionally
+    with optimizer state) every ``period`` epochs."""
+    stride = max(int(period), 1)
+
+    def _on_epoch_end(epoch, sym=None, arg=None, aux=None):
+        done = epoch + 1
+        if done % stride == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
+    return _on_epoch_end
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging current train metrics every ``period``
+    batches (``Iter[e] Batch[n] Train-name=value``)."""
+
+    def _on_batch_end(param):
+        if param.nbatch % period:
+            return
+        for name, value in _metric_pairs(param.eval_metric):
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
+    return _on_batch_end
 
 
 class LogValidationMetricsCallback:
+    """Epoch-end eval callback: ``Epoch[e] Validation-name=value`` lines."""
+
     def __call__(self, param):
-        if param.eval_metric is None:
-            return
-        for name, value in param.eval_metric.get_name_value():
+        for name, value in _metric_pairs(param.eval_metric):
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -52,25 +52,33 @@ def compute_gradient(outputs):
     return backward(outputs)
 
 
+def _select_args(args, argnum):
+    """Pick the differentiated subset of positional args (all by default)
+    and type-check them."""
+    if argnum is None:
+        chosen = list(args)
+    else:
+        indices = argnum if isinstance(argnum, list) else [argnum]
+        chosen = [args[i] for i in indices]
+    for x in chosen:
+        if not isinstance(x, NDArray):
+            raise TypeError("type of autograd input should NDArray.")
+    return chosen
+
+
 def grad_and_loss(func, argnum=None):
     """Decorate ``func`` to return (arg_gradients, loss)
     (ref contrib/autograd.py:163)."""
 
     @functools.wraps(func)
     def wrapped(*args):
-        variables = list(args)
-        if argnum is not None:
-            argnums = argnum if isinstance(argnum, list) else [argnum]
-            variables = [args[i] for i in argnums]
-        for x in variables:
-            if not isinstance(x, NDArray):
-                raise TypeError("type of autograd input should NDArray.")
+        variables = _select_args(args, argnum)
         grads = [zeros_like(x) for x in variables]
         mark_variables(variables, grads)
         with train_section():
             outputs = func(*args)
-        compute_gradient([outputs] if isinstance(outputs, NDArray)
-                         else outputs)
+        heads = [outputs] if isinstance(outputs, NDArray) else outputs
+        compute_gradient(heads)
         return grads, outputs
 
     return wrapped
@@ -79,10 +87,11 @@ def grad_and_loss(func, argnum=None):
 def grad(func, argnum=None):
     """Decorate ``func`` to return only the argument gradients
     (ref contrib/autograd.py:195)."""
-    grad_with_loss_func = grad_and_loss(func, argnum)
+    with_loss = grad_and_loss(func, argnum)
 
-    @functools.wraps(grad_with_loss_func)
+    @functools.wraps(with_loss)
     def wrapped(*args):
-        return grad_with_loss_func(*args)[0]
+        gradients, _ = with_loss(*args)
+        return gradients
 
     return wrapped

@@ -1,7 +1,5 @@
-"""Text helpers.
-
-Reference parity: python/mxnet/contrib/text/utils.py:28 (count_tokens_from_str).
-"""
+"""Text corpus helpers (behavioral parity:
+python/mxnet/contrib/text/utils.py:28, count_tokens_from_str)."""
 from __future__ import annotations
 
 import collections
@@ -12,14 +10,14 @@ __all__ = ["count_tokens_from_str"]
 
 def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
-    """Count tokens in a string; delimiters are regular expressions
-    (ref utils.py:28-84). Returns ``counter_to_update`` updated in place,
-    or a fresh Counter."""
-    source_str = re.split(token_delim + "|" + seq_delim, source_str)
-    tokens = [t for t in source_str if t]
-    if to_lower:
-        tokens = [t.lower() for t in tokens]
-    counter = (counter_to_update if counter_to_update is not None
-               else collections.Counter())
-    counter.update(tokens)
-    return counter
+    """Tokenise ``source_str`` on the union of the two delimiter regexes and
+    tally token frequencies.  Updates and returns ``counter_to_update`` when
+    given, else returns a fresh ``Counter``."""
+    if counter_to_update is None:
+        counter_to_update = collections.Counter()
+    splitter = re.compile(f"(?:{token_delim})|(?:{seq_delim})")
+    for piece in splitter.split(source_str):
+        if not piece:
+            continue
+        counter_to_update[piece.lower() if to_lower else piece] += 1
+    return counter_to_update

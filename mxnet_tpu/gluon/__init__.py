@@ -1,18 +1,16 @@
 """Gluon: imperative / hybridizable neural-network API.
 
-Reference parity: python/mxnet/gluon/__init__.py (Block, HybridBlock,
+API parity: python/mxnet/gluon/__init__.py (Block, HybridBlock,
 SymbolBlock, Parameter, ParameterDict, Trainer, nn, rnn, loss, data,
 model_zoo). TPU-native: hybridize() compiles the block to one XLA
 computation; Trainer's allreduce rides kvstore → ICI/DCN collectives.
 """
-from .parameter import (Parameter, Constant, ParameterDict,
-                        DeferredInitializationError)
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
-from . import nn
-from . import loss
-from . import rnn
-from . import data
-from . import model_zoo
-from . import utils
-from . import contrib
+from . import contrib, data, loss, model_zoo, nn, rnn, utils
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "ParameterDict", "DeferredInitializationError", "Trainer",
+           "contrib", "data", "loss", "model_zoo", "nn", "rnn", "utils"]

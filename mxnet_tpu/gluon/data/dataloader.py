@@ -253,9 +253,23 @@ class DataLoader:
                     sent += 1
                 except StopIteration:
                     break
+            import queue as _queue
             while recvd < sent:
                 while recvd not in buffered:
-                    seq, desc, err = res_q.get()
+                    try:
+                        seq, desc, err = res_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        # a worker that died without enqueueing an error
+                        # (segfault, OOM-kill) would otherwise hang this
+                        # loop forever — poll liveness while waiting
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker process(es) died "
+                                f"unexpectedly (exitcodes "
+                                f"{[w.exitcode for w in dead]}); "
+                                f"batch {recvd} never arrived")
+                        continue
                     if err is not None:
                         raise RuntimeError("DataLoader worker failed: %s"
                                            % err)

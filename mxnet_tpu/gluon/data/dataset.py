@@ -1,4 +1,6 @@
-"""Datasets (reference python/mxnet/gluon/data/dataset.py)."""
+"""Dataset abstractions for gluon data pipelines (behavioral parity:
+python/mxnet/gluon/data/dataset.py — Dataset/SimpleDataset/ArrayDataset/
+RecordFileDataset with the same transform semantics)."""
 from __future__ import annotations
 
 import os
@@ -7,8 +9,7 @@ __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
 
 
 class Dataset:
-    """Abstract dataset: __getitem__ + __len__
-    (reference data/dataset.py:29)."""
+    """Random-access collection of samples: ``__getitem__`` + ``__len__``."""
 
     def __getitem__(self, idx):
         raise NotImplementedError
@@ -17,19 +18,21 @@ class Dataset:
         raise NotImplementedError
 
     def transform(self, fn, lazy=True):
-        """Return a dataset with ``fn(x)`` applied to each sample."""
-        trans = _LazyTransformDataset(self, fn)
+        """Map ``fn`` over samples.  Lazy by default (applied per access);
+        ``lazy=False`` materialises the whole mapped dataset now."""
+        mapped = _MappedDataset(self, fn)
         if lazy:
-            return trans
-        return SimpleDataset([trans[i] for i in range(len(trans))])
+            return mapped
+        return SimpleDataset([mapped[i] for i in range(len(mapped))])
 
     def transform_first(self, fn, lazy=True):
-        """Apply ``fn`` to only the first element of each sample."""
-        return self.transform(_TransformFirstClosure(fn), lazy)
+        """Map ``fn`` over only the first field of each sample (the usual
+        image-not-label case)."""
+        return self.transform(_FirstFieldTransform(fn), lazy)
 
 
 class SimpleDataset(Dataset):
-    """Wrap any list-like into a Dataset (reference data/dataset.py:75)."""
+    """View any indexable sequence as a Dataset."""
 
     def __init__(self, data):
         self._data = data
@@ -41,68 +44,69 @@ class SimpleDataset(Dataset):
         return self._data[idx]
 
 
-class _LazyTransformDataset(Dataset):
-    def __init__(self, data, fn):
-        self._data = data
+class _MappedDataset(Dataset):
+    """Lazy element-wise transform; tuple samples are splatted into ``fn``."""
+
+    def __init__(self, source, fn):
+        self._source = source
         self._fn = fn
 
     def __len__(self):
-        return len(self._data)
+        return len(self._source)
 
     def __getitem__(self, idx):
-        item = self._data[idx]
-        if isinstance(item, tuple):
-            return self._fn(*item)
-        return self._fn(item)
+        sample = self._source[idx]
+        return self._fn(*sample) if isinstance(sample, tuple) \
+            else self._fn(sample)
 
 
-class _TransformFirstClosure:
+class _FirstFieldTransform:
+    """Picklable closure: apply ``fn`` to field 0, pass the rest through."""
+
     def __init__(self, fn):
         self._fn = fn
 
-    def __call__(self, x, *args):
-        if args:
-            return (self._fn(x),) + args
-        return self._fn(x)
+    def __call__(self, first, *rest):
+        return (self._fn(first), *rest) if rest else self._fn(first)
 
 
 class ArrayDataset(Dataset):
-    """Zip of array-likes (reference data/dataset.py:95)."""
+    """Zip one or more equal-length array-likes; single-array datasets yield
+    bare elements, multi-array datasets yield tuples."""
 
-    def __init__(self, *args):
-        assert len(args) > 0, "Needs at least 1 arrays"
-        self._length = len(args[0])
-        self._data = []
-        for i, data in enumerate(args):
-            assert len(data) == self._length, \
-                "All arrays must have the same length; array[0] has length " \
-                "%d while array[%d] has %d." % (self._length, i, len(data))
-            from ...ndarray import NDArray
-            import numpy as np
-            if isinstance(data, NDArray) and data.ndim == 1:
-                data = data.asnumpy()
-            self._data.append(data)
-
-    def __getitem__(self, idx):
-        if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(data[idx] for data in self._data)
+    def __init__(self, *arrays):
+        if not arrays:
+            raise ValueError("Needs at least 1 arrays")
+        from ...ndarray import NDArray
+        self._length = len(arrays[0])
+        self._fields = []
+        for i, arr in enumerate(arrays):
+            if len(arr) != self._length:
+                raise ValueError(
+                    f"All arrays must have the same length; array[0] has "
+                    f"length {self._length} while array[{i}] has {len(arr)}.")
+            if isinstance(arr, NDArray) and arr.ndim == 1:
+                arr = arr.asnumpy()
+            self._fields.append(arr)
 
     def __len__(self):
         return self._length
 
+    def __getitem__(self, idx):
+        row = tuple(field[idx] for field in self._fields)
+        return row[0] if len(row) == 1 else row
+
 
 class RecordFileDataset(Dataset):
-    """Dataset over a RecordIO (.rec) file
-    (reference data/dataset.py:125); requires the .idx file."""
+    """Raw records from a RecordIO pair (``file.rec`` + ``file.idx``)."""
 
     def __init__(self, filename):
         from ...recordio import MXIndexedRecordIO
-        idx_file = os.path.splitext(filename)[0] + ".idx"
-        self._record = MXIndexedRecordIO(idx_file, filename, "r")
-
-    def __getitem__(self, idx):
-        return self._record.read_idx(self._record.keys[idx])
+        index_path = os.path.splitext(filename)[0] + ".idx"
+        self._record = MXIndexedRecordIO(index_path, filename, "r")
 
     def __len__(self):
         return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

@@ -1,12 +1,18 @@
-"""Samplers (reference python/mxnet/gluon/data/sampler.py)."""
+"""Index samplers for DataLoader (behavioral parity:
+python/mxnet/gluon/data/sampler.py — same classes, same ``last_batch``
+policies)."""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
+_LAST_BATCH_POLICIES = ("keep", "discard", "rollover")
+
 
 class Sampler:
+    """Iterable over sample indices with a known length."""
+
     def __len__(self):
         raise NotImplementedError
 
@@ -14,68 +20,66 @@ class Sampler:
         raise NotImplementedError
 
 
-class SequentialSampler(Sampler):
+class _RangeSampler(Sampler):
     def __init__(self, length):
         self._length = length
-
-    def __iter__(self):
-        return iter(range(self._length))
 
     def __len__(self):
         return self._length
 
 
-class RandomSampler(Sampler):
-    def __init__(self, length):
-        self._length = length
+class SequentialSampler(_RangeSampler):
+    """Indices 0..length-1 in order."""
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices.tolist())
+        yield from range(self._length)
 
-    def __len__(self):
-        return self._length
+
+class RandomSampler(_RangeSampler):
+    """A fresh uniform permutation of 0..length-1 each epoch."""
+
+    def __iter__(self):
+        yield from np.random.permutation(self._length).tolist()
 
 
 class BatchSampler(Sampler):
-    """Wrap a sampler into batches; last_batch in
-    {'keep', 'discard', 'rollover'} (reference data/sampler.py:89)."""
+    """Group an index sampler into batch-sized lists.
+
+    ``last_batch`` controls the final partial batch: ``'keep'`` yields it
+    short, ``'discard'`` drops it, ``'rollover'`` saves it to lead the next
+    epoch.
+    """
 
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in _LAST_BATCH_POLICIES:
+            raise ValueError(
+                f"last_batch must be one of 'keep', 'discard', or "
+                f"'rollover', but got {last_batch}")
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
         self._prev = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
-                yield batch
-                batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    "'rollover', but got %s" % self._last_batch)
+        pending = self._prev
+        self._prev = []
+        for idx in self._sampler:
+            pending.append(idx)
+            if len(pending) == self._batch_size:
+                yield pending
+                pending = []
+        if not pending:
+            return
+        if self._last_batch == "keep":
+            yield pending
+        elif self._last_batch == "rollover":
+            self._prev = pending
+        # 'discard': drop the remainder
 
     def __len__(self):
+        n, b = len(self._sampler), self._batch_size
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) \
-                // self._batch_size
+            return -(-n // b)
         if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
-        if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) \
-                // self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+            return n // b
+        return (n + len(self._prev)) // b  # rollover

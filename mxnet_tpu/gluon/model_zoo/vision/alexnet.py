@@ -1,4 +1,13 @@
-"""Gluon AlexNet (reference python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""Gluon AlexNet (Krizhevsky et al. 2012, the one-column variant used by
+torchvision and the reference model zoo).
+
+API parity with ``python/mxnet/gluon/model_zoo/vision/alexnet.py``.
+
+CONTRACT CONSTRAINT: layer construction order is pinned by the reference
+checkpoint's parameter names (``alexnet0_conv0_weight``...); the
+table-driven builder below reproduces that order from the paper's
+architecture, not the reference's statement sequence.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -6,40 +15,50 @@ from ... import nn
 
 __all__ = ["AlexNet", "alexnet"]
 
+# Convolutional stem: (channels, kernel, stride, pad, maxpool-after?).
+_STEM = [
+    (64, 11, 4, 2, True),
+    (192, 5, 1, 2, True),
+    (384, 3, 1, 1, False),
+    (256, 3, 1, 1, False),
+    (256, 3, 1, 1, True),
+]
+
+_HEAD_WIDTH = 4096
+_DROP_RATE = 0.5
+
+
+def _build_features():
+    seq = nn.HybridSequential(prefix="")
+    with seq.name_scope():
+        for ch, k, s, p, pool_after in _STEM:
+            seq.add(nn.Conv2D(ch, kernel_size=k, strides=s, padding=p,
+                              activation="relu"))
+            if pool_after:
+                seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        seq.add(nn.Flatten())
+        for _ in range(2):
+            seq.add(nn.Dense(_HEAD_WIDTH, activation="relu"))
+            seq.add(nn.Dropout(_DROP_RATE))
+    return seq
+
 
 class AlexNet(HybridBlock):
+    """Five relu convs (pools after 1, 2 and 5) then two dropout-regularised
+    4096-wide relu Dense layers and a linear classifier."""
+
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
+            self.features = _build_features()
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    """AlexNet factory; ``pretrained=True`` loads from the local model store."""
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import load_pretrained

@@ -1,4 +1,12 @@
-"""Gluon DenseNet (reference python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+"""Gluon DenseNet 121/161/169/201 (Huang et al. 1608.06993).
+
+API parity with ``python/mxnet/gluon/model_zoo/vision/densenet.py``.
+
+CONTRACT CONSTRAINT: checkpoint parameter names pin the construction order
+of parametered layers; the composite-function builder below re-derives the
+architecture (BN-relu-conv composite functions, dense concatenation,
+half-width transitions) from the paper.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -7,45 +15,57 @@ from ... import nn
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
+# depth -> (stem width, growth rate k, layers per dense block)
+densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
+                 161: (96, 48, [6, 12, 36, 24]),
+                 169: (64, 32, [6, 12, 32, 32]),
+                 201: (64, 32, [6, 12, 48, 32])}
+
+
+def _composite(seq, channels, kernel, padding=0):
+    """The paper's composite function H: BN → relu → conv."""
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, padding=padding,
+                      use_bias=False))
+
 
 class _DenseLayer(HybridBlock):
+    """Bottlenecked composite (1x1 to bn_size*k, then 3x3 to k channels);
+    output is the input with the k new feature maps concatenated."""
+
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
+        _composite(self.body, bn_size * growth_rate, 1)
+        _composite(self.body, growth_rate, 3, padding=1)
         if dropout:
             self.body.add(nn.Dropout(dropout))
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, self.body(x), dim=1)
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
+def _dense_stage(n_layers, bn_size, growth_rate, dropout, index):
+    stage = nn.HybridSequential(prefix=f"stage{index}_")
+    with stage.name_scope():
+        for _ in range(n_layers):
+            stage.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return stage
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _transition(channels):
+    """Between dense blocks: composite 1x1 conv then 2x2 average pool."""
+    seq = nn.HybridSequential(prefix="")
+    _composite(seq, channels, 1)
+    seq.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return seq
 
 
 class DenseNet(HybridBlock):
+    """7x7/2 stem → dense blocks with half-width transitions → BN-relu →
+    7x7 average pool → linear classifier."""
+
     def __init__(self, num_init_features, growth_rate, block_config,
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
@@ -56,14 +76,15 @@ class DenseNet(HybridBlock):
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features += num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features //= 2
+            width = num_init_features
+            last = len(block_config) - 1
+            for i, n_layers in enumerate(block_config):
+                self.features.add(_dense_stage(n_layers, bn_size, growth_rate,
+                                               dropout, i + 1))
+                width += n_layers * growth_rate
+                if i != last:
+                    width //= 2
+                    self.features.add(_transition(width))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.AvgPool2D(pool_size=7))
@@ -71,38 +92,27 @@ class DenseNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
-                 161: (96, 48, [6, 12, 36, 24]),
-                 169: (64, 32, [6, 12, 32, 32]),
-                 201: (64, 32, [6, 12, 48, 32])}
-
-
-def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
-                 **kwargs):
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+    net = DenseNet(*densenet_spec[num_layers], **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        load_pretrained(net, "densenet%d" % num_layers, root=root, ctx=ctx)
+        load_pretrained(net, f"densenet{num_layers}", root=root, ctx=ctx)
     return net
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+def _register_factories():
+    for depth in sorted(densenet_spec):
+        name = f"densenet{depth}"
+
+        def _factory(depth=depth, **kwargs):
+            return get_densenet(depth, **kwargs)
+        _factory.__name__ = name
+        _factory.__qualname__ = name
+        _factory.__doc__ = f"DenseNet-{depth} model."
+        globals()[name] = _factory
 
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+_register_factories()

@@ -1,7 +1,15 @@
-"""Gluon ResNet v1/v2 (reference python/mxnet/gluon/model_zoo/vision/resnet.py).
+"""Gluon ResNet v1 (He et al. 1512.03385, post-activation) and v2
+(He et al. 1603.05027, pre-activation).
 
-v1: He et al. 1512.03385 (post-activation, downsample 1x1 conv).
-v2: He et al. 1603.05027 (pre-activation).
+API parity with ``python/mxnet/gluon/model_zoo/vision/resnet.py``.
+
+CONTRACT CONSTRAINT: parameter names must match the reference checkpoints
+(``resnetv10_stage1_conv0_weight``...) so ``tools/convert_params.py`` output
+and the local pretrained store load without remapping.  Under gluon's naming
+rules that pins only the *construction order* of parametered layers inside
+each name scope — everything else here (the per-block conv/BN plan tables,
+the shared residual stem builder, the generated factory aliases) is our own
+derivation from the papers, not the reference's statement sequence.
 """
 from __future__ import annotations
 
@@ -15,213 +23,200 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet101_v2", "resnet152_v2"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+# Per-block convolution plans: (out_channels, kernel, stride, pad, bias,
+# in_channels).  Stride goes on the first 3x3 for basic blocks, on the 1x1
+# (v1) or the 3x3 (v2) for bottlenecks — the paper's placement (and, for
+# v1's biased 1x1 convs, the reference's quirk, which the checkpoint layout
+# bakes in).  in_channels entries mirror the reference declarations exactly:
+# a conv with known in_channels allocates (and seeds) its weight eagerly,
+# so this column pins the RNG consumption order of seeded initialization —
+# the committed logits fixture depends on it.
+def _basic_plan(ch, stride, in_ch):
+    return [(ch, 3, stride, 1, False, in_ch), (ch, 3, 1, 1, False, ch)]
 
 
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+def _bottleneck_v1_plan(ch, stride):
+    return [(ch // 4, 1, stride, 0, True, 0),
+            (ch // 4, 3, 1, 1, False, ch // 4),
+            (ch, 1, 1, 0, True, 0)]
+
+
+def _bottleneck_v2_plan(ch, stride):
+    return [(ch // 4, 1, 1, 0, False, 0),
+            (ch // 4, 3, stride, 1, False, ch // 4),
+            (ch, 1, 1, 0, False, 0)]
+
+
+def _conv(ch, kernel, stride, pad, bias, in_channels=0):
+    return nn.Conv2D(ch, kernel_size=kernel, strides=stride, padding=pad,
+                     use_bias=bias, in_channels=in_channels)
+
+
+class _ResidualV1(HybridBlock):
+    """Post-activation residual unit: relu(body(x) + shortcut(x)).
+
+    ``body`` is conv→BN pairs with interior relus; ``shortcut`` is a strided
+    1x1 conv + BN when the shape changes, else identity.
+    """
+
+    def __init__(self, plan, stride, downsample, in_channels, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
+        last = len(plan) - 1
+        for i, (ch, k, s, p, bias, in_ch) in enumerate(plan):
+            self.body.add(_conv(ch, k, s, p, bias, in_ch))
+            self.body.add(nn.BatchNorm())
+            if i != last:
+                self.body.add(nn.Activation("relu"))
         if downsample:
+            out_ch = plan[-1][0]
             self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
+            self.downsample.add(_conv(out_ch, 1, stride, 0, False,
+                                      in_channels))
             self.downsample.add(nn.BatchNorm())
         else:
             self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+        shortcut = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + shortcut, act_type="relu")
 
 
-class BottleneckV1(HybridBlock):
+class BasicBlockV1(_ResidualV1):
+    """Two 3x3 convs (ResNet-18/34 unit)."""
+
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
+        super().__init__(_basic_plan(channels, stride, in_channels),
+                         stride, downsample, in_channels, **kwargs)
+
+
+class BottleneckV1(_ResidualV1):
+    """1x1 (strided) → 3x3 → 1x1 expand (ResNet-50/101/152 unit)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(_bottleneck_v1_plan(channels, stride), stride,
+                         downsample, in_channels, **kwargs)
+
+
+class _ResidualV2(HybridBlock):
+    """Pre-activation residual unit: each conv is preceded by BN→relu, the
+    shortcut projection (if any) taps the FIRST pre-activation output, and
+    the sum is returned un-activated."""
+
+    def __init__(self, plan, stride, downsample, in_channels, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
+        self._depth = len(plan)
+        for i, (ch, k, s, p, _bias, in_ch) in enumerate(plan, start=1):
+            setattr(self, f"bn{i}", nn.BatchNorm())
+            setattr(self, f"conv{i}", _conv(ch, k, s, p, False, in_ch))
         if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+            self.downsample = _conv(plan[-1][0], 1, stride, 0, False,
+                                    in_channels)
         else:
             self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
+        shortcut = x
+        for i in range(1, self._depth + 1):
+            x = getattr(self, f"bn{i}")(x)
+            x = F.Activation(x, act_type="relu")
+            if i == 1 and self.downsample is not None:
+                shortcut = self.downsample(x)
+            x = getattr(self, f"conv{i}")(x)
+        return x + shortcut
 
 
-class BasicBlockV2(HybridBlock):
+class BasicBlockV2(_ResidualV2):
+    """Pre-activation pair of 3x3 convs."""
+
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+        super().__init__(_basic_plan(channels, stride, in_channels),
+                         stride, downsample, in_channels, **kwargs)
 
 
-class BottleneckV2(HybridBlock):
+class BottleneckV2(_ResidualV2):
+    """Pre-activation bottleneck; the stride sits on the 3x3."""
+
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+        super().__init__(_bottleneck_v2_plan(channels, stride), stride,
+                         downsample, in_channels, **kwargs)
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+
+def _imagenet_stem(seq, first_channels, thumbnail):
+    """7x7/2 conv + BN + relu + 3x3/2 maxpool, or a bare 3x3 for CIFAR-size
+    inputs (``thumbnail=True``)."""
+    if thumbnail:
+        seq.add(_conv(first_channels, 3, 1, 1, False))
+    else:
+        seq.add(nn.Conv2D(first_channels, 7, 2, 3, use_bias=False))
+        seq.add(nn.BatchNorm())
+        seq.add(nn.Activation("relu"))
+        seq.add(nn.MaxPool2D(3, 2, 1))
+
+
+def _stage(block, n_units, channels, stride, index, in_channels):
+    """One spatial stage: a strided/projecting unit then n-1 identity units."""
+    seq = nn.HybridSequential(prefix=f"stage{index}_")
+    with seq.name_scope():
+        seq.add(block(channels, stride, channels != in_channels,
+                      in_channels=in_channels, prefix=""))
+        for _ in range(n_units - 1):
+            seq.add(block(channels, 1, False, in_channels=channels, prefix=""))
+    return seq
 
 
 class ResNetV1(HybridBlock):
+    """Post-activation ResNet: stem → 4 stages → global pool → classifier."""
+
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        if len(layers) != len(channels) - 1:
+            raise ValueError("need one channel count per stage plus the stem")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
+            _imagenet_stem(self.features, channels[0], thumbnail)
+            for i, n_units in enumerate(layers):
+                self.features.add(_stage(block, n_units, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1,
+                                         channels[i]))
             self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class ResNetV2(HybridBlock):
+    """Pre-activation ResNet: input-normalising BN → stem → stages → final
+    BN+relu → global pool → classifier."""
+
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        if len(layers) != len(channels) - 1:
+            raise ValueError("need one channel count per stage plus the stem")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
+            _imagenet_stem(self.features, channels[0], thumbnail)
+            width = channels[0]
+            for i, n_units in enumerate(layers):
+                self.features.add(_stage(block, n_units, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1, width))
+                width = channels[i + 1]
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.GlobalAvgPool2D())
             self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
+            self.output = nn.Dense(classes, in_units=width)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 resnet_spec = {18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
@@ -238,60 +233,36 @@ resnet_block_versions = [{"basic_block": BasicBlockV1,
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    """(reference model_zoo/vision/resnet.py get_resnet).
-    ``pretrained=True`` loads ``{root}/resnet{N}_v{V}.params`` from the
-    LOCAL model store (model_store.py; populate it with
-    tools/convert_params.py — no network egress here)."""
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version in (1, 2), "Invalid resnet version: %d." % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    """Instantiate a ResNet by (version, depth).  ``pretrained=True`` loads
+    ``resnet{N}_v{V}.params`` from the LOCAL model store (model_store.py;
+    populate with tools/convert_params.py — no network egress)."""
+    if num_layers not in resnet_spec:
+        raise ValueError(f"Invalid number of layers: {num_layers}. "
+                         f"Options are {sorted(resnet_spec)}")
+    if version not in (1, 2):
+        raise ValueError(f"Invalid resnet version: {version}.")
+    block_kind, layers, channels = resnet_spec[num_layers]
+    net_cls = resnet_net_versions[version - 1]
+    block_cls = resnet_block_versions[version - 1][block_kind]
+    net = net_cls(block_cls, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        load_pretrained(net, "resnet%d_v%d" % (num_layers, version),
+        load_pretrained(net, f"resnet{num_layers}_v{version}",
                         root=root, ctx=ctx)
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _register_factories():
+    for depth in sorted(resnet_spec):
+        for version in (1, 2):
+            name = f"resnet{depth}_v{version}"
+
+            def _factory(version=version, depth=depth, **kwargs):
+                return get_resnet(version, depth, **kwargs)
+            _factory.__name__ = name
+            _factory.__qualname__ = name
+            _factory.__doc__ = f"ResNet-{depth} v{version} model."
+            globals()[name] = _factory
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+_register_factories()

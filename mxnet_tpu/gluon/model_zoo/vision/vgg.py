@@ -1,4 +1,12 @@
-"""Gluon VGG (reference python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""Gluon VGG 11/13/16/19, plain and batch-normalised (Simonyan & Zisserman
+1409.1556, configurations A/B/D/E).
+
+API parity with ``python/mxnet/gluon/model_zoo/vision/vgg.py``.
+
+CONTRACT CONSTRAINT: checkpoint parameter names pin the construction order
+of parametered layers; the block-table builder below re-derives that order
+from the paper's configuration table.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -8,96 +16,77 @@ from ....initializer import Xavier
 __all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
            "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
 
-
-class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(filters)
-        with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.output = nn.Dense(classes, weight_initializer="normal",
-                                   bias_initializer="zeros")
-
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(
-                    filters[i], kernel_size=3, padding=1,
-                    weight_initializer=Xavier(rnd_type="gaussian",
-                                              factor_type="out",
-                                              magnitude=2),
-                    bias_initializer="zeros"))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
+# Paper table 1: convs-per-block for each depth; widths are shared.
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
             16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
+_CONV_INIT = dict(
+    weight_initializer=Xavier(rnd_type="gaussian", factor_type="out",
+                              magnitude=2),
+    bias_initializer="zeros")
+
+
+class VGG(HybridBlock):
+    """Stacked 3x3-conv blocks (each followed by a 2x2 maxpool), then the
+    classic 4096-4096-classes head with dropout."""
+
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if len(layers) != len(filters):
+            raise ValueError("one filter width per conv block required")
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            for n_convs, width in zip(layers, filters):
+                self._add_block(n_convs, width, batch_norm)
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu",
+                                           weight_initializer="normal",
+                                           bias_initializer="zeros"))
+                self.features.add(nn.Dropout(rate=0.5))
+            self.output = nn.Dense(classes, weight_initializer="normal",
+                                   bias_initializer="zeros")
+
+    def _add_block(self, n_convs, width, batch_norm):
+        for _ in range(n_convs):
+            self.features.add(nn.Conv2D(width, kernel_size=3, padding=1,
+                                        **_CONV_INIT))
+            if batch_norm:
+                self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(strides=2))
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, **kwargs)
+    """VGG-``num_layers`` factory; ``pretrained=True`` loads
+    ``vgg{N}[_bn]`` from the local model store."""
+    net = VGG(*vgg_spec[num_layers], **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
-        batch_norm = kwargs.get("batch_norm", False)
-        load_pretrained(net, "vgg%d%s" % (num_layers,
-                                          "_bn" if batch_norm else ""),
-                        root=root, ctx=ctx)
+        suffix = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{suffix}", root=root, ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _register_factories():
+    for depth in sorted(vgg_spec):
+        for bn in (False, True):
+            name = f"vgg{depth}_bn" if bn else f"vgg{depth}"
+
+            def _factory(depth=depth, bn=bn, **kwargs):
+                if bn:
+                    kwargs["batch_norm"] = True
+                return get_vgg(depth, **kwargs)
+            _factory.__name__ = name
+            _factory.__qualname__ = name
+            _factory.__doc__ = (f"VGG-{depth} model"
+                                + (" with batch normalisation." if bn else "."))
+            globals()[name] = _factory
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+_register_factories()

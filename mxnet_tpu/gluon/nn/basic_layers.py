@@ -1,4 +1,13 @@
-"""Basic layers (reference python/mxnet/gluon/nn/basic_layers.py)."""
+"""Core gluon layers: sequentials, Dense, activations, dropout, norms,
+embedding.
+
+API parity: python/mxnet/gluon/nn/basic_layers.py (same classes, same
+constructor signatures, same ``gamma``/``beta``/``running_*`` parameter
+names).  Re-derived around shared helpers: one sequencing mixin for both
+Sequential flavours, and one gamma/beta registration helper for the three
+normalisation layers.  Every hybrid layer lowers to a registered op, so a
+hybridized stack compiles to a single fused XLA computation.
+"""
 from __future__ import annotations
 
 import numpy as _np
@@ -10,35 +19,43 @@ __all__ = ["Sequential", "HybridSequential", "Dense", "Activation",
            "Flatten", "Lambda", "HybridLambda", "LeakyReLU"]
 
 
-class Sequential(Block):
-    """Stack of Blocks executed sequentially."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+class _ChainMixin:
+    """add/len/index/iterate over registered children, shared by both
+    sequential containers."""
 
     def add(self, *blocks):
         for block in blocks:
             self.register_child(block)
 
-    def forward(self, x):
-        for block in self._children.values():
-            x = block(x)
-        return x
+    def _chain(self):
+        return list(self._children.values())
 
     def __len__(self):
         return len(self._children)
 
-    def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(key, slice):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
-
     def __iter__(self):
         return iter(self._children.values())
+
+    def __getitem__(self, key):
+        picked = self._chain()[key]
+        if not isinstance(key, slice):
+            return picked
+        view = type(self)(prefix=self._prefix)
+        with view.name_scope():
+            view.add(*picked)
+        return view
+
+
+class Sequential(_ChainMixin, Block):
+    """Imperative chain of Blocks."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
 
     def hybridize(self, active=True, **kwargs):
         if self._children and all(isinstance(c, HybridBlock)
@@ -51,40 +68,22 @@ class Sequential(Block):
         super().hybridize(active, **kwargs)
 
 
-class HybridSequential(HybridBlock):
-    """Stack of HybridBlocks; hybridizes into one XLA computation."""
+class HybridSequential(_ChainMixin, HybridBlock):
+    """Chain of HybridBlocks; hybridizes into one XLA computation."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
 
     def hybrid_forward(self, F, x):
         for block in self._children.values():
             x = block(x)
         return x
 
-    def __len__(self):
-        return len(self._children)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(key, slice):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
-
-    def __iter__(self):
-        return iter(self._children.values())
-
 
 class Dense(HybridBlock):
-    """Fully-connected layer (reference basic_layers.py Dense; lowers to one
-    MXU matmul via the FullyConnected op)."""
+    """Fully-connected layer — one MXU matmul via the FullyConnected op.
+    ``flatten=True`` collapses all trailing axes first (reference
+    semantics)."""
 
     def __init__(self, units, activation=None, use_bias=True, flatten=True,
                  dtype="float32", weight_initializer=None,
@@ -96,42 +95,32 @@ class Dense(HybridBlock):
             self.weight = self.params.get(
                 "weight", shape=(units, in_units), dtype=dtype,
                 init=weight_initializer, allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    "bias", shape=(units,), dtype=dtype,
-                    init=bias_initializer, allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + "_")
-            else:
-                self.act = None
+            self.bias = self.params.get(
+                "bias", shape=(units,), dtype=dtype, init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            self.act = None if activation is None else \
+                Activation(activation, prefix=activation + "_")
 
     def _infer_param_shapes(self, x):
-        if self._flatten:
-            in_units = int(_np.prod(x.shape[1:]))
-        else:
-            in_units = x.shape[-1]
-        self.weight._shape_from_data((self._units, in_units))
+        width = int(_np.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight._shape_from_data((self._units, width))
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
-                               num_hidden=self._units,
-                               flatten=self._flatten)
-        if self.act is not None:
-            out = self.act(out)
-        return out
+        y = F.FullyConnected(x, weight, bias, no_bias=bias is None,
+                             num_hidden=self._units, flatten=self._flatten)
+        return y if self.act is None else self.act(y)
 
     def __repr__(self):
-        shape = self.weight.shape
-        return "Dense(%s -> %s, %s)" % (
-            shape[1] if shape[1] else None, shape[0],
-            "linear" if self.act is None else self.act)
+        w = self.weight.shape
+        head = "linear" if self.act is None else self.act
+        return f"Dense({w[1] if w[1] else None} -> {w[0]}, {head})"
 
 
 class Activation(HybridBlock):
+    """Named elementwise nonlinearity (relu/sigmoid/tanh/softrelu...)."""
+
     def __init__(self, activation, **kwargs):
-        self._act_type = activation
+        self._act_type = activation  # read by _alias() in Block.__init__
         super().__init__(**kwargs)
 
     def _alias(self):
@@ -141,10 +130,12 @@ class Activation(HybridBlock):
         return F.Activation(x, act_type=self._act_type)
 
     def __repr__(self):
-        return "Activation(%s)" % self._act_type
+        return f"Activation({self._act_type})"
 
 
 class LeakyReLU(HybridBlock):
+    """max(x, alpha*x)."""
+
     def __init__(self, alpha, **kwargs):
         super().__init__(**kwargs)
         self._alpha = alpha
@@ -154,6 +145,8 @@ class LeakyReLU(HybridBlock):
 
 
 class Dropout(HybridBlock):
+    """Inverted dropout; ``axes`` selects broadcast (shared-mask) axes."""
+
     def __init__(self, rate, axes=(), **kwargs):
         super().__init__(**kwargs)
         self._rate = rate
@@ -163,9 +156,24 @@ class Dropout(HybridBlock):
         return F.Dropout(x, p=self._rate, axes=self._axes)
 
 
+def _register_affine(layer, scale, center, in_channels, gamma_init,
+                     beta_init, deferred=True):
+    """Register the gamma/beta pair shared by all norm layers; a disabled
+    branch becomes a frozen ('null' grad) parameter, as in the reference."""
+    layer.gamma = layer.params.get(
+        "gamma", grad_req="write" if scale else "null",
+        shape=(in_channels,), init=gamma_init,
+        allow_deferred_init=deferred, differentiable=scale)
+    layer.beta = layer.params.get(
+        "beta", grad_req="write" if center else "null",
+        shape=(in_channels,), init=beta_init,
+        allow_deferred_init=deferred, differentiable=center)
+
+
 class BatchNorm(HybridBlock):
-    """Batch normalization with running stats as non-differentiable
-    parameters (reference basic_layers.py BatchNorm)."""
+    """Batch normalisation; running statistics are frozen aux parameters
+    updated inside the op (reference semantics, ``fix_gamma`` mapping
+    included)."""
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
@@ -173,27 +181,18 @@ class BatchNorm(HybridBlock):
                  running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0, **kwargs):
         super().__init__(**kwargs)
+        self._axis = axis
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale,
                         "use_global_stats": use_global_stats}
-        self._axis = axis
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True, differentiable=scale)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True, differentiable=center)
-            self.running_mean = self.params.get(
-                "running_mean", grad_req="null", shape=(in_channels,),
-                init=running_mean_initializer, allow_deferred_init=True,
-                differentiable=False)
-            self.running_var = self.params.get(
-                "running_var", grad_req="null", shape=(in_channels,),
-                init=running_variance_initializer, allow_deferred_init=True,
-                differentiable=False)
+            _register_affine(self, scale, center, in_channels,
+                             gamma_initializer, beta_initializer)
+            for stat, init in (("running_mean", running_mean_initializer),
+                               ("running_var", running_variance_initializer)):
+                setattr(self, stat, self.params.get(
+                    stat, grad_req="null", shape=(in_channels,), init=init,
+                    allow_deferred_init=True, differentiable=False))
 
     def _infer_param_shapes(self, x):
         ch = x.shape[self._axis]
@@ -206,32 +205,30 @@ class BatchNorm(HybridBlock):
 
 
 class InstanceNorm(HybridBlock):
+    """Normalise over spatial axes per sample and channel."""
+
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
                  beta_initializer="zeros", gamma_initializer="ones",
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
-        self._epsilon = epsilon
         self._axis = axis
+        self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _register_affine(self, scale, center, in_channels,
+                             gamma_initializer, beta_initializer)
 
     def _infer_param_shapes(self, x):
         ch = x.shape[self._axis]
-        for p in (self.gamma, self.beta):
-            p._shape_from_data((ch,))
+        self.gamma._shape_from_data((ch,))
+        self.beta._shape_from_data((ch,))
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
 
 
 class LayerNorm(HybridBlock):
+    """Normalise over one axis (default last) per sample."""
+
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
                  in_channels=0, **kwargs):
@@ -239,26 +236,21 @@ class LayerNorm(HybridBlock):
         self._axis = axis
         self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get(
-                "gamma", grad_req="write" if scale else "null",
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                "beta", grad_req="write" if center else "null",
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _register_affine(self, scale, center, in_channels,
+                             gamma_initializer, beta_initializer)
 
     def _infer_param_shapes(self, x):
         ch = x.shape[self._axis]
-        for p in (self.gamma, self.beta):
-            p._shape_from_data((ch,))
+        self.gamma._shape_from_data((ch,))
+        self.beta._shape_from_data((ch,))
 
     def hybrid_forward(self, F, x, gamma, beta):
-        return F.LayerNorm(x, gamma, beta, axis=self._axis,
-                           eps=self._epsilon)
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
 
 
 class Embedding(HybridBlock):
+    """Index lookup into a trainable (input_dim, output_dim) table."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
@@ -274,6 +266,8 @@ class Embedding(HybridBlock):
 
 
 class Flatten(HybridBlock):
+    """Collapse all axes after the batch axis."""
+
     def hybrid_forward(self, F, x):
         return F.Flatten(x)
 
@@ -281,48 +275,53 @@ class Flatten(HybridBlock):
         return "Flatten"
 
 
+def _resolve_nd_function(name):
+    from ... import ndarray as nd
+    if not hasattr(nd, name):
+        raise ValueError(f"Function name {name} is not found in ndarray.")
+    return getattr(nd, name)
+
+
 class Lambda(Block):
-    """Wrap a function as a Block (reference basic_layers.py Lambda)."""
+    """Wrap a function (or an ndarray-op name) as an eager Block."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
         if isinstance(function, str):
-            from ... import ndarray as nd
-            assert hasattr(nd, function), \
-                "Function name %s is not found in ndarray." % function
-            self._func_impl = getattr(nd, function)
             self._func_name = function
+            self._func_impl = _resolve_nd_function(function)
         else:
-            self._func_impl = function
             self._func_name = function.__name__
+            self._func_impl = function
         self._func = self._func_impl
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return "Lambda(%s)" % self._func_name
+        return f"Lambda({self._func_name})"
 
 
 class HybridLambda(HybridBlock):
+    """Wrap an ``f(F, x, ...)`` function (or a dual ndarray/symbol op name)
+    as a hybridizable block."""
+
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
         if isinstance(function, str):
             from ... import ndarray as nd
             from ... import symbol as sym
-            assert hasattr(nd, function) and hasattr(sym, function), \
-                "Function name %s is not found in ndarray/symbol." % function
+            if not (hasattr(nd, function) and hasattr(sym, function)):
+                raise ValueError(
+                    f"Function name {function} is not found in ndarray/symbol.")
             self._func_name = function
-
-            def _f(F, *args):
-                return getattr(F, function)(*args)
-            self._func = _f
+            self._func = lambda F, *args: getattr(F, function)(*args)
         else:
-            self._func = lambda F, *args: function(F, *args)
             self._func_name = function.__name__
+            self._func = lambda F, *args: function(F, *args)
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return "HybridLambda(%s)" % self._func_name
+        return f"HybridLambda({self._func_name})"

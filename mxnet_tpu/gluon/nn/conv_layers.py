@@ -1,5 +1,13 @@
-"""Convolution and pooling layers
-(reference python/mxnet/gluon/nn/conv_layers.py)."""
+"""Convolution and pooling layers.
+
+API parity: python/mxnet/gluon/nn/conv_layers.py (same class names, same
+constructor signatures, same ``weight``/``bias`` parameter naming so
+checkpoints interoperate).  Re-derived around two generic N-D cores — one
+``_Conv`` handling both directions (forward / transposed) with scalar
+arguments normalised per rank, and one ``_Pooling`` whose 12 public
+subclasses are generated from a (kind, rank, global?) grid instead of
+twelve hand-written classes.
+"""
 from __future__ import annotations
 
 from ..block import HybridBlock
@@ -13,164 +21,107 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
            "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
            "ReflectionPad2D"]
 
+_SPATIAL_LAYOUTS = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
 
-def _tuple(v, n):
-    if isinstance(v, int):
-        return (v,) * n
-    return tuple(v)
+
+def _per_axis(value, rank):
+    """Broadcast a scalar to a rank-tuple; pass tuples through."""
+    return (value,) * rank if isinstance(value, int) else tuple(value)
 
 
 class _Conv(HybridBlock):
-    def __init__(self, channels, kernel_size, strides, padding, dilation,
-                 groups, layout, in_channels=0, activation=None,
+    """Rank-generic convolution.  ``output_padding=None`` selects the
+    forward op; a tuple selects Deconvolution (transposed) with that
+    ``adj``.  Weight layout: (out, in/g, *k) forward, (in, out/g, *k)
+    transposed — the reference/cuDNN convention."""
+
+    def __init__(self, rank, channels=0, kernel_size=0, strides=1, padding=0,
+                 dilation=1, groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", op_name="Convolution",
-                 adj=None, **kwargs):
+                 bias_initializer="zeros", in_channels=0, output_padding=None,
+                 **kwargs):
         super().__init__(**kwargs)
         self._channels = channels
         self._in_channels = in_channels
-        ndim = len(kernel_size)
-        self._op_name = op_name
+        transposed = output_padding is not None
+        self._op_name = "Deconvolution" if transposed else "Convolution"
+        kernel = _per_axis(kernel_size, rank)
         self._kwargs = {
-            "kernel": kernel_size, "stride": strides, "dilate": dilation,
-            "pad": padding, "num_filter": channels, "num_group": groups,
-            "no_bias": not use_bias, "layout": layout}
-        if adj is not None:
-            self._kwargs["adj"] = adj
-        if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) \
-                + kernel_size
-        else:  # Deconvolution: (in, out/g, *k)
-            wshape = (in_channels, channels // groups) + kernel_size
+            "kernel": kernel, "stride": _per_axis(strides, rank),
+            "dilate": _per_axis(dilation, rank),
+            "pad": _per_axis(padding, rank), "num_filter": channels,
+            "num_group": groups, "no_bias": not use_bias, "layout": layout}
+        if transposed:
+            self._kwargs["adj"] = _per_axis(output_padding, rank)
         with self.name_scope():
             self.weight = self.params.get(
-                "weight", shape=wshape, init=weight_initializer,
-                allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    "bias", shape=(channels,), init=bias_initializer,
-                    allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + "_")
-            else:
-                self.act = None
+                "weight", shape=self._weight_shape(in_channels),
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            self.act = Activation(activation, prefix=activation + "_") \
+                if activation is not None else None
+
+    def _weight_shape(self, in_ch):
+        g = self._kwargs["num_group"]
+        k = self._kwargs["kernel"]
+        if self._op_name == "Convolution":
+            return (self._channels, in_ch // g if in_ch else 0) + k
+        return (in_ch, self._channels // g) + k
 
     def _infer_param_shapes(self, x):
-        in_ch = x.shape[1]
-        g = self._kwargs["num_group"]
-        if self._op_name == "Convolution":
-            self.weight._shape_from_data(
-                (self._channels, in_ch // g) + self._kwargs["kernel"])
-        else:
-            self.weight._shape_from_data(
-                (in_ch, self._channels // g) + self._kwargs["kernel"])
+        self.weight._shape_from_data(self._weight_shape(x.shape[1]))
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
-        if bias is None:
-            out = op(x, weight, no_bias=True,
-                     **{k: v for k, v in self._kwargs.items()
-                        if k != "no_bias"})
-        else:
-            out = op(x, weight, bias,
-                     **{k: v for k, v in self._kwargs.items()
-                        if k != "no_bias"}, no_bias=False)
-        if self.act is not None:
-            out = self.act(out)
-        return out
+        call_kwargs = dict(self._kwargs, no_bias=bias is None)
+        out = op(x, weight, **call_kwargs) if bias is None \
+            else op(x, weight, bias, **call_kwargs)
+        return self.act(out) if self.act is not None else out
 
     def __repr__(self):
-        return "%s(%s, kernel_size=%s, stride=%s)" % (
-            type(self).__name__, self._channels, self._kwargs["kernel"],
-            self._kwargs["stride"])
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']})")
 
 
-class Conv1D(_Conv):
+def _forward_conv_init(rank):
     def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None,
-                 use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 1), _tuple(strides, 1),
-                         _tuple(padding, 1), _tuple(dilation, 1), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
-
-
-class Conv2D(_Conv):
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
-                 use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 2), _tuple(strides, 2),
-                         _tuple(padding, 2), _tuple(dilation, 2), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
-
-
-class Conv3D(_Conv):
-    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
-                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 3), _tuple(strides, 3),
-                         _tuple(padding, 3), _tuple(dilation, 3), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
-
-
-class Conv1DTranspose(_Conv):
-    def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 dilation=1, groups=1, layout=_SPATIAL_LAYOUTS[rank],
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 1), _tuple(strides, 1),
-                         _tuple(padding, 1), _tuple(dilation, 1), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name="Deconvolution",
-                         adj=_tuple(output_padding, 1), **kwargs)
+        _Conv.__init__(self, rank, channels, kernel_size, strides, padding,
+                       dilation, groups, layout, activation, use_bias,
+                       weight_initializer, bias_initializer, in_channels,
+                       None, **kwargs)
+    return __init__
 
 
-class Conv2DTranspose(_Conv):
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 2), _tuple(strides, 2),
-                         _tuple(padding, 2), _tuple(dilation, 2), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name="Deconvolution",
-                         adj=_tuple(output_padding, 2), **kwargs)
-
-
-class Conv3DTranspose(_Conv):
-    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
-                 padding=(0, 0, 0), output_padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
-                 activation=None, use_bias=True, weight_initializer=None,
+def _transposed_conv_init(rank):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1,
+                 layout=_SPATIAL_LAYOUTS[rank], activation=None,
+                 use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        super().__init__(channels, _tuple(kernel_size, 3), _tuple(strides, 3),
-                         _tuple(padding, 3), _tuple(dilation, 3), groups,
-                         layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name="Deconvolution",
-                         adj=_tuple(output_padding, 3), **kwargs)
+        _Conv.__init__(self, rank, channels, kernel_size, strides, padding,
+                       dilation, groups, layout, activation, use_bias,
+                       weight_initializer, bias_initializer, in_channels,
+                       output_padding, **kwargs)
+    return __init__
 
 
 class _Pooling(HybridBlock):
+    """Rank-generic pooling over the trailing spatial axes."""
+
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
                  pool_type, count_include_pad=None, **kwargs):
         super().__init__(**kwargs)
-        if strides is None:
-            strides = pool_size
         self._kwargs = {
-            "kernel": pool_size, "stride": strides, "pad": padding,
-            "pool_type": pool_type, "global_pool": global_pool,
+            "kernel": pool_size,
+            "stride": pool_size if strides is None else strides,
+            "pad": padding, "pool_type": pool_type,
+            "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid"}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -182,101 +133,76 @@ class _Pooling(HybridBlock):
         return F.Pooling(x, **self._kwargs)
 
     def __repr__(self):
-        return "%s(size=%s, stride=%s, padding=%s)" % (
-            type(self).__name__, self._kwargs["kernel"],
-            self._kwargs["stride"], self._kwargs["pad"])
+        return (f"{type(self).__name__}(size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']}, "
+                f"pad={self._kwargs['pad']})")
 
 
-class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
-                 ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 1),
-                         _tuple(strides, 1) if strides is not None else None,
-                         _tuple(padding, 1), ceil_mode, False, "max", **kwargs)
+def _pool_init(rank, kind, with_count_arg):
+    if with_count_arg:
+        def __init__(self, pool_size=2, strides=None, padding=0,
+                     layout=_SPATIAL_LAYOUTS[rank], ceil_mode=False,
+                     count_include_pad=True, **kwargs):
+            _Pooling.__init__(
+                self, _per_axis(pool_size, rank),
+                None if strides is None else _per_axis(strides, rank),
+                _per_axis(padding, rank), ceil_mode, False, kind,
+                count_include_pad, **kwargs)
+    else:
+        def __init__(self, pool_size=2, strides=None, padding=0,
+                     layout=_SPATIAL_LAYOUTS[rank], ceil_mode=False,
+                     **kwargs):
+            _Pooling.__init__(
+                self, _per_axis(pool_size, rank),
+                None if strides is None else _per_axis(strides, rank),
+                _per_axis(padding, rank), ceil_mode, False, kind, **kwargs)
+    return __init__
 
 
-class MaxPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 2),
-                         _tuple(strides, 2) if strides is not None else None,
-                         _tuple(padding, 2), ceil_mode, False, "max", **kwargs)
+def _global_pool_init(rank, kind):
+    def __init__(self, layout=_SPATIAL_LAYOUTS[rank], **kwargs):
+        _Pooling.__init__(self, (1,) * rank, None, (0,) * rank, True, True,
+                          kind, **kwargs)
+    return __init__
 
 
-class MaxPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 3),
-                         _tuple(strides, 3) if strides is not None else None,
-                         _tuple(padding, 3), ceil_mode, False, "max", **kwargs)
+def _register_layer_classes():
+    """Stamp out the public per-rank classes from the generic cores."""
+    for rank in (1, 2, 3):
+        suffix = f"{rank}D"
+        for name, init in ((f"Conv{suffix}", _forward_conv_init(rank)),
+                           (f"Conv{suffix}Transpose",
+                            _transposed_conv_init(rank))):
+            globals()[name] = type(name, (_Conv,), {
+                "__init__": init, "__module__": __name__,
+                "__doc__": f"{rank}-D {'transposed ' if 'Transpose' in name else ''}"
+                           f"convolution layer (API parity with the "
+                           f"reference {name})."})
+        for kind in ("max", "avg"):
+            pool_name = f"{kind.capitalize()}Pool{suffix}"
+            globals()[pool_name] = type(pool_name, (_Pooling,), {
+                "__init__": _pool_init(rank, kind, kind == "avg"),
+                "__module__": __name__,
+                "__doc__": f"{rank}-D {kind} pooling (API parity with the "
+                           f"reference {pool_name})."})
+            global_name = f"Global{pool_name}"
+            globals()[global_name] = type(global_name, (_Pooling,), {
+                "__init__": _global_pool_init(rank, kind),
+                "__module__": __name__,
+                "__doc__": f"Global {rank}-D {kind} pooling."})
 
 
-class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
-                 ceil_mode=False, count_include_pad=True, **kwargs):
-        super().__init__(_tuple(pool_size, 1),
-                         _tuple(strides, 1) if strides is not None else None,
-                         _tuple(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
-
-
-class AvgPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, count_include_pad=True,
-                 **kwargs):
-        super().__init__(_tuple(pool_size, 2),
-                         _tuple(strides, 2) if strides is not None else None,
-                         _tuple(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
-
-
-class AvgPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
-                 **kwargs):
-        super().__init__(_tuple(pool_size, 3),
-                         _tuple(strides, 3) if strides is not None else None,
-                         _tuple(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
-
-
-class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "max", **kwargs)
-
-
-class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "max", **kwargs)
-
-
-class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max",
-                         **kwargs)
-
-
-class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg",
-                         **kwargs)
+_register_layer_classes()
 
 
 class ReflectionPad2D(HybridBlock):
+    """Reflect-pad the two trailing spatial axes; an int pads H and W
+    symmetrically (8-tuple form matches the reference Pad op order)."""
+
     def __init__(self, padding=0, **kwargs):
         super().__init__(**kwargs)
         if isinstance(padding, int):
-            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+            padding = (0, 0, 0, 0) + (padding,) * 4
         self._padding = tuple(padding)
 
     def hybrid_forward(self, F, x):

@@ -1,11 +1,12 @@
 """Fused recurrent layers RNN / LSTM / GRU.
 
-Reference parity: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer packing
-per-layer i2h/h2h Parameters into the fused RNN op's flat weight vector,
-cuDNN layout). TPU-native: the fused op (ops/rnn.py) is one ``lax.scan``
-XLA while-loop per layer/direction with the input matmul hoisted onto the
-MXU — the packed-layout parity means checkpoints interoperate with the
-reference's cuDNN weights.
+API parity: python/mxnet/gluon/rnn/rnn_layer.py (same constructors, same
+``l{i}_i2h_weight``-style parameter names, same packed flat-weight layout
+as the reference's cuDNN path so checkpoints interoperate).  TPU-native:
+the fused op (ops/rnn.py) is one ``lax.scan`` XLA while-loop per
+layer/direction with the input matmul hoisted onto the MXU.  Layers are
+eager-only ``Block``s like the reference's 1.x `_RNNLayer` — the fused op
+is itself a single jitted scan, so hybridization would add nothing.
 """
 from __future__ import annotations
 
@@ -13,19 +14,26 @@ from ..block import Block
 
 __all__ = ["RNN", "LSTM", "GRU"]
 
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
-class _RNNLayer(Block):
-    """Eager-only like the reference's 1.x ``_RNNLayer`` (a ``Block``): the
-    fused op is itself one jitted ``lax.scan``, so hybridization adds
-    nothing."""
+
+class _FusedRecurrent(Block):
+    """Common machinery: a grid of per-layer/per-direction i2h/h2h params,
+    packed on demand into the fused op's flat vector (all weights, then all
+    biases, each layer-major then direction-major, i2h before h2h)."""
+
+    #: number of recurrent state tensors (LSTM overrides with 2)
+    _state_arity = 1
+
     def __init__(self, hidden_size, num_layers, layout, dropout,
                  bidirectional, input_size, i2h_weight_initializer,
                  h2h_weight_initializer, i2h_bias_initializer,
                  h2h_bias_initializer, mode, prefix=None, params=None):
-        self._mode = mode  # before super(): _alias() runs in Block.__init__
+        self._mode = mode  # read by _alias() inside Block.__init__
         super().__init__(prefix=prefix, params=params)
-        assert layout in ("TNC", "NTC"), \
-            "Invalid layout %s; must be one of ['TNC', 'NTC']" % layout
+        if layout not in ("TNC", "NTC"):
+            raise ValueError(
+                f"Invalid layout {layout}; must be one of ['TNC', 'NTC']")
         self._hidden_size = hidden_size
         self._num_layers = num_layers
         self._layout = layout
@@ -36,135 +44,123 @@ class _RNNLayer(Block):
         self._h2h_weight_initializer = h2h_weight_initializer
         self._i2h_bias_initializer = i2h_bias_initializer
         self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = _GATES[mode]
 
-        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
-        ng, ni, nh = self._gates, input_size, hidden_size
-        for i in range(num_layers):
-            for j in ["l", "r"][:self._dir]:
-                self._register_param("%s%d_i2h_weight" % (j, i),
-                                     shape=(ng * nh, ni),
-                                     init=i2h_weight_initializer)
-                self._register_param("%s%d_h2h_weight" % (j, i),
-                                     shape=(ng * nh, nh),
-                                     init=h2h_weight_initializer)
-                self._register_param("%s%d_i2h_bias" % (j, i),
-                                     shape=(ng * nh,),
-                                     init=i2h_bias_initializer)
-                self._register_param("%s%d_h2h_bias" % (j, i),
-                                     shape=(ng * nh,),
-                                     init=h2h_bias_initializer)
-            ni = nh * self._dir
-
-    def _register_param(self, name, shape, init):
-        p = self.params.get(name, shape=shape, init=init,
-                            allow_deferred_init=True)
-        setattr(self, name, p)
-        return p
-
-    def __repr__(self):
-        s = "{name}({mapping}, {_layout}"
-        if self._num_layers != 1:
-            s += ", num_layers={_num_layers}"
-        if self._dropout != 0:
-            s += ", dropout={_dropout}"
-        if self._dir == 2:
-            s += ", bidirectional"
-        s += ")"
-        shape = self.l0_i2h_weight.shape
-        mapping = "%s -> %s" % (shape[1] if shape[1] else None,
-                                shape[0] // self._gates)
-        return s.format(name=self.__class__.__name__, mapping=mapping,
-                        **self.__dict__)
+        inits = {"i2h_weight": i2h_weight_initializer,
+                 "h2h_weight": h2h_weight_initializer,
+                 "i2h_bias": i2h_bias_initializer,
+                 "h2h_bias": h2h_bias_initializer}
+        for name, shape in self._param_grid(input_size):
+            kind = name.split("_", 1)[1]
+            param = self.params.get(name, shape=shape, init=inits[kind],
+                                    allow_deferred_init=True)
+            setattr(self, name, param)
 
     def _alias(self):
         return self._mode
 
+    def _directions(self):
+        return ("l", "r")[:self._dir]
+
+    def _param_grid(self, input_size):
+        """Yield (param_name, shape) for every layer x direction x kind."""
+        rows = self._gates * self._hidden_size
+        width_in = input_size
+        for layer in range(self._num_layers):
+            for d in self._directions():
+                yield f"{d}{layer}_i2h_weight", (rows, width_in)
+                yield f"{d}{layer}_h2h_weight", (rows, self._hidden_size)
+                yield f"{d}{layer}_i2h_bias", (rows,)
+                yield f"{d}{layer}_h2h_bias", (rows,)
+            width_in = self._hidden_size * self._dir
+
+    def __repr__(self):
+        w = self.l0_i2h_weight.shape
+        mapping = f"{w[1] if w[1] else None} -> {w[0] // self._gates}"
+        opts = "" if self._num_layers == 1 else f", num_layers={self._num_layers}"
+        if self._dropout:
+            opts += f", dropout={self._dropout}"
+        if self._dir == 2:
+            opts += ", bidirectional"
+        return f"{type(self).__name__}({mapping}, {self._layout}{opts})"
+
+    # -- states ---------------------------------------------------------
     def state_info(self, batch_size=0):
-        raise NotImplementedError
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"}
+                for _ in range(self._state_arity)]
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        """Initial recurrent states (reference rnn_layer.py begin_state)."""
+        """Zero (or ``func``-built) initial states for a batch."""
         from ... import ndarray as nd
-        states = []
-        for info in self.state_info(batch_size):
-            info = dict(info)
-            shape = info.pop("shape")
-            info.pop("__layout__", None)
-            if func is None:
-                states.append(nd.zeros(shape, **kwargs))
-            else:
-                states.append(func(shape=shape, **kwargs))
-        return states
+        make = func or (lambda shape, **kw: nd.zeros(shape, **kw))
+        return [make(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
 
-    def _infer_param_shapes(self, inputs):
-        ni = inputs.shape[2]  # called with TNC inputs
-        ng, nh = self._gates, self._hidden_size
-        for j in ["l", "r"][:self._dir]:
-            getattr(self, "%s0_i2h_weight" % j).shape = (ng * nh, ni)
-
+    # -- forward --------------------------------------------------------
     def forward(self, inputs, states=None):
-        """Accepts layout ``self._layout``; states optional
-        (reference rnn_layer.py forward_kernel/forward)."""
+        """Run the fused recurrence.  ``states`` optional — when omitted,
+        zeros are used and only the output sequence is returned."""
         from ... import ndarray as nd
-        batch_size = inputs.shape[self._layout.find("N")]
-        skip_states = states is None
-        if skip_states:
-            states = self.begin_state(batch_size, ctx=inputs.context,
+        batch = inputs.shape[self._layout.index("N")]
+        implicit = states is None
+        if implicit:
+            states = self.begin_state(batch, ctx=inputs.context,
                                       dtype=str(inputs.dtype))
-        if isinstance(states, nd.NDArray):
+        elif isinstance(states, nd.NDArray):
             states = [states]
-        for info, state in zip(self.state_info(batch_size), states):
+        for info, state in zip(self.state_info(batch), states):
             if state.shape != info["shape"]:
                 raise ValueError(
-                    "Invalid recurrent state shape. Expecting %s, got %s." %
-                    (str(info["shape"]), str(state.shape)))
-        out = self._forward_kernel(inputs, states)
-        # out: (output, states); skip states in return if not given
-        return out[0] if skip_states else out
+                    f"Invalid recurrent state shape. Expecting "
+                    f"{info['shape']}, got {state.shape}.")
+        outputs, out_states = self._run_fused(inputs, states)
+        return outputs if implicit else (outputs, out_states)
 
-    def _forward_kernel(self, inputs, states):
+    def _packed_params(self, F):
+        """Late-bind deferred shapes from the first input, then concatenate
+        the parameter grid into the fused op's flat layout."""
+        def flat(name):
+            return getattr(self, name).data().reshape((-1,))
+        weights = []
+        for i in range(self._num_layers):
+            for d in self._directions():
+                weights += [flat(f"{d}{i}_i2h_weight"),
+                            flat(f"{d}{i}_h2h_weight")]
+        biases = []
+        for i in range(self._num_layers):
+            for d in self._directions():
+                biases += [flat(f"{d}{i}_i2h_bias"), flat(f"{d}{i}_h2h_bias")]
+        return F.concat(*(weights + biases), dim=0)
+
+    def _run_fused(self, inputs, states):
         from ... import ndarray as F
         if self._layout == "NTC":
             inputs = F.swapaxes(inputs, dim1=0, dim2=1)
-        # pack flat params in the fused op's cuDNN layout: all weights
-        # (per layer, per dir: i2h then h2h) then all biases
         if any(p._data is None for p in self._reg_params.values()):
-            self._infer_param_shapes(inputs)
+            # first call: bind layer-0 input width, then materialise
+            rows = self._gates * self._hidden_size
+            for d in self._directions():
+                getattr(self, f"{d}0_i2h_weight").shape = \
+                    (rows, inputs.shape[2])
             for p in self._reg_params.values():
                 p._finish_deferred_init()
-        wbits, bbits = [], []
-        for i in range(self._num_layers):
-            for j in ["l", "r"][:self._dir]:
-                wbits.append(getattr(self, "%s%d_i2h_weight" % (j, i))
-                             .data().reshape((-1,)))
-                wbits.append(getattr(self, "%s%d_h2h_weight" % (j, i))
-                             .data().reshape((-1,)))
-        for i in range(self._num_layers):
-            for j in ["l", "r"][:self._dir]:
-                bbits.append(getattr(self, "%s%d_i2h_bias" % (j, i))
-                             .data().reshape((-1,)))
-                bbits.append(getattr(self, "%s%d_h2h_bias" % (j, i))
-                             .data().reshape((-1,)))
-        params = F.concat(*(wbits + bbits), dim=0)
-
-        rnn_args = [inputs, params] + list(states)
+        args = [inputs, self._packed_params(F), *states]
         if self._mode != "lstm":
-            rnn_args = rnn_args[:3]
-        rnn = F.RNN(*rnn_args, state_size=self._hidden_size,
-                    num_layers=self._num_layers, bidirectional=self._dir == 2,
-                    p=self._dropout, state_outputs=True, mode=self._mode)
-        if self._mode == "lstm":
-            outputs, states = rnn[0], [rnn[1], rnn[2]]
-        else:
-            outputs, states = rnn[0], [rnn[1]]
+            args = args[:3]
+        result = F.RNN(*args, state_size=self._hidden_size,
+                       num_layers=self._num_layers,
+                       bidirectional=self._dir == 2, p=self._dropout,
+                       state_outputs=True, mode=self._mode)
+        outputs = result[0]
+        out_states = list(result[1:1 + self._state_arity])
         if self._layout == "NTC":
             outputs = F.swapaxes(outputs, dim1=0, dim2=1)
-        return outputs, states
+        return outputs, out_states
 
 
-class RNN(_RNNLayer):
-    """Multi-layer Elman RNN (relu or tanh), fused
-    (reference rnn_layer.py RNN)."""
+class RNN(_FusedRecurrent):
+    """Multi-layer Elman RNN with relu or tanh activation, fused."""
 
     def __init__(self, hidden_size, num_layers=1, activation="relu",
                  layout="TNC", dropout=0, bidirectional=False,
@@ -177,13 +173,11 @@ class RNN(_RNNLayer):
                          i2h_bias_initializer, h2h_bias_initializer,
                          "rnn_" + activation, **kwargs)
 
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]
 
+class LSTM(_FusedRecurrent):
+    """Multi-layer LSTM, fused; carries (h, c) state pair."""
 
-class LSTM(_RNNLayer):
-    """Multi-layer LSTM, fused (reference rnn_layer.py LSTM)."""
+    _state_arity = 2
 
     def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
                  bidirectional=False, input_size=0,
@@ -196,15 +190,9 @@ class LSTM(_RNNLayer):
                          i2h_bias_initializer, h2h_bias_initializer,
                          "lstm", **kwargs)
 
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"},
-                {"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]
 
-
-class GRU(_RNNLayer):
-    """Multi-layer GRU, fused (reference rnn_layer.py GRU)."""
+class GRU(_FusedRecurrent):
+    """Multi-layer GRU, fused."""
 
     def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
                  bidirectional=False, input_size=0,
@@ -216,7 +204,3 @@ class GRU(_RNNLayer):
                          i2h_weight_initializer, h2h_weight_initializer,
                          i2h_bias_initializer, h2h_bias_initializer,
                          "gru", **kwargs)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]

@@ -20,24 +20,14 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """One ``step()`` = reduce grads across replicas + apply the optimizer.
+    Keys on the kvstore are the parameters' positional indices."""
+
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None):
-        if isinstance(params, (dict, ParameterDict)):
-            params = [params[k] for k in sorted(params.keys())]
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % type(params))
-        self._params = []
-        self._param2idx = {}
-        for i, p in enumerate(params):
-            if not isinstance(p, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % type(p))
-            self._param2idx[p.name] = i
-            self._params.append(p)
+        self._params = self._normalize_params(params)
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
         self._compression_params = compression_params
         optimizer_params = optimizer_params or {}
         self._scale = float(optimizer_params.get("rescale_grad", 1.0))
@@ -47,6 +37,33 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore = None
         self._update_on_kvstore = None
+
+    @staticmethod
+    def _normalize_params(params):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())]
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                f"First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        for p in params:
+            if not isinstance(p, Parameter):
+                raise ValueError(
+                    f"First argument must be a list or dict of Parameters, "
+                    f"got list of {type(p)}.")
+        return list(params)
+
+    def _trainable(self):
+        """(index, param) pairs that receive gradients."""
+        return ((i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null")
+
+    def _require_worker_side_update(self, what):
+        if self._kvstore and self._update_on_kvstore:
+            raise AssertionError(
+                f"{what} when parameters are updated on kvstore is not "
+                f"supported. Try setting `update_on_kvstore` to False "
+                f"when creating trainer.")
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -79,9 +96,7 @@ class Trainer:
                 # only for row_sparse; 2bit runs fine on the store
             self._kvstore = kv
             self._update_on_kvstore = update_on_kvstore
-            for i, param in enumerate(self._params):
-                if param.grad_req == "null":
-                    continue
+            for i, param in self._trainable():
                 kv.init(i, param.data())
             if update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
@@ -115,19 +130,13 @@ class Trainer:
         valid with update_on_kvstore=False (reference trainer.py:276)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        if self._kvstore and self._update_on_kvstore:
-            raise AssertionError(
-                "allreduce_grads() when parameters are updated on kvstore "
-                "is not supported. Try setting `update_on_kvstore` to False "
-                "when creating trainer.")
+        self._require_worker_side_update("allreduce_grads()")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
+        for i, param in self._trainable():
             self._kvstore.push(i, param.list_grad())
             if not self._update_on_kvstore:
                 self._kvstore.pull(i, param.list_grad())
@@ -137,25 +146,20 @@ class Trainer:
         (reference trainer.py:300)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        if self._kvstore and self._update_on_kvstore:
-            raise AssertionError(
-                "update() when parameters are updated on kvstore is not "
-                "supported. Try setting `update_on_kvstore` to False when "
-                "creating trainer.")
+        self._require_worker_side_update("update()")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
+        store_side = self._kvstore and self._update_on_kvstore
+        for i, param in self._trainable():
             if param._data is None:
                 if ignore_stale_grad:
                     continue
                 raise UserWarning(
-                    "Gradient of Parameter `%s` has not been initialized"
-                    % param.name)
-            if self._kvstore and self._update_on_kvstore:
+                    f"Gradient of Parameter `{param.name}` has not been "
+                    f"initialized")
+            if store_side:
                 self._kvstore.pull(i, param.list_data())
                 continue
             for upd, arr, grad in zip(self._updaters, param.list_data(),

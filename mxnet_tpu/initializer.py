@@ -290,7 +290,8 @@ class LSTMBias(Initializer):
     def _init_bias(self, name, arr):
         arr[:] = 0.0
         num_hidden = arr.shape[0] // 4
-        a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        # asnumpy() views the immutable JAX buffer — copy before editing
+        a = _np.array(arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
         a[num_hidden:2 * num_hidden] = self.forget_bias
         arr[:] = a
 

@@ -33,8 +33,10 @@ standalone with the same immediate-apply semantics.
 """
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
@@ -49,11 +51,26 @@ from .kvstore import KVStore, _key_value, _updater_key
 __all__ = ["KVStoreDistAsync", "ParamServer", "serve_forever"]
 
 _HDR = struct.Struct(">Q")
+_MAC_BYTES = 32  # HMAC-SHA256
 
 
-def _send_msg(sock, obj):
+def _job_secret():
+    """Per-job wire secret. launch.py generates one and exports
+    MXTPU_PS_SECRET to every worker/server; standalone mode generates a
+    process-local one. The wire is pickle, so every frame carries an
+    HMAC-SHA256 over the payload — a peer without the secret cannot get
+    a frame deserialized (ADVICE r4: pickle over TCP is an arbitrary-
+    code-execution surface without authentication)."""
+    return os.environ.get("MXTPU_PS_SECRET", "").encode()
+
+
+def _mac(secret, payload):
+    return hmac.new(secret, payload, "sha256").digest()
+
+
+def _send_msg(sock, obj, secret=b""):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    sock.sendall(_HDR.pack(len(payload)) + _mac(secret, payload) + payload)
 
 
 def _recv_exact(sock, n):
@@ -66,9 +83,15 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, secret=b""):
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    mac = _recv_exact(sock, _MAC_BYTES)
+    payload = _recv_exact(sock, n)
+    if not hmac.compare_digest(mac, _mac(secret, payload)):
+        # authentication failure: never unpickle the payload
+        raise ConnectionError("bad frame MAC (wrong or missing "
+                              "MXTPU_PS_SECRET)")
+    return pickle.loads(payload)
 
 
 class _App:
@@ -233,6 +256,13 @@ class ParamServer:
                 elif app.barrier_gen == gen:
                     while app.barrier_gen == gen:
                         if not app.barrier_cv.wait(timeout=120):
+                            # roll this worker back OUT of the barrier so a
+                            # later retry re-enters cleanly instead of
+                            # double-counting (ADVICE r4); without this the
+                            # barrier could release with a worker absent.
+                            if app.barrier_gen == gen:
+                                app.barrier_count -= 1
+                                app.barrier_entered.pop(wkr, None)
                             return {"ok": False, "error": "barrier timeout"}
             return {"ok": True}
         if op == "ping":
@@ -244,14 +274,15 @@ class ParamServer:
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        secret = self.server.secret
         while True:
             try:
-                msg = _recv_msg(self.request)
+                msg = _recv_msg(self.request, secret)
             except (ConnectionError, OSError):
                 return
             resp = self.server.param_server.handle(msg)
             try:
-                _send_msg(self.request, resp)
+                _send_msg(self.request, resp, secret)
             except (ConnectionError, OSError):
                 return
             if resp.get("stop"):
@@ -265,17 +296,19 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve_forever(host, port, num_workers):
+def serve_forever(host, port, num_workers, secret=None):
     """Run one parameter server (blocking). kvstore_server.py calls this
     for DMLC_ROLE=server processes."""
     srv = _TCPServer((host, port), _Handler)
     srv.param_server = ParamServer(num_workers)
+    srv.secret = _job_secret() if secret is None else secret
     srv.serve_forever()
 
 
-def _spawn_inprocess_server(port, num_workers):
+def _spawn_inprocess_server(port, num_workers, secret):
     srv = _TCPServer(("127.0.0.1", port), _Handler)
     srv.param_server = ParamServer(num_workers)
+    srv.secret = secret
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name="mxtpu-param-server")
     t.start()
@@ -304,6 +337,7 @@ class KVStoreDistAsync(KVStore):
         host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "0")) or 9091
         self._own_server = None
+        self._secret = _job_secret()
         if nserv <= 0:
             if self._nworkers > 1:
                 raise MXNetError(
@@ -319,13 +353,21 @@ class KVStoreDistAsync(KVStore):
             port = s.getsockname()[1]
             s.close()
             host = "127.0.0.1"
-            self._own_server = _spawn_inprocess_server(port, self._nworkers)
+            if not self._secret:
+                # standalone: nobody shares this server, so mint a
+                # process-local secret rather than running unauthenticated
+                self._secret = _secrets.token_bytes(16)
+            self._own_server = _spawn_inprocess_server(port, self._nworkers,
+                                                       self._secret)
             nserv = 1
         self._servers = [(host, port + i) for i in range(nserv)]
         self._socks = [None] * nserv
         self._sock_locks = [threading.Lock() for _ in range(nserv)]
-        # per-instance RPC sequence for at-most-once retransmit dedupe
-        self._rpc_seq = 0
+        # Per-shard RPC sequence for at-most-once retransmit dedupe.
+        # Server-side dedupe state is per server, so independent per-shard
+        # counters (each guarded by that shard's socket lock) cannot race
+        # across threads the way one shared counter could (ADVICE r4).
+        self._rpc_seq = [0] * nserv
 
     # ------------------------------------------------------------------
     def _server_of(self, key):
@@ -340,8 +382,8 @@ class KVStoreDistAsync(KVStore):
         msg.setdefault("app", self._app_id)
         msg.setdefault("wkr", self._rank)
         with self._sock_locks[sidx]:
-            self._rpc_seq += 1
-            msg.setdefault("seq", self._rpc_seq)
+            self._rpc_seq[sidx] += 1
+            msg.setdefault("seq", self._rpc_seq[sidx])
             for attempt in range(retries):
                 sock = self._socks[sidx]
                 if sock is None:
@@ -355,8 +397,8 @@ class KVStoreDistAsync(KVStore):
                         time.sleep(0.25)
                         continue
                 try:
-                    _send_msg(sock, msg)
-                    resp = _recv_msg(sock)
+                    _send_msg(sock, msg, self._secret)
+                    resp = _recv_msg(sock, self._secret)
                 except (ConnectionError, OSError):
                     self._socks[sidx] = None
                     time.sleep(0.25)

@@ -1,4 +1,6 @@
-"""Learning-rate schedulers (reference parity: python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules (behavioral parity: python/mxnet/lr_scheduler.py
+— same classes, same curves; ``WarmupScheduler`` and ``CosineScheduler``
+match the rahul003 fork's additions)."""
 from __future__ import annotations
 
 import math
@@ -8,6 +10,10 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
+    """Maps ``num_update`` (the optimizer's update counter) to a learning
+    rate.  Stateful: the rate never rewinds if ``num_update`` goes
+    backwards (matters under async/parameter-server replay)."""
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
@@ -16,7 +22,8 @@ class LRScheduler:
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference FactorScheduler)."""
+    """Geometric decay: multiply by ``factor`` once per ``step`` updates,
+    floored at ``stop_factor_lr``."""
 
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01):
         super().__init__(base_lr)
@@ -27,71 +34,84 @@ class FactorScheduler(LRScheduler):
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+        self._decays_applied = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
+        # decays owed so far: one per whole `step` strictly before num_update
+        due = max(0, num_update - 1) // self.step
+        while self._decays_applied < due:
+            self._decays_applied += 1
+            self.base_lr = max(self.base_lr * self.factor,
+                               self.stop_factor_lr)
         return self.base_lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step boundary (reference MultiFactorScheduler)."""
+    """Multiply by ``factor`` as ``num_update`` passes each boundary in the
+    increasing list ``step``."""
 
     def __init__(self, step, factor=1.0, base_lr=0.01):
         super().__init__(base_lr)
-        if not all(step[i] < step[i + 1] for i in range(len(step) - 1)):
+        if any(a >= b for a, b in zip(step, step[1:])):
             raise ValueError("steps must be increasing")
         self.step = list(step)
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
+        self._next_boundary = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
+        while (self._next_boundary < len(self.step)
+               and num_update > self.step[self._next_boundary]):
+            self._next_boundary += 1
+            self.base_lr *= self.factor
         return self.base_lr
 
 
-class PolyScheduler(LRScheduler):
+class _AnnealingScheduler(LRScheduler):
+    """Shared shape-based annealing from base_lr to final_lr over
+    ``max_update`` steps; subclasses supply the unit-interval shape."""
+
+    def __init__(self, max_update, base_lr, final_lr):
+        super().__init__(base_lr)
+        self.base_lr_orig = base_lr
+        self.max_update = max_update
+        self.final_lr = final_lr
+
+    def _shape(self, t):
+        """Remaining-lr fraction at progress t in [0, 1]."""
+        raise NotImplementedError
+
+    def __call__(self, num_update):
+        if num_update <= self.max_update:
+            span = self.base_lr_orig - self.final_lr
+            self.base_lr = self.final_lr + \
+                span * self._shape(num_update / self.max_update)
+        return self.base_lr
+
+
+class PolyScheduler(_AnnealingScheduler):
+    """Polynomial decay: lr follows (1 - t)^pwr down to ``final_lr``."""
+
     def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0):
-        super().__init__(base_lr)
+        super().__init__(max_update, base_lr, final_lr)
         self.power = pwr
-        self.base_lr_orig = base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
 
-    def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 - num_update / self.max_update) ** self.power
-        return self.base_lr
+    def _shape(self, t):
+        return (1 - t) ** self.power
 
 
-class CosineScheduler(LRScheduler):
+class CosineScheduler(_AnnealingScheduler):
+    """Half-cosine decay from base_lr to ``final_lr``."""
+
     def __init__(self, max_update, base_lr=0.01, final_lr=0):
-        super().__init__(base_lr)
-        self.base_lr_orig = base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
+        super().__init__(max_update, base_lr, final_lr)
 
-    def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * num_update / self.max_update)) / 2
-        return self.base_lr
+    def _shape(self, t):
+        return (1 + math.cos(math.pi * t)) / 2
 
 
 class WarmupScheduler(LRScheduler):
-    """Linear warmup wrapping another scheduler."""
+    """Linear ramp from ``warmup_begin_lr`` to the wrapped scheduler's base
+    rate over ``warmup_steps``, then defer to the wrapped scheduler."""
 
     def __init__(self, scheduler, warmup_steps, warmup_begin_lr=0.0):
         super().__init__(scheduler.base_lr)
@@ -100,8 +120,8 @@ class WarmupScheduler(LRScheduler):
         self.warmup_begin_lr = warmup_begin_lr
 
     def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.warmup_begin_lr + (
-                self.scheduler.base_lr - self.warmup_begin_lr) * \
-                num_update / self.warmup_steps
-        return self.scheduler(num_update)
+        if num_update >= self.warmup_steps:
+            return self.scheduler(num_update)
+        ramp = num_update / self.warmup_steps
+        return self.warmup_begin_lr + \
+            ramp * (self.scheduler.base_lr - self.warmup_begin_lr)

@@ -1,13 +1,16 @@
-"""3-layer perceptron (reference example/image-classification/symbols/mlp.py)."""
+"""Symbolic 3-layer perceptron for the image-classification examples
+(behavioral parity: example/image-classification/symbols/mlp.py)."""
 from .. import symbol as sym
+
+_HIDDEN = (128, 64)
 
 
 def get_symbol(num_classes=10, **kwargs):
-    data = sym.Variable("data")
-    net = sym.Flatten(data=data)
-    net = sym.FullyConnected(data=net, name="fc1", num_hidden=128)
-    net = sym.Activation(data=net, name="relu1", act_type="relu")
-    net = sym.FullyConnected(data=net, name="fc2", num_hidden=64)
-    net = sym.Activation(data=net, name="relu2", act_type="relu")
-    net = sym.FullyConnected(data=net, name="fc3", num_hidden=num_classes)
+    """Flatten → fc(128)/relu → fc(64)/relu → fc(num_classes) → softmax."""
+    net = sym.Flatten(data=sym.Variable("data"))
+    for i, width in enumerate(_HIDDEN, start=1):
+        net = sym.FullyConnected(data=net, name=f"fc{i}", num_hidden=width)
+        net = sym.Activation(data=net, name=f"relu{i}", act_type="relu")
+    net = sym.FullyConnected(data=net, name=f"fc{len(_HIDDEN) + 1}",
+                             num_hidden=num_classes)
     return sym.SoftmaxOutput(data=net, name="softmax")

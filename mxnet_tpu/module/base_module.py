@@ -1,9 +1,16 @@
-"""BaseModule: the high-level train/predict interface.
+"""BaseModule: the high-level train / score / predict interface.
 
-Reference parity: python/mxnet/module/base_module.py (fit :399, score :168,
-predict :264). The training loop is identical in shape — bind → init_params
-→ init_optimizer → per-batch forward_backward/update/update_metric —
-but each step lowers to a fused XLA program via the executor.
+API parity with the reference's ``python/mxnet/module/base_module.py``
+(``fit`` :399, ``score`` :168, ``predict`` :264) — same signatures, same
+log-line shapes — but the engine underneath is different and the loop is
+built for it.  On TPU each ``forward_backward``+``update`` is ONE fused XLA
+program whose dispatch returns immediately (the result arrays are futures);
+the only host-blocking points are metric readback and data staging.  The
+epoch loop here is therefore organised around a one-step-lookahead
+``_Prefetcher`` (host decodes/stages batch N+1 while the device runs step N)
+and metrics that read back only at callback boundaries, keeping the device
+queue full instead of replaying the reference's synchronous
+compute→wait→update sequence.
 """
 from __future__ import annotations
 
@@ -12,36 +19,93 @@ import time
 
 import numpy as _np
 
-from ..base import MXNetError
-from .. import metric as metric_mod
 from .. import io as io_mod
-from ..io.io import DataBatch, DataDesc
-from ..model import BatchEndParam
+from .. import metric as metric_mod
 from ..initializer import Uniform
+from ..model import BatchEndParam
 from ..ndarray.ndarray import concatenate
 
 __all__ = ["BaseModule"]
 
 
-def _as_list(obj):
-    if obj is None:
-        return []
-    return obj if isinstance(obj, (list, tuple)) else [obj]
+def _callbacks(spec):
+    """Normalise a callback spec (None | callable | list) to a tuple."""
+    if spec is None:
+        return ()
+    if callable(spec):
+        return (spec,)
+    return tuple(spec)
+
+
+def _ensure_metric(m):
+    return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
+
+
+def _trim_pad(arrays, pad):
+    """Drop the iterator's pad rows from each output array."""
+    if not pad:
+        return list(arrays)
+    return [a[: a.shape[0] - pad] for a in arrays]
 
 
 def _check_input_names(symbol, names, typename, throw):
-    args = symbol.list_arguments() + symbol.list_auxiliary_states()
+    """Warn/raise when a user-declared input name is absent from the graph."""
+    known = set(symbol.list_arguments()) | set(symbol.list_auxiliary_states())
     for name in names:
-        if name not in args:
-            msg = "You created Module with Module(..., %s_names=%s) but " \
-                  "input with name '%s' is not found in symbol.list_arguments()." \
-                  % (typename, str(names), name)
-            if throw:
-                raise ValueError(msg)
-            logging.warning(msg)
+        if name in known:
+            continue
+        msg = (f"You created Module with Module(..., {typename}_names={names}) "
+               f"but input with name '{name}' is not found in "
+               f"symbol.list_arguments().")
+        if throw:
+            raise ValueError(msg)
+        logging.warning(msg)
+
+
+class _Prefetcher:
+    """One-step-lookahead wrapper over a DataIter.
+
+    ``advance()`` returns the staged batch and immediately pulls + stages the
+    next one, so host-side staging (including sparse row-id pulls via
+    ``module.prepare``) overlaps the device executing the current step.
+    ``peek_done`` is True once the underlying iterator is exhausted, letting
+    the loop know the batch in hand is the last.
+    """
+
+    def __init__(self, data_iter, module, sparse_row_id_fn=None):
+        self._it = iter(data_iter)
+        self._mod = module
+        self._row_fn = sparse_row_id_fn
+        self._staged = None
+        self._pull()
+
+    def _pull(self):
+        try:
+            self._staged = next(self._it)
+        except StopIteration:
+            self._staged = None
+
+    @property
+    def has_next(self):
+        return self._staged is not None
+
+    def advance(self):
+        batch = self._staged
+        self._pull()
+        return batch
+
+    def stage_next(self):
+        """Stage the already-fetched lookahead batch (sparse row pulls etc.).
+        Called after the current step's ``update`` so staged rows reflect
+        post-update parameter values."""
+        if self._staged is not None:
+            self._mod.prepare(self._staged, sparse_row_id_fn=self._row_fn)
 
 
 class BaseModule:
+    """Abstract train/eval surface; concrete modules implement the
+    bind/forward/backward/update primitives and inherit the loops."""
+
     def __init__(self, logger=logging):
         self.logger = logger
         self.binded = False
@@ -53,84 +117,12 @@ class BaseModule:
         self._total_exec_bytes = 0
 
     # ------------------------------------------------------------------
-    # high-level API
+    # training
     # ------------------------------------------------------------------
     def forward_backward(self, data_batch):
+        """One fused fwd+bwd dispatch (a single XLA program downstream)."""
         self.forward(data_batch, is_train=True)
         self.backward()
-
-    def score(self, eval_data, eval_metric, num_batch=None,
-              batch_end_callback=None, score_end_callback=None, reset=True,
-              epoch=0, sparse_row_id_fn=None):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
-
-    def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
-                       for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
-
-    def predict(self, eval_data, num_batch=None, merge_batches=True,
-                reset=True, always_output_list=False):
-        assert self.binded and self.params_initialized
-        if isinstance(eval_data, (_np.ndarray,)) or hasattr(eval_data, "shape"):
-            eval_data = io_mod.NDArrayIter(eval_data,
-                                           batch_size=eval_data.shape[0])
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                if len(out) != num_outputs:
-                    raise ValueError("Cannot merge batches: different number "
-                                     "of outputs")
-            output_list2 = [concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -140,11 +132,15 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """Train the module (reference base_module.py:399)."""
-        assert num_epoch is not None, "please specify number of epochs"
+        """Train for ``num_epoch`` epochs.  Signature parity with the
+        reference ``fit`` (base_module.py:399); loop structure is the
+        prefetched design described in the module docstring."""
+        if num_epoch is None:
+            raise ValueError("please specify number of epochs")
+
         self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label, for_training=True,
-                  force_rebind=force_rebind)
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,
@@ -152,64 +148,171 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+
+        train_metric = _ensure_metric(eval_metric)
+        val_metric = validation_metric or train_metric
+        on_batch = _callbacks(batch_end_callback)
+        on_epoch = _callbacks(epoch_end_callback)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
-
+            self._run_train_epoch(
+                epoch, train_data, train_metric, monitor, on_batch,
+                sparse_row_id_fn)
+            # Sync params out of the device-side optimizer state once per
+            # epoch so epoch callbacks (checkpointing) see current values.
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            for cb in on_epoch:
+                cb(epoch, self.symbol, arg_now, aux_now)
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                scores = self.score(eval_data, val_metric,
+                                    score_end_callback=eval_end_callback,
+                                    batch_end_callback=eval_batch_end_callback,
+                                    epoch=epoch)
+                for name, val in scores:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
+    def _run_train_epoch(self, epoch, train_data, train_metric, monitor,
+                         on_batch, sparse_row_id_fn):
+        """One epoch: keep the device queue full, read metrics back only at
+        callback boundaries."""
+        t0 = time.time()
+        train_metric.reset()
+        flow = _Prefetcher(train_data, self, sparse_row_id_fn)
+        nbatch = 0
+        while flow.has_next:
+            batch = flow.advance()
+            if monitor is not None:
+                monitor.tic()
+            # forward+backward+update enqueue async XLA work; while the
+            # device runs, the host stages the (already-fetched) next batch
+            # and accumulates metrics on this step's future-valued outputs.
+            self.forward_backward(batch)
+            self.update()
+            flow.stage_next()
+            self.update_metric(train_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            if on_batch:
+                info = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=train_metric, locals=None)
+                for cb in on_batch:
+                    cb(info)
+            nbatch += 1
+        for name, val in train_metric.get_name_value():
+            self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+        self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - t0)
+
     # ------------------------------------------------------------------
-    # properties / abstract surface
+    # evaluation / inference
+    # ------------------------------------------------------------------
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0, sparse_row_id_fn=None):
+        """Run ``eval_data`` through forward and accumulate ``eval_metric``."""
+        if not (self.binded and self.params_initialized):
+            raise RuntimeError("score() requires bind() + init_params()")
+        if reset:
+            eval_data.reset()
+        eval_metric = _ensure_metric(eval_metric)
+        eval_metric.reset()
+        on_batch = _callbacks(batch_end_callback)
+
+        seen = 0
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.prepare(batch, sparse_row_id_fn=sparse_row_id_fn)
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            for cb in on_batch:
+                cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                 eval_metric=eval_metric, locals=None))
+            seen += 1
+        for cb in _callbacks(score_end_callback):
+            cb(BatchEndParam(epoch=epoch, nbatch=seen,
+                             eval_metric=eval_metric, locals=None))
+        return eval_metric.get_name_value()
+
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Yield ``(outputs, nbatch, batch)`` per forward pass (pad-trimmed)."""
+        if not (self.binded and self.params_initialized):
+            raise RuntimeError("iter_predict() requires bind() + init_params()")
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            yield _trim_pad(self.get_outputs(), batch.pad), nbatch, batch
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """Forward every batch; by default concatenate per-output across
+        batches (and unwrap a single output, matching the reference)."""
+        if not (self.binded and self.params_initialized):
+            raise RuntimeError("predict() requires bind() + init_params()")
+        if isinstance(eval_data, _np.ndarray) or hasattr(eval_data, "shape"):
+            eval_data = io_mod.NDArrayIter(eval_data,
+                                           batch_size=eval_data.shape[0])
+        if reset:
+            eval_data.reset()
+
+        per_batch = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            per_batch.append([o.copy() for o in
+                              _trim_pad(self.get_outputs(), batch.pad)])
+        if not per_batch:
+            return per_batch
+        if not merge_batches:
+            return per_batch
+        widths = {len(outs) for outs in per_batch}
+        if len(widths) != 1:
+            raise ValueError("Cannot merge batches: different number of outputs")
+        merged = [concatenate([outs[i] for outs in per_batch])
+                  for i in range(widths.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ------------------------------------------------------------------
+    # parameter persistence
+    # ------------------------------------------------------------------
+    def save_params(self, fname):
+        """Save current params in the reference's ``arg:``/``aux:`` layout."""
+        from .. import ndarray as nd
+        arg_params, aux_params = self.get_params()
+        blob = {f"arg:{k}": v for k, v in arg_params.items()}
+        blob.update({f"aux:{k}": v for k, v in aux_params.items()})
+        nd.save(fname, blob)
+
+    def load_params(self, fname):
+        """Load params saved by :meth:`save_params` (reference layout)."""
+        from .. import ndarray as nd
+        arg_params, aux_params = {}, {}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                arg_params[name] = value
+            elif kind == "aux":
+                aux_params[name] = value
+            else:
+                raise ValueError("Invalid param file " + fname)
+        self.set_params(arg_params, aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # ------------------------------------------------------------------
+    # abstract surface (implemented by Module / BucketingModule / ...)
     # ------------------------------------------------------------------
     @property
     def symbol(self):
@@ -242,33 +345,6 @@ class BaseModule:
                     aux_params=None, allow_missing=False, force_init=False,
                     allow_extra=False):
         raise NotImplementedError
-
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init, allow_extra=allow_extra)
-
-    def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        from .. import ndarray as nd
-        nd.save(fname, save_dict)
-
-    def load_params(self, fname):
-        from .. import ndarray as nd
-        save_dict = nd.load(fname)
-        arg_params, aux_params = {}, {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
 
     def install_monitor(self, mon):
         raise NotImplementedError

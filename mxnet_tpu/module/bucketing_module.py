@@ -1,16 +1,19 @@
-"""BucketingModule: per-sequence-length executors with shared parameters.
+"""BucketingModule: one compiled executor per bucket key, shared weights.
 
-Reference parity: python/mxnet/module/bucketing_module.py. On TPU the
-shape-keyed jit cache makes bucketing "natural" (SURVEY.md §7 hard part 2):
-each bucket is a Module sharing parameter NDArrays; switching buckets swaps
-the compiled executable, not the weights.
+Behavioral parity with the reference's ``python/mxnet/module/
+bucketing_module.py`` (same constructor / ``switch_bucket`` surface), built
+around the TPU-natural design (SURVEY.md §7 hard part 2): the shape-keyed
+jit cache means each bucket is just a ``Module`` bound against the default
+bucket's parameter arrays — switching buckets swaps which compiled XLA
+program runs next, never the weights.  Internally buckets are materialised
+on demand by ``_materialize`` from one captured kwargs record, rather than
+the reference's inline re-construction at each site.
 """
 from __future__ import annotations
 
 import logging
 import warnings
 
-from ..base import MXNetError
 from ..initializer import Uniform
 from .base_module import BaseModule
 from .module import Module
@@ -19,22 +22,24 @@ __all__ = ["BucketingModule"]
 
 
 class BucketingModule(BaseModule):
+    """Drive a ``sym_gen(bucket_key) -> (symbol, data_names, label_names)``
+    factory; grads/optimizer state live on the default bucket's module."""
+
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
+        if default_bucket_key is None:
+            raise ValueError("default_bucket_key is required")
         self._sym_gen = sym_gen
-        self._fixed_param_names = fixed_param_names or []
-        self._state_names = state_names or []
-        self._context = context
-        self._work_load_list = work_load_list
-        self._group2ctxs = group2ctxs
-        self._compression_params = compression_params
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self._default_bucket_key = default_bucket_key
+        # One record of Module-constructor kwargs, reused for every bucket.
+        self._mod_kwargs = dict(
+            logger=logger, context=context, work_load_list=work_load_list,
+            fixed_param_names=fixed_param_names or [],
+            state_names=state_names or [], group2ctxs=group2ctxs,
+            compression_params=compression_params)
+        self._reset_bind()
         self._params_dirty = False
         self._monitor = None
         self._grad_req = None
@@ -42,50 +47,50 @@ class BucketingModule(BaseModule):
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self._active = None
+        self._active_key = None
 
+    # -- introspection --------------------------------------------------
     @property
     def data_names(self):
         if self.binded:
-            return self._curr_module.data_names
-        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
-        return data_names
+            return self._active.data_names
+        return self._call_sym_gen(self._default_bucket_key)[1]
 
     @property
     def output_names(self):
         if self.binded:
-            return self._curr_module.output_names
-        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
-        return symbol.list_outputs()
+            return self._active.output_names
+        return self._call_sym_gen(self._default_bucket_key)[0].list_outputs()
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._curr_module.data_shapes
+        return self._active.data_shapes
 
     @property
     def label_shapes(self):
         assert self.binded
-        return self._curr_module.label_shapes
+        return self._active.label_shapes
 
     @property
     def output_shapes(self):
         assert self.binded
-        return self._curr_module.output_shapes
+        return self._active.output_shapes
 
     @property
     def symbol(self):
         assert self.binded
-        return self._curr_module.symbol
+        return self._active.symbol
 
     def _call_sym_gen(self, bucket_key):
         return self._sym_gen(bucket_key)
 
+    # -- parameters -----------------------------------------------------
     def get_params(self):
         assert self.params_initialized
-        self._curr_module._params_dirty = self._params_dirty
-        params = self._curr_module.get_params()
+        self._active._params_dirty = self._params_dirty
+        params = self._active.get_params()
         self._params_dirty = False
         return params
 
@@ -95,12 +100,11 @@ class BucketingModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        self._curr_module.init_params(initializer=initializer,
-                                      arg_params=arg_params,
-                                      aux_params=aux_params,
-                                      allow_missing=allow_missing,
-                                      force_init=force_init,
-                                      allow_extra=allow_extra)
+        self._active.init_params(initializer=initializer,
+                                 arg_params=arg_params, aux_params=aux_params,
+                                 allow_missing=allow_missing,
+                                 force_init=force_init,
+                                 allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
 
@@ -108,18 +112,31 @@ class BucketingModule(BaseModule):
                    force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             warnings.warn("Parameters already initialized and force_init=False")
             return
-        self._curr_module.set_params(arg_params, aux_params,
-                                     allow_missing=allow_missing,
-                                     force_init=force_init,
-                                     allow_extra=allow_extra)
+        self._active.set_params(arg_params, aux_params,
+                                allow_missing=allow_missing,
+                                force_init=force_init, allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
+
+    # -- binding / bucket management ------------------------------------
+    def _materialize(self, bucket_key, data_shapes, label_shapes, shared):
+        """Create and bind the Module for one bucket key."""
+        symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+        mod = Module(symbol, data_names, label_names, **self._mod_kwargs)
+        mod.bind(data_shapes, label_shapes, self.for_training,
+                 self.inputs_need_grad, force_rebind=False,
+                 shared_module=shared, grad_req=self._grad_req)
+        if self._monitor is not None:
+            mod.install_monitor(self._monitor)
+        self._buckets[bucket_key] = mod
+        return mod
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -129,50 +146,37 @@ class BucketingModule(BaseModule):
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
-        assert shared_module is None
-
+        if shared_module is not None:
+            raise ValueError("BucketingModule does not support shared_module")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self.binded = True
         self._grad_req = grad_req
-
-        symbol, data_names, label_names = self._call_sym_gen(
-            self._default_bucket_key)
-        module = Module(symbol, data_names, label_names, logger=self.logger,
-                        context=self._context,
-                        work_load_list=self._work_load_list,
-                        fixed_param_names=self._fixed_param_names,
-                        state_names=self._state_names,
-                        group2ctxs=self._group2ctxs,
-                        compression_params=self._compression_params)
-        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
-                    force_rebind=False, shared_module=None, grad_req=grad_req)
-        self._curr_module = module
-        self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
+        self.binded = True
+        self._active = self._materialize(self._default_bucket_key,
+                                         data_shapes, label_shapes, None)
+        self._active_key = self._default_bucket_key
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Make ``bucket_key`` the active executor, materialising it (bound
+        against the default bucket's weights) on first use."""
         assert self.binded, "call bind before switching bucket"
-        if bucket_key not in self._buckets:
-            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
-            module = Module(symbol, data_names, label_names,
-                            logger=self.logger, context=self._context,
-                            work_load_list=self._work_load_list,
-                            fixed_param_names=self._fixed_param_names,
-                            state_names=self._state_names,
-                            group2ctxs=self._group2ctxs,
-                            compression_params=self._compression_params)
-            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
-                        self._curr_module.inputs_need_grad,
-                        force_rebind=False,
-                        shared_module=self._buckets[self._default_bucket_key],
-                        grad_req=self._grad_req)
-            if self._monitor is not None:
-                module.install_monitor(self._monitor)
-            self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
-        self._curr_bucket_key = bucket_key
+        mod = self._buckets.get(bucket_key)
+        if mod is None:
+            mod = self._materialize(bucket_key, data_shapes, label_shapes,
+                                    self._buckets[self._default_bucket_key])
+        self._active = mod
+        self._active_key = bucket_key
 
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        """Pre-materialise the bucket for a lookahead batch without leaving
+        the current one active."""
+        assert self.binded and self.params_initialized
+        current = self._active_key
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self.switch_bucket(current, None, None)
+
+    # -- optimizer ------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -180,49 +184,42 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
-                                         force_init=force_init)
+        self._active.init_optimizer(kvstore, optimizer, optimizer_params,
+                                    force_init=force_init)
         for mod in self._buckets.values():
-            if mod is not self._curr_module:
-                mod.borrow_optimizer(self._curr_module)
+            if mod is not self._active:
+                mod.borrow_optimizer(self._active)
         self.optimizer_initialized = True
 
-    def prepare(self, data_batch, sparse_row_id_fn=None):
-        assert self.binded and self.params_initialized
-        bucket_key = data_batch.bucket_key
-        original_bucket_key = self._curr_bucket_key
-        self.switch_bucket(bucket_key, data_batch.provide_data,
-                           data_batch.provide_label)
-        self.switch_bucket(original_bucket_key, None, None)
-
+    # -- execution (delegates to the active bucket) ---------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
-        self._curr_module.forward(data_batch, is_train=is_train)
+        self._active.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        self._curr_module.backward(out_grads=out_grads)
+        self._active.backward(out_grads=out_grads)
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
-        self._curr_module.update()
+        self._active.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context)
+        return self._active.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized \
             and self.inputs_need_grad
-        return self._curr_module.get_input_grads(merge_multi_context)
+        return self._active.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         assert self.binded and self.params_initialized
-        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+        self._active.update_metric(eval_metric, labels, pre_sliced)
 
     def install_monitor(self, mon):
         assert self.binded

@@ -1,7 +1,11 @@
-"""Module: symbol + executor-group + optimizer intermediate API.
+"""Module: symbol + executor-group + optimizer, the mid-level training API.
 
-Reference parity: python/mxnet/module/module.py (bind :364, init_params
-:270, init_optimizer :465, forward :570, backward :600, update :643).
+API parity: python/mxnet/module/module.py (bind :364, init_params :270,
+init_optimizer :465, forward :570, update :643) — same surface, re-derived
+implementation.  The executor group compiles forward(+backward) into one
+fused XLA program per shape signature; ``forward`` transparently re-binds
+when a batch arrives with a new shape (the compiled-program cache makes
+that cheap after the first time).
 """
 from __future__ import annotations
 
@@ -22,7 +26,18 @@ from .executor_group import DataParallelExecutorGroup
 __all__ = ["Module"]
 
 
+def _as_descs(shapes):
+    """Normalise a list of (name, shape) / DataDesc into DataDesc records;
+    None/empty passes through as None."""
+    if not shapes:
+        return None
+    return DataDesc.get_list(
+        [d if isinstance(d, DataDesc) else tuple(d) for d in shapes])
+
+
 class Module(BaseModule):
+    """Bind a Symbol over contexts and drive fused train/eval steps."""
+
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
@@ -31,37 +46,33 @@ class Module(BaseModule):
         super().__init__(logger=logger)
         if context is None:
             context = current_context()
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
+        self._context = [context] if isinstance(context, Context) else context
         self._work_load_list = work_load_list
         self._group2ctxs = group2ctxs
-
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        state_names = list(state_names) if state_names is not None else []
-        fixed_param_names = list(fixed_param_names) \
-            if fixed_param_names is not None else []
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, state_names, "state", True)
-        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+        self._compression_params = compression_params
 
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names + state_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = fixed_param_names
+        names = {"data": list(data_names or []),
+                 "label": list(label_names or []),
+                 "state": list(state_names or []),
+                 "fixed_param": list(fixed_param_names or [])}
+        for kind, lst in names.items():
+            _check_input_names(symbol, lst, kind, throw=kind != "label")
+        self._data_names = names["data"]
+        self._label_names = names["label"]
+        self._state_names = names["state"]
+        self._fixed_param_names = names["fixed_param"]
+
+        non_params = set(self._data_names + self._label_names
+                         + self._state_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in non_params]
         self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
-        self._state_names = state_names
         self._output_names = symbol.list_outputs()
 
         self._arg_params = None
         self._aux_params = None
         self._params_dirty = False
-        self._compression_params = compression_params
         self._optimizer = None
         self._kvstore = None
         self._update_on_kvstore = None
@@ -71,26 +82,28 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
 
+    # -- checkpointing --------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Rebuild a Module from ``prefix-symbol.json`` + params at epoch."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
-            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """(reference module.py:165)"""
-        self._symbol.save("%s-symbol.json" % prefix)
+        """Write symbol/params (and optionally optimizer state) in the
+        reference's file layout."""
+        self._symbol.save(f"{prefix}-symbol.json")
         arg_params, aux_params = self.get_params()
         save_checkpoint(prefix, epoch, None, arg_params, aux_params)
         if save_optimizer_states:
-            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
 
-    # ------------------------------------------------------------------
+    # -- introspection --------------------------------------------------
     @property
     def data_names(self):
         return self._data_names
@@ -116,22 +129,48 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        # infer from the bound input shapes — must work before any
-        # forward has run (SequentialModule wires layers at bind time)
+        # Derived by shape inference from the bound inputs so it works
+        # before any forward has run (SequentialModule wires at bind time).
         known = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            known.update({l.name: l.shape for l in self._label_shapes})
+        for l in self._label_shapes or []:
+            known[l.name] = l.shape
         _, out_shapes, _ = self._symbol.infer_shape_partial(**known)
-        return list(zip(self._output_names,
-                        [tuple(s) if s is not None else None
-                         for s in out_shapes]))
+        return [(name, tuple(s) if s is not None else None)
+                for name, s in zip(self._output_names, out_shapes)]
 
-    # ------------------------------------------------------------------
+    @property
+    def _param_names_bound(self):
+        return self._exec_group.param_names
+
+    # -- parameters -----------------------------------------------------
     def get_params(self):
         assert self.binded or self.params_initialized
         if self.binded and self._params_dirty:
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
+
+    def _host_param_caches(self):
+        """Materialise host-side copies of device params on first touch."""
+        if self._arg_params is None:
+            live = self._exec_group._exec.arg_dict
+            bound_names = [n for n in self._param_names if n in live]
+            self._arg_params = {
+                name: arrs[0].copyto(cpu())
+                for name, arrs in zip(bound_names,
+                                      self._exec_group.param_arrays)}
+        if self._aux_params is None:
+            self._aux_params = {
+                name: arrs[0].copyto(cpu())
+                for name, arrs in zip(self._aux_names,
+                                      self._exec_group.aux_arrays)}
+
+    def _reject_extra(self, arg_params, aux_params):
+        orphans = [n for n in (arg_params or {}) if n not in self._arg_params]
+        orphans += [n for n in (aux_params or {}) if n not in self._aux_params]
+        if orphans:
+            raise MXNetError(
+                f"set_params/init_params got extra parameter(s) "
+                f"{sorted(orphans)} (pass allow_extra=True to ignore)")
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -140,58 +179,32 @@ class Module(BaseModule):
             warnings.warn("Parameters already initialized and force_init=False")
             return
         assert self.binded, "call bind before initializing the parameters"
-
-        if self._arg_params is None:
-            self._arg_params = {
-                name: arr[0].copyto(cpu())
-                for name, arr in zip(
-                    [n for n in self._param_names
-                     if n in self._exec_group._exec.arg_dict],
-                    self._exec_group.param_arrays)}
-        if self._aux_params is None:
-            self._aux_params = {
-                name: arr[0].copyto(cpu())
-                for name, arr in zip(self._aux_names,
-                                     self._exec_group.aux_arrays)}
-
+        self._host_param_caches()
         attrs = self._symbol.attr_dict()
-
         if not allow_extra:
-            # reference module.py set_params: unknown names are an error
-            # unless allow_extra_params is set
-            extra = [n for n in (arg_params or {})
-                     if n not in self._arg_params]
-            extra += [n for n in (aux_params or {})
-                      if n not in self._aux_params]
-            if extra:
-                raise MXNetError(
-                    "set_params/init_params got extra parameter(s) %s "
-                    "(pass allow_extra=True to ignore)" % sorted(extra))
+            self._reject_extra(arg_params, aux_params)
 
-        def _impl(name, arr, cache):
-            if cache is not None and name in cache:
-                cache_arr = cache[name]
-                if cache_arr is not arr:
-                    if cache_arr.shape != arr.shape:
-                        raise MXNetError("shape mismatch for %s: %s vs %s"
-                                         % (name, cache_arr.shape, arr.shape))
-                    cache_arr.copyto(arr)
-            else:
-                if not allow_missing:
-                    raise RuntimeError("%s is not presented" % name)
-                if initializer is not None:
-                    initializer(InitDesc(name, attrs.get(name)), arr)
+        def fill(name, target, source):
+            """Resolve one parameter: copy from `source` if present, else
+            fall back to missing-policy / initializer."""
+            if source is not None and name in source:
+                given = source[name]
+                if given is not target:
+                    if given.shape != target.shape:
+                        raise MXNetError(
+                            f"shape mismatch for {name}: {given.shape} vs "
+                            f"{target.shape}")
+                    given.copyto(target)
+                return
+            if source is not None and not allow_missing:
+                raise RuntimeError(f"{name} is not presented")
+            if initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), target)
 
-        for name, arr in sorted(self._arg_params.items()):
-            if arg_params is not None:
-                _impl(name, arr, arg_params)
-            elif initializer is not None:
-                initializer(InitDesc(name, attrs.get(name)), arr)
-        for name, arr in sorted(self._aux_params.items()):
-            if aux_params is not None:
-                _impl(name, arr, aux_params)
-            elif initializer is not None:
-                initializer(InitDesc(name, attrs.get(name)), arr)
+        for name, target in sorted(self._arg_params.items()):
+            fill(name, target, arg_params)
+        for name, target in sorted(self._aux_params.items()):
+            fill(name, target, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -202,7 +215,8 @@ class Module(BaseModule):
                    force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
@@ -213,7 +227,7 @@ class Module(BaseModule):
         self._params_dirty = True
         self.params_initialized = True
 
-    # ------------------------------------------------------------------
+    # -- binding --------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -222,20 +236,14 @@ class Module(BaseModule):
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        if not for_training and inputs_need_grad:
+            raise ValueError("inputs_need_grad requires for_training")
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
         self.binded = True
-
-        if not for_training:
-            assert not inputs_need_grad
-
-        self._data_shapes = DataDesc.get_list(
-            [tuple(d) if not isinstance(d, DataDesc) else d
-             for d in data_shapes])
-        self._label_shapes = DataDesc.get_list(
-            [tuple(l) if not isinstance(l, DataDesc) else l
-             for l in label_shapes]) if label_shapes else None
 
         shared_group = None
         if shared_module is not None:
@@ -251,16 +259,15 @@ class Module(BaseModule):
             group2ctxs=self._group2ctxs)
         self._total_exec_bytes = 0
         if shared_module is not None:
+            # share host caches and (if live) the optimizer with the donor
             self.params_initialized = True
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
+            if shared_module.optimizer_initialized:
+                self.borrow_optimizer(shared_module)
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params,
                                         allow_extra=True)
-        if shared_module is not None and shared_module.optimizer_initialized:
-            # a bucket created mid-training adopts the live optimizer
-            # (reference module.py:455)
-            self.borrow_optimizer(shared_module)
 
     def _reset_bind(self):
         self.binded = False
@@ -269,20 +276,37 @@ class Module(BaseModule):
         self._label_shapes = None
 
     def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind to new input shapes, reusing weights (and the compiled
+        program cache keyed by shape)."""
         assert self.binded
-        self._data_shapes = DataDesc.get_list(
-            [tuple(d) if not isinstance(d, DataDesc) else d
-             for d in data_shapes])
-        self._label_shapes = DataDesc.get_list(
-            [tuple(l) if not isinstance(l, DataDesc) else l
-             for l in label_shapes]) if label_shapes else None
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
         self._exec_group = self._exec_group.reshape(self._data_shapes,
                                                     self._label_shapes)
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params,
                                         allow_extra=True)
 
-    # ------------------------------------------------------------------
+    # -- optimizer ------------------------------------------------------
+    def _effective_batch_size(self, kvstore):
+        first = self._exec_group.data_shapes[0]
+        batch = first.shape[0] if isinstance(first, DataDesc) \
+            else first[1][0]
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            batch *= kvstore.num_workers
+        return batch
+
+    def _param_index_names(self, update_on_kvstore):
+        """Index→name map handed to the optimizer (per-device interleaved
+        when updates run on workers, matching the reference's updater
+        keying)."""
+        names = self._exec_group.param_names
+        if update_on_kvstore:
+            return dict(enumerate(names))
+        n_dev = len(self._context)
+        return {i * n_dev + k: n
+                for i, n in enumerate(names) for k in range(n_dev)}
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -293,38 +317,25 @@ class Module(BaseModule):
         if self._params_dirty:
             self._sync_params_from_devices()
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
-        first = self._exec_group.data_shapes[0]
-        batch_size = first.shape[0] if isinstance(first, DataDesc) \
-            else first[1][0]
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
+        rescale_grad = 1.0 / self._effective_batch_size(kvstore)
 
-        idx2name = {}
-        if update_on_kvstore:
-            idx2name.update(enumerate(self._exec_group.param_names))
-        else:
-            for k in range(len(self._context)):
-                idx2name.update(
-                    {i * len(self._context) + k: n
-                     for i, n in enumerate(self._exec_group.param_names)})
-        # param_names for the exec group = Module's param names present
         if isinstance(optimizer, str):
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
-            optimizer = opt.create(optimizer, sym=self._symbol,
-                                   param_idx2name=idx2name, **optimizer_params)
+            config = dict(optimizer_params)
+            config.setdefault("rescale_grad", rescale_grad)
+            optimizer = opt.create(
+                optimizer, sym=self._symbol,
+                param_idx2name=self._param_index_names(update_on_kvstore),
+                **config)
         else:
             assert isinstance(optimizer, opt.Optimizer)
             if optimizer.rescale_grad != rescale_grad:
                 warnings.warn(
-                    "Optimizer created manually outside Module but "
-                    "rescale_grad is not normalized to 1.0/batch_size/"
-                    "num_workers (%s vs. %s). Is this intended?"
-                    % (optimizer.rescale_grad, rescale_grad))
+                    f"Optimizer created manually outside Module but "
+                    f"rescale_grad is not normalized to 1.0/batch_size/"
+                    f"num_workers ({optimizer.rescale_grad} vs. "
+                    f"{rescale_grad}). Is this intended?")
 
         self._optimizer = optimizer
         self._kvstore = kvstore
@@ -343,13 +354,15 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
-
         self.optimizer_initialized = True
+
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
     def borrow_optimizer(self, shared_module):
+        """Adopt a live optimizer/kvstore/updater from another module (the
+        bucketing path)."""
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
@@ -357,38 +370,39 @@ class Module(BaseModule):
         self._updater = shared_module._updater
         self.optimizer_initialized = True
 
-    # ------------------------------------------------------------------
+    # -- execution ------------------------------------------------------
+    def _batch_descs(self, data_batch, new_shapes):
+        """Build (data_descs, label_descs) for a batch whose shapes differ
+        from the bound ones."""
+        if getattr(data_batch, "provide_data", None):
+            d_descs = data_batch.provide_data
+        else:
+            d_descs = [DataDesc(d.name, shape, d.dtype, d.layout)
+                       for d, shape in zip(self._data_shapes, new_shapes)]
+        labels = getattr(data_batch, "label", None)
+        if getattr(data_batch, "provide_label", None):
+            l_descs = data_batch.provide_label
+        elif labels:
+            if self._label_shapes:
+                l_descs = [DataDesc(l.name, arr.shape, l.dtype, l.layout)
+                           for l, arr in zip(self._label_shapes, labels)]
+            else:
+                # a previous unlabeled batch dropped the label shapes;
+                # rebuild them from the declared label names
+                l_descs = [DataDesc(name, arr.shape)
+                           for name, arr in zip(self._label_names, labels)]
+        else:
+            l_descs = None
+        return d_descs, l_descs
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
-        if isinstance(data_batch, list):
-            new_data_shapes = tuple(b.data[0].shape for b in data_batch)
-        else:
-            new_data_shapes = tuple(i.shape for i in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            if hasattr(data_batch, "provide_data") and data_batch.provide_data:
-                new_dshape = data_batch.provide_data
-            else:
-                new_dshape = [DataDesc(i.name, shape, i.dtype, i.layout)
-                              for i, shape in
-                              zip(self._data_shapes, new_data_shapes)]
-            if hasattr(data_batch, "provide_label") and data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif hasattr(data_batch, "label") and data_batch.label:
-                if self._label_shapes:
-                    new_lshape = [DataDesc(i.name, j.shape, i.dtype,
-                                           i.layout)
-                                  for i, j in
-                                  zip(self._label_shapes, data_batch.label)]
-                else:
-                    # a previous unlabeled batch dropped the label
-                    # shapes; rebuild them from the declared label names
-                    new_lshape = [DataDesc(name, j.shape)
-                                  for name, j in zip(self._label_names,
-                                                     data_batch.label)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
+        bound = tuple(d.shape for d in self._data_shapes)
+        arriving = tuple(b.data[0].shape for b in data_batch) \
+            if isinstance(data_batch, list) \
+            else tuple(a.shape for a in data_batch.data)
+        if bound != arriving:
+            self.reshape(*self._batch_descs(data_batch, arriving))
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -396,22 +410,19 @@ class Module(BaseModule):
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """(reference module.py:643) push grads / run updater."""
+        """Apply one optimizer step (kvstore push/pull or local updater)."""
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        group = self._exec_group
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore,
-                                      self._exec_group.param_names)
+            _update_params_on_kvstore(group.param_arrays, group.grad_arrays,
+                                      self._kvstore, group.param_names)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
-                           num_device=1,
+            _update_params(group.param_arrays, group.grad_arrays,
+                           updater=self._updater, num_device=1,
                            kvstore=self._kvstore,
-                           param_names=self._exec_group.param_names)
+                           param_names=group.param_names)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -428,10 +439,11 @@ class Module(BaseModule):
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
-            for param_name, param_val in sorted(self._arg_params.items()):
-                self._kvstore.pull(param_name, param_val)
+            for name, value in sorted(self._arg_params.items()):
+                self._kvstore.pull(name, value)
         self._params_dirty = False
 
+    # -- optimizer state persistence ------------------------------------
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
@@ -454,7 +466,3 @@ class Module(BaseModule):
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
-
-    @property
-    def _param_names_bound(self):
-        return self._exec_group.param_names

@@ -1,10 +1,19 @@
-"""SequentialModule: chain of modules executed in order.
+"""SequentialModule: a pipeline of sub-modules, each feeding the next.
 
-Reference parity: python/mxnet/module/sequential_module.py.
+API parity with the reference's ``python/mxnet/module/sequential_module.py``
+(``add(module, take_labels=..., auto_wiring=...)``, same META_* constants),
+re-derived around an explicit ``_Stage`` record per sub-module instead of the
+reference's parallel meta-dict list.  Forward threads each stage's outputs
+into the next stage's inputs; backward threads input-gradients in reverse.
+Each stage still compiles to its own fused XLA program, so a sequential
+module is a chain of compiled steps rather than one — use plain ``Module``
+on a composed symbol when you want single-program fusion.
 """
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass
+from typing import Any
 
 from ..initializer import Uniform
 from .base_module import BaseModule
@@ -12,45 +21,59 @@ from .base_module import BaseModule
 __all__ = ["SequentialModule"]
 
 
+@dataclass
+class _Stage:
+    """One link of the chain and its wiring options."""
+    module: Any
+    takes_labels: bool = False
+    auto_wire: bool = False
+
+
 class SequentialModule(BaseModule):
+    """Chain sub-modules; data flows first→last, gradients last→first."""
+
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages: list[_Stage] = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = {SequentialModule.META_TAKE_LABELS,
-                           SequentialModule.META_AUTO_WIRING}
 
+    # -- construction ---------------------------------------------------
     def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta '%s'" % key
-        self._metas.append(kwargs)
+        """Append ``module``; keyword metas select label routing/auto-wiring."""
+        allowed = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        unknown = set(kwargs) - allowed
+        if unknown:
+            raise ValueError(f"Unknown meta keys {sorted(unknown)}; "
+                             f"allowed: {sorted(allowed)}")
+        self._stages.append(_Stage(
+            module=module,
+            takes_labels=bool(kwargs.get(self.META_TAKE_LABELS, False)),
+            auto_wire=bool(kwargs.get(self.META_AUTO_WIRING, False))))
+        # Any structural edit invalidates previous binding state.
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    def _mods(self):
+        return [s.module for s in self._stages]
+
+    # -- introspection --------------------------------------------------
     @property
     def data_names(self):
-        if self._modules:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if self._modules:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -60,16 +83,17 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # -- parameters -----------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params, aux_params = {}, {}
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for m in self._mods():
+            a, x = m.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -77,68 +101,76 @@ class SequentialModule(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            # each sub-module consumes only its own subset of the combined
-            # dict, so the others' names are always "extra" from its view
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init, allow_extra=True)
-        if not allow_extra and (arg_params or aux_params):
-            known = set()
-            for module in self._modules:
-                known.update(module._arg_params or {})
-                known.update(module._aux_params or {})
-            extra = [n for n in (arg_params or {}) if n not in known]
-            extra += [n for n in (aux_params or {}) if n not in known]
-            if extra:
-                from ..base import MXNetError
-                raise MXNetError(
-                    "init_params got parameter(s) %s unknown to every "
-                    "sub-module (pass allow_extra=True to ignore)"
-                    % sorted(extra))
+        for m in self._mods():
+            # A name owned by stage j is "extra" from stage i's point of
+            # view, so per-stage allow_extra must be True; cross-stage
+            # unknown names are checked once below.
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=allow_missing,
+                          force_init=force_init, allow_extra=True)
+        if not allow_extra:
+            self._reject_unclaimed(arg_params, aux_params)
         self.params_initialized = True
 
+    def _reject_unclaimed(self, arg_params, aux_params):
+        """Raise if a provided param name belongs to no stage at all."""
+        if not (arg_params or aux_params):
+            return
+        claimed = set()
+        for m in self._mods():
+            claimed.update(m._arg_params or {})
+            claimed.update(m._aux_params or {})
+        orphans = sorted(n for src in (arg_params, aux_params)
+                         for n in (src or {}) if n not in claimed)
+        if orphans:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"init_params got parameter(s) {orphans} unknown to every "
+                f"sub-module (pass allow_extra=True to ignore)")
+
+    # -- binding --------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
-        assert shared_module is None
+        if shared_module is not None:
+            raise ValueError("SequentialModule does not support shared_module")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self.binded = True
         self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for new_name, (_, shape)
-                                  in zip(data_names,
-                                         [tuple(d) for d in my_data_shapes])]
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-        if not anybody_ever_needs_label:
+        feed = data_shapes
+        label_used = False
+        for idx, stage in enumerate(self._stages):
+            if stage.auto_wire:
+                feed = self._rewire(stage.module.data_names, feed)
+            stage.module.bind(
+                data_shapes=feed,
+                label_shapes=label_shapes if stage.takes_labels else None,
+                for_training=for_training,
+                # interior stages need input grads to continue backprop even
+                # when the caller doesn't ask for grads w.r.t. the data
+                inputs_need_grad=inputs_need_grad or (for_training and idx > 0),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            label_used = label_used or stage.takes_labels
+            feed = stage.module.output_shapes
+        if not label_used:
             self._label_shapes = None
+        self.binded = True
 
+    @staticmethod
+    def _rewire(names, shapes):
+        """Rename upstream output descs to this stage's declared input names."""
+        if len(names) != len(shapes):
+            raise ValueError(
+                f"auto_wiring: stage declares {len(names)} inputs but "
+                f"upstream produces {len(shapes)} outputs")
+        return [(name, tuple(desc)[1]) for name, desc in zip(names, shapes)]
+
+    # -- optimizer ------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -146,59 +178,60 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for m in self._mods():
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
         self.optimizer_initialized = True
 
+    # -- execution ------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io.io import DataBatch
-        data_batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                               pad=data_batch.pad, index=data_batch.index)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        # Work on a shallow copy: we mutate .data as activations flow through.
+        flowing = DataBatch(data=data_batch.data, label=data_batch.label,
+                            pad=data_batch.pad, index=data_batch.index)
+        last = len(self._stages) - 1
+        for idx, stage in enumerate(self._stages):
+            stage.module.forward(flowing, is_train=is_train)
+            if idx == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_batch.provide_data = [
-                    (x.name if hasattr(x, "name") else x[0], y.shape)
-                    for x, y in zip(module.output_shapes,
-                                    module.get_outputs())]
+            flowing.data = stage.module.get_outputs()
+            if hasattr(flowing, "provide_data"):
+                flowing.provide_data = [
+                    (getattr(desc, "name", desc[0]), out.shape)
+                    for desc, out in zip(stage.module.output_shapes,
+                                         flowing.data)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for idx in range(len(self._stages) - 1, -1, -1):
+            self._stages[idx].module.backward(out_grads=out_grads)
+            if idx:
+                out_grads = self._stages[idx].module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for m in self._mods():
+            m.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized \
             and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels, pre_sliced)
+        for stage in self._stages:
+            if stage.takes_labels:
+                stage.module.update_metric(eval_metric, labels, pre_sliced)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for m in self._mods():
+            m.install_monitor(mon)

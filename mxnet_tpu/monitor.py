@@ -1,17 +1,15 @@
-"""Monitor — tap intermediate outputs of bound executors for debugging.
+"""Monitor — sample statistics of intermediate tensors in bound executors.
 
-Reference parity: python/mxnet/monitor.py:33 (Monitor installs a callback
-via executor.set_monitor_callback; graph_executor.cc SetMonitorCallback
-fires it with each op's output). TPU-native: the executor compiles the
-whole graph into one XLA program, so intermediates normally never
-materialize. With the default statistic the taps STREAM from inside that
-one program: the stat (mean |x|) is computed on-device per tap and only
-the scalar crosses to the host via ``jax.debug.callback`` — a monitored
-batch costs about one plain step plus the stats (the analog of the
-reference engine streaming callbacks from in-flight execution; timed in
-tests/test_monitor_stream.py). A custom host-side ``stat_func`` falls
-back to the "tapped" mode: a second jitted program returning every
-intermediate (~2x step cost on monitored batches).
+Behavioral parity: python/mxnet/monitor.py:33 (install via
+``executor.set_monitor_callback``; ``tic()``/``toc_print()`` around a batch).
+TPU-native design: the executor compiles the whole graph into ONE XLA
+program, so intermediates normally never materialise.  With the default
+statistic the taps STREAM from inside that program — the stat (mean |x|) is
+computed on-device per tap and only the scalar crosses to the host via
+``jax.debug.callback``; a monitored batch costs about one plain step plus
+the stats (timed bound in tests/test_monitor_stream.py).  A custom
+host-side ``stat_func`` falls back to "tapped" mode: a second jitted
+program returning every intermediate (~2x step cost on monitored batches).
 """
 from __future__ import annotations
 
@@ -22,115 +20,110 @@ from .ndarray.ndarray import NDArray
 __all__ = ["Monitor"]
 
 
+def _render_stat(value):
+    """Format one collected stat value (NDArray or list of them) the way the
+    reference prints: scalars bare, tensors via numpy repr, tab-joined."""
+    values = value if isinstance(value, list) else [value]
+    parts = []
+    for v in values:
+        if not isinstance(v, NDArray):
+            raise TypeError(f"monitor stat must be NDArray, got {type(v)}")
+        arr = v.asnumpy()
+        parts.append(str(arr.reshape(-1)[0]) if arr.size == 1 else str(arr))
+    return "\t".join(parts) + "\t"
+
+
 class Monitor:
-    """Collect statistics of intermediate outputs every ``interval``
-    batches (reference monitor.py Monitor).
+    """Collect per-tensor statistics every ``interval`` batches.
 
-    Monitored batches run an extra tapped forward program (~2x step
-    cost; see Executor.set_monitor_callback) — pick ``interval``
-    accordingly; batches the interval gate skips pay nothing.
-
-    Parameters
-    ----------
-    interval : int
-        Sample every ``interval`` calls to ``tic()``.
-    stat_func : callable(NDArray) -> NDArray, optional
-        Statistic to compute per tapped array; default mean(|x|)
-        (the reference's asum/size).
-    pattern : str
-        Regex on tap names; only matches are collected.
-    sort : bool
-        Sort the toc() result by name.
-    monitor_all : bool
-        Also tap op *inputs* (weights, data), not just op outputs.
+    Parameters mirror the reference: ``interval`` (sampling period in
+    ``tic()`` calls), ``stat_func`` (host statistic; None selects the
+    on-device streaming default of mean(|x|)), ``pattern`` (regex filter on
+    tap names), ``sort`` (order ``toc()`` output by name), ``monitor_all``
+    (also tap op inputs — weights and data — not just outputs).
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
                  monitor_all=False):
-        self._default_stat = stat_func is None
-        if stat_func is None:
-            def stat_func(x):
-                return x.abs().mean()
-        self.stat_func = stat_func
         self.interval = interval
+        self.stat_func = stat_func
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
         self.activated = False
         self.queue = []
         self.step = 0
         self.exes = []
-        self.re_pattern = re.compile(pattern)
-        self.sort = sort
-        self.monitor_all = monitor_all
+        # Executors consult this backref on the callback to skip launching
+        # the monitored program on batches the interval gate drops.
+        self._tap = self._make_tap(device_stat=stat_func is None)
+        self._tap._monitor = self
 
-        def stat_helper(name, array):
+    def _make_tap(self, device_stat):
+        """Build the (name, array) callback handed to executors.  In stream
+        mode the array already IS the on-device statistic; in tapped mode we
+        apply the host stat_func here."""
+        def tap(name, array):
             if not self.activated or not self.re_pattern.match(name):
                 return
             if not isinstance(array, NDArray):
                 array = NDArray(array)
-            self.queue.append((self.step, name, self.stat_func(array)))
-
-        def stream_helper(name, array):
-            # stream mode: the statistic was already computed on-device
-            # inside the compiled step; the tap IS the stat
-            if not self.activated or not self.re_pattern.match(name):
-                return
-            if not isinstance(array, NDArray):
-                array = NDArray(array)
-            self.queue.append((self.step, name, array))
-
-        # the executor consults this backref to skip the monitored-program
-        # launch on batches the interval gate would drop anyway
-        stat_helper._monitor = self
-        stream_helper._monitor = self
-        self.stat_helper = stat_helper
-        self.stream_helper = stream_helper
+            stat = array if device_stat else self.stat_func(array)
+            self.queue.append((self.step, name, stat))
+        return tap
 
     def install(self, exe):
-        """Attach this monitor to an executor. With the default statistic
-        the stat runs on-device inside the one compiled step (stream
-        mode); a custom host ``stat_func`` uses the tapped fallback."""
-        if self._default_stat:
+        """Attach to an executor.  Default statistic → stream mode (stat
+        computed inside the compiled step); custom ``stat_func`` → tapped
+        fallback."""
+        if self.stat_func is None:
             from .executor import DEFAULT_STREAM_STAT
-            exe.set_monitor_callback(
-                self.stream_helper, self.monitor_all, mode="stream",
-                stat_fn=DEFAULT_STREAM_STAT)
+            exe.set_monitor_callback(self._tap, self.monitor_all,
+                                     mode="stream",
+                                     stat_fn=DEFAULT_STREAM_STAT)
         else:
-            exe.set_monitor_callback(self.stat_helper, self.monitor_all,
+            exe.set_monitor_callback(self._tap, self.monitor_all,
                                      mode="tapped")
         self.exes.append(exe)
 
+    # Back-compat aliases for the reference's two callback attributes
+    # (settable: tests wrap the callback to observe taps).
+    @property
+    def stat_helper(self):
+        return self._tap
+
+    @stat_helper.setter
+    def stat_helper(self, fn):
+        if not hasattr(fn, "_monitor"):
+            fn._monitor = self
+        self._tap = fn
+
+    @property
+    def stream_helper(self):
+        return self._tap
+
+    @stream_helper.setter
+    def stream_helper(self, fn):
+        self.stat_helper = fn
+
     def tic(self):
-        """Start collecting for this batch if the interval has elapsed."""
+        """Arm collection for this batch when the interval has elapsed."""
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
         self.step += 1
 
     def toc(self):
-        """End collection; returns [(step, name, stat_str)]."""
+        """Disarm and drain: returns [(step, name, stat_str)]."""
         if not self.activated:
             return []
         self.activated = False
-        res = []
-        queue = self.queue
-        if self.sort:
-            queue = sorted(queue, key=lambda x: x[1])
-        for n, k, v_list in queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
+        drained = sorted(self.queue, key=lambda rec: rec[1]) if self.sort \
+            else self.queue
         self.queue = []
-        return res
+        return [(step, name, _render_stat(val)) for step, name, val in drained]
 
     def toc_print(self):
-        """End collection and print the collected stats."""
-        res = self.toc()
-        for n, k, v in res:
-            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        """Disarm, drain, and print one line per collected stat."""
+        for step, name, text in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {text}")

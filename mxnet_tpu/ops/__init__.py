@@ -1,23 +1,14 @@
 """Operator corpus — pure-JAX implementations behind the registry.
 
-Importing this package registers all ops (the analog of the reference's
-static NNVM_REGISTER_OP initializers across src/operator/)."""
+Importing this package registers every op family (the analog of the
+reference's static NNVM_REGISTER_OP initializers across src/operator/).
+"""
 from . import registry
-from .registry import register, get_op, list_ops, OpDef
-
-from . import elemwise      # noqa: F401
-from . import tensor        # noqa: F401
-from . import nn            # noqa: F401
-from . import optimizer_ops  # noqa: F401
-from . import random_ops    # noqa: F401
-from . import rnn           # noqa: F401
-from . import custom        # noqa: F401
-from . import contrib_ops   # noqa: F401
-from . import quantization_ops  # noqa: F401
-from . import extra         # noqa: F401
-from . import tail_ops      # noqa: F401
-from . import rcnn          # noqa: F401
-from . import fused         # noqa: F401
-from . import shape_rules   # noqa: F401
+from .registry import OpDef, get_op, list_ops, register
+# Each family module self-registers on import; order only matters for the
+# few families that extend earlier ones (fused/shape_rules go last).
+from . import (elemwise, tensor, nn, optimizer_ops, random_ops, rnn,  # noqa: F401
+               custom, contrib_ops, quantization_ops, extra, tail_ops,
+               rcnn, fused, shape_rules)
 
 __all__ = ["registry", "register", "get_op", "list_ops", "OpDef"]

@@ -29,7 +29,18 @@ def _defbinary(name, fn, aliases=()):
 _defbinary("broadcast_add", jnp.add, aliases=("broadcast_plus", "elemwise_add", "_add", "_plus", "_Plus"))
 _defbinary("broadcast_sub", jnp.subtract, aliases=("broadcast_minus", "elemwise_sub", "_sub", "_minus", "_Minus"))
 _defbinary("broadcast_mul", jnp.multiply, aliases=("elemwise_mul", "_mul", "_Mul"))
-_defbinary("broadcast_div", jnp.divide, aliases=("elemwise_div", "_div", "_Div"))
+def _ref_div(a, b):
+    """Reference division semantics: integer inputs keep the integer dtype
+    with C-style truncation (mshadow's `/` lowers to C `/`); floats divide
+    normally. jnp.divide alone would promote ints to float."""
+    out_dtype = jnp.result_type(a, b)
+    q = jnp.divide(a, b)
+    if jnp.issubdtype(out_dtype, jnp.integer):
+        return jnp.trunc(q).astype(out_dtype)
+    return q
+
+
+_defbinary("broadcast_div", _ref_div, aliases=("elemwise_div", "_div", "_Div"))
 _defbinary("broadcast_mod", jnp.mod, aliases=("_mod",))
 _defbinary("broadcast_power", lambda a, b: jnp.power(a, b), aliases=("_power", "_Power", "pow"))
 _defbinary("broadcast_maximum", jnp.maximum, aliases=("_maximum", "maximum"))
@@ -70,7 +81,7 @@ def _defscalar(name, fwd, rev=None, aliases=()):
 _defscalar("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
 _defscalar("_minus_scalar", jnp.subtract, jnp.subtract, aliases=("_MinusScalar",))
 _defscalar("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
-_defscalar("_div_scalar", jnp.divide, jnp.divide, aliases=("_DivScalar",))
+_defscalar("_div_scalar", _ref_div, _ref_div, aliases=("_DivScalar",))
 _defscalar("_mod_scalar", jnp.mod, jnp.mod, aliases=("_ModScalar",))
 _defscalar("_power_scalar", jnp.power, jnp.power, aliases=("_PowerScalar",))
 
@@ -87,7 +98,7 @@ def _defrscalar(name, fn, aliases=()):
 
 
 _defrscalar("_rminus_scalar", jnp.subtract, aliases=("_RMinusScalar",))
-_defrscalar("_rdiv_scalar", jnp.divide, aliases=("_RDivScalar",))
+_defrscalar("_rdiv_scalar", _ref_div, aliases=("_RDivScalar",))
 _defrscalar("_rmod_scalar", jnp.mod, aliases=("_RModScalar",))
 _defrscalar("_rpower_scalar", jnp.power, aliases=("_RPowerScalar",))
 _defscalar("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))

@@ -144,6 +144,24 @@ def rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
     ctx = current_op_context()
     ndir = 2 if bidirectional else 1
     input_size = data.shape[2]
+    # Batch-1 initial states broadcast to the data batch (the symbolic
+    # cell zoo's default begin_state emits (L*D, 1, H) zeros; cuDNN-parity
+    # callers pass the full batch). ONLY the batch axis broadcasts —
+    # wrong layer/direction/hidden axes must still raise.
+    full = (num_layers * ndir, data.shape[1], state_size)
+
+    def _fit_state(s_, what):
+        if s_.shape == full:
+            return s_
+        if s_.shape == (full[0], 1, full[2]):
+            return jnp.broadcast_to(s_, full)
+        raise ValueError(
+            f"RNN {what} has shape {s_.shape}; expected {full} "
+            f"or ({full[0]}, 1, {full[2]})")
+
+    state = _fit_state(state, "state")
+    if state_cell is not None:
+        state_cell = _fit_state(state_cell, "state_cell")
     ws, bs = _unpack_params(parameters, num_layers, input_size, state_size,
                             bidirectional, mode)
     x = data

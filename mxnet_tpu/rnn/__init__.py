@@ -1,11 +1,19 @@
-"""mx.rnn — symbol-side RNN utilities (reference python/mxnet/rnn/).
+"""mx.rnn — symbol-side RNN toolkit (reference python/mxnet/rnn/).
 
-The reference package carries symbol RNN cells plus BucketSentenceIter.
-Cells live in ``mx.gluon.rnn`` here (the imperative-first home); the
-symbol path uses the fused ``sym.RNN`` op directly (ops/rnn.py — one
-lax.scan per graph, the cuDNN-RNN analog). This package provides the
-data-side parity surface: BucketSentenceIter and encode_sentences.
+Cells: step-composable symbolic cells + combinators (rnn_cell.py), the
+fused ``FusedRNNCell`` over ``sym.RNN`` (one lax.scan XLA while-loop),
+and the pack/unpack weight bridge between the two.  Data: the bucketing
+sentence iterator.  Checkpoints: per-gate save/load helpers (rnn.py).
 """
 from .io import BucketSentenceIter, encode_sentences
+from .rnn import do_rnn_checkpoint, load_rnn_checkpoint, save_rnn_checkpoint
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
+                       ZoneoutCell)
 
-__all__ = ["BucketSentenceIter", "encode_sentences"]
+__all__ = ["BucketSentenceIter", "encode_sentences",
+           "save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint",
+           "RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]

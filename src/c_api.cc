@@ -526,4 +526,298 @@ int MXKVStoreBarrier(KVStoreHandle kv) {
   return 0;
 }
 
+
+// ---------------------------------------------------------------------
+// Atom-level symbol composition (reference c_api.h:1111)
+// ---------------------------------------------------------------------
+
+namespace {
+
+// process-global cache for creator/iterator name listings
+int GlobalListNames(const char *impl_fn, mx_uint *out_size,
+                    const char ***out_names) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *lst = CallImpl(impl_fn, nullptr);
+  if (lst == nullptr) return -1;
+  NameList nl;
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    if (s == nullptr) {
+      Py_DECREF(lst);
+      SetPyError(impl_fn);
+      return -1;
+    }
+    nl.strings.emplace_back(s);
+  }
+  Py_DECREF(lst);
+  for (const auto &s : nl.strings) nl.ptrs.push_back(s.c_str());
+  auto &slot = (*NameCache())[const_cast<char *>(impl_fn)];
+  slot = std::move(nl);
+  *out_size = static_cast<mx_uint>(slot.ptrs.size());
+  *out_names = slot.ptrs.data();
+  return 0;
+}
+
+// num_param (keys, vals) C arrays -> two PyLists (new refs)
+int StringPairs(mx_uint num, const char **keys, const char **vals,
+                PyObject **out_keys, PyObject **out_vals) {
+  PyObject *pk = PyList_New(num);
+  PyObject *pv = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject *k = PyUnicode_FromString(keys ? keys[i] : "");
+    PyObject *v = PyUnicode_FromString(vals ? vals[i] : "");
+    if (k == nullptr || v == nullptr) {
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      Py_DECREF(pk);
+      Py_DECREF(pv);
+      SetPyError("attr strings");
+      return -1;
+    }
+    PyList_SetItem(pk, i, k);
+    PyList_SetItem(pv, i, v);
+  }
+  *out_keys = pk;
+  *out_vals = pv;
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     const char ***out_names) {
+  return GlobalListNames("list_atomic_symbol_creators", out_size, out_names);
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *pk = nullptr, *pv = nullptr;
+  if (StringPairs(num_param, keys, vals, &pk, &pv) != 0) return -1;
+  PyObject *atom = CallImpl("create_atomic_symbol",
+                            Py_BuildValue("(sNN)", op_name, pk, pv));
+  if (atom == nullptr) return -1;
+  *out = atom;
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *var = CallImpl("create_variable", Py_BuildValue("(s)", name));
+  if (var == nullptr) return -1;
+  *out = var;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *pk = PyList_New(0);
+  if (keys != nullptr) {
+    Py_DECREF(pk);
+    pk = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i) {
+      PyObject *k = PyUnicode_FromString(keys[i]);
+      if (k == nullptr) {
+        Py_DECREF(pk);
+        SetPyError("MXSymbolCompose keys");
+        return -1;
+      }
+      PyList_SetItem(pk, i, k);
+    }
+  }
+  PyObject *pa = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *a = static_cast<PyObject *>(args[i]);
+    Py_INCREF(a);
+    PyList_SetItem(pa, i, a);
+  }
+  PyObject *r = CallImpl("symbol_compose",
+                         Py_BuildValue("(OsNN)", sym, name ? name : "",
+                                       pk, pa));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Autograd (reference c_api.h:963)
+// ---------------------------------------------------------------------
+
+namespace {
+
+int SetAutogradFlag(const char *impl_fn, int flag, int *prev) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl(impl_fn, Py_BuildValue("(i)", flag));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+PyObject *HandleList(mx_uint num, NDArrayHandle *handles) {
+  // a NULL entry maps to Python None (the reference allows per-output
+  // NULL head-grads meaning "default ones for this output")
+  PyObject *lst = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject *o = handles[i] == nullptr
+        ? Py_None : static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+}  // namespace
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return SetAutogradFlag("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return SetAutogradFlag("autograd_set_training", is_training, prev);
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs,
+                            NDArrayHandle *grad_handles) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *vars = HandleList(num_var, var_handles);
+  PyObject *grads = HandleList(num_var, grad_handles);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromLong(grad_reqs[i]));
+  PyObject *r = CallImpl("autograd_mark_variables",
+                         Py_BuildValue("(NNN)", vars, reqs, grads));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int train_mode) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *outs = HandleList(num_output, output_handles);
+  PyObject *ograds = ograd_handles == nullptr
+      ? PyList_New(0) : HandleList(num_output, ograd_handles);
+  PyObject *r = CallImpl("autograd_backward",
+                         Py_BuildValue("(NNii)", outs, ograds, retain_graph,
+                                       train_mode));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *g = CallImpl("ndarray_get_grad", Py_BuildValue("(O)", handle));
+  if (g == nullptr) return -1;
+  *out = g;
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Data iterators (reference MXDataIter*)
+// ---------------------------------------------------------------------
+
+int MXListDataIters(mx_uint *out_size, const char ***out_names) {
+  return GlobalListNames("list_data_iters", out_size, out_names);
+}
+
+int MXDataIterCreateIter(const char *iter_name, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *pk = nullptr, *pv = nullptr;
+  if (StringPairs(num_param, keys, vals, &pk, &pv) != 0) return -1;
+  PyObject *it = CallImpl("create_data_iter",
+                          Py_BuildValue("(sNN)", iter_name, pk, pv));
+  if (it == nullptr) return -1;
+  *out = it;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle it) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Py_DECREF(static_cast<PyObject *>(it));
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle it, int *out, DataBatchHandle *out_batch) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *b = CallImpl("data_iter_next", Py_BuildValue("(O)", it));
+  if (b == nullptr) return -1;
+  if (b == Py_None) {
+    Py_DECREF(b);
+    *out = 0;
+    if (out_batch != nullptr) *out_batch = nullptr;
+    return 0;
+  }
+  *out = 1;
+  *out_batch = b;
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle it) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *r = CallImpl("data_iter_reset", Py_BuildValue("(O)", it));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+int BatchField(const char *impl_fn, DataBatchHandle batch,
+               NDArrayHandle *out) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *a = CallImpl(impl_fn, Py_BuildValue("(O)", batch));
+  if (a == nullptr) return -1;
+  *out = a;
+  return 0;
+}
+
+}  // namespace
+
+int MXDataIterGetData(DataBatchHandle batch, NDArrayHandle *out) {
+  return BatchField("data_iter_get_data", batch, out);
+}
+
+int MXDataIterGetLabel(DataBatchHandle batch, NDArrayHandle *out) {
+  return BatchField("data_iter_get_label", batch, out);
+}
+
+int MXDataIterGetPadNum(DataBatchHandle batch, int *pad) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  PyObject *p = CallImpl("data_iter_get_pad", Py_BuildValue("(O)", batch));
+  if (p == nullptr) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(p));
+  Py_DECREF(p);
+  return 0;
+}
+
+int MXDataBatchFree(DataBatchHandle batch) {
+  if (!EnsurePython()) return -1;
+  GILGuard gil;
+  Py_DECREF(static_cast<PyObject *>(batch));
+  return 0;
+}
+
 }  // extern "C"

@@ -66,3 +66,22 @@ def test_c_kvstore_demo(tmp_path):
                        env=predict_subprocess_env(), timeout=300)
     assert r.returncode == 0, "stdout:%s\nstderr:%s" % (r.stdout, r.stderr)
     assert "c_kvstore_demo OK" in r.stdout
+
+
+@pytest.fixture(scope="module")
+def autograd_demo_exe(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("c_autograd")
+    return compile_against_predict_lib(
+        [os.path.join(ROOT, "tests", "c_autograd_mlp_demo.c")],
+        str(tmp / "c_autograd_mlp_demo"), lang="c")
+
+
+def test_c_autograd_compose_dataiter_demo(autograd_demo_exe):
+    """Round-5 C legs: atom-level compose, C autograd, C data iterator,
+    error paths (reference c_api.h:963,1111; MXDataIter*)."""
+    r = subprocess.run([autograd_demo_exe], capture_output=True, text=True,
+                       timeout=900, env=predict_subprocess_env())
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "compose OK" in r.stdout
+    assert "error paths OK" in r.stdout
+    assert "c_autograd_mlp_demo OK" in r.stdout

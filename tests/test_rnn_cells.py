@@ -1,0 +1,234 @@
+"""Symbolic RNN cell zoo (mx.rnn.*Cell) — reference parity.
+
+Covers: per-cell math vs a numpy recurrence oracle, pack/unpack weight
+round-trips, FusedRNNCell <-> unfuse() numerical equivalence through the
+packed-vector bridge (reference rnn_cell.py:600-747), combinator cells,
+and checkpoint helpers (reference rnn/rnn.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _bind_forward(out_sym, arrays, batch=4):
+    """Bind an unrolled graph on cpu and run one forward."""
+    ex = out_sym.simple_bind(
+        ctx=mx.cpu(), grad_req="null",
+        **{k: v.shape for k, v in arrays.items()})
+    for k, v in arrays.items():
+        ex.arg_dict[k][:] = v
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def _rand_args(out_sym, data_shape, seed=0):
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = out_sym.infer_shape(data=data_shape)
+    names = out_sym.list_arguments()
+    return {n: mx.nd.array(rng.uniform(-0.4, 0.4, s).astype(np.float32))
+            for n, s in zip(names, shapes)}
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_rnn_cell_math_vs_numpy():
+    T, N, C, H = 3, 2, 5, 4
+    cell = mx.rnn.RNNCell(H, activation="tanh")
+    out, _ = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    args = _rand_args(out, (N, T, C))
+    got = _bind_forward(out, args)[0]
+
+    x = args["data"].asnumpy()
+    iW, iB = args["rnn_i2h_weight"].asnumpy(), args["rnn_i2h_bias"].asnumpy()
+    hW, hB = args["rnn_h2h_weight"].asnumpy(), args["rnn_h2h_bias"].asnumpy()
+    h = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x[:, t] @ iW.T + iB + h @ hW.T + hB)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_cell_math_vs_numpy():
+    T, N, C, H = 3, 2, 5, 4
+    cell = mx.rnn.LSTMCell(H)
+    out, _ = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    args = _rand_args(out, (N, T, C))
+    got = _bind_forward(out, args)[0]
+
+    x = args["data"].asnumpy()
+    iW, iB = args["lstm_i2h_weight"].asnumpy(), args["lstm_i2h_bias"].asnumpy()
+    hW, hB = args["lstm_h2h_weight"].asnumpy(), args["lstm_h2h_bias"].asnumpy()
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        g = x[:, t] @ iW.T + iB + h @ hW.T + hB
+        i, f, cand, o = [g[:, k * H:(k + 1) * H] for k in range(4)]
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(cand)
+        h = _sigmoid(o) * np.tanh(c)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_gru_cell_math_vs_numpy():
+    T, N, C, H = 3, 2, 5, 4
+    cell = mx.rnn.GRUCell(H)
+    out, _ = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    args = _rand_args(out, (N, T, C))
+    got = _bind_forward(out, args)[0]
+
+    x = args["data"].asnumpy()
+    iW, iB = args["gru_i2h_weight"].asnumpy(), args["gru_i2h_bias"].asnumpy()
+    hW, hB = args["gru_h2h_weight"].asnumpy(), args["gru_h2h_bias"].asnumpy()
+    h = np.zeros((N, H), np.float32)
+    want = []
+    for t in range(T):
+        gi = x[:, t] @ iW.T + iB
+        gh = h @ hW.T + hB
+        r = _sigmoid(gi[:, :H] + gh[:, :H])
+        z = _sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+        n = np.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+        h = (1 - z) * n + z * h
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("Cell", [mx.rnn.RNNCell, mx.rnn.LSTMCell,
+                                  mx.rnn.GRUCell])
+def test_pack_unpack_roundtrip(Cell):
+    H, C = 4, 5
+    cell = Cell(H)
+    out, _ = cell.unroll(2, sym.Variable("data"), merge_outputs=True)
+    args = _rand_args(out, (2, 2, C))
+    del args["data"]
+    unpacked = cell.unpack_weights(args)
+    # every gate gets its own entry
+    for gate in cell._gate_names:
+        assert f"{cell._prefix}i2h{gate}_weight" in unpacked
+    repacked = cell.pack_weights(unpacked)
+    assert sorted(repacked) == sorted(args)
+    for k in args:
+        np.testing.assert_array_equal(repacked[k].asnumpy(),
+                                      args[k].asnumpy())
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+def test_fused_vs_unfused(mode):
+    """unfuse() + unpack_weights reproduces the fused op's outputs."""
+    T, N, C, H, L = 4, 3, 6, 5, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode)
+    fout, _ = fused.unroll(T, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    fargs = _rand_args(fout, (N, T, C), seed=3)
+    fgot = _bind_forward(fout, fargs)[0]
+
+    stack = fused.unfuse()
+    sout, _ = stack.unroll(T, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    per_gate = fused.unpack_weights(fargs)
+    sargs = stack.pack_weights(per_gate)   # per-gate -> per-cell stacked
+    sgot = _bind_forward(sout, sargs)[0]
+    np.testing.assert_allclose(fgot, sgot, rtol=1e-4, atol=1e-4)
+
+    # and the weight bridge round-trips bit-exactly
+    repacked = fused.pack_weights(per_gate)
+    np.testing.assert_array_equal(
+        repacked[fused._parameter.name].asnumpy(),
+        fargs[fused._parameter.name].asnumpy())
+
+
+def test_fused_vs_unfused_bidirectional():
+    T, N, C, H = 3, 2, 4, 3
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                bidirectional=True)
+    fout, _ = fused.unroll(T, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    fargs = _rand_args(fout, (N, T, C), seed=5)
+    fgot = _bind_forward(fout, fargs)[0]
+
+    stack = fused.unfuse()
+    sout, _ = stack.unroll(T, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    sargs = stack.pack_weights(fused.unpack_weights(fargs))
+    sgot = _bind_forward(sout, sargs)[0]
+    np.testing.assert_allclose(fgot, sgot, rtol=1e-4, atol=1e-4)
+
+
+def test_sequential_and_residual_and_dropout():
+    T, N, C, H = 3, 2, 4, 4
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(H, prefix="l0_"))
+    stack.add(mx.rnn.DropoutCell(0.0, prefix="do_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(H, prefix="l1_")))
+    out, states = stack.unroll(T, sym.Variable("data"), merge_outputs=True)
+    args = _rand_args(out, (N, T, C), seed=7)
+    got = _bind_forward(out, args)[0]
+    assert got.shape == (N, T, H)
+    # residual: the l1 GRU's output is added to its input; with l1 weights
+    # zeroed the residual path must pass the LSTM output through untouched
+    zero = dict(args)
+    for k in args:
+        if k.startswith("l1_"):
+            zero[k] = mx.nd.zeros(args[k].shape)
+    got_zero = _bind_forward(out, zero)[0]
+    lstm_only, _ = mx.rnn.LSTMCell(H, prefix="l0_").unroll(
+        T, sym.Variable("data"), merge_outputs=True)
+    base = _bind_forward(lstm_only,
+                         {k: v for k, v in zero.items()
+                          if k == "data" or k.startswith("l0_")})[0]
+    np.testing.assert_allclose(got_zero, base, rtol=1e-5, atol=1e-5)
+
+
+def test_zoneout_smoke_and_modifier_guard():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4), zoneout_outputs=0.3,
+                              zoneout_states=0.2)
+    out, _ = cell.unroll(3, sym.Variable("data"), merge_outputs=True)
+    args = _rand_args(out, (2, 3, 4), seed=9)
+    got = _bind_forward(out, args)[0]  # eval mode: dropout inactive
+    assert got.shape == (2, 3, 4)
+    # the wrapped base cell must refuse direct begin_state
+    with pytest.raises(RuntimeError):
+        cell.base_cell.begin_state()
+
+
+def test_bidirectional_output_is_lr_concat():
+    T, N, C, H = 3, 2, 4, 3
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(H, prefix="f_"),
+                                  mx.rnn.RNNCell(H, prefix="b_"))
+    out, _ = bi.unroll(T, sym.Variable("data"), merge_outputs=True)
+    args = _rand_args(out, (N, T, C), seed=11)
+    got = _bind_forward(out, args)[0]
+    assert got.shape == (N, T, 2 * H)
+
+    fwd, _ = mx.rnn.RNNCell(H, prefix="f_").unroll(
+        T, sym.Variable("data"), merge_outputs=True)
+    fwd_got = _bind_forward(fwd, {k: v for k, v in args.items()
+                                  if k == "data" or k.startswith("f_")})[0]
+    np.testing.assert_allclose(got[:, :, :H], fwd_got, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_checkpoint_helpers(tmp_path):
+    H, C, T = 4, 5, 2
+    cell = mx.rnn.LSTMCell(H)
+    out, _ = cell.unroll(T, sym.Variable("data"), merge_outputs=True)
+    args = _rand_args(out, (2, T, C), seed=13)
+    arg_params = {k: v for k, v in args.items() if k != "data"}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, out, arg_params, {})
+    sym2, arg2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    assert sorted(arg2) == sorted(arg_params)
+    for k in arg_params:
+        np.testing.assert_array_equal(arg2[k].asnumpy(),
+                                      arg_params[k].asnumpy())

@@ -72,8 +72,16 @@ def _run_example(rel, *args, timeout=480):
 
 
 def test_lstm_bucketing_example():
+    # default path = the symbolic cell zoo (SequentialRNNCell of LSTMCells
+    # unrolled per bucket), matching the reference example's construction
     out = _run_example("example/rnn/lstm_bucketing.py",
                        "--num-epochs", "2", "--batch-size", "16")
+    assert "Train-perplexity" in out
+
+
+def test_lstm_bucketing_example_fused():
+    out = _run_example("example/rnn/lstm_bucketing.py",
+                       "--num-epochs", "2", "--batch-size", "16", "--fused")
     assert "Train-perplexity" in out
 
 

@@ -137,6 +137,12 @@ def launch_local(args):
     port = _free_port()
     server_port = (_free_port_range(args.num_servers)
                    if args.num_servers else port)
+    # one wire-auth secret per job: every frame on the parameter-server
+    # wire is HMAC-signed with it (kvstore_async.py), so a stray process
+    # that can reach the port cannot feed the server pickles
+    if args.num_servers and "MXTPU_PS_SECRET" not in os.environ:
+        import secrets as _secrets
+        os.environ["MXTPU_PS_SECRET"] = _secrets.token_hex(16)
     procs = []
     server_procs = []
     for srank in range(args.num_servers):
@@ -196,6 +202,15 @@ def launch_ssh(args):
     port = args.port or _free_port()
     server_port = port + 1000 if args.num_servers else port
     cwd = os.getcwd()
+    # per-job wire-auth secret (HMAC on every parameter-server frame;
+    # kvstore_async.py). Passed in the remote env line: visible to other
+    # users of the remote hosts via `ps` — acceptable on the same
+    # trusted-cluster assumption as the reference's ps-lite, while still
+    # shutting out off-host peers that can merely reach the open port.
+    ps_secret = os.environ.get("MXTPU_PS_SECRET")
+    if args.num_servers and not ps_secret:
+        import secrets as _secrets
+        ps_secret = _secrets.token_hex(16)
 
     def _ssh(host, env, command, stdin=None):
         envstr = " ".join("%s=%s" % (k, shlex.quote(v))
@@ -220,6 +235,8 @@ def launch_ssh(args):
                "DMLC_PS_ROOT_PORT": str(server_port),
                "DMLC_PS_BIND": "0.0.0.0",
                "MXTPU_SERVER_RANK": str(srank)}
+        if ps_secret:
+            env["MXTPU_PS_SECRET"] = ps_secret
         for kv in args.env:
             name, _, value = kv.partition("=")
             env[name] = value
@@ -247,6 +264,8 @@ def launch_ssh(args):
         if args.num_servers:
             env["MXTPU_COORDINATOR"] = "%s:%d" % (root_uri, port)
             env["DMLC_NUM_SERVER"] = str(args.num_servers)
+            if ps_secret:
+                env["MXTPU_PS_SECRET"] = ps_secret
         procs.append(_ssh(hosts[rank], env, args.command))
     rc = _wait_all(procs, daemons=server_procs)
     for p in server_procs:
