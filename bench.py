@@ -140,6 +140,27 @@ def _cost_flops(ts, flops_probe):
         return None
 
 
+def _flash_attention_flops(args):
+    """Analytic FLOPs of the Pallas flash-attention kernels per step —
+    XLA's cost analysis reports 0 for custom calls, so without this the
+    MFU numerator silently drops the attention matmuls when the fused
+    kernel is active (ops/nn.py _use_flash_attention). Counted causally
+    (half the S^2 blocks): forward = QK^T + PV = 2 matmuls, backward =
+    score recompute + dV + dP + dQ + dK = 5 matmuls.
+    """
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import _use_flash_attention
+    B, S = args.lm_batch, args.lm_seq
+    H, D = args.lm_heads, args.lm_d_model // args.lm_heads
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else \
+        jnp.dtype(args.dtype)
+    if not _use_flash_attention(S, D, dtype):
+        return 0.0  # XLA path: cost analysis already counts these
+    per_matmul = 2.0 * B * H * S * S * D
+    causal = 0.5
+    return args.lm_layers * (2 + 5) * per_matmul * causal
+
+
 def _fori_timed(ts, batches, iters, lr, warmup=1):
     """Time ``iters`` training steps as the DIFFERENCE between one
     (n0+iters)-step and one n0-step program, each a single launch with
@@ -372,6 +393,8 @@ def bench_transformer(args):
     dt = _fori_timed(ts, batches, args.iters, lr=0.01,
                      warmup=args.warmup)
     flops_per_step = _cost_flops(ts, probe)
+    if flops_per_step:
+        flops_per_step += _flash_attention_flops(args)
 
     tok_per_sec = B * S * args.iters / dt
     dev = jax.devices()[0]

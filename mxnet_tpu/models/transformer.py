@@ -48,12 +48,16 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
     for i in range(int(num_layers)):
         pre = "layer%d_" % i
         ln1 = sym.LayerNorm(data=x, name=pre + "ln1")
-        qkv = sym.FullyConnected(data=ln1, num_hidden=3 * d, flatten=False,
-                                 name=pre + "qkv")
-        att = sym.contrib.CausalSelfAttention(qkv, num_heads=int(num_heads),
-                                              name=pre + "attn")
-        proj = sym.FullyConnected(data=att, num_hidden=d, flatten=False,
-                                  name=pre + "proj")
+        # one fused sublayer op: qkv proj + causal MHA + out proj with
+        # head-major internal layout (no transposes); weight names keep
+        # the unfused FullyConnected convention so checkpoints interop
+        proj = sym.contrib.FusedCausalSelfAttention(
+            ln1,
+            sym.Variable(pre + "qkv_weight"),
+            sym.Variable(pre + "qkv_bias", init=_init.Zero()),
+            sym.Variable(pre + "proj_weight"),
+            sym.Variable(pre + "proj_bias", init=_init.Zero()),
+            num_heads=int(num_heads), name=pre + "attn")
         if lp > 0:
             proj = sym.Dropout(data=proj, p=lp, name=pre + "drop1")
         x = x + proj
@@ -75,7 +79,8 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
         else:
             h = sym.FullyConnected(data=ln2, num_hidden=ffn,
                                    flatten=False, name=pre + "ffn_up")
-            h = sym.LeakyReLU(data=h, act_type="gelu", name=pre + "gelu")
+            h = sym.LeakyReLU(data=h, act_type="gelu_tanh",
+                              name=pre + "gelu")
             h = sym.FullyConnected(data=h, num_hidden=d, flatten=False,
                                    name=pre + "ffn_down")
         if lp > 0:

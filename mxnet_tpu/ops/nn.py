@@ -427,6 +427,10 @@ def leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25,
         return lam * jnp.where(data > 0, data, alpha * jnp.expm1(data))
     if act_type == "gelu":
         return jax.nn.gelu(data, approximate=False)
+    if act_type == "gelu_tanh":
+        # tanh-approximated GELU (GPT-2 convention) — extension beyond the
+        # reference's erf GELU; polynomial VPU math, no erf transcendental
+        return jax.nn.gelu(data, approximate=True)
     if act_type == "rrelu":
         ctx = current_op_context()
         if ctx.is_train:
@@ -599,6 +603,46 @@ def softmax_activation(data, *, mode="instance"):
 # attention entirely, SURVEY.md §5.7; sequence-parallel forms live in
 # parallel/ring_attention.py)
 # ----------------------------------------------------------------------
+def _use_flash_attention(seq_len, head_dim, dtype):
+    """Select the fused Pallas flash kernel.  MXNET_ATTN_IMPL:
+    ``auto`` (default) = flash when the backend/geometry supports it,
+    ``xla`` = force the materialized-softmax path (A/B runs),
+    ``flash`` = require the kernel — raise instead of silently measuring
+    the wrong path when it cannot run."""
+    import os
+    impl = os.environ.get("MXNET_ATTN_IMPL", "auto")
+    if impl == "xla":
+        return False
+    if impl not in ("auto", "flash"):
+        raise ValueError(f"MXNET_ATTN_IMPL={impl}; use auto|flash|xla")
+    supported = (jax.default_backend() == "tpu" and head_dim % 128 == 0
+                 and seq_len % 512 == 0
+                 and dtype in (jnp.bfloat16, jnp.float32))
+    if impl == "flash" and not supported:
+        raise ValueError(
+            f"MXNET_ATTN_IMPL=flash but the kernel cannot run here "
+            f"(backend={jax.default_backend()}, head_dim={head_dim}, "
+            f"seq={seq_len}, dtype={dtype}); need TPU, head_dim%128==0, "
+            f"seq%512==0, bf16/f32")
+    return supported
+
+
+def _flash_attention(q, k, v, sm_scale):
+    """Invoke the Pallas flash kernel on head-major (B, H, S, D) inputs
+    with the 512x512 block geometry measured fastest on v5e at S1024/D128
+    (docs/PERF.md round 5 — the library defaults measure SLOWER than the
+    XLA path)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes as _BlockSizes, flash_attention as _flash)
+    blk = 512  # geometry gate guarantees S % 512 == 0
+    bs = _BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_k_dkv=blk, block_q_dkv=blk,
+        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+    return _flash(q, k, v, causal=True, sm_scale=sm_scale, block_sizes=bs)
+
+
 @register("_contrib_CausalSelfAttention", aliases=("CausalSelfAttention",))
 def causal_self_attention(qkv, *, num_heads, scale=None):
     """Fused causal multi-head self-attention over a packed QKV tensor:
@@ -618,6 +662,17 @@ def causal_self_attention(qkv, *, num_heads, scale=None):
     D = d // H
     sc = (1.0 / D ** 0.5) if scale is None else float(scale)
 
+    if _use_flash_attention(S, D, qkv.dtype):
+        # Pallas flash kernel: QK^T -> online softmax -> PV in ONE kernel,
+        # blocks resident in VMEM — the (S, S) score tensor never touches
+        # HBM in forward OR backward (the kernel brings its own
+        # recomputing VJP, so no jax.checkpoint wrapper here; wrapping
+        # would re-pay the whole kernel a third time).
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        o = _flash_attention(to_heads(q), to_heads(k), to_heads(v), sc)
+        return o.transpose(0, 2, 1, 3).reshape(B, S, d)
+
     @jax.checkpoint
     def attn(qkv):
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -632,6 +687,51 @@ def causal_self_attention(qkv, *, num_heads, scale=None):
         return o.reshape(B, S, d)
 
     return attn(qkv)
+
+
+@register("_contrib_FusedCausalSelfAttention",
+          aliases=("FusedCausalSelfAttention",))
+def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
+                                proj_bias, *, num_heads, scale=None):
+    """Whole attention sublayer in one op: QKV projection -> causal MHA ->
+    output projection, (B, S, d) -> (B, S, d).
+
+    TPU-first layout trick: the projections are dot_generals that emit /
+    consume the HEAD-MAJOR (B, H, S, D) layout directly, so no transpose
+    ever materialises between the matmuls and the fused Pallas flash
+    kernel (a separate (B,S,H,D)->(B,H,S,D) copy costs ~0.5 ms/layer
+    fwd+bwd at d2048/S1024 on v5e — measured in docs/PERF.md).  Weight
+    layouts match the reference FullyConnected convention ((3d, d) /
+    (d, d) row-major), so checkpoints from the unfused pair load
+    unchanged.
+    """
+    B, S, d = data.shape
+    H = int(num_heads)
+    if d % H:
+        raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
+    D = d // H
+    sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+
+    Wqkv = qkv_weight.reshape(3, H, D, d)
+    bqkv = qkv_bias.reshape(3, H, 1, D)
+    q = jnp.einsum("bsd,hed->bhse", data, Wqkv[0]) + bqkv[0]
+    k = jnp.einsum("bsd,hed->bhse", data, Wqkv[1]) + bqkv[1]
+    v = jnp.einsum("bsd,hed->bhse", data, Wqkv[2]) + bqkv[2]
+
+    if _use_flash_attention(S, D, data.dtype):
+        o = _flash_attention(q, k, v, sc)
+    else:
+        @jax.checkpoint
+        def attn(q, k, v):
+            s = jnp.einsum("bhqe,bhke->bhqk", q, k) * sc
+            mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+            s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bhke->bhqe", p, v)
+        o = attn(q, k, v)
+
+    return jnp.einsum("bhse,dhe->bsd", o,
+                      proj_weight.reshape(d, H, D)) + proj_bias
 
 
 @register("_contrib_SwitchMoE", aliases=("SwitchMoE",), num_outputs=2,

@@ -111,6 +111,18 @@ def _switch_moe_shapes(known, attrs):
 _set("_contrib_SwitchMoE", _switch_moe_shapes)
 
 
+def _fused_attn_shapes(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return {}
+    d = int(data[-1])
+    return {"qkv_weight": (3 * d, d), "qkv_bias": (3 * d,),
+            "proj_weight": (d, d), "proj_bias": (d,)}
+
+
+_set("_contrib_FusedCausalSelfAttention", _fused_attn_shapes)
+
+
 def _ln_shapes(known, attrs):
     data = known.get("data")
     if data is None:
