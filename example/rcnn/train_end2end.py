@@ -1,0 +1,492 @@
+#!/usr/bin/env python
+"""Faster-RCNN approximate-joint training, end to end, on synthetic
+COCO-shaped scenes — the full reference recipe at miniature scale
+(behavioral parity: example/rcnn/train_end2end.py + rcnn/core's
+AnchorTargetLayer / proposal_target):
+
+* anchor targets: IoU matching (positive >= 0.6 or argmax per gt,
+  negative < 0.3, rest ignored), balanced sampling, and SmoothL1 bbox
+  delta regression with inside-weights;
+* proposals: the in-graph `_contrib_Proposal` op (fixed-shape NMS riding
+  inside the jitted program) exposed as an output; the host-side
+  proposal_target then APPENDS THE GROUND-TRUTH BOXES (the reference's
+  crucial trick — without it early training shows the ROI head almost
+  no foreground and it collapses to background), samples a balanced
+  fg/bg ROI batch, and feeds the sampled rois back through a variable
+  into ROIPooling;
+* two heads: RPN (objectness + deltas) and per-ROI (K+1-way class +
+  per-class deltas), trained jointly each step (the reference's
+  approximate-joint schedule: proposals treated as fixed inputs to the
+  ROI head within a step);
+* metric: AP@0.5 on a held-out set (decode deltas -> NMS -> greedy
+  match), printed as a curve for docs/CONVERGENCE.md.
+
+Scenes: 1-3 objects of 2 classes (bright squares / dark disks) on
+noise, boxes in (x1, y1, x2, y2) like COCO after conversion.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python \
+        example/rcnn/train_end2end.py --num-iter 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+IMG = 64
+STRIDE = 8
+FEAT = IMG // STRIDE
+SCALES = (2, 4)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+POST_NMS = 16
+NUM_FG_CLASSES = 2          # squares, disks
+NUM_CLASSES = NUM_FG_CLASSES + 1
+ROI_BATCH = POST_NMS        # rois sampled per image
+RPN_BATCH = 32              # anchors sampled per image
+FG_FRACTION = 0.5
+
+
+# ----------------------------------------------------------------------
+# geometry helpers (the reference's bbox_transform / generate_anchors)
+# ----------------------------------------------------------------------
+def base_anchors():
+    from mxnet_tpu.ops.rcnn import _generate_anchors
+    return _generate_anchors(STRIDE, list(RATIOS), list(SCALES))
+
+
+def all_anchors():
+    """(A*F*F, 4) anchors over the stride grid, x1y1x2y2."""
+    base = base_anchors()                       # (A, 4)
+    shift_x = np.arange(FEAT) * STRIDE
+    shift_y = np.arange(FEAT) * STRIDE
+    sx, sy = np.meshgrid(shift_x, shift_y)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
+    anchors = (base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+    return anchors.astype(np.float32)           # (F*F*A, 4), cell-major
+
+
+def iou_matrix(boxes, gts):
+    """(N, G) IoU."""
+    N, G = len(boxes), len(gts)
+    if G == 0:
+        return np.zeros((N, 0), np.float32)
+    x1 = np.maximum(boxes[:, None, 0], gts[None, :, 0])
+    y1 = np.maximum(boxes[:, None, 1], gts[None, :, 1])
+    x2 = np.minimum(boxes[:, None, 2], gts[None, :, 2])
+    y2 = np.minimum(boxes[:, None, 3], gts[None, :, 3])
+    iw = np.clip(x2 - x1 + 1, 0, None)
+    ih = np.clip(y2 - y1 + 1, 0, None)
+    inter = iw * ih
+    area_b = ((boxes[:, 2] - boxes[:, 0] + 1)
+              * (boxes[:, 3] - boxes[:, 1] + 1))[:, None]
+    area_g = ((gts[:, 2] - gts[:, 0] + 1)
+              * (gts[:, 3] - gts[:, 1] + 1))[None, :]
+    return (inter / np.clip(area_b + area_g - inter, 1e-6, None)) \
+        .astype(np.float32)
+
+
+def bbox_deltas(src, dst):
+    """Regression targets (dx, dy, dw, dh) from src boxes to dst boxes."""
+    sw = src[:, 2] - src[:, 0] + 1.0
+    sh = src[:, 3] - src[:, 1] + 1.0
+    scx = src[:, 0] + 0.5 * (sw - 1)
+    scy = src[:, 1] + 0.5 * (sh - 1)
+    dw_ = dst[:, 2] - dst[:, 0] + 1.0
+    dh_ = dst[:, 3] - dst[:, 1] + 1.0
+    dcx = dst[:, 0] + 0.5 * (dw_ - 1)
+    dcy = dst[:, 1] + 0.5 * (dh_ - 1)
+    return np.stack([(dcx - scx) / sw, (dcy - scy) / sh,
+                     np.log(dw_ / sw), np.log(dh_ / sh)], 1) \
+        .astype(np.float32)
+
+
+def decode_deltas(src, deltas):
+    sw = src[:, 2] - src[:, 0] + 1.0
+    sh = src[:, 3] - src[:, 1] + 1.0
+    scx = src[:, 0] + 0.5 * (sw - 1)
+    scy = src[:, 1] + 0.5 * (sh - 1)
+    cx = deltas[:, 0] * sw + scx
+    cy = deltas[:, 1] * sh + scy
+    w = np.exp(np.clip(deltas[:, 2], -4, 4)) * sw
+    h = np.exp(np.clip(deltas[:, 3], -4, 4)) * sh
+    return np.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                     cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], 1)
+
+
+# ----------------------------------------------------------------------
+# target layers (reference AnchorTargetLayer / proposal_target)
+# ----------------------------------------------------------------------
+def anchor_targets(anchors, gts, rng):
+    """Per-anchor (labels, bbox_targets, bbox_weights)."""
+    N = len(anchors)
+    labels = np.full(N, -1, np.float32)
+    targets = np.zeros((N, 4), np.float32)
+    weights = np.zeros((N, 4), np.float32)
+    if len(gts):
+        ious = iou_matrix(anchors, gts)
+        best_gt = ious.argmax(1)
+        best_iou = ious.max(1)
+        labels[best_iou < 0.3] = 0
+        labels[best_iou >= 0.6] = 1
+        # reference rule: every gt keeps its single best anchor positive
+        labels[ious.argmax(0)] = 1
+        pos = labels == 1
+        targets[pos] = bbox_deltas(anchors[pos], gts[best_gt[pos], :4])
+        weights[pos] = 1.0
+    else:
+        labels[:] = 0
+    # balanced subsample to RPN_BATCH (reference: disable the excess)
+    for cls, quota in ((1, int(RPN_BATCH * FG_FRACTION)), (0, RPN_BATCH)):
+        idx = np.flatnonzero(labels == cls)
+        keep = quota if cls == 1 else \
+            RPN_BATCH - min(int((labels == 1).sum()), quota)
+        if len(idx) > keep:
+            disable = rng.choice(idx, len(idx) - keep, replace=False)
+            labels[disable] = -1
+    return labels, targets, weights
+
+
+def proposal_targets(proposals, gts, gt_classes, rng):
+    """The reference proposal_target layer: append gt boxes to the
+    proposals, then sample a balanced ROI batch with labels and
+    per-class bbox-delta targets.  Returns exactly ROI_BATCH rois."""
+    cand = np.concatenate([proposals, gts], 0) if len(gts) else proposals
+    labels = np.zeros(len(cand), np.float32)
+    gt_idx = np.zeros(len(cand), np.int64)
+    if len(gts):
+        ious = iou_matrix(cand, gts)
+        gt_idx = ious.argmax(1)
+        best_iou = ious.max(1)
+        labels[best_iou >= 0.5] = \
+            gt_classes[gt_idx[best_iou >= 0.5]].astype(np.float32)
+    fg_idx = np.flatnonzero(labels > 0)
+    bg_idx = np.flatnonzero(labels == 0)
+    if not len(bg_idx):
+        # every candidate overlaps a gt (converged RPN on large objects):
+        # fall back to the lowest-IoU candidates as background, like the
+        # reference's guard against an empty bg pool
+        order = ious.max(1).argsort() if len(gts) else np.arange(len(cand))
+        bg_idx = order[: max(1, len(cand) // 4)]
+        labels[bg_idx] = 0
+    n_fg = min(len(fg_idx), int(ROI_BATCH * FG_FRACTION))
+    pick_fg = rng.choice(fg_idx, n_fg, replace=False) if n_fg else \
+        np.zeros(0, np.int64)
+    n_bg = ROI_BATCH - n_fg
+    pick_bg = rng.choice(bg_idx, n_bg, replace=len(bg_idx) < n_bg) \
+        if n_bg else np.zeros(0, np.int64)
+    keep = np.concatenate([pick_fg, pick_bg])
+    rois = cand[keep]
+    lab = labels[keep]
+    targets = np.zeros((ROI_BATCH, 4 * NUM_CLASSES), np.float32)
+    weights = np.zeros((ROI_BATCH, 4 * NUM_CLASSES), np.float32)
+    if len(gts):
+        deltas = bbox_deltas(rois, gts[gt_idx[keep], :4])
+        for row in np.flatnonzero(lab > 0):
+            cls = int(lab[row])
+            targets[row, 4 * cls:4 * cls + 4] = deltas[row]
+            weights[row, 4 * cls:4 * cls + 4] = 1.0
+    return rois, lab, targets, weights
+
+
+# ----------------------------------------------------------------------
+# network
+# ----------------------------------------------------------------------
+def build_net():
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    im_info = sym.Variable("im_info")
+    rpn_label = sym.Variable("rpn_label")              # (B, A*F*F)
+    rpn_bbox_target = sym.Variable("rpn_bbox_target")  # (B, 4A, F, F)
+    rpn_bbox_weight = sym.Variable("rpn_bbox_weight")
+    roi_label = sym.Variable("roi_label")              # (B*R,)
+    roi_bbox_target = sym.Variable("roi_bbox_target")  # (B*R, 4K)
+    roi_bbox_weight = sym.Variable("roi_bbox_weight")
+    rois_in = sym.Variable("rois_in")                  # (B*R, 5) sampled
+
+    body = data
+    for i, (nf, st) in enumerate([(8, 2), (16, 2), (32, 2)]):
+        body = sym.Convolution(body, kernel=(3, 3), stride=(st, st),
+                               pad=(1, 1), num_filter=nf, name=f"conv{i}")
+        body = sym.Activation(body, act_type="relu", name=f"relu{i}")
+
+    rpn = sym.Activation(
+        sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                        name="rpn_conv"),
+        act_type="relu", name="rpn_relu")
+    rpn_cls = sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A,
+                              name="rpn_cls_score")
+    rpn_bbox = sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A,
+                               name="rpn_bbox_pred")
+
+    # RPN objectness loss (ignore -1 = unsampled anchors)
+    rpn_cls_prob = sym.SoftmaxOutput(
+        sym.Reshape(rpn_cls, shape=(0, 2, -1), name="rpn_cls_resh"),
+        label=rpn_label, multi_output=True, use_ignore=True,
+        ignore_label=-1, normalization="valid", name="rpn_cls_prob")
+    # RPN bbox regression (SmoothL1 on inside-weighted deltas)
+    rpn_bbox_loss = sym.MakeLoss(
+        sym.sum(sym.smooth_l1(rpn_bbox_weight * (rpn_bbox -
+                                                 rpn_bbox_target),
+                              scalar=3.0), name="rpn_l1_sum")
+        / float(RPN_BATCH), name="rpn_bbox_loss", grad_scale=1.0)
+
+    rpn_prob = sym.Reshape(
+        sym.softmax(sym.Reshape(rpn_cls, shape=(0, 2, -1),
+                                name="rpn_prob_resh"), axis=1,
+                    name="rpn_prob_soft"),
+        shape=(0, 2 * A, FEAT, FEAT), name="rpn_prob_back")
+    rois = sym.contrib.Proposal(
+        rpn_prob, rpn_bbox, im_info, feature_stride=STRIDE,
+        scales=SCALES, ratios=RATIOS, rpn_pre_nms_top_n=32,
+        rpn_post_nms_top_n=POST_NMS, threshold=0.7, rpn_min_size=2,
+        name="proposal")
+
+    pooled = sym.ROIPooling(body, rois_in, pooled_size=(4, 4),
+                            spatial_scale=1.0 / STRIDE, name="roi_pool")
+    fc = sym.Activation(
+        sym.FullyConnected(sym.Flatten(pooled, name="roi_flat"),
+                           num_hidden=64, name="roi_fc"),
+        act_type="relu", name="roi_fc_relu")
+    cls_score = sym.FullyConnected(fc, num_hidden=NUM_CLASSES,
+                                   name="cls_score")
+    bbox_pred = sym.FullyConnected(fc, num_hidden=4 * NUM_CLASSES,
+                                   name="bbox_pred")
+    cls_prob = sym.SoftmaxOutput(cls_score, label=roi_label,
+                                 use_ignore=True, ignore_label=-1,
+                                 normalization="valid", name="cls_prob")
+    roi_bbox_loss = sym.MakeLoss(
+        sym.sum(sym.smooth_l1(roi_bbox_weight * (bbox_pred -
+                                                 roi_bbox_target),
+                              scalar=1.0), name="roi_l1_sum")
+        / float(ROI_BATCH), name="roi_bbox_loss", grad_scale=1.0)
+
+    rois_out = sym.BlockGrad(rois, name="rois_out")
+    bbox_out = sym.BlockGrad(bbox_pred, name="bbox_out")
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob,
+                      roi_bbox_loss, rois_out, bbox_out])
+
+
+# ----------------------------------------------------------------------
+# data + metric
+# ----------------------------------------------------------------------
+def make_scene(rng):
+    """One COCO-shaped scene: image + (G, 5) [x1 y1 x2 y2 class]."""
+    img = rng.rand(3, IMG, IMG).astype(np.float32) * 0.1
+    n_obj = rng.randint(1, 4)
+    gts = []
+    for _ in range(n_obj):
+        side = rng.randint(12, 26)
+        x1 = rng.randint(0, IMG - side)
+        y1 = rng.randint(0, IMG - side)
+        cls = rng.randint(1, NUM_FG_CLASSES + 1)
+        if cls == 1:      # bright square
+            img[:, y1:y1 + side, x1:x1 + side] += 0.9
+        else:             # dark disk
+            yy, xx = np.mgrid[0:side, 0:side]
+            r = side / 2.0
+            disk = ((yy - r + .5) ** 2 + (xx - r + .5) ** 2) <= r * r
+            img[:, y1:y1 + side, x1:x1 + side] -= 0.8 * disk
+        gts.append([x1, y1, x1 + side - 1, y1 + side - 1, cls])
+    return img, np.asarray(gts, np.float32)
+
+
+def nms(dets, thresh=0.4):
+    order = dets[:, 4].argsort()[::-1]
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        ious = iou_matrix(dets[order[1:], :4], dets[i:i + 1, :4])[:, 0]
+        order = order[1:][ious < thresh]
+    return dets[keep]
+
+
+def average_precision(all_dets, all_gts, iou_thr=0.5):
+    """AP@iou_thr over the eval set, classes pooled (micro)."""
+    records = []   # (score, is_tp)
+    n_gt = sum(len(g) for g in all_gts)
+    for dets, gts in zip(all_dets, all_gts):
+        used = np.zeros(len(gts), bool)
+        for det in dets[dets[:, 4].argsort()[::-1]]:
+            if not len(gts):
+                records.append((det[4], 0))
+                continue
+            ious = iou_matrix(det[None, :4], gts[:, :4])[0]
+            ious[used] = -1
+            cand = int(ious.argmax())
+            ok = (ious[cand] >= iou_thr
+                  and int(det[5]) == int(gts[cand, 4]))
+            if ok:
+                used[cand] = True
+            records.append((det[4], int(ok)))
+    if not records or n_gt == 0:
+        return 0.0
+    records.sort(key=lambda r: -r[0])
+    tp = np.cumsum([r[1] for r in records])
+    fp = np.cumsum([1 - r[1] for r in records])
+    recall = tp / n_gt
+    precision = tp / np.maximum(tp + fp, 1)
+    # 11-point interpolated AP (VOC-style)
+    return float(np.mean([precision[recall >= t].max()
+                          if (recall >= t).any() else 0.0
+                          for t in np.linspace(0, 1, 11)]))
+
+
+def detections_from(rois, bbox_deltas_pred, cls_probs, batch_size):
+    """Decode per-class deltas, NMS per image -> (x1 y1 x2 y2 score cls)."""
+    out = [[] for _ in range(batch_size)]
+    cls = cls_probs.argmax(1)
+    score = cls_probs.max(1)
+    for i, (b_idx, x1, y1, x2, y2) in enumerate(rois):
+        c = int(cls[i])
+        if c == 0:
+            continue
+        box = decode_deltas(np.array([[x1, y1, x2, y2]], np.float32),
+                            bbox_deltas_pred[i, 4 * c:4 * c + 4][None])[0]
+        box = np.clip(box, 0, IMG - 1)
+        out[int(b_idx)].append([*box, score[i], c])
+    return [nms(np.asarray(d, np.float32)) if d else
+            np.zeros((0, 6), np.float32) for d in out]
+
+
+# ----------------------------------------------------------------------
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--num-iter", type=int, default=320)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--eval-every", type=int, default=15)
+    ap.add_argument("--eval-scenes", type=int, default=16)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    net = build_net()
+    B = args.batch_size
+    shapes = {"data": (B, 3, IMG, IMG), "im_info": (B, 3),
+              "rpn_label": (B, A * FEAT * FEAT),
+              "rpn_bbox_target": (B, 4 * A, FEAT, FEAT),
+              "rpn_bbox_weight": (B, 4 * A, FEAT, FEAT),
+              "roi_label": (B * ROI_BATCH,),
+              "roi_bbox_target": (B * ROI_BATCH, 4 * NUM_CLASSES),
+              "roi_bbox_weight": (B * ROI_BATCH, 4 * NUM_CLASSES),
+              "rois_in": (B * ROI_BATCH, 5)}
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="write", **shapes)
+    rng = np.random.RandomState(0)
+    init = mx.initializer.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in shapes:
+            init(mx.initializer.InitDesc(name), arr)
+    # step schedule like the reference (x0.1 at 2/3 of the run)
+    sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[int(args.num_iter * 2 / 3)], factor=0.1, base_lr=args.lr)
+    opt = mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9,
+                           rescale_grad=1.0 / B, lr_scheduler=sched)
+    updater = mx.optimizer.get_updater(opt)
+
+    anchors = all_anchors()
+
+    eval_scenes = [make_scene(rng) for _ in range(args.eval_scenes)]
+    curve = []
+    for it in range(args.num_iter):
+        scenes = [make_scene(rng) for _ in range(B)]
+        data = np.stack([s[0] for s in scenes])
+        im_info = np.tile(np.array([[IMG, IMG, 1.0]], np.float32), (B, 1))
+
+        rpn_label = np.zeros((B, A * FEAT * FEAT), np.float32)
+        rpn_t = np.zeros((B, A * FEAT * FEAT, 4), np.float32)
+        rpn_w = np.zeros((B, A * FEAT * FEAT, 4), np.float32)
+        for b, (_, gts) in enumerate(scenes):
+            lab, tgt, wgt = anchor_targets(anchors, gts[:, :4], rng)
+            # reorder cell-major -> head layout (A, F*F)
+            rpn_label[b] = lab.reshape(FEAT * FEAT, A).T.ravel()
+            rpn_t[b] = tgt.reshape(FEAT * FEAT, A, 4) \
+                .transpose(1, 0, 2).reshape(-1, 4)
+            rpn_w[b] = wgt.reshape(FEAT * FEAT, A, 4) \
+                .transpose(1, 0, 2).reshape(-1, 4)
+
+        ex.arg_dict["data"][:] = data
+        ex.arg_dict["im_info"][:] = im_info
+        ex.arg_dict["rpn_label"][:] = rpn_label
+        ex.arg_dict["rpn_bbox_target"][:] = (
+            rpn_t.reshape(B, A, FEAT, FEAT, 4)
+            .transpose(0, 1, 4, 2, 3).reshape(B, 4 * A, FEAT, FEAT))
+        ex.arg_dict["rpn_bbox_weight"][:] = (
+            rpn_w.reshape(B, A, FEAT, FEAT, 4)
+            .transpose(0, 1, 4, 2, 3).reshape(B, 4 * A, FEAT, FEAT))
+
+        # pass 1: proposals for this step's weights
+        outs = ex.forward(is_train=True)
+        proposals = outs[4].asnumpy()
+        rois_in = np.zeros((B * ROI_BATCH, 5), np.float32)
+        roi_lab = np.zeros(B * ROI_BATCH, np.float32)
+        roi_t = np.zeros((B * ROI_BATCH, 4 * NUM_CLASSES), np.float32)
+        roi_w = np.zeros((B * ROI_BATCH, 4 * NUM_CLASSES), np.float32)
+        for b, (_, gts) in enumerate(scenes):
+            sel = proposals[:, 0] == b
+            rois, lab, tgt, wgt = proposal_targets(
+                proposals[sel, 1:], gts[:, :4], gts[:, 4], rng)
+            sl = slice(b * ROI_BATCH, (b + 1) * ROI_BATCH)
+            rois_in[sl, 0] = b
+            rois_in[sl, 1:] = rois
+            roi_lab[sl] = lab
+            roi_t[sl] = tgt
+            roi_w[sl] = wgt
+        ex.arg_dict["rois_in"][:] = rois_in
+        ex.arg_dict["roi_label"][:] = roi_lab
+        ex.arg_dict["roi_bbox_target"][:] = roi_t
+        ex.arg_dict["roi_bbox_weight"][:] = roi_w
+
+        # pass 2: fused forward+backward (approximate joint)
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, name in enumerate(net.list_arguments()):
+            if name in shapes:
+                continue
+            g = ex.grad_dict.get(name)
+            if g is not None:
+                updater(i, g, ex.arg_dict[name])
+
+        if (it + 1) % args.eval_every == 0 or it == 0:
+            ap50 = evaluate(ex, eval_scenes, B)
+            curve.append((it + 1, ap50))
+            print("iter %3d: AP@0.5 = %.3f" % (it + 1, ap50))
+
+    print("AP curve:", " ".join("(%d, %.3f)" % c for c in curve))
+    assert curve[-1][1] > 0.5, \
+        "detector did not learn (final AP@0.5 %.3f)" % curve[-1][1]
+    print("faster-rcnn train_end2end OK")
+    return curve
+
+
+def evaluate(ex, scenes, batch_size):
+    """Test-mode protocol: proposals from pass 1 become the rois (no gt
+    involved), pass 2 classifies/regresses them."""
+    all_dets, all_gts = [], []
+    for i in range(0, len(scenes), batch_size):
+        chunk = scenes[i:i + batch_size]
+        if len(chunk) < batch_size:
+            break
+        data = np.stack([s[0] for s in chunk])
+        ex.arg_dict["data"][:] = data
+        outs = ex.forward(is_train=False)
+        proposals = outs[4].asnumpy()
+        ex.arg_dict["rois_in"][:] = proposals[:batch_size * ROI_BATCH]
+        outs = ex.forward(is_train=False)
+        rois = ex.arg_dict["rois_in"].asnumpy()
+        bbox = outs[5].asnumpy()
+        probs = outs[2].asnumpy()
+        dets = detections_from(rois, bbox, probs, batch_size)
+        all_dets.extend(dets)
+        all_gts.extend(s[1] for s in chunk)
+    return average_precision(all_dets, all_gts)
+
+
+if __name__ == "__main__":
+    main()

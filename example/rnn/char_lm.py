@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Character-level LSTM language model on REAL committed data
+(tests/fixtures/public_domain_text.txt — public-domain English prose and
+verse) through the bucketing path: lines become char sequences, bucketed
+by length, one compiled program per bucket, weights shared via
+BucketingModule (behavioral parity: example/rnn/bucketing/ at character
+granularity, which needs no dataset download).
+
+Prints the train perplexity curve; exits 0 iff the final perplexity
+clears --target-ppl (default 4.5 — against a ~45-symbol character
+vocabulary whose uniform perplexity is ~45 and unigram perplexity is
+~17, so the model must learn real English character structure).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "..", "tests",
+                       "fixtures", "public_domain_text.txt")
+
+
+def char_sentences(path, max_len=96):
+    """Lines -> char-token lists (lowercased, blank lines dropped),
+    split to at most max_len chars so buckets stay compact."""
+    sents = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip().lower()
+            if not line:
+                continue
+            chars = list(line)
+            for i in range(0, len(chars), max_len):
+                piece = chars[i:i + max_len]
+                if len(piece) >= 4:
+                    sents.append(piece)
+    return sents
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--num-hidden", type=int, default=192)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=1)
+    ap.add_argument("--num-epochs", type=int, default=40)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--buckets", type=str, default="16,32,48,64,96")
+    ap.add_argument("--target-ppl", type=float, default=4.5)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    sents = char_sentences(FIXTURE)
+    encoded, vocab = mx.rnn.encode_sentences(sents, invalid_label=0,
+                                             invalid_key="<pad>",
+                                             start_label=1)
+    vocab_size = len(vocab) + 1
+    print("fixture: %d char sequences, vocab %d" % (len(sents), vocab_size))
+    buckets = [int(b) for b in args.buckets.split(",")]
+    train = mx.rnn.BucketSentenceIter(encoded, args.batch_size,
+                                      buckets=buckets, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix=f"lstm_l{i}_"))
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, lab, use_ignore=True,
+                                 ignore_label=0, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train.default_bucket_key,
+        context=mx.cpu())
+
+    metric = mx.metric.Perplexity(ignore_label=0)
+    per_epoch = {}
+
+    def tap(param):
+        # fit resets the metric at each epoch start, so the last
+        # batch-end value of an epoch IS the epoch's train perplexity
+        per_epoch[param.epoch] = param.eval_metric.get_name_value()[0][1]
+
+    model.fit(train, num_epoch=args.num_epochs, eval_metric=metric,
+              optimizer="adam",
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.init.Xavier(factor_type="in",
+                                         magnitude=2.34),
+              batch_end_callback=tap)
+    curve = [per_epoch[e] for e in sorted(per_epoch)]
+    for epoch in range(0, len(curve), 5):
+        print("epoch %2d: train perplexity %.3f" % (epoch, curve[epoch]))
+
+    print("perplexity curve:",
+          " ".join("%.2f" % p for p in curve[:: max(1, len(curve) // 10)]))
+    final = curve[-1]
+    print("final train perplexity: %.3f (vocab %d)" % (final, vocab_size))
+    assert final < args.target_ppl, \
+        "char LM did not reach %.2f (got %.3f)" % (args.target_ppl, final)
+    print("char_lm OK")
+    return curve
+
+
+if __name__ == "__main__":
+    main()

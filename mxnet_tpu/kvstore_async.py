@@ -216,6 +216,16 @@ class ParamServer:
                 grad = self._decompress(app, grad)
             self._apply(app, msg["key"], grad)
             return {"ok": True}
+        if op == "pull_rows":
+            key = msg["key"]
+            rows = _np.asarray(msg["rows"], _np.int64)
+            lk = self._lock_for(app, key)
+            with lk:
+                val = app.store.get(key)
+                if val is None:
+                    return {"ok": False,
+                            "error": "key %r not initialized" % (key,)}
+                return {"ok": True, "value": val[rows], "rows": rows}
         if op == "pull":
             key = msg["key"]
             deadline = time.time() + msg.get("timeout", 60.0)
@@ -379,11 +389,15 @@ class KVStoreDistAsync(KVStore):
     def _request(self, sidx, msg, retries=240):
         # generous connect retries: the server process imports the full
         # package before listening (~seconds on a loaded host)
+        # fresh copy per (request, shard): callers (and _all_servers)
+        # reuse msg dicts, and a seq stamped for one shard must never
+        # leak to another — each server dedupes on its own counter line
+        msg = dict(msg)
         msg.setdefault("app", self._app_id)
         msg.setdefault("wkr", self._rank)
         with self._sock_locks[sidx]:
             self._rpc_seq[sidx] += 1
-            msg.setdefault("seq", self._rpc_seq[sidx])
+            msg["seq"] = self._rpc_seq[sidx]
             for attempt in range(retries):
                 sock = self._socks[sidx]
                 if sock is None:
@@ -465,6 +479,49 @@ class KVStoreDistAsync(KVStore):
             val = jnp.asarray(resp["value"])
             for o in olist:
                 o._set_data(val.astype(o.dtype))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Reference PullRowSparse over the async wire: the server slices
+        the requested rows (op ``pull_rows``) so only those rows cross
+        the wire — no dense transfer, no shared-state mutation."""
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        import jax.numpy as jnp
+        from .kvstore import _key_value
+        from .ndarray import NDArray
+        from .ndarray.sparse import RowSparseNDArray
+
+        keys, outs = _key_value(key, out)
+        n_out = sum(len(olist) for olist in outs)
+        if isinstance(row_ids, NDArray):
+            rid_list = [row_ids] * n_out
+        else:
+            rid_list = list(row_ids)
+            if len(rid_list) != n_out:
+                raise MXNetError(
+                    "row_sparse_pull: %d row_ids for %d out arrays"
+                    % (len(rid_list), n_out))
+        i = 0
+        for k, olist in zip(keys, outs):
+            for o in olist:
+                rows = _np.unique(
+                    _np.asarray(rid_list[i].asnumpy(), _np.int64))
+                i += 1
+                resp = self._request(self._server_of(k),
+                                     {"op": "pull_rows", "key": k,
+                                      "rows": rows})
+                vals = jnp.asarray(resp["value"])
+                if isinstance(o, RowSparseNDArray):
+                    # _set_data re-derives the (data, indices) pair from
+                    # the dense view; zero rows drop out
+                    full = jnp.zeros(o.shape, vals.dtype) \
+                        .at[jnp.asarray(rows)].set(vals)
+                    o._set_data(full.astype(o.dtype))
+                else:
+                    dense = jnp.asarray(o._data) \
+                        .at[jnp.asarray(rows)].set(vals)
+                    o._set_data(dense.astype(o.dtype))
 
     def pull_with_meta(self, key):
         """(value, applied_push_count) — observability used by tests to

@@ -240,9 +240,14 @@ class NDArray:
         return self
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage not supported on this array type")
-        return self
+        """Convert to another storage type (reference ndarray.py tostype:
+        dense -> row_sparse/csr runs cast_storage)."""
+        if stype == "default":
+            return self
+        if stype in ("row_sparse", "csr"):
+            from . import sparse as _sparse
+            return _sparse.cast_storage(self, stype)
+        raise MXNetError(f"unknown storage type {stype!r}")
 
     # ------------------------------------------------------------------
     # autograd hooks (implemented in mxnet_tpu.autograd)

@@ -125,3 +125,20 @@ def test_quantization_example_runs():
     out = _run_example("example/quantization/quantize_model.py",
                        "--calib-mode", "naive", timeout=500)
     assert "quantize_model example OK" in out
+
+
+def test_rcnn_train_end2end():
+    """Full faster-rcnn recipe (anchor targets, gt-appended proposal
+    sampling, joint RPN+ROI heads) must reach AP@0.5 > 0.5 on the
+    synthetic COCO-shaped scenes (reference example/rcnn/train_end2end)."""
+    out = _run_example("example/rcnn/train_end2end.py", timeout=2400)
+    assert "faster-rcnn train_end2end OK" in out
+
+
+def test_char_lm_on_committed_fixture():
+    """Char-level LSTM LM through the bucketing path on the committed
+    public-domain text fixture; perplexity must clear the quoted bar
+    (4.5 vs the 45-symbol uniform ~45 / unigram ~17)."""
+    out = _run_example("example/rnn/char_lm.py",
+                       "--num-epochs", "28", timeout=2400)
+    assert "char_lm OK" in out
