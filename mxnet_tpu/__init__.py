@@ -52,6 +52,7 @@ from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)
 from . import random
+from . import name
 from . import autograd
 from . import ndarray
 from . import ndarray as nd

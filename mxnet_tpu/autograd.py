@@ -178,13 +178,28 @@ def backward(outputs, out_grads=None, retain_graph=False, train_mode=True,
     leaf_ids = [id(v) for v in leaves]
     leaf_id_set = set(leaf_ids)
 
+    def _floatable(x):
+        # int leaves/outputs flow float32 gradients (jax would emit
+        # float0). Documented bound: int values above 2^24 lose
+        # precision in the replayed forward, and fractional gradients
+        # truncate on the cast back to the leaf dtype.
+        return not jnp.issubdtype(x.dtype, jnp.inexact)
+
     def replay(leaf_vals):
         # a marked variable that is itself a record output stays a
         # leaf: keep the vjp input value so its gradient flows
         env = dict(zip(leaf_ids, leaf_vals))
-        return _replay_records(nodes, env, leaf_id_set, outputs)
+        outs = _replay_records(nodes, env, leaf_id_set, outputs)
+        # integer outputs would yield float0 cotangents (jax refuses int
+        # differentials); the reference treats dtype as incidental —
+        # d(x[idx])/dx is a scatter whatever the dtype — so grads flow
+        # in float and are cast back to the leaf dtype at the end
+        return [o.astype(jnp.float32) if _floatable(o) else o
+                for o in outs]
 
     leaf_vals = [v._data for v in leaves]
+    leaf_vals = [lv.astype(jnp.float32) if _floatable(lv) else lv
+                 for lv in leaf_vals]
     with _Scope(recording=False, training=train_mode):
         out_vals, vjp_fn = jax.vjp(replay, leaf_vals)
     if out_grads is None:
@@ -192,6 +207,7 @@ def backward(outputs, out_grads=None, retain_graph=False, train_mode=True,
     else:
         cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                for g in out_grads]
+        cts = [c.astype(v.dtype) for c, v in zip(cts, out_vals)]
     (grads,) = vjp_fn(cts)
 
     if not retain_graph:

@@ -28,8 +28,10 @@ class Context:
     device_id : int
     """
 
-    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 6: "tpu"}
-    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 6}
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared",
+                   6: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3,
+                   "cpu_shared": 5, "tpu": 6}
     _default_ctx = threading.local()
 
     def __init__(self, device_type, device_id=0):
@@ -85,7 +87,7 @@ def _accelerators():
 
 
 def _resolve_device(ctx: Context) -> jax.Device:
-    if ctx.device_type == "cpu" or ctx.device_type == "cpu_pinned":
+    if ctx.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
         if not cpus:
             # accelerator-platform processes still carry a host backend;

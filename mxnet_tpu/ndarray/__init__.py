@@ -93,6 +93,26 @@ def _as_shape(s):
 random = _RandomNS()
 
 
+def eye(N, M=0, k=0, ctx=None, dtype="float32", out=None, **kw):
+    """Positional form (reference nd.eye(N, M, k)); the generated wrapper
+    would mistake the scalars for tensor inputs."""
+    from . import dispatch
+    return dispatch.invoke_by_name(
+        "_eye", [], {"N": int(N), "M": int(M), "k": int(k),
+                     "dtype": dtype}, out=out)
+
+
+def clip(data, a_min=None, a_max=None, out=None, **kw):
+    """Positional-scalar form (reference nd.clip(data, a_min, a_max));
+    the generated wrapper would mistake the bounds for tensor inputs.
+    Bounds keep their python type so integer arrays stay integer."""
+    if a_min is None or a_max is None:
+        raise ValueError("nd.clip requires both a_min and a_max")
+    from . import dispatch
+    return dispatch.invoke_by_name(
+        "clip", [data], {"a_min": a_min, "a_max": a_max}, out=out)
+
+
 def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
     return random.uniform(low, high, shape, dtype, ctx, out, **kw)
 
@@ -108,6 +128,38 @@ def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
 def load(fname):
     from ..serialization import load_ndarray_file
     return load_ndarray_file(fname)
+
+
+def load_frombuffer(buf):
+    """Deserialize nd.save output from bytes (reference
+    MXNDArrayLoadFromBuffer)."""
+    from ..serialization import load_ndarray_bytes
+    return load_ndarray_bytes(buf)
+
+
+class _InternalNS:
+    """mx.nd._internal — the reference's generated _internal ops that
+    user/test code calls directly (a thin dispatch shim)."""
+
+    def __getattr__(self, name):
+        from . import dispatch
+
+        def op(*args, out=None, **kwargs):
+            tensors = []
+            for a in args:
+                if isinstance(a, NDArray):
+                    tensors.append(a)
+                else:
+                    raise TypeError(
+                        f"_internal.{name}: positional scalars are not "
+                        f"supported here; pass them as keywords "
+                        f"(got {type(a).__name__})")
+            return dispatch.invoke_by_name(name, tensors, kwargs, out=out)
+        op.__name__ = name
+        return op
+
+
+_internal = _InternalNS()
 
 
 def save(fname, data):

@@ -24,6 +24,13 @@ def _freeze(v):
         return tuple(_freeze(x) for x in v)
     if isinstance(v, dict):
         return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, slice):
+        return ("slice", v.start, v.stop, v.step)
+    if hasattr(v, "tobytes") and hasattr(v, "shape"):
+        # array-valued attr (fancy-index keys): identity by content
+        import numpy as _np_
+        a = _np_.asarray(v)
+        return ("arr", a.shape, str(a.dtype), a.tobytes())
     return v
 
 

@@ -92,10 +92,38 @@ class NDArray:
         return self.shape[0]
 
     def __bool__(self):
+        if self.size == 0:
+            return False
         if self.size == 1:
             return bool(self.asscalar())
         raise ValueError("The truth value of an NDArray with multiple "
                          "elements is ambiguous.")
+
+    def __getattr__(self, name):
+        # Fluent surface (reference ndarray.py registers every op as a
+        # method): resolve registered op names lazily so x.norm(),
+        # x.nansum(axis=...) etc. work without hand-written wrappers.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops import registry as _registry
+        try:
+            _registry.get_op(name)
+        except Exception:
+            raise AttributeError(
+                f"'NDArray' object has no attribute {name!r}") from None
+
+        def _fluent(*args, **kwargs):
+            extra = []
+            for a in args:
+                if isinstance(a, NDArray):
+                    extra.append(a)
+                else:
+                    raise TypeError(
+                        f"{name}: positional non-NDArray arguments are "
+                        f"not supported on the fluent form; pass keywords")
+            return _dispatch.invoke_by_name(name, [self, *extra], kwargs)
+        _fluent.__name__ = name
+        return _fluent
 
     def __iter__(self):
         for i in range(len(self)):
@@ -105,8 +133,11 @@ class NDArray:
     # host/device movement & sync
     # ------------------------------------------------------------------
     def asnumpy(self):
-        """Blocking copy to host (the reference's implicit sync point)."""
-        return _np.asarray(self._data)
+        """Blocking copy to host (the reference's implicit sync point).
+        Always WRITABLE like the reference's copy — jax would otherwise
+        hand back a read-only zero-copy view on CPU."""
+        out = _np.asarray(self._data)
+        return out if out.flags.writeable else out.copy()
 
     def asscalar(self):
         if self.size != 1:
@@ -165,7 +196,36 @@ class NDArray:
             raise MXNetError("shape mismatch in _sync_copyfrom")
         self._data = jax.device_put(jnp.asarray(arr), self._ctx.jax_device)
 
+    @staticmethod
+    def _norm_key(key):
+        """jax rejects bare python sequences as fancy indices; numpy-ify
+        them (also unwrap NDArray indices), at any nesting level of a
+        tuple key."""
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, list):
+            return _np.asarray(key)
+        if isinstance(key, tuple):
+            return tuple(NDArray._norm_key(k) if isinstance(k, (list, NDArray))
+                         else k for k in key)
+        return key
+
     def __setitem__(self, key, value):
+        key = NDArray._norm_key(key)
+        from .. import autograd as _ag
+        recorded = (self._grad is not None
+                    or self._autograd_entry is not None
+                    or (isinstance(value, NDArray)
+                        and (value._grad is not None
+                             or value._autograd_entry is not None)))
+        if _ag.is_recording() and recorded:
+            # only arrays PARTICIPATING in the recorded graph are
+            # protected — scratch buffers (deferred init, metrics) may
+            # still be written while a record scope is open elsewhere
+            raise MXNetError(
+                "Inplace operations (+=, -=, x[:]=, etc) are not supported "
+                "when recording with autograd (reference ndarray.py "
+                "check_call guard); compute a new array instead")
         if isinstance(value, NDArray):
             value = value._data
         elif isinstance(value, (_np.ndarray, _np.generic, list)):
@@ -179,13 +239,34 @@ class NDArray:
                 v = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
                 self._data = v
             return
-        self._data = self._data.at[key].set(value)
+        try:
+            self._data = self._data.at[key].set(value)
+        except (TypeError, ValueError):
+            # reference/numpy assignment semantics: a size-matching value
+            # with EXTRA SIZE-1 DIMS squeezes into the slot
+            # (b[0] = np.array([47.8]) — apache/incubator-mxnet#8668).
+            # Only squeezing is allowed — arbitrary same-size reshapes
+            # (e.g. (3,2) into a (2,3) slot) must keep raising.
+            slot = jnp.shape(self._data[key])
+            v = jnp.asarray(value, dtype=self.dtype)
+            squeeze = tuple(d for d in v.shape if d != 1)
+            if squeeze == tuple(d for d in slot if d != 1):
+                self._data = self._data.at[key].set(v.reshape(slot))
+            else:
+                raise
 
     def __getitem__(self, key):
-        if isinstance(key, NDArray):
-            key = key._data
-        out = self._data[key]
-        return NDArray(out, self._ctx)
+        key = NDArray._norm_key(key)
+        from .. import autograd as _ag
+        if _ag.is_recording():
+            # Keep sliced reads on the tape (indices are captured
+            # constants — no gradient flows through them). NOTE: each
+            # distinct key compiles + caches its own program; loops that
+            # slice with varying indices under record() should prefer
+            # nd.take / nd.slice_axis (traced operands) on the hot path.
+            return _dispatch.invoke_by_name("_ndarray_getitem", [self],
+                                            {"key": key})
+        return NDArray(self._data[key], self._ctx)
 
     # ------------------------------------------------------------------
     # shape ops (view-free: XLA reshapes are free inside jit). Routed
@@ -197,11 +278,17 @@ class NDArray:
             shape = tuple(shape[0])
         if not shape:
             shape = kwargs.get("shape", ())
+        if kwargs.get("reverse"):
+            # magic values resolve right-to-left (reference matrix_op
+            # reverse attr); the op's own inference handles it
+            return _dispatch.invoke_by_name(
+                "reshape", [self], {"shape": tuple(shape), "reverse": True})
         shape = _infer_reshape(self.shape, tuple(shape))
         return _dispatch.invoke_by_name("reshape", [self], {"shape": shape})
 
-    def reshape_like(self, other):
-        return self.reshape(other.shape)
+    def reshape_like(self, other=None, rhs=None, **kwargs):
+        target = other if other is not None else rhs
+        return self.reshape(target.shape)
 
     def expand_dims(self, axis):
         return _dispatch.invoke_by_name("expand_dims", [self], {"axis": axis})
@@ -209,8 +296,10 @@ class NDArray:
     def squeeze(self, axis=None):
         return _dispatch.invoke_by_name("squeeze", [self], {"axis": axis})
 
-    def transpose(self, *axes):
-        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+    def transpose(self, *axes, **kwargs):
+        if not axes and kwargs.get("axes") is not None:
+            axes = tuple(kwargs["axes"])
+        elif len(axes) == 1 and isinstance(axes[0], (list, tuple)):
             axes = tuple(axes[0])
         axes = axes if axes else None
         return _dispatch.invoke_by_name("transpose", [self], {"axes": axes})
@@ -431,28 +520,38 @@ def empty(shape, ctx=None, dtype="float32"):
     return zeros(shape, ctx, dtype)
 
 
-def zeros(shape, ctx=None, dtype="float32", **kwargs):
+def _emit(values, ctx, out):
+    """Return a fresh NDArray or write into ``out`` (reference out= on
+    the creation ops)."""
+    if out is None:
+        return NDArray(values, ctx)
+    out._set_data(values)
+    return out
+
+
+def zeros(shape, ctx=None, dtype="float32", out=None, **kwargs):
     ctx = _ctx_or_default(ctx)
     if isinstance(shape, integer_types):
         shape = (shape,)
     with jax.default_device(ctx.jax_device):
-        return NDArray(jnp.zeros(shape, dtype=dtype or "float32"), ctx)
+        return _emit(jnp.zeros(shape, dtype=dtype or "float32"), ctx, out)
 
 
-def ones(shape, ctx=None, dtype="float32", **kwargs):
+def ones(shape, ctx=None, dtype="float32", out=None, **kwargs):
     ctx = _ctx_or_default(ctx)
     if isinstance(shape, integer_types):
         shape = (shape,)
     with jax.default_device(ctx.jax_device):
-        return NDArray(jnp.ones(shape, dtype=dtype or "float32"), ctx)
+        return _emit(jnp.ones(shape, dtype=dtype or "float32"), ctx, out)
 
 
-def full(shape, val, ctx=None, dtype="float32"):
+def full(shape, val, ctx=None, dtype="float32", out=None):
     ctx = _ctx_or_default(ctx)
     if isinstance(shape, integer_types):
         shape = (shape,)
     with jax.default_device(ctx.jax_device):
-        return NDArray(jnp.full(shape, val, dtype=dtype or "float32"), ctx)
+        return _emit(jnp.full(shape, val, dtype=dtype or "float32"), ctx,
+                     out)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
@@ -465,7 +564,13 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
 
 
 def moveaxis(tensor, source, destination):
-    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+    # reference compat: destination == ndim means "after the last axis"
+    # (MXNet 1.x accepted it; numpy does not)
+    nd_ = tensor._data.ndim
+    if isinstance(destination, int) and destination == nd_:
+        destination = nd_ - 1
+    return NDArray(jnp.moveaxis(tensor._data, source, destination),
+                   tensor._ctx)
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -286,6 +286,14 @@ def _topk_nout(attrs):
 
 @register("topk", num_outputs=_topk_nout, num_visible_outputs=_topk_nout)
 def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    if axis is None:
+        # reference: axis=None flattens before ranking; a mask comes back
+        # in the ORIGINAL shape
+        flat = topk(data.reshape(-1), axis=-1, k=k, ret_typ=ret_typ,
+                    is_ascend=is_ascend, dtype=dtype)
+        if ret_typ == "mask":
+            return flat.reshape(data.shape)
+        return flat
     ax = int(axis) % data.ndim
     k = int(k) if int(k) > 0 else data.shape[ax]
     x = jnp.moveaxis(data, ax, -1)
@@ -381,3 +389,27 @@ def _eye(*, N, M=0, k=0, dtype="float32", ctx=None):
 @register("diag")
 def diag(data, *, k=0):
     return jnp.diag(data, k=int(k)) if data.ndim <= 2 else jnp.diagonal(data, offset=int(k))
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """Per-row gather: out[i] = lhs[i, rhs[i]] (reference
+    src/operator/tensor/broadcast_reduce_op_index.cc pick 0-index form)."""
+    idx = rhs.astype(jnp.int32)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """Per-row scatter: out[i, rhs[i]] = mhs[i] (reference parity)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(
+        mhs.astype(lhs.dtype))
+
+
+@register("_ndarray_getitem")
+def _ndarray_getitem(data, *, key=None):
+    """Basic/advanced indexing as a differentiable op — NDArray.__getitem__
+    routes here while autograd records so sliced reads stay on the tape
+    (the reference records its slice/gather kernels the same way)."""
+    return data[key]

@@ -9,7 +9,9 @@ from __future__ import annotations
 import jax
 import numpy as _np
 
-__all__ = ["seed", "next_key", "next_seed"]
+__all__ = ["seed", "next_key", "next_seed", "uniform", "normal", "randint",
+           "exponential", "gamma", "poisson", "multinomial", "shuffle",
+           "randn"]
 
 _STATE = {"key": None, "seed": 0, "host_rng": None}
 
@@ -40,3 +42,52 @@ def next_seed():
     if _STATE["host_rng"] is None:
         _STATE["host_rng"] = _np.random.RandomState()  # OS entropy
     return _np.uint32(_STATE["host_rng"].randint(0, 2 ** 31 - 1))
+
+
+# ----------------------------------------------------------------------
+# Sampling surface (reference python/mxnet/random.py re-exports the
+# ndarray samplers at module level: mx.random.uniform(-10, 10, shape)).
+# ----------------------------------------------------------------------
+def _nd_random():
+    from .ndarray import random as _r
+    return _r
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
+            out=None, **kw):
+    return _nd_random().uniform(low, high, shape, dtype, ctx, out, **kw)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
+           out=None, **kw):
+    return _nd_random().normal(loc, scale, shape, dtype, ctx, out, **kw)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", **kw):
+    return _nd_random().normal(loc, scale, tuple(shape) or (1,), dtype)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None, **kw):
+    return _nd_random().randint(low, high, shape, dtype, ctx, out, **kw)
+
+
+def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None,
+                **kw):
+    return _nd_random().exponential(scale, shape, dtype, ctx, out, **kw)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
+          out=None, **kw):
+    return _nd_random().gamma(alpha, beta, shape, dtype, ctx, out, **kw)
+
+
+def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return _nd_random().poisson(lam, shape, dtype, ctx, out, **kw)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    return _nd_random().multinomial(data, shape, get_prob, dtype, **kw)
+
+
+def shuffle(data, **kw):
+    return _nd_random().shuffle(data, **kw)

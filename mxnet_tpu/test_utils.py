@@ -33,14 +33,16 @@ def same(a, b):
     return np.array_equal(np.asarray(a), np.asarray(b))
 
 
-def almost_equal(a, b, rtol=1e-5, atol=1e-20):
-    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
 
 
-def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b"),
+                        equal_nan=False):
     a = a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
     b = b.asnumpy() if hasattr(b, "asnumpy") else np.asarray(b)
-    if not np.allclose(a, b, rtol=rtol, atol=atol):
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
         idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
         raise AssertionError(
             "%s and %s differ: max |diff|=%g at %s (%s vs %s), rtol=%g atol=%g"

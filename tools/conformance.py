@@ -114,6 +114,11 @@ class _MxAliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
 sys.modules.setdefault("mxnet", importlib.import_module("mxnet_tpu"))
 sys.meta_path.insert(0, _MxAliasFinder())
 
+# numeric-parity tests assume fp32 accumulation; CPU XLA may otherwise
+# drop matmuls to bf16 (same setting as the repo's own tests/conftest.py)
+import jax
+jax.config.update("jax_default_matmul_precision", "float32")
+
 # ---- skiplist -> pytest collection hook ----
 sys.path.insert(0, {tools_dir!r})
 from conformance_skips import SKIPS
