@@ -637,12 +637,97 @@ def bench_inference(args):
                 table.get("resnet50-b32", 0) / 1076.81, 3)}
 
 
+def bench_serving(args):
+    """mx.serving throughput: concurrent clients against the in-process
+    ModelServer (dynamic micro-batching + bucket padding over a jitted
+    ResNet forward). The headline is ``serving_qps`` — single-example
+    requests served per second end to end (queue + batcher + device),
+    NOT the raw batched-forward img/s, so it prices the batching control
+    plane the way a traffic-serving deployment would see it."""
+    import threading
+
+    import jax
+    import numpy as np
+    from mxnet_tpu import models
+    from mxnet_tpu.serving import ModelServer
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    sym = models.get_symbol("resnet", num_classes=1000,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape, dtype="float32")
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(1,) + image_shape, softmax_label=(1,))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.normal(0, 0.05, s).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n not in ("data", "softmax_label")}
+    auxs = {}
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        auxs[n] = (np.zeros(s, np.float32) if n.endswith("_mean")
+                   else np.ones(s, np.float32))
+
+    n_req = args.serving_requests
+    srv = ModelServer(sym, params, auxs, {"data": image_shape},
+                      num_replicas=args.serving_replicas,
+                      max_batch_size=args.serving_max_batch,
+                      max_latency_ms=args.serving_latency_ms,
+                      queue_capacity=n_req + args.serving_max_batch)
+    try:
+        xs = [rng.uniform(-1, 1, image_shape).astype(np.float32)
+              for _ in range(8)]
+        # warmup already compiled every bucket; a short served burst
+        # warms the control plane too — then zero the stats so the
+        # occupancy-1 warmup batches don't bias the reported metrics
+        for x in xs:
+            srv.predict({"data": x})
+        srv.drain(timeout=600)
+        srv.reset_stats()
+
+        futs = []
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def client(k):
+            local = []
+            for i in range(n_req // args.serving_clients):
+                local.append(srv.submit({"data": xs[(k + i) % len(xs)]}))
+            with lock:
+                futs.extend(local)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(args.serving_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for f in futs:
+            f.result(timeout=600)
+        dt = time.perf_counter() - t0
+        st = srv.stats()
+    finally:
+        srv.stop()
+    dev = jax.devices()[0]
+    return {
+        "metric": "serving_qps",
+        "value": round(len(futs) / dt, 1),
+        "unit": "req/s",
+        "device_kind": dev.device_kind,
+        "replicas": args.serving_replicas,
+        "max_batch_size": args.serving_max_batch,
+        "max_latency_ms": args.serving_latency_ms,
+        "mean_batch_occupancy": round(st["batches"]["mean_occupancy"], 2)
+        if st["batches"]["mean_occupancy"] else None,
+        "latency_p50_ms": st["latency_ms"]["p50"],
+        "latency_p99_ms": st["latency_ms"]["p99"],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", type=str, default="all",
                     choices=["all", "resnet", "transformer"])
     ap.add_argument("--mode", type=str, default="train",
-                    choices=["train", "inference"])
+                    choices=["train", "inference", "serving"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
     ap.add_argument("--layout", type=str, default="NHWC",
@@ -669,6 +754,13 @@ def main():
     ap.add_argument("--quantized", action="store_true",
                     help="with --mode inference: calibrated int8/uint8 "
                          "ResNet-50 scoring (ops/quantization_ops.py)")
+    # mx.serving throughput (--mode serving; also folded into the default
+    # run as serving_* fields so BENCH_* tracks it alongside training)
+    ap.add_argument("--serving-requests", type=int, default=256)
+    ap.add_argument("--serving-clients", type=int, default=4)
+    ap.add_argument("--serving-replicas", type=int, default=1)
+    ap.add_argument("--serving-max-batch", type=int, default=8)
+    ap.add_argument("--serving-latency-ms", type=float, default=5.0)
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -680,6 +772,9 @@ def main():
 
     if args.pipeline_scaling:
         print(json.dumps(bench_pipeline_scaling(args)))
+        return
+    if args.mode == "serving":
+        print(json.dumps(bench_serving(args)))
         return
     if args.mode == "inference":
         if args.quantized:
@@ -696,13 +791,18 @@ def main():
     if args.model == "resnet" or args.pipeline:
         print(json.dumps(bench_resnet(args)))
         return
-    # default: resnet headline + transformer_* fields, one JSON line
+    # default: resnet headline + transformer_* + serving_* fields, one
+    # JSON line (BENCH_* tracks serving throughput alongside training)
     out = bench_resnet(args)
     lm = bench_transformer(args)
     out["transformer_tokens_per_sec"] = lm["value"]
     out["transformer_mfu"] = lm["mfu"]
     out["transformer_achieved_tflops"] = lm["achieved_tflops"]
     out["transformer_config"] = lm["config"]
+    sv = bench_serving(args)
+    out["serving_qps"] = sv["value"]
+    out["serving_mean_batch_occupancy"] = sv["mean_batch_occupancy"]
+    out["serving_latency_p99_ms"] = sv["latency_p99_ms"]
     print(json.dumps(out))
 
 

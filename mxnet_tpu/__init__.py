@@ -90,6 +90,7 @@ from . import rnn
 from . import rtc
 from . import config
 from . import predictor
+from . import serving
 from . import profiler
 from . import monitor
 from .monitor import Monitor

@@ -13,6 +13,11 @@ Usage::
     pred = mx.predictor.Predictor.load("model", epoch=9,
                                        input_shapes={"data": (1, 3, 224, 224)})
     out = pred.forward(data=batch)[0]        # numpy in, numpy out
+
+A Predictor is single-threaded like the reference's PredictorHandle
+(forward mutates bound input state); for concurrent traffic use
+``mx.serving.ModelServer``, which gives each replica its own Predictor
+behind a thread-safe queue.
 """
 from __future__ import annotations
 
@@ -34,6 +39,7 @@ class Predictor:
         self._symbol = symbol
         self._dtype = dtype
         self._input_names = list(input_shapes)
+        self._input_shapes = {n: tuple(s) for n, s in input_shapes.items()}
         type_dict = {n: dtype for n in input_shapes} \
             if dtype != "float32" else None
         self._exe = symbol.simple_bind(ctx=self._ctx, grad_req="null",
@@ -89,17 +95,65 @@ class Predictor:
 
     # ------------------------------------------------------------------
     def forward(self, **inputs):
-        """Set inputs (numpy or NDArray), run forward, return a list of
-        host numpy outputs (MXPredSetInput + MXPredForward +
-        MXPredGetOutput in one call)."""
-        self._exe.forward(is_train=False, **inputs)
+        """Set inputs, run forward, return a list of host numpy outputs
+        (MXPredSetInput + MXPredForward + MXPredGetOutput in one call).
+
+        Inputs may be numpy arrays, ``NDArray``, raw ``jax.Array``
+        (device-resident values stay zero-copy on device), or anything
+        ``np.asarray`` accepts. Shapes are validated against the bind
+        shapes up front (MXPredSetInput's size check), so a mismatched
+        feed fails with a clear error instead of a trace-time one."""
+        import jax
+        from .ndarray.ndarray import NDArray
+        norm = {}
+        for name, v in inputs.items():
+            # declared inputs only (MXPredSetInput's contract) — checking
+            # the full arg_dict would let a typo'd name silently overwrite
+            # bound WEIGHTS and corrupt every later forward
+            if name not in self._input_shapes:
+                raise MXNetError("unknown input %r (bound inputs: %s)"
+                                 % (name, self._input_names))
+            dst = self._exe.arg_dict[name]
+            if isinstance(v, jax.Array):
+                v = NDArray(v)
+            elif not isinstance(v, NDArray):
+                v = _np.asarray(v)
+            if tuple(v.shape) != dst.shape:
+                raise MXNetError(
+                    "input %r: shape %s does not match bind shape %s "
+                    "(use reshape() to re-bind)"
+                    % (name, tuple(v.shape), dst.shape))
+            norm[name] = v
+        self._exe.forward(is_train=False, **norm)
         return [o.asnumpy() for o in self._exe.outputs]
 
     def reshape(self, input_shapes):
-        """Re-bind for new input shapes, keeping params and dtype
-        (MXPredReshape)."""
-        return Predictor(self._symbol, self._arg_params, self._aux_params,
-                         input_shapes, self._ctx, self._dtype)
+        """Re-bind for new input shapes (MXPredReshape). The returned
+        Predictor SHARES this one's device-resident parameters through
+        ``Executor.reshape`` — no host->device weight copy — and the jit
+        cache is per symbol, so flipping between shapes (e.g. serving's
+        batch-size buckets) never recompiles an already-seen shape."""
+        unknown = [n for n in input_shapes if n not in self._exe.arg_dict]
+        if unknown:
+            raise MXNetError("reshape: unknown input(s) %s (bound inputs: %s)"
+                             % (unknown, self._input_names))
+        merged = dict(self._input_shapes)
+        merged.update({n: tuple(s) for n, s in input_shapes.items()})
+        new = Predictor.__new__(Predictor)
+        new._ctx = self._ctx
+        new._symbol = self._symbol
+        new._dtype = self._dtype
+        new._input_names = list(merged)
+        new._input_shapes = merged
+        new._exe = self._exe.reshape(partial_shaping=True, **merged)
+        new._arg_params = self._arg_params
+        new._aux_params = self._aux_params
+        return new
+
+    @property
+    def input_shapes(self):
+        """Bind-time input shapes ({name: shape tuple})."""
+        return dict(self._input_shapes)
 
     @property
     def output_names(self):

@@ -254,27 +254,42 @@ class Frame(_Span):
 
 
 class Counter:
+    """Thread-safe: serving replicas and user threads may bump the same
+    counter concurrently (reference ProfileCounter is atomic too,
+    src/profiler/profiler.h)."""
+
     def __init__(self, domain, name, value=None):
         self.domain, self.name = domain, name
+        self._vlock = threading.Lock()
         self._value = 0 if value is None else value
         if value is not None:
-            self._emit()
+            self._emit(self._value)
 
-    def _emit(self):
+    @property
+    def value(self):
+        return self._value
+
+    def _emit(self, value):
         add_event(self.name, self.domain.name if self.domain else "counter",
-                  _now_us(), 0, ph="C", args={self.name: self._value})
+                  _now_us(), 0, ph="C", args={self.name: value})
 
+    # _emit stays inside the lock so trace samples record in value order
+    # (an emit outside would let a stale value land last in the trace);
+    # add_event's module lock never takes _vlock, so no ordering cycle
     def set_value(self, value):
-        self._value = value
-        self._emit()
+        with self._vlock:
+            self._value = value
+            self._emit(value)
 
     def increment(self, delta=1):
-        self._value += delta
-        self._emit()
+        with self._vlock:
+            self._value += delta
+            self._emit(self._value)
 
     def decrement(self, delta=1):
-        self._value -= delta
-        self._emit()
+        with self._vlock:
+            self._value -= delta
+            self._emit(self._value)
 
     def __iadd__(self, delta):
         self.increment(delta)

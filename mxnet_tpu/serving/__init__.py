@@ -1,0 +1,41 @@
+"""mx.serving — dynamic-batching, multi-replica TPU inference serving.
+
+The capability the MXNet ecosystem shipped as MXNet Model Server, built
+TPU-native on top of ``mx.predictor.Predictor``: an in-process
+``ModelServer`` that coalesces single-example requests behind a bounded
+queue into micro-batches (Clipper-style adaptive batching, NSDI '17),
+pads them to a fixed ladder of batch-size buckets so every forward hits
+an already-compiled XLA executable (no per-request recompiles — the
+shape-bucketing insight continuous-batching systems build on), and
+dispatches to N replica workers, each owning a ``Predictor`` bound to
+its own device context.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import ModelServer
+
+    srv = ModelServer.load("model", epoch=9,
+                           input_shapes={"data": (3, 224, 224)},  # per example
+                           num_replicas=2, max_batch_size=8,
+                           max_latency_ms=5.0)
+    fut = srv.submit({"data": img})          # -> concurrent.futures.Future
+    probs = fut.result()[0]                  # list of per-output numpy rows
+    print(srv.stats())                       # p50/p99, occupancy, qps, depth
+    srv.stop()
+
+See docs/SERVING.md for the full knob table and metrics glossary.
+"""
+from .batcher import (ServingError, QueueFullError, DeadlineExceededError,
+                      ServerClosedError, Request, RequestQueue,
+                      DynamicBatcher, MicroBatch, bucketize, default_buckets)
+from .replica import Replica, ReplicaPool
+from .server import ModelServer, ServerStats
+
+__all__ = [
+    "ModelServer", "ServerStats",
+    "Replica", "ReplicaPool",
+    "Request", "RequestQueue", "DynamicBatcher", "MicroBatch",
+    "ServingError", "QueueFullError", "DeadlineExceededError",
+    "ServerClosedError", "bucketize", "default_buckets",
+]

@@ -1,0 +1,305 @@
+"""Bounded request queue + dynamic micro-batcher with bucket padding.
+
+The admission path is deliberately synchronous and cheap: ``submit`` either
+enqueues or fails *immediately* (``QueueFullError``) — backpressure is a
+structured error the client can retry against, never unbounded memory
+growth. Batching is adaptive (Clipper, NSDI '17): the first waiting
+request opens a batching window of at most ``max_latency_ms``; the window
+closes early the moment ``max_batch_size`` requests are waiting, so an
+idle server adds at most one window of latency and a loaded server runs
+full buckets back to back.
+
+Bucket padding keeps the XLA jit cache warm: a batch of n requests is
+padded up to the smallest bucket in the ladder (1, 2, 4, ..., max) by
+replicating the first row. Every forward therefore runs one of
+log2(max)+1 compiled shapes — never a fresh compile mid-traffic — and the
+padded rows are sliced off before results are delivered, so padding can
+never leak into outputs.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
+           "ServerClosedError", "Request", "RequestQueue", "DynamicBatcher",
+           "MicroBatch", "bucketize", "default_buckets"]
+
+
+class ServingError(MXNetError):
+    """Base class for structured serving errors."""
+
+
+class QueueFullError(ServingError):
+    """Admission control: the request queue is at capacity; retry later."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before a forward slot ran it."""
+
+
+class ServerClosedError(ServingError):
+    """The server is stopped (or stopping) and accepts no new work."""
+
+
+def settle_exception(future, exc):
+    """Fail a future, tolerating a client cancel racing us. Returns True
+    when the exception landed, False when the future was already settled
+    (cancelled/raced) — callers route accounting on it so every request
+    settles exactly once and a lost race can never raise into (and kill)
+    a replica worker loop."""
+    try:
+        future.set_exception(exc)
+        return True
+    except Exception:
+        return False
+
+
+def default_buckets(max_batch_size):
+    """Power-of-two ladder 1, 2, 4, ..., capped and topped by max."""
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return out
+
+
+def normalize_buckets(buckets, max_batch_size):
+    """The ONE ladder-normalization rule, shared by ModelServer and
+    DynamicBatcher so the shapes the batcher emits and the shapes the
+    replicas warm can never diverge: sorted, deduped, topped up to
+    max_batch_size."""
+    if not buckets:
+        return default_buckets(max_batch_size)
+    out = sorted(set(int(b) for b in buckets))
+    if out[0] < 1:
+        raise MXNetError("buckets must be >= 1 (got %s)" % out)
+    if out[-1] > max_batch_size:
+        # an oversized bucket would pad EVERY batch past the cap — the
+        # batcher never takes more than max_batch_size real requests
+        raise MXNetError("bucket %d exceeds max_batch_size %d"
+                         % (out[-1], max_batch_size))
+    if out[-1] < max_batch_size:
+        out.append(max_batch_size)
+    return out
+
+
+def bucketize(n, buckets):
+    """Smallest bucket >= n (buckets sorted ascending)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+class Request:
+    """One single-example inference request riding the queue."""
+    __slots__ = ("rid", "inputs", "future", "deadline", "t_submit")
+    _ids = itertools.count()
+
+    def __init__(self, inputs, future, deadline=None):
+        self.rid = next(Request._ids)
+        self.inputs = inputs          # {name: per-example numpy array}
+        self.future = future          # concurrent.futures.Future
+        self.deadline = deadline      # monotonic seconds, or None
+        self.t_submit = time.monotonic()
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+
+class MicroBatch:
+    """A dequeued, padded batch ready for one forward."""
+    __slots__ = ("requests", "arrays", "bucket", "n_real")
+
+    def __init__(self, requests, arrays, bucket):
+        self.requests = requests      # the n_real live requests, in order
+        self.arrays = arrays          # {name: (bucket,)+shape numpy}
+        self.bucket = bucket
+        self.n_real = len(requests)
+
+    @property
+    def occupancy(self):
+        """Real requests per executed forward (the acceptance metric)."""
+        return self.n_real
+
+    @property
+    def fill(self):
+        """Fraction of the bucket carrying real work."""
+        return self.n_real / float(self.bucket)
+
+
+class RequestQueue:
+    """Bounded FIFO with immediate-reject admission control.
+
+    All waits are predicate-loop waits on one Condition; ``close()``
+    wakes every waiter, so no consumer can block past shutdown — the
+    deadlock-freedom contract tests/test_serving.py exercises under
+    concurrent clients.
+    """
+
+    def __init__(self, capacity):
+        if capacity < 1:
+            raise MXNetError("queue capacity must be >= 1 (got %d)" % capacity)
+        self._capacity = capacity
+        self._items = []
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self):
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def capacity(self):
+        return self._capacity
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def put(self, req):
+        """Enqueue or raise immediately — never blocks the submitter."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+            if len(self._items) >= self._capacity:
+                raise QueueFullError(
+                    "request queue full (%d/%d); retry with backoff"
+                    % (len(self._items), self._capacity))
+            self._items.append(req)
+            self._nonempty.notify()
+
+    def wait_first(self, poll_s=0.05):
+        """Block until an item is available or the queue closes. Returns
+        True when items are waiting, False on close-and-drained."""
+        with self._lock:
+            while not self._items:
+                if self._closed:
+                    return False
+                self._nonempty.wait(poll_s)
+            return True
+
+    def take(self, max_n):
+        """Pop up to ``max_n`` items (possibly zero; never blocks)."""
+        with self._lock:
+            got = self._items[:max_n]
+            del self._items[:max_n]
+            return got
+
+    def close(self):
+        """Stop admitting; wake all waiting consumers. Items already
+        queued remain takeable so a graceful drain can finish them."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+
+    def reject_all(self, exc_factory):
+        """Fail every queued request (non-graceful stop path). Returns
+        (n_failed, n_raced) — raced = already settled/cancelled."""
+        with self._lock:
+            items, self._items = self._items, []
+        n_failed = 0
+        for req in items:
+            if settle_exception(req.future, exc_factory(req)):
+                n_failed += 1
+        return n_failed, len(items) - n_failed
+
+
+class DynamicBatcher:
+    """Coalesce queued requests into bucket-padded micro-batches.
+
+    Shared by all replica workers: each idle worker calls
+    ``next_batch()``, so dispatch is least-loaded by construction (only a
+    replica with a free forward slot ever pulls work — a busy replica
+    never has a batch assigned to it while an idle peer waits).
+    """
+
+    def __init__(self, queue, max_batch_size, max_latency_ms, buckets=None):
+        if max_batch_size < 1:
+            raise MXNetError("max_batch_size must be >= 1")
+        self.queue = queue
+        self.max_batch_size = max_batch_size
+        self.max_latency_s = max(0.0, float(max_latency_ms)) / 1e3
+        self.buckets = normalize_buckets(buckets, max_batch_size)
+        # server-installed stats hooks (req) -> None; drain() counts on
+        # every admitted request reaching exactly one settled hook.
+        # on_depth(depth) fires after every dequeue so the queue-depth
+        # observable falls when the queue drains, not only on admits
+        self.on_expired = None
+        self.on_cancelled = None
+        self.on_depth = None
+
+    # ------------------------------------------------------------------
+    def _expire(self, requests):
+        """Split out expired/cancelled requests, failing their futures
+        before they waste a forward slot."""
+        live = []
+        now = time.monotonic()
+        for req in requests:
+            if req.future.cancelled():
+                if self.on_cancelled is not None:
+                    self.on_cancelled(req)
+                continue
+            if req.expired(now):
+                landed = settle_exception(req.future, DeadlineExceededError(
+                    "request %d deadline expired after %.1f ms in queue"
+                    % (req.rid, (now - req.t_submit) * 1e3)))
+                hook = self.on_expired if landed else self.on_cancelled
+                if hook is not None:
+                    hook(req)
+                continue
+            live.append(req)
+        return live
+
+    def next_batch(self, poll_s=0.05):
+        """Block until a micro-batch is ready; None when closed+drained.
+
+        The batching window: the first request opens it; it closes when
+        ``max_batch_size`` requests are waiting or ``max_latency_ms``
+        elapsed — whichever is first.
+        """
+        while True:
+            if not self.queue.wait_first(poll_s):
+                return None
+            t_open = time.monotonic()
+            # the window: sleep in short slices so a burst arriving right
+            # after the first request still closes the window early
+            while (len(self.queue) < self.max_batch_size
+                   and time.monotonic() - t_open < self.max_latency_s):
+                time.sleep(min(0.001, self.max_latency_s / 4 or 0.001))
+            requests = self._expire(self.queue.take(self.max_batch_size))
+            if self.on_depth is not None:
+                self.on_depth(len(self.queue))
+            if requests:
+                return self._pad(requests)
+            # everything taken had expired — go back to waiting
+
+    # ------------------------------------------------------------------
+    def _pad(self, requests):
+        bucket = bucketize(len(requests), self.buckets)
+        names = requests[0].inputs.keys()
+        arrays = {}
+        for name in names:
+            rows = [req.inputs[name] for req in requests]
+            stacked = _np.stack(rows, axis=0)
+            if bucket > len(rows):
+                # replicate row 0 into the padding slots: real values keep
+                # the numerics in-range (an all-zero pad can produce inf/
+                # nan in ops like log-softmax whose rows are independent
+                # anyway), and the rows are sliced off before delivery
+                pad = _np.broadcast_to(
+                    stacked[:1], (bucket - len(rows),) + stacked.shape[1:])
+                stacked = _np.concatenate([stacked, pad], axis=0)
+            arrays[name] = stacked
+        return MicroBatch(requests, arrays, bucket)

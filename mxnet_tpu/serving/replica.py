@@ -1,0 +1,159 @@
+"""Replica workers: one Predictor per device context, bucketed executors.
+
+Each ``Replica`` owns a ``Predictor`` bound to its own context —
+``mx.tpu(i)`` in production, ``mx.cpu(i)`` under the test mesh — and a
+ladder of bucket-shaped rebinds of it created through
+``Predictor.reshape``, which shares the device-resident parameters
+(executor-level reuse; no per-bucket host->device weight copy). The jit
+cache is per *symbol*, so all replicas and all buckets share one trace
+cache and each (bucket, device) pair compiles exactly once.
+
+Dispatch is least-loaded by construction: every replica runs a pull loop
+against the shared ``DynamicBatcher``, and only a replica with a free
+forward slot pulls — a busy replica never queues work while an idle peer
+waits. Per-replica in-flight/served counters feed ``ModelServer.stats()``.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from .batcher import DeadlineExceededError, settle_exception
+
+__all__ = ["Replica", "ReplicaPool"]
+
+
+class Replica:
+    """One worker thread + one Predictor (and its bucket rebinds)."""
+
+    def __init__(self, index, ctx, predictor, buckets, batcher, stats=None):
+        self.index = index
+        self.ctx = ctx
+        self.buckets = sorted(buckets)
+        self._batcher = batcher
+        self._stats = stats
+        self._preds = {self.buckets[-1]: predictor}
+        self._base = predictor
+        self._thread = None
+        self._inflight = 0
+        self.batches_served = 0
+        self.requests_served = 0
+
+    # ------------------------------------------------------------------
+    def _pred_for(self, bucket):
+        """Bucket-shaped Predictor, rebound lazily; parameters are shared
+        device arrays (Predictor.reshape), so this costs one bind + (on
+        first forward) one XLA compile per bucket, ever."""
+        pred = self._preds.get(bucket)
+        if pred is None:
+            shapes = {name: (bucket,) + tuple(shape[1:])
+                      for name, shape in self._base.input_shapes.items()}
+            pred = self._base.reshape(shapes)
+            self._preds[bucket] = pred
+        return pred
+
+    def warmup(self):
+        """Compile every bucket shape before serving (cold-start cost paid
+        up front, not by the first unlucky requests)."""
+        for bucket in self.buckets:
+            pred = self._pred_for(bucket)
+            dummy = {name: _np.zeros((bucket,) + tuple(shape[1:]),
+                                     dtype=_np.float32)
+                     for name, shape in self._base.input_shapes.items()}
+            pred.forward(**dummy)
+
+    # ------------------------------------------------------------------
+    @property
+    def inflight(self):
+        return self._inflight
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="mx-serving-replica-%d" % self.index,
+            daemon=True)
+        self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        while True:
+            batch = self._batcher.next_batch()
+            if batch is None:       # queue closed and drained
+                return
+            self._inflight = batch.n_real
+            try:
+                self._execute(batch)
+            finally:
+                self._inflight = 0
+
+    def _execute(self, mb):
+        stats = self._stats
+        try:
+            pred = self._pred_for(mb.bucket)
+            outs = pred.forward(**mb.arrays)
+        except Exception as exc:     # deliver, don't kill the worker
+            for req in mb.requests:
+                settle_exception(req.future, exc)
+            if stats is not None:
+                stats.record_failed_batch(self.index, mb, exc)
+            return
+        # slice the padding off before delivery — rows [n_real:] are
+        # replicas of row 0 and must never leak into any result
+        for i, req in enumerate(mb.requests):
+            if req.future.cancelled():
+                if stats is not None:
+                    stats.record_cancelled(req)
+                continue
+            if req.expired():
+                landed = settle_exception(req.future, DeadlineExceededError(
+                    "request %d deadline expired during forward" % req.rid))
+                if stats is not None:
+                    (stats.record_expired if landed
+                     else stats.record_cancelled)(req)
+                continue
+            try:
+                req.future.set_result([out[i] for out in outs])
+            except Exception:        # client cancelled in the window above
+                if stats is not None:
+                    stats.record_cancelled(req)
+        self.batches_served += 1
+        self.requests_served += mb.n_real
+        if stats is not None:
+            stats.record_batch(self.index, mb)
+
+    def snapshot(self):
+        return {"replica": self.index, "ctx": str(self.ctx),
+                "inflight": self._inflight,
+                "batches_served": self.batches_served,
+                "requests_served": self.requests_served,
+                "buckets_bound": sorted(self._preds)}
+
+
+class ReplicaPool:
+    """N replicas pulling from one shared batcher."""
+
+    def __init__(self, contexts, make_predictor, buckets, batcher,
+                 stats=None, warmup=True):
+        self.replicas = []
+        for i, ctx in enumerate(contexts):
+            pred = make_predictor(ctx)
+            self.replicas.append(
+                Replica(i, ctx, pred, buckets, batcher, stats))
+        if warmup:
+            for rep in self.replicas:
+                rep.warmup()
+
+    def start(self):
+        for rep in self.replicas:
+            rep.start()
+
+    def join(self, timeout=None):
+        for rep in self.replicas:
+            rep.join(timeout)
+
+    def snapshot(self):
+        return [rep.snapshot() for rep in self.replicas]

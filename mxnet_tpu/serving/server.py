@@ -1,0 +1,491 @@
+"""ModelServer: the public serving API + optional stdlib HTTP endpoint.
+
+One object wires the subsystem together: a bounded ``RequestQueue``
+(admission control), a shared ``DynamicBatcher`` (micro-batching +
+bucket padding), and a ``ReplicaPool`` (one Predictor per device).
+
+API surface::
+
+    srv = ModelServer(sym, arg_params, aux_params,
+                      input_shapes={"data": (3, 224, 224)},   # per example
+                      num_replicas=2, max_batch_size=8)
+    fut  = srv.submit({"data": x})            # future of [out_i rows]
+    outs = srv.predict({"data": x})           # sync convenience
+    outs = await srv.submit_async({"data": x})
+    srv.drain(); srv.stop()
+    srv.stats()                               # metrics snapshot (dict)
+    srv.start_http(port=8123)                 # POST /predict, GET /stats
+
+Observability: every snapshot field is also exported through
+``mx.profiler`` user objects (Domain "serving": queue-depth and
+batch-occupancy Counters, reject Markers), so a profiler trace shows the
+serving control plane alongside the device timeline.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import profiler as _prof
+from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
+                      Request, RequestQueue, ServerClosedError, ServingError,
+                      normalize_buckets)
+from .replica import ReplicaPool
+
+__all__ = ["ModelServer", "ServerStats"]
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class ServerStats:
+    """Thread-safe metrics sink shared by the queue, batcher and replicas.
+
+    Latency/throughput track a sliding window of recent completions (the
+    last ``window`` requests), counters are monotonic totals. The same
+    numbers feed ``stats()`` snapshots and the mx.profiler Counters.
+    """
+
+    def __init__(self, window=4096):
+        self._lock = threading.Lock()
+        self.settled_cv = threading.Condition(self._lock)
+        self.t_start = time.monotonic()
+        # monotonic totals
+        self.admitted = 0
+        self.completed = 0
+        self.rejected_queue_full = 0
+        self.rejected_deadline = 0
+        self.failed = 0
+        self.cancelled = 0
+        # batching
+        self.batches = 0
+        self.occupancy_sum = 0
+        self.fill_sum = 0.0
+        self.per_bucket = {}
+        # sliding windows
+        self._latencies = deque(maxlen=window)      # seconds
+        self._completions = deque(maxlen=window)    # monotonic timestamps
+        # profiler export (events only recorded while the profiler runs)
+        dom = _prof.Domain("serving")
+        self._c_depth = dom.new_counter("serving.queue_depth")
+        self._c_occ = dom.new_counter("serving.batch_occupancy")
+        self._c_p50 = dom.new_counter("serving.latency_p50_us")
+        self._c_p99 = dom.new_counter("serving.latency_p99_us")
+        self._c_qps = dom.new_counter("serving.throughput_qps")
+        self._m_reject = dom.new_marker("serving.reject")
+
+    # -- hooks ---------------------------------------------------------
+    def record_admitted(self, depth):
+        with self._lock:
+            self.admitted += 1
+        self._c_depth.set_value(depth)
+
+    def record_depth(self, depth):
+        self._c_depth.set_value(depth)
+
+    def record_queue_full(self):
+        with self._lock:
+            self.rejected_queue_full += 1
+        self._m_reject.mark()
+
+    def record_expired(self, req):
+        with self.settled_cv:
+            self.rejected_deadline += 1
+            self.settled_cv.notify_all()
+        self._m_reject.mark()
+
+    def record_cancelled(self, req):
+        with self.settled_cv:
+            self.cancelled += 1
+            self.settled_cv.notify_all()
+
+    def record_batch(self, replica_idx, mb):
+        now = time.monotonic()
+        with self.settled_cv:
+            self.batches += 1
+            self.occupancy_sum += mb.n_real
+            self.fill_sum += mb.fill
+            self.per_bucket[mb.bucket] = self.per_bucket.get(mb.bucket, 0) + 1
+            for req in mb.requests:
+                if (req.future.done() and not req.future.cancelled()
+                        and req.future.exception() is None):
+                    self.completed += 1
+                    self._latencies.append(now - req.t_submit)
+                    self._completions.append(now)
+            self.settled_cv.notify_all()
+        self._c_occ.set_value(mb.n_real)
+
+    def record_failed_batch(self, replica_idx, mb, exc):
+        with self.settled_cv:
+            self.failed += mb.n_real
+            self.settled_cv.notify_all()
+
+    def reset(self):
+        """Zero every counter and window (benchmarks reset after warmup
+        so compile-time batches don't bias occupancy/latency). Call only
+        while the server is idle — an in-flight request would settle
+        against the fresh counters and skew drain accounting."""
+        with self.settled_cv:
+            self.t_start = time.monotonic()
+            self.admitted = self.completed = 0
+            self.rejected_queue_full = self.rejected_deadline = 0
+            self.failed = self.cancelled = 0
+            self.batches = 0
+            self.occupancy_sum = 0
+            self.fill_sum = 0.0
+            self.per_bucket = {}
+            self._latencies.clear()
+            self._completions.clear()
+            self.settled_cv.notify_all()
+
+    # -- drain support -------------------------------------------------
+    def settled(self):
+        return (self.completed + self.rejected_deadline + self.failed
+                + self.cancelled)
+
+    def wait_settled(self, target, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.settled_cv:
+            while self.settled() < target:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self.settled_cv.wait(left if left is not None else 0.1)
+            return True
+
+    # -- snapshot ------------------------------------------------------
+    def snapshot(self, queue_depth=0, replicas=None):
+        with self._lock:
+            lat = sorted(self._latencies)
+            comps = list(self._completions)
+            batches = self.batches
+            snap = {
+                "uptime_s": round(time.monotonic() - self.t_start, 3),
+                "queue_depth": queue_depth,
+                "requests": {
+                    "admitted": self.admitted,
+                    "completed": self.completed,
+                    "rejected_queue_full": self.rejected_queue_full,
+                    "rejected_deadline": self.rejected_deadline,
+                    "failed": self.failed,
+                    "cancelled": self.cancelled,
+                },
+                "batches": {
+                    "count": batches,
+                    "mean_occupancy": (self.occupancy_sum / batches
+                                       if batches else None),
+                    "mean_fill": (self.fill_sum / batches
+                                  if batches else None),
+                    "per_bucket": dict(sorted(self.per_bucket.items())),
+                },
+            }
+        to_ms = lambda v: None if v is None else round(v * 1e3, 3)
+        snap["latency_ms"] = {
+            "p50": to_ms(_percentile(lat, 0.50)),
+            "p90": to_ms(_percentile(lat, 0.90)),
+            "p99": to_ms(_percentile(lat, 0.99)),
+            "mean": to_ms(sum(lat) / len(lat) if lat else None),
+            "max": to_ms(lat[-1] if lat else None),
+        }
+        if len(comps) >= 2 and comps[-1] > comps[0]:
+            snap["throughput_qps"] = round(
+                (len(comps) - 1) / (comps[-1] - comps[0]), 2)
+        else:
+            snap["throughput_qps"] = None
+        if replicas is not None:
+            snap["replicas"] = replicas
+        # mirror the derived metrics into the profiler counters so a
+        # chrome trace carries p50/p99/qps tracks next to the per-batch
+        # queue-depth/occupancy ones (events only record while running)
+        if _prof.state() == "run":
+            if snap["latency_ms"]["p50"] is not None:
+                self._c_p50.set_value(snap["latency_ms"]["p50"] * 1e3)
+                self._c_p99.set_value(snap["latency_ms"]["p99"] * 1e3)
+            if snap["throughput_qps"] is not None:
+                self._c_qps.set_value(snap["throughput_qps"])
+        return snap
+
+
+class ModelServer:
+    """Dynamic-batching, multi-replica inference server (module docs).
+
+    Parameters
+    ----------
+    symbol, arg_params, aux_params : the model (as for ``Predictor``)
+    input_shapes : dict of per-EXAMPLE shapes, WITHOUT the batch axis —
+        ``{"data": (3, 224, 224)}`` serves batches of (b, 3, 224, 224).
+    num_replicas : worker replicas; replica i binds to ``contexts[i]``
+        (default: ``mx.tpu(i)`` when accelerators exist, else ``mx.cpu(i)``)
+    max_batch_size : micro-batch cap = the top bucket
+    max_latency_ms : batching window opened by the first waiting request
+    queue_capacity : admission bound; a full queue rejects immediately
+    timeout_ms : default per-request deadline (None = no deadline)
+    buckets : batch-size ladder (default 1, 2, 4, ..., max_batch_size)
+    warmup : pre-compile every bucket shape at construction
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, input_shapes,
+                 num_replicas=1, contexts=None, max_batch_size=8,
+                 max_latency_ms=5.0, queue_capacity=None, timeout_ms=None,
+                 dtype="float32", buckets=None, warmup=True):
+        from ..predictor import Predictor
+
+        for name, shape in input_shapes.items():
+            if not isinstance(shape, (tuple, list)):
+                raise MXNetError("input_shapes[%r] must be a shape tuple "
+                                 "(per example, no batch axis)" % name)
+        self._example_shapes = {n: tuple(s) for n, s in input_shapes.items()}
+        self._dtype = dtype
+        self._timeout_ms = timeout_ms
+        # one ladder for everyone: the batcher can emit any bucket in it,
+        # so the replicas/warmup/top-bind must see the identical list —
+        # including a max_batch_size cap the caller's ladder didn't reach
+        # (otherwise the first full-load batch would compile mid-traffic)
+        self._buckets = normalize_buckets(buckets, max_batch_size)
+        if queue_capacity is None:
+            queue_capacity = max(64, 4 * max_batch_size * num_replicas)
+        self._queue = RequestQueue(queue_capacity)
+        self._stats = ServerStats()
+        self._batcher = DynamicBatcher(self._queue, max_batch_size,
+                                       max_latency_ms, self._buckets)
+        self._batcher.on_expired = self._stats.record_expired
+        self._batcher.on_cancelled = self._stats.record_cancelled
+        self._batcher.on_depth = self._stats.record_depth
+
+        if contexts is None:
+            contexts = self._default_contexts(num_replicas)
+        if len(contexts) != num_replicas:
+            raise MXNetError("need %d contexts, got %d"
+                             % (num_replicas, len(contexts)))
+        top = self._buckets[-1]
+
+        def make_predictor(ctx):
+            return Predictor(
+                symbol, arg_params, aux_params,
+                {n: (top,) + s for n, s in self._example_shapes.items()},
+                ctx=ctx, dtype=dtype)
+
+        self._pool = ReplicaPool(contexts, make_predictor, self._buckets,
+                                 self._batcher, self._stats, warmup=warmup)
+        self._closed = False
+        self._http = None
+        self._http_thread = None
+        self._pool.start()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _default_contexts(n):
+        import jax
+        from .. import context as _ctx
+        if any(d.platform != "cpu" for d in jax.local_devices()):
+            return [_ctx.tpu(i % _ctx.num_tpus()) for i in range(n)]
+        return [_ctx.cpu(i) for i in range(n)]
+
+    @classmethod
+    def load(cls, prefix, epoch, input_shapes, **kwargs):
+        """Build a server from ``prefix-symbol.json`` + ``prefix-%04d.params``
+        (the MXPredCreate file form)."""
+        from .. import model as _model
+        sym, arg_params, aux_params = _model.load_checkpoint(prefix, epoch)
+        return cls(sym, arg_params, aux_params, input_shapes, **kwargs)
+
+    # ------------------------------------------------------------------
+    def _normalize(self, inputs):
+        if set(inputs) != set(self._example_shapes):
+            raise MXNetError(
+                "inputs must provide exactly %s (got %s)"
+                % (sorted(self._example_shapes), sorted(inputs)))
+        out = {}
+        for name, value in inputs.items():
+            if hasattr(value, "asnumpy"):     # NDArray
+                value = value.asnumpy()
+            try:
+                arr = _np.asarray(value, dtype=self._dtype)
+            except (TypeError, ValueError) as e:
+                # keep the structured-error contract: a garbage payload
+                # is a client error (HTTP 400), not an internal 500
+                raise MXNetError("input %r: cannot convert to a %s array "
+                                 "(%s)" % (name, self._dtype, e)) from e
+            want = self._example_shapes[name]
+            if arr.shape != want:
+                raise MXNetError(
+                    "input %r: expected per-example shape %s, got %s"
+                    % (name, want, arr.shape))
+            out[name] = arr
+        return out
+
+    def submit(self, inputs=None, timeout_ms=None, **kw_inputs):
+        """Enqueue one example; returns a ``concurrent.futures.Future``
+        resolving to ``[output_i_row, ...]`` (one numpy array per model
+        output). Raises ``QueueFullError`` (backpressure) or
+        ``ServerClosedError`` immediately; the future fails with
+        ``DeadlineExceededError`` when the deadline expires first."""
+        if inputs is None:
+            inputs = kw_inputs
+        elif kw_inputs:
+            raise MXNetError("pass inputs as one dict or as kwargs, not both")
+        if self._closed:
+            raise ServerClosedError("server is stopped")
+        arrays = self._normalize(inputs)
+        timeout_ms = self._timeout_ms if timeout_ms is None else timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        fut = Future()
+        req = Request(arrays, fut, deadline)
+        try:
+            self._queue.put(req)
+        except QueueFullError:
+            self._stats.record_queue_full()
+            raise
+        self._stats.record_admitted(len(self._queue))
+        return fut
+
+    def predict(self, inputs=None, timeout_ms=None, **kw_inputs):
+        """Synchronous convenience: submit + wait."""
+        fut = self.submit(inputs, timeout_ms=timeout_ms, **kw_inputs)
+        return fut.result()
+
+    async def submit_async(self, inputs=None, timeout_ms=None, **kw_inputs):
+        """Asyncio form: ``outs = await srv.submit_async({...})``."""
+        import asyncio
+        fut = self.submit(inputs, timeout_ms=timeout_ms, **kw_inputs)
+        return await asyncio.wrap_future(fut)
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout=None):
+        """Block until everything admitted so far has settled (completed,
+        expired, or failed). Returns False on timeout."""
+        with self._stats._lock:
+            target = self._stats.admitted
+        return self._stats.wait_settled(target, timeout)
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the server. ``drain=True`` (graceful) finishes queued work
+        first; ``drain=False`` fails queued requests with
+        ``ServerClosedError``. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.stop_http()
+        if drain:
+            self.drain(timeout)
+            self._queue.close()
+        else:
+            self._queue.close()
+            n_failed, n_raced = self._queue.reject_all(
+                lambda req: ServerClosedError("server stopped before "
+                                              "request %d ran" % req.rid))
+            if n_failed or n_raced:
+                with self._stats.settled_cv:
+                    self._stats.failed += n_failed
+                    self._stats.cancelled += n_raced
+                    self._stats.settled_cv.notify_all()
+        self._pool.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Metrics snapshot: queue depth, admission/served counters, batch
+        occupancy, latency percentiles, throughput, per-replica detail
+        (glossary in docs/SERVING.md)."""
+        return self._stats.snapshot(queue_depth=len(self._queue),
+                                    replicas=self._pool.snapshot())
+
+    def reset_stats(self):
+        """Zero the metrics (e.g. after a warmup phase); the server must
+        be idle — drain() first if unsure."""
+        self._stats.reset()
+
+    # ------------------------------------------------------------------
+    # optional JSON-over-HTTP endpoint (stdlib only)
+    # ------------------------------------------------------------------
+    def start_http(self, port=8123, host="127.0.0.1"):
+        """Serve ``POST /predict`` ({"inputs": {...}, "timeout_ms": n}),
+        ``GET /stats`` and ``GET /health`` on a daemon thread. Returns the
+        bound (host, port)."""
+        if self._http is not None:
+            raise MXNetError("HTTP endpoint already running")
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # keep pytest/console output clean
+                pass
+
+            def _reply(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    self._reply(200, server.stats())
+                elif self.path == "/health":
+                    self._reply(200 if not server._closed else 503,
+                                {"status": "ok" if not server._closed
+                                 else "stopped"})
+                else:
+                    self._reply(404, {"error": "unknown path %s" % self.path})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "unknown path %s" % self.path})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError as e:   # malformed body = client error
+                        self._reply(400, {"error": "invalid JSON: %s" % e,
+                                          "type": "bad_request"})
+                        return
+                    fut = server.submit(doc.get("inputs") or {},
+                                        timeout_ms=doc.get("timeout_ms"))
+                    outs = fut.result()
+                    self._reply(200, {"outputs": [o.tolist() for o in outs]})
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e), "type": "queue_full"})
+                except DeadlineExceededError as e:
+                    self._reply(504, {"error": str(e), "type": "deadline"})
+                except ServerClosedError as e:
+                    self._reply(503, {"error": str(e), "type": "closed"})
+                except ServingError as e:
+                    self._reply(400, {"error": str(e), "type": "bad_request"})
+                except MXNetError as e:
+                    self._reply(400, {"error": str(e), "type": "bad_request"})
+                except Exception as e:   # noqa: BLE001 — surface, don't hang
+                    self._reply(500, {"error": str(e), "type": "internal"})
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, name="mx-serving-http",
+            daemon=True)
+        self._http_thread.start()
+        return self._http.server_address
+
+    def stop_http(self):
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+            self._http_thread = None

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..base import MXNetError, current_name_manager
 from ..ops import registry as _reg
 from .symbol import (Symbol, Variable, var, Group, load, load_json, AttrScope,
-                     _Node)
+                     _Node, _expand_user_attrs)
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "AttrScope"]
@@ -25,10 +25,12 @@ def _entry_of(s):
 
 def _invoke_op(opname, sym_inputs, attrs=None, name=None):
     opdef = _reg.get_op(opname)
+    given = list(attrs or {})
     attrs = opdef.normalize_attrs(attrs or {})
     nm = current_name_manager().get(name, opdef.name.replace("_", ""))
     inputs = [_entry_of(s) for s in sym_inputs]
-    node = _Node(opdef, nm, attrs, inputs, AttrScope.current_attrs())
+    node = _Node(opdef, nm, attrs, inputs, AttrScope.current_attrs(),
+                 given_attrs=given)
     vis = opdef.visible_out_count(attrs)
     return Symbol([(node, i) for i in range(vis)]) if vis > 1 else Symbol([(node, 0)])
 
@@ -39,10 +41,33 @@ def _invoke_scalar(opname, s, scalar, reverse):
 
 def _make_sym_func(opdef, fname):
     def fn(*args, name=None, attr=None, **kwargs):
+        # user attrs riding the op call (reference register.py creator):
+        # lr_mult/wd_mult-style kwargs plus free-form __dunder__ kwargs
+        # become str attrs, never op params
+        user_kwargs = {}
+        for k in list(kwargs):
+            if (k in ("lr_mult", "wd_mult", "force_mirroring")
+                    and k not in opdef.attr_names) \
+                    or (k.startswith("__") and k.endswith("__")):
+                user_kwargs[k] = str(kwargs.pop(k))
         kw_inputs, attrs = opdef.split_kwargs(kwargs)
+        given = list(attrs)
         attrs = opdef.normalize_attrs(attrs)
         hint = opdef.name.lower().replace("_", "")
         nm = current_name_manager().get(name, hint)
+
+        # merged user attrs: enclosing AttrScope, then attr= dict, then
+        # attr-ish kwargs (innermost wins, like the reference)
+        str_attrs = AttrScope.current_attrs()
+        if attr:
+            str_attrs.update({k: str(v) for k, v in attr.items()})
+        str_attrs.update(user_kwargs)
+        str_attrs = _expand_user_attrs(str_attrs)
+        # auto-created parameter variables inherit the dunder user attrs
+        # (nnvm compose copies __attr__ entries onto the variables it
+        # creates — how conv_weight/conv_bias pick up e.g. __init__)
+        var_attr = {k: v for k, v in str_attrs.items()
+                    if k.startswith("__") and k.endswith("__")}
 
         if opdef.variadic:
             inputs = [_entry_of(s) for s in args]
@@ -63,12 +88,15 @@ def _make_sym_func(opdef, fname):
                 elif in_name in unused:
                     continue
                 else:
-                    # auto-create the parameter variable (ref: nnvm compose)
-                    s = Variable("%s_%s" % (nm, in_name))
+                    # auto-create the parameter variable (ref: nnvm
+                    # compose); it inherits the dunder user attrs plus
+                    # the enclosing AttrScope (Variable merges the scope
+                    # itself — keeps ctx_group placement working)
+                    s = Variable("%s_%s" % (nm, in_name),
+                                 attr=var_attr or None)
                 inputs.append(_entry_of(s))
-        node = _Node(opdef, nm, attrs, inputs, AttrScope.current_attrs())
-        if attr:
-            node.str_attrs.update({k: str(v) for k, v in attr.items()})
+        node = _Node(opdef, nm, attrs, inputs, str_attrs,
+                     given_attrs=given)
         vis = opdef.visible_out_count(attrs)
         if vis > 1:
             return Symbol([(node, i) for i in range(vis)])

@@ -50,15 +50,21 @@ class AttrScope:
 
 
 class _Node:
-    __slots__ = ("op", "name", "attrs", "str_attrs", "inputs", "cf_meta")
+    __slots__ = ("op", "name", "attrs", "str_attrs", "inputs", "cf_meta",
+                 "given_attrs")
     _uid = [0]
 
     def __init__(self, op, name, attrs, inputs, str_attrs=None,
-                 cf_meta=None):
+                 cf_meta=None, given_attrs=None):
         self.op = op            # OpDef or None for variables
         self.name = name
         self.attrs = attrs      # typed op attrs
         self.str_attrs = dict(str_attrs or {})  # user attrs (ctx_group, __shape__…)
+        # attr names the CALLER passed (normalize_attrs fills defaults
+        # into `attrs`, losing explicitness); None = unknown, fall back
+        # to the value-differs-from-default heuristic
+        self.given_attrs = (frozenset(given_attrs)
+                            if given_attrs is not None else None)
         self.inputs = inputs    # list[(Node, out_idx)]
         # control-flow metadata: {"kind", "subgraphs": [Symbol, ...],
         # **json-able fields} — lets foreach/while_loop/cond nodes
@@ -84,6 +90,20 @@ class _Node:
             return self.name + "_output"
         # match reference multi-output naming: name + suffix per output
         return "%s_output%d" % (self.name, idx)
+
+    def explicit_attrs(self):
+        """The op attrs the caller actually passed, as {name: value} —
+        exact when tracked at creation, else the params whose value
+        differs from the registry default (a value explicitly set TO its
+        default is indistinguishable then)."""
+        if self.is_var:
+            return {}
+        if self.given_attrs is not None:
+            return {k: v for k, v in self.attrs.items()
+                    if k in self.given_attrs}
+        defaults = self.op.attr_defaults
+        return {k: v for k, v in self.attrs.items()
+                if k not in defaults or defaults[k] != v}
 
 
 class Symbol:
@@ -190,26 +210,49 @@ class Symbol:
     # attrs
     # ------------------------------------------------------------------
     def attr(self, key):
+        """User attribute lookup with the reference's dunder fallback:
+        ``attr('lr_mult')`` finds a value stored as ``__lr_mult__`` and
+        vice versa (conformance: the reference's test_attr reads both
+        spellings of the same attribute)."""
         node = self._entries[0][0]
-        return node.str_attrs.get(key)
+        if key in node.str_attrs:
+            return node.str_attrs[key]
+        if not (key.startswith("__") and key.endswith("__")):
+            return node.str_attrs.get("__%s__" % key)
+        stripped = key[2:-2]
+        if stripped:
+            return node.str_attrs.get(stripped)
+        return None
 
-    def list_attr(self):
+    def list_attr(self, recursive=False):
+        """Shallow user-attr dict of the head node (reference
+        symbol.py list_attr; recursive aggregation moved to
+        ``attr_dict`` in the reference too)."""
+        if recursive:
+            raise DeprecationWarning(
+                "Symbol.list_attr with recursive=True has been deprecated; "
+                "use attr_dict instead")
         return dict(self._entries[0][0].str_attrs)
 
     def attr_dict(self):
+        """{node name: attrs} over the whole graph. Matches the
+        reference's aggregation: user attrs verbatim, plus — for op
+        nodes — the *explicitly given* op params as MXNet-style strings
+        (the reference's nnvm attrs.dict holds only what the caller
+        passed; filled-in defaults stay out)."""
         out = {}
         for node in self._topo():
             if node.str_attrs or not node.is_var:
                 d = dict(node.str_attrs)
-                if not node.is_var:
-                    d.update({k: _attr_to_str(v) for k, v in node.attrs.items()})
+                d.update({k: _attr_to_str(v)
+                          for k, v in node.explicit_attrs().items()})
                 if d:
                     out[node.name] = d
         return out
 
     def _set_attr(self, **kwargs):
         self._entries[0][0].str_attrs.update(
-            {k: str(v) for k, v in kwargs.items()})
+            _expand_user_attrs({k: str(v) for k, v in kwargs.items()}))
 
     # ------------------------------------------------------------------
     # shape/type inference
@@ -390,7 +433,11 @@ class Symbol:
         for i, n in enumerate(nodes):
             if n.is_var:
                 arg_nodes.append(i)
-            attrs = {k: _attr_to_str(v) for k, v in (n.attrs or {}).items()}
+            # explicit params only — the reference's symbol.json carries
+            # what the caller passed, never parser-filled defaults (and
+            # load_json can then recover the explicit set exactly)
+            attrs = {k: _attr_to_str(v)
+                     for k, v in n.explicit_attrs().items()}
             attrs.update(n.str_attrs)
             jn = {"op": "null" if n.is_var else n.op.name,
                   "name": n.name,
@@ -459,7 +506,7 @@ class Symbol:
             else:
                 new = _Node(node.op, node.name, dict(node.attrs),
                             [(rebuild(i), oi) for i, oi in node.inputs],
-                            node.str_attrs)
+                            node.str_attrs, given_attrs=node.given_attrs)
             memo[id(node)] = new
             return new
 
@@ -497,6 +544,16 @@ class Symbol:
 
     def __hash__(self):
         return id(self)
+
+    # pickling rides the JSON wire format (the reference pickles through
+    # tojson/load_json the same way, symbol.py __getstate__): _Node/OpDef
+    # object graphs never enter the pickle, so compiled-cache handles and
+    # op closures can't leak in
+    def __getstate__(self):
+        return {"handle": self.tojson()}
+
+    def __setstate__(self, state):
+        self._entries = load_json(state["handle"])._entries
 
     def __repr__(self):
         outs = self.list_outputs()
@@ -542,13 +599,31 @@ def _attr_to_str(v):
     return str(v)
 
 
+# the user attrs the framework itself consumes in dunder form
+# (optimizer lr/wd multipliers, the mirroring hint) — a plain-spelled
+# one is mirrored to its dunder twin at store time, like the reference
+_MIRRORED_USER_ATTRS = ("lr_mult", "wd_mult", "force_mirroring")
+
+
+def _expand_user_attrs(attrs):
+    """Mirror recognized plain keys to their dunder twins so both
+    spellings list (conformance: test_attr reads attr('lr_mult') and
+    attr('__lr_mult__') after setting either one)."""
+    out = dict(attrs)
+    for key in _MIRRORED_USER_ATTRS:
+        if key in out and ("__%s__" % key) not in out:
+            out["__%s__" % key] = str(out[key])
+    return out
+
+
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     if not isinstance(name, str):
         raise TypeError("Variable name must be a string")
     str_attrs = AttrScope.current_attrs()
     if attr:
-        str_attrs.update(attr)
+        str_attrs.update({k: str(v) for k, v in attr.items()})
+    str_attrs = _expand_user_attrs(str_attrs)
     if shape is not None:
         str_attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -559,6 +634,17 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         str_attrs["__wd_mult__"] = str(wd_mult)
     if init is not None:
         str_attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            # free-form dunder kwargs attach as user attrs (reference
+            # symbol.py var(): "Additional attributes must start and end
+            # with double underscores")
+            str_attrs[k] = str(v)
+        else:
+            raise ValueError(
+                "Variable attribute name=%s is not supported. Additional "
+                "attributes must start and end with double underscores, "
+                "e.g. __yourattr__" % k)
     node = _Node(None, name, {}, [], str_attrs)
     return Symbol([(node, 0)])
 
@@ -612,9 +698,10 @@ def _load_graph_dict(data):
                                str_attrs=user, cf_meta=meta))
         else:
             opdef = _reg.get_op(jn["op"])
-            typed = opdef.normalize_attrs(
-                {k: v for k, v in attrs.items() if k in opdef.attr_names})
+            given = [k for k in attrs if k in opdef.attr_names]
+            typed = opdef.normalize_attrs({k: attrs[k] for k in given})
             user = {k: v for k, v in attrs.items() if k not in opdef.attr_names}
-            nodes.append(_Node(opdef, jn["name"], typed, inputs, user))
+            nodes.append(_Node(opdef, jn["name"], typed, inputs, user,
+                               given_attrs=given))
     heads = data["heads"]
     return Symbol([(nodes[h[0]], h[1]) for h in heads])

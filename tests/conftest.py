@@ -18,6 +18,12 @@ import pytest
 
 import jax
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: soak/stress tests excluded from tier-1 (-m 'not slow')")
+
 # Force CPU even when a TPU plugin was registered at interpreter start
 # (single-tenant TPU tunnels make concurrent test runs deadlock; the real
 # chip is exercised by bench.py, not the unit suite). Backends are created

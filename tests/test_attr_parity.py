@@ -1,0 +1,107 @@
+"""Symbol attribute semantics — the reference's test_attr.py contract.
+
+These encode the four conformance items triaged in docs/CONFORMANCE.md
+("attribute scope: attr= dicts on Variables/ops, lr_mult et al. as op
+kwargs, list_attr()/attr_dict() aggregation") in the shape of the
+reference's tests/python/unittest/test_attr.py, runnable without the
+staged reference tree.
+"""
+import pickle as pkl
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_attr_basic():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.symbol.Variable("data",
+                                  attr={"dtype": "data", "group": "1",
+                                        "force_mirroring": "True"},
+                                  lr_mult=1)
+        gdata = mx.symbol.Variable("data2")
+    assert gdata.attr("group") == "4"          # from the enclosing scope
+    assert data.attr("group") == "1"           # attr= overrides the scope
+    # both spellings of framework-consumed attrs resolve
+    assert data.attr("lr_mult") == "1"
+    assert data.attr("__lr_mult__") == "1"
+    assert data.attr("force_mirroring") == "True"
+    assert data.attr("__force_mirroring__") == "True"
+    # symbols pickle (through the JSON wire format)
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr("dtype") == data2.attr("dtype") == "data"
+
+
+def test_attr_operator():
+    data = mx.symbol.Variable("data")
+    with mx.AttrScope(__group__="4", __data__="great"):
+        fc1 = mx.symbol.Activation(data, act_type="relu")
+        with mx.AttrScope(__init_bias__="0.0"):
+            fc2 = mx.symbol.FullyConnected(fc1, num_hidden=10, name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    # pickling round-trips the exact JSON
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    # the auto-created weight inherited the dunder scope attrs
+    fc2weight = fc2.get_internals()["fc2_weight"]
+    assert fc2weight.attr("__init_bias__") == "0.0"
+    assert fc2weight.attr("__data__") == "great"
+
+
+def test_attr_list_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1,
+                            attr={"__mood__": "so so", "wd_mult": "x"})
+    la = op.list_attr()
+    assert la["__mood__"] == "so so"
+    assert la["wd_mult"] == "x"
+    assert la["__wd_mult__"] == "x"    # recognized keys mirror to dunder
+    assert "kernel" not in la          # op params are not user attrs
+    with pytest.raises(DeprecationWarning):
+        op.list_attr(recursive=True)
+
+
+def test_attr_dict_aggregation():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"},
+                            lr_mult=1)
+    ad = op.attr_dict()
+    assert ad["data"] == {"mood": "angry"}
+    # attr= dunders propagate to the auto-created parameter variables
+    assert ad["conv_weight"]["__mood__"] == "so so"
+    assert ad["conv_bias"]["__mood__"] == "so so"
+    conv = ad["conv"]
+    assert conv["__mood__"] == "so so"
+    assert conv["kernel"] == "(1, 1)"
+    assert conv["num_filter"] == "1"
+    assert conv["__lr_mult__"] == "1"
+    # only EXPLICITLY GIVEN op params appear (reference nnvm attrs.dict
+    # holds what the caller passed; filled-in defaults stay out)
+    assert "stride" not in conv and "pad" not in conv and \
+        "no_bias" not in conv
+
+
+def test_attr_op_kwarg_lr_mult_reaches_optimizer():
+    """lr_mult as an op kwarg lands on the auto-created weight var in
+    dunder form — where Optimizer._set_lr_mult reads it."""
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc", lr_mult=2),
+        name="softmax")
+    assert net.attr_dict()["fc_weight"]["__lr_mult__"] == "2"
+    opt = mx.optimizer.SGD(learning_rate=0.1, sym=net)
+    opt.set_lr_mult({})
+    assert opt.lr_mult.get("fc_weight") == 2.0
+
+
+def test_variable_rejects_non_dunder_kwargs():
+    with pytest.raises(ValueError):
+        mx.sym.Variable("x", not_dunder=1)
+    # dunder kwargs attach as user attrs
+    v = mx.sym.Variable("x", __foo__="bar")
+    assert v.attr("__foo__") == "bar"
+    assert v.attr("foo") == "bar"      # fallback lookup

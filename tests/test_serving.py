@@ -1,0 +1,470 @@
+"""mx.serving: dynamic batching, replicas, deadlines, backpressure.
+
+The contract under test (ISSUE 1 acceptance):
+  * batched outputs are numerically identical to per-request
+    ``Predictor.forward`` results (exact at the same bucket shape; 1-2
+    ulps across bucket shapes, where XLA emits different codegen),
+  * bucket padding never leaks into outputs,
+  * deadline expiry and queue-full backpressure raise structured errors
+    without hanging the server,
+  * multi-replica CPU dispatch under concurrent clients is deadlock-free
+    and reports mean batch occupancy > 1.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import (DeadlineExceededError, ModelServer,
+                               QueueFullError, ServerClosedError, bucketize,
+                               default_buckets)
+
+FEAT = 8
+NCLASS = 4
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=NCLASS,
+                                               name="fc2"), name="softmax")
+    return net
+
+
+@pytest.fixture(scope="module")
+def model():
+    net = _mlp()
+    rng = np.random.RandomState(7)
+    arg_shapes, _, _ = net.infer_shape(data=(1, FEAT))
+    args = {n: rng.uniform(-0.5, 0.5, s).astype(np.float32)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    return net, args
+
+
+def _server(model, **kw):
+    net, args = model
+    kw.setdefault("num_replicas", 1)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_latency_ms", 3.0)
+    return ModelServer(net, args, {}, {"data": (FEAT,)}, **kw)
+
+
+def _single_forward(model, x):
+    net, args = model
+    pred = Predictor(net, args, {}, {"data": (1, FEAT)}, ctx=mx.cpu())
+    return pred.forward(data=x.reshape(1, FEAT))[0][0]
+
+
+# ----------------------------------------------------------------------
+# buckets
+# ----------------------------------------------------------------------
+def test_bucket_ladder():
+    assert default_buckets(8) == [1, 2, 4, 8]
+    assert default_buckets(6) == [1, 2, 4, 6]
+    assert default_buckets(1) == [1]
+    assert bucketize(3, [1, 2, 4, 8]) == 4
+    assert bucketize(1, [1, 2, 4, 8]) == 1
+    assert bucketize(8, [1, 2, 4, 8]) == 8
+
+
+# ----------------------------------------------------------------------
+# numerics: batched == unbatched
+# ----------------------------------------------------------------------
+def test_single_request_exact(model):
+    """A lone request rides bucket 1 — the same shape a per-request
+    Predictor runs — and must match bit for bit."""
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (FEAT,)).astype(np.float32)
+    with _server(model) as srv:
+        out = srv.predict({"data": x})
+    assert np.array_equal(out[0], _single_forward(model, x))
+
+
+def test_batched_matches_unbatched(model):
+    """Coalesced batches agree with per-request forwards (1-2 ulps across
+    bucket shapes; XLA vectorizes different batch sizes differently)."""
+    rng = np.random.RandomState(1)
+    xs = [rng.uniform(-1, 1, (FEAT,)).astype(np.float32) for _ in range(16)]
+    with _server(model, max_latency_ms=10.0) as srv:
+        futs = [srv.submit({"data": x}) for x in xs]
+        res = [f.result(timeout=60) for f in futs]
+        st = srv.stats()
+    for x, r in zip(xs, res):
+        np.testing.assert_allclose(r[0], _single_forward(model, x),
+                                   rtol=1e-6, atol=1e-7)
+        assert r[0].shape == (NCLASS,)
+    assert st["requests"]["completed"] == len(xs)
+    assert st["batches"]["mean_occupancy"] > 1   # acceptance criterion
+
+
+def test_bucket_padding_never_leaks(model):
+    """3 requests pad to bucket 4; every delivered row must be the row of
+    ITS OWN input, and exactly n_real rows are delivered."""
+    rng = np.random.RandomState(2)
+    xs = [rng.uniform(-1, 1, (FEAT,)).astype(np.float32) for _ in range(3)]
+    # window long enough that all 3 coalesce into one batch
+    with _server(model, max_batch_size=4, max_latency_ms=200.0) as srv:
+        futs = [srv.submit({"data": x}) for x in xs]
+        res = [f.result(timeout=60) for f in futs]
+        st = srv.stats()
+    assert st["batches"]["count"] == 1
+    assert st["batches"]["per_bucket"] == {4: 1}
+    assert st["batches"]["mean_occupancy"] == 3
+    for x, r in zip(xs, res):
+        np.testing.assert_allclose(r[0], _single_forward(model, x),
+                                   rtol=1e-6, atol=1e-7)
+    # rows 0 and 1 differ => results aren't the padding replica of row 0
+    assert not np.allclose(res[0][0], res[1][0])
+
+
+# ----------------------------------------------------------------------
+# robustness: deadlines, backpressure, shutdown
+# ----------------------------------------------------------------------
+def test_deadline_expiry_structured_error(model):
+    with _server(model) as srv:
+        fut = srv.submit({"data": np.zeros(FEAT, np.float32)},
+                         timeout_ms=0.0)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+        # the server keeps serving afterwards
+        out = srv.predict({"data": np.ones(FEAT, np.float32)})
+        assert out[0].shape == (NCLASS,)
+        st = srv.stats()
+    assert st["requests"]["rejected_deadline"] >= 1
+    assert st["requests"]["completed"] >= 1
+
+
+def test_queue_full_backpressure(model):
+    srv = _server(model, max_batch_size=2, max_latency_ms=50.0,
+                  queue_capacity=2)
+    try:
+        accepted, rejected = [], 0
+        for _ in range(40):
+            try:
+                accepted.append(
+                    srv.submit({"data": np.zeros(FEAT, np.float32)}))
+            except QueueFullError:
+                rejected += 1
+        assert rejected > 0
+        # admitted work still completes; nothing hangs
+        for f in accepted:
+            assert f.result(timeout=60)[0].shape == (NCLASS,)
+        st = srv.stats()
+        assert st["requests"]["rejected_queue_full"] == rejected
+        assert st["requests"]["completed"] == len(accepted)
+    finally:
+        srv.stop()
+
+
+def test_bad_input_rejected_immediately(model):
+    with _server(model) as srv:
+        with pytest.raises(mx.MXNetError):
+            srv.submit({"data": np.zeros(FEAT + 1, np.float32)})
+        with pytest.raises(mx.MXNetError):
+            srv.submit({"wrong_name": np.zeros(FEAT, np.float32)})
+        with pytest.raises(mx.MXNetError):   # unconvertible payload
+            srv.submit({"data": "garbage"})
+        with pytest.raises(mx.MXNetError):   # ragged list
+            srv.submit({"data": [[1.0, 2.0], [3.0]]})
+
+
+def test_oversized_bucket_rejected(model):
+    with pytest.raises(mx.MXNetError):
+        _server(model, buckets=[16], max_batch_size=8)
+
+
+def test_cancelled_future_settles_without_killing_worker(model):
+    """A client cancel racing the batcher/replica must be absorbed (a
+    raised InvalidStateError would kill the replica thread and hang the
+    server forever)."""
+    with _server(model, max_latency_ms=50.0) as srv:
+        fut = srv.submit({"data": np.zeros(FEAT, np.float32)})
+        fut.cancel()
+        # also exercise the dequeue-time expiry path against a cancel
+        fut2 = srv.submit({"data": np.zeros(FEAT, np.float32)},
+                          timeout_ms=0.0)
+        fut2.cancel()
+        assert srv.drain(timeout=60)          # both settle in accounting
+        # the worker survived: new work still completes
+        out = srv.predict({"data": np.ones(FEAT, np.float32)})
+        assert out[0].shape == (NCLASS,)
+        st = srv.stats()
+    assert st["requests"]["cancelled"] >= 1
+    assert st["requests"]["completed"] >= 1
+
+
+def test_custom_buckets_unified_with_max_batch(model):
+    """A user ladder whose top is below max_batch_size is extended for
+    replicas AND batcher alike — warmup covers every shape the batcher
+    can emit, so full-load batches never compile mid-traffic."""
+    srv = _server(model, buckets=[1, 2], max_batch_size=6,
+                  max_latency_ms=100.0)
+    try:
+        assert srv._buckets == [1, 2, 6]
+        rep = srv._pool.replicas[0]
+        assert sorted(rep._preds) == [1, 2, 6]   # warmup bound them all
+        futs = [srv.submit({"data": np.full(FEAT, i, np.float32)})
+                for i in range(5)]
+        for f in futs:
+            assert f.result(timeout=60)[0].shape == (NCLASS,)
+        st = srv.stats()
+        assert set(st["batches"]["per_bucket"]) <= {1, 2, 6}
+    finally:
+        srv.stop()
+
+
+def test_stop_rejects_new_work(model):
+    srv = _server(model)
+    srv.predict({"data": np.zeros(FEAT, np.float32)})
+    srv.stop()
+    with pytest.raises(ServerClosedError):
+        srv.submit({"data": np.zeros(FEAT, np.float32)})
+    srv.stop()   # idempotent
+
+
+def test_drain_settles_everything(model):
+    with _server(model, max_latency_ms=20.0) as srv:
+        futs = [srv.submit({"data": np.full(FEAT, i, np.float32)})
+                for i in range(10)]
+        assert srv.drain(timeout=60)
+        assert all(f.done() for f in futs)
+
+
+# ----------------------------------------------------------------------
+# multi-replica concurrent dispatch
+# ----------------------------------------------------------------------
+def test_multi_replica_concurrent_clients(model):
+    """8 client threads against 2 CPU replicas: deadlock-free, everything
+    settles, numerics hold, occupancy > 1 (the acceptance scenario)."""
+    n_threads, per_thread = 8, 8
+    rng = np.random.RandomState(3)
+    inputs = [[rng.uniform(-1, 1, (FEAT,)).astype(np.float32)
+               for _ in range(per_thread)] for _ in range(n_threads)]
+    results = [[None] * per_thread for _ in range(n_threads)]
+    errors = []
+    srv = _server(model, num_replicas=2,
+                  contexts=[mx.cpu(0), mx.cpu(1)],
+                  max_batch_size=8, max_latency_ms=5.0,
+                  queue_capacity=256)
+    barrier = threading.Barrier(n_threads)
+
+    def client(t):
+        try:
+            barrier.wait(timeout=30)
+            futs = [srv.submit({"data": x}) for x in inputs[t]]
+            for i, f in enumerate(futs):
+                results[t][i] = f.result(timeout=60)
+        except Exception as e:   # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive(), "client thread deadlocked"
+    assert not errors, errors
+
+    st = srv.stats()
+    srv.stop()
+    assert st["requests"]["completed"] == n_threads * per_thread
+    assert st["batches"]["mean_occupancy"] > 1   # acceptance criterion
+    assert st["latency_ms"]["p50"] is not None
+    assert st["latency_ms"]["p99"] is not None
+    assert st["throughput_qps"] is not None
+    assert sum(r["requests_served"] for r in st["replicas"]) \
+        == n_threads * per_thread
+    for t in range(n_threads):
+        for i in range(per_thread):
+            np.testing.assert_allclose(
+                results[t][i][0], _single_forward(model, inputs[t][i]),
+                rtol=1e-6, atol=1e-7)
+
+
+def test_profiler_export(model, tmp_path):
+    """Serving metrics land in the chrome trace as Counter/Marker events
+    under the 'serving' domain."""
+    from mxnet_tpu import profiler
+    profiler.set_config(filename=str(tmp_path / "serving_trace.json"))
+    profiler.start()
+    try:
+        with _server(model, max_latency_ms=10.0) as srv:
+            futs = [srv.submit({"data": np.full(FEAT, i, np.float32)})
+                    for i in range(8)]
+            for f in futs:
+                f.result(timeout=60)
+            srv.stats()   # mirrors p50/p99/qps into the counters
+    finally:
+        profiler.stop()
+    profiler.dump()
+    doc = json.loads((tmp_path / "serving_trace.json").read_text())
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "serving.queue_depth" in names
+    assert "serving.batch_occupancy" in names
+    assert "serving.latency_p50_us" in names
+    assert "serving.throughput_qps" in names
+
+
+def test_submit_async(model):
+    import asyncio
+
+    async def go(srv):
+        return await srv.submit_async(
+            {"data": np.ones(FEAT, np.float32)})
+
+    with _server(model) as srv:
+        out = asyncio.run(go(srv))
+    assert out[0].shape == (NCLASS,)
+
+
+# ----------------------------------------------------------------------
+# HTTP endpoint
+# ----------------------------------------------------------------------
+def test_http_endpoint(model):
+    with _server(model) as srv:
+        host, port = srv.start_http(port=0)
+        url = "http://%s:%d" % (host, port)
+
+        body = json.dumps({"inputs": {"data": [0.1] * FEAT}}).encode()
+        r = urllib.request.urlopen(
+            urllib.request.Request(url + "/predict", data=body,
+                                   method="POST"), timeout=30)
+        doc = json.loads(r.read())
+        assert r.status == 200
+        np.testing.assert_allclose(
+            np.asarray(doc["outputs"][0], np.float32),
+            _single_forward(model, np.full(FEAT, 0.1, np.float32)),
+            rtol=1e-6, atol=1e-7)
+
+        r = urllib.request.urlopen(url + "/stats", timeout=30)
+        st = json.loads(r.read())
+        assert st["requests"]["completed"] >= 1
+
+        r = urllib.request.urlopen(url + "/health", timeout=30)
+        assert json.loads(r.read())["status"] == "ok"
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            bad = json.dumps({"inputs": {"data": [0.1] * 3}}).encode()
+            urllib.request.urlopen(
+                urllib.request.Request(url + "/predict", data=bad,
+                                       method="POST"), timeout=30)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["type"] == "bad_request"
+
+
+# ----------------------------------------------------------------------
+# Predictor satellite: NDArray/jax inputs + shared-param reshape
+# ----------------------------------------------------------------------
+def test_predictor_accepts_ndarray_and_jax_inputs(model):
+    import jax.numpy as jnp
+    net, args = model
+    pred = Predictor(net, args, {}, {"data": (2, FEAT)}, ctx=mx.cpu())
+    x = np.random.RandomState(4).uniform(-1, 1, (2, FEAT)) \
+        .astype(np.float32)
+    base = pred.forward(data=x)[0]
+    via_nd = pred.forward(data=mx.nd.array(x))[0]
+    via_jax = pred.forward(data=jnp.asarray(x))[0]
+    assert np.array_equal(base, via_nd)
+    assert np.array_equal(base, via_jax)
+
+
+def test_predictor_reshape_shares_params(model):
+    net, args = model
+    pred = Predictor(net, args, {}, {"data": (4, FEAT)}, ctx=mx.cpu())
+    small = pred.reshape({"data": (2, FEAT)})
+    assert small.input_shapes["data"] == (2, FEAT)
+    # the weights are the SAME device buffers, not host re-copies
+    assert small._exe.arg_dict["fc1_weight"] is pred._exe.arg_dict["fc1_weight"]
+    x = np.random.RandomState(5).uniform(-1, 1, (2, FEAT)) \
+        .astype(np.float32)
+    got = small.forward(data=x)[0]
+    fresh = Predictor(net, args, {}, {"data": (2, FEAT)}, ctx=mx.cpu())
+    assert np.array_equal(got, fresh.forward(data=x)[0])
+
+
+def test_predictor_input_validation(model):
+    net, args = model
+    pred = Predictor(net, args, {}, {"data": (1, FEAT)}, ctx=mx.cpu())
+    with pytest.raises(mx.MXNetError):
+        pred.forward(data=np.zeros((2, FEAT), np.float32))  # wrong shape
+    with pytest.raises(mx.MXNetError):
+        pred.forward(bogus=np.zeros((1, FEAT), np.float32))  # wrong name
+    with pytest.raises(mx.MXNetError):
+        pred.reshape({"bogus": (1, FEAT)})
+    # a PARAMETER name must be rejected too, not silently overwrite the
+    # bound weights (it lives in arg_dict but is not a declared input)
+    x = np.zeros((1, FEAT), np.float32)
+    before = pred.forward(data=x)[0]
+    with pytest.raises(mx.MXNetError):
+        pred.forward(data=x, fc1_weight=np.zeros_like(args["fc1_weight"]))
+    assert np.array_equal(pred.forward(data=x)[0], before)
+
+
+# ----------------------------------------------------------------------
+# soak (excluded from tier-1)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_serving_soak_concurrent_stress(model):
+    """Sustained mixed load: bursty clients, short deadlines, small
+    queue — every admitted request settles and the server survives."""
+    rng = np.random.RandomState(6)
+    srv = _server(model, num_replicas=2,
+                  contexts=[mx.cpu(0), mx.cpu(1)],
+                  max_batch_size=8, max_latency_ms=2.0, queue_capacity=64)
+    stop_at = time.monotonic() + 20.0
+    outcome = {"ok": 0, "expired": 0, "full": 0, "err": []}
+    lock = threading.Lock()
+
+    def client(seed):
+        r = np.random.RandomState(seed)
+        while time.monotonic() < stop_at:
+            x = r.uniform(-1, 1, (FEAT,)).astype(np.float32)
+            try:
+                fut = srv.submit({"data": x},
+                                 timeout_ms=float(r.choice([1.0, 50, 1000])))
+                out = fut.result(timeout=60)
+                with lock:
+                    outcome["ok"] += 1
+                assert out[0].shape == (NCLASS,)
+            except DeadlineExceededError:
+                with lock:
+                    outcome["expired"] += 1
+            except QueueFullError:
+                with lock:
+                    outcome["full"] += 1
+                time.sleep(0.002)
+            except Exception as e:   # noqa: BLE001
+                with lock:
+                    outcome["err"].append(e)
+                return
+            if r.rand() < 0.3:
+                time.sleep(float(r.uniform(0, 0.004)))
+
+    threads = [threading.Thread(target=client, args=(1000 + i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+        assert not th.is_alive(), "soak client deadlocked"
+    assert not outcome["err"], outcome["err"]
+    assert srv.drain(timeout=60)
+    st = srv.stats()
+    srv.stop()
+    assert outcome["ok"] > 0
+    assert st["requests"]["completed"] == outcome["ok"]
+    assert (st["requests"]["admitted"]
+            == st["requests"]["completed"]
+            + st["requests"]["rejected_deadline"]
+            + st["requests"]["failed"]
+            + st["requests"]["cancelled"])
