@@ -136,10 +136,19 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        # one batched push (then pull) over every trainable param so the
+        # bucketed kvstore hot path can pack the full keyset into compiled
+        # buckets; per-key priority -i keeps reference dispatch order
+        keys, grads, prios = [], [], []
         for i, param in self._trainable():
-            self._kvstore.push(i, param.list_grad())
-            if not self._update_on_kvstore:
-                self._kvstore.pull(i, param.list_grad())
+            keys.append(i)
+            grads.append(param.list_grad())
+            prios.append(-i)
+        if not keys:
+            return
+        self._kvstore.push(keys, grads, priority=prios)
+        if not self._update_on_kvstore:
+            self._kvstore.pull(keys, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Apply optimizer only — only valid with update_on_kvstore=False

@@ -65,6 +65,13 @@ class KVStore:
         self._updater = None
         self._compression = None
         self._compression_residuals = {}
+        # compiled bucketed hot path (kvstore_fused.py, docs/KVSTORE.md):
+        # on by default for the single-process stores; subclasses that
+        # override push never enqueue, so the engine stays inert there
+        self._bucketed = os.environ.get("MXNET_KVSTORE_FUSED", "1") != "0"
+        self._async_push = os.environ.get(
+            "MXNET_KVSTORE_ASYNC_PUSH", "0") == "1"
+        self._engine = None
 
     @property
     def type(self):
@@ -89,20 +96,86 @@ class KVStore:
         """Aggregate values per key (reference KVStoreLocal::PushImpl
         kvstore_local.h:168 → Comm::Reduce). When a compression config is
         set, each device gradient goes through quantize→dequantize with
-        per-key error-feedback residual, matching gradient_compression.h."""
+        per-key error-feedback residual, matching gradient_compression.h.
+
+        Eligible dense pushes take the compiled bucketed hot path
+        (kvstore_fused.py): same-dtype gradients flatten into size-capped
+        buckets and each bucket runs one jitted compress→reduce→update
+        computation. ``priority`` (an int, or a per-key list for batched
+        calls) orders bucket dispatch, highest first. With async push
+        enabled (``set_async_push``/``MXNET_KVSTORE_ASYNC_PUSH=1``) work
+        stays enqueued until a ``pull``/``barrier``/state-save sync point,
+        letting XLA overlap it with remaining backward compute."""
         keys, values = _key_value(key, value)
-        for k, vlist in zip(keys, values):
-            if self._compression is not None:
-                vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
-            reduced = self._local_reduce(vlist)
-            if self._updater is not None:
-                if k not in self._store:
-                    raise MXNetError("key %s not initialized" % k)
-                self._updater(_updater_key(k), reduced, self._store[k])
+        if isinstance(priority, (list, tuple)):
+            if len(priority) != len(keys):
+                raise MXNetError(
+                    "push: %d priorities for %d keys"
+                    % (len(priority), len(keys)))
+            prios = list(priority)
+        else:
+            prios = [priority] * len(keys)
+        eng = self._get_engine()
+        mode = eng._updater_mode() if eng is not None else False
+        for k, vlist, prio in zip(keys, values, prios):
+            if eng is not None and eng.eligible(k, vlist, mode):
+                eng.enqueue(k, vlist, prio)
             else:
-                self._store[k] = reduced.copy()
+                self._push_one(k, vlist)
+        if eng is not None and not self._async_push:
+            eng.flush()
+
+    def _push_one(self, k, vlist):
+        """Eager per-key push (the reference shape; also the fallback for
+        sparse values, custom updaters, and non-fusable optimizers)."""
+        if self._compression is not None:
+            vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
+        reduced = self._local_reduce(vlist)
+        if self._updater is not None:
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            self._updater(_updater_key(k), reduced, self._store[k])
+        else:
+            self._store[k] = reduced.copy()
+
+    def _get_engine(self):
+        if not self._bucketed:
+            return None
+        if self._engine is None:
+            from .kvstore_fused import FusedBucketEngine
+            self._engine = FusedBucketEngine(self)
+        return self._engine
+
+    def _flush_pending(self):
+        if self._engine is not None:
+            self._engine.flush()
+
+    def _sync_engine(self):
+        """Flush pending buckets under the CURRENT mode, then spill flat
+        error-feedback residuals back to the per-key dict. Every entry
+        point that changes push routing (bucketing toggle, updater,
+        compression config) must call this FIRST — in this order — or
+        the engine dispatches stale-mode buckets / strands residuals."""
+        self._flush_pending()
+        if self._engine is not None:
+            self._engine.spill_residuals()
+
+    def set_bucketing(self, enabled):
+        """Toggle the compiled bucketed hot path (docs/KVSTORE.md);
+        pending async pushes are flushed first and flat error-feedback
+        residuals spill back to the per-key dict."""
+        self._sync_engine()
+        self._bucketed = bool(enabled)
+
+    def set_async_push(self, enabled):
+        """Defer bucket dispatch until the next sync point (pull/barrier/
+        state save) so pushes enqueue without blocking backward."""
+        if not enabled:
+            self._flush_pending()
+        self._async_push = bool(enabled)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        self._flush_pending()
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -121,6 +194,7 @@ class KVStore:
         if row_ids is None:
             self.pull(key, out=out, priority=priority)
             return
+        self._flush_pending()
         from .ndarray.sparse import RowSparseNDArray
         keys, outs = _key_value(key, out)
         n_out = sum(len(olist) for olist in outs)
@@ -156,6 +230,7 @@ class KVStore:
                     o._set_data(src._data)
 
     def set_updater(self, updater):
+        self._sync_engine()
         self._updater = updater
 
     def set_optimizer(self, optimizer):
@@ -163,6 +238,7 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression (reference kvstore.py:392)."""
+        self._sync_engine()
         ctype = compression_params.get("type", "2bit")
         if ctype not in ("2bit",):
             raise MXNetError("unsupported compression type %s" % ctype)
@@ -204,7 +280,7 @@ class KVStore:
         return NDArray(out, grad.context)
 
     def barrier(self):
-        pass
+        self._flush_pending()
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Liveness query (reference kvstore.h:341); single-process → 0."""
@@ -217,12 +293,14 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no updater/optimizer set")
+        self._flush_pending()
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no updater/optimizer set")
+        self._flush_pending()
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 

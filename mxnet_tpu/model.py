@@ -56,33 +56,62 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(name, param_on_devs, priority=-idx)
 
 
-def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """(reference model.py:145) push grad, pull back updated weight."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
+def _batched_push(kvstore, param_names, grad_arrays, push_order):
+    """ONE push call covering every key with a gradient — the bucketed
+    kvstore hot path (kvstore_fused.py) streams size-capped compiled
+    buckets instead of dispatching per-key. ``push_order``
+    (executor_group.push_order) lists indices in backward
+    gradient-availability order; with the engine's streaming flush, the
+    buckets those keys fill dispatch while this loop is still walking
+    the remaining keys. Returns (names, grads) pushed, in push order."""
+    order = list(push_order) if push_order is not None \
+        else list(range(len(grad_arrays)))
+    names, grads, prios = [], [], []
+    for index in order:
+        if grad_arrays[index][0] is None:
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        names.append(param_names[index])
+        grads.append(grad_arrays[index])
+        prios.append(-index)
+    if names:
+        kvstore.push(names, grads, priority=prios)
+    return names, grads
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names,
+                              push_order=None):
+    """(reference model.py:145) push grad, pull back updated weight;
+    push batched (see _batched_push), pull batched in forward order —
+    matching next-forward consumption."""
+    names, _ = _batched_push(kvstore, param_names, grad_arrays, push_order)
+    if not names:
+        return
+    pull_names, pull_args = [], []
+    for index in range(len(param_arrays)):
+        if grad_arrays[index][0] is None:
+            continue
+        pull_names.append(param_names[index])
+        pull_args.append(param_arrays[index])
+    kvstore.pull(pull_names, out=pull_args)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
-                   kvstore=None, param_names=None):
-    """(reference model.py:163) update on workers via the local updater."""
+                   kvstore=None, param_names=None, push_order=None):
+    """(reference model.py:163) update on workers via the local updater;
+    the kvstore reduce runs batched (see _batched_push)."""
+    if kvstore:
+        names, grads = _batched_push(kvstore, param_names, grad_arrays,
+                                     push_order)
+        if names:
+            kvstore.pull(names, out=grads)
     updates = [[] for _ in range(num_device)]
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
-        index = i
-        if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updates[k].append((index * num_device + k, g, w))
+            updates[k].append((i * num_device + k, g, w))
     for dev_updates in updates:
         for upd in dev_updates:
             updater(*upd)

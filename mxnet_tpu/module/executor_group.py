@@ -91,6 +91,17 @@ class DataParallelExecutorGroup:
                             for n in param_names if n in self._exec.arg_dict]
         self.aux_arrays = [[self._exec.aux_dict[n]] for n in self.aux_names]
 
+    @property
+    def push_order(self):
+        """param_arrays indices in backward gradient-availability order:
+        arguments list in forward/topological order, so backward produces
+        the last parameters' gradients first. The bucketed kvstore's
+        streaming flush (kvstore_fused.enqueue) dispatches each bucket as
+        soon as enough pending bytes accumulate, so enqueue order decides
+        which buckets hit the device while the host is still walking the
+        remaining keys (model.py _batched_push)."""
+        return list(range(len(self.param_arrays)))[::-1]
+
     # ------------------------------------------------------------------
     def _batch_sharding(self):
         return NamedSharding(self._mesh, P("dp"))

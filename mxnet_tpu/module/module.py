@@ -417,12 +417,14 @@ class Module(BaseModule):
         group = self._exec_group
         if self._update_on_kvstore:
             _update_params_on_kvstore(group.param_arrays, group.grad_arrays,
-                                      self._kvstore, group.param_names)
+                                      self._kvstore, group.param_names,
+                                      push_order=group.push_order)
         else:
             _update_params(group.param_arrays, group.grad_arrays,
                            updater=self._updater, num_device=1,
                            kvstore=self._kvstore,
-                           param_names=group.param_names)
+                           param_names=group.param_names,
+                           push_order=group.push_order)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -100,6 +100,14 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def _fused_bucket_sig(self):
+        """Signature enabling the kvstore compiled bucketed hot path
+        (kvstore_fused.py): a hashable tuple fully determining the pure
+        per-bucket update, or None to keep updates per-key eager. The
+        tuple is part of the bucket-program cache key, so mutating any
+        hyperparameter in it retraces exactly once."""
+        return None
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
             inner_state, weight32 = state
@@ -186,6 +194,16 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return None
         return zeros(weight.shape, weight.context, dtype="float32")
+
+    def _fused_bucket_sig(self):
+        if self.multi_precision:
+            return None    # (state, weight32) tuples stay per-key eager
+        # rescale_grad is NOT part of the signature: gluon Trainer.step
+        # rewrites it every call (scale/batch_size), so it rides along as
+        # a runtime scalar — a ragged final batch must not retrace
+        return ("sgd", float(self.momentum),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -582,6 +600,9 @@ class LBSGD(SGD):
         self.warmup_epochs = warmup_epochs
         self.batch_scale = batch_scale
         self.updates_per_epoch = updates_per_epoch
+
+    def _fused_bucket_sig(self):
+        return None    # per-key LARS norms don't fit the shared bucket fn
 
     def _get_lars(self, weight, g, wd):
         w_norm = float(nd.norm(weight).asscalar())

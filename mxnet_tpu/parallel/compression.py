@@ -26,13 +26,31 @@ __all__ = ["TwoBitCompressor"]
 
 
 class TwoBitCompressor:
+    """All three entry points are jitted with ``self`` static; equality/
+    hash are defined on the threshold alone so every compressor with the
+    same config shares one compile-cache entry — N kvstores (or N
+    re-creations across steps) never retrace. ``_traces`` counts actual
+    traces (it only increments while JAX traces a method body), which the
+    regression test in tests/test_kvstore_fused.py pins flat across
+    steps."""
+
+    _traces = 0
+
     def __init__(self, threshold=0.5):
         self.threshold = float(threshold)
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other.threshold == self.threshold)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.threshold))
 
     @functools.partial(jax.jit, static_argnums=0)
     def compress_decompress(self, grad, residual):
         """Returns (quantized_grad, new_residual) — the fused local form
         used by single-process kvstores (comm.h usage in the reference)."""
+        TwoBitCompressor._traces += 1
         t = jnp.asarray(self.threshold, dtype=grad.dtype)
         acc = residual + grad
         q = jnp.where(acc > t, t, jnp.where(acc < -t, -t, jnp.zeros_like(acc)))
@@ -43,6 +61,7 @@ class TwoBitCompressor:
         """Returns (packed_uint8, new_residual): 4 2-bit codes per byte —
         the wire format for cross-host (DCN) pushes. Code: 0 = zero,
         1 = +threshold, 2 = -threshold (reference -inl.h quantize_2bit)."""
+        TwoBitCompressor._traces += 1
         t = jnp.asarray(self.threshold, dtype=grad.dtype)
         acc = residual + grad
         code = jnp.where(acc > t, 1, jnp.where(acc < -t, 2, 0)).astype(jnp.uint8)
@@ -60,6 +79,7 @@ class TwoBitCompressor:
 
     @functools.partial(jax.jit, static_argnums=(0, 2, 3))
     def _decompress(self, packed, shape, dtype):
+        TwoBitCompressor._traces += 1
         t = jnp.asarray(self.threshold, dtype=dtype)
         codes = jnp.stack([packed & 3, (packed >> 2) & 3, (packed >> 4) & 3,
                            (packed >> 6) & 3], axis=-1).reshape(-1)

@@ -123,6 +123,30 @@ def test_optimizer_state_save_load(tmp_path):
     np.testing.assert_allclose(p1.asnumpy(), p2.asnumpy(), rtol=1e-6)
 
 
+def test_pull_alias_inplace_write_cannot_corrupt_store():
+    """pull shares the store's immutable jax buffer into each out array
+    (zero-copy); a later in-place write on the out array rebinds only
+    that array's buffer (jax arrays are immutable, sliced writes are
+    copy-on-write), so the store — and every other puller — must be
+    unaffected."""
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones(SHAPE) * 3)
+    out1, out2 = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    kv.pull("w", out=out1)
+    kv.pull("w", out=out2)
+    out1[:] = 99.0                      # full in-place overwrite
+    out1[0, 0] = -1.0                   # sliced in-place write
+    _check(out2, 3.0)                   # sibling alias untouched
+    fresh = nd.zeros(SHAPE)
+    kv.pull("w", out=fresh)
+    _check(fresh, 3.0)                  # store itself untouched
+    _check(kv._store["w"], 3.0)
+    # and pushing through the store still starts from the clean value
+    kv.push("w", nd.ones(SHAPE))
+    kv.pull("w", out=fresh)
+    _check(fresh, 1.0)
+
+
 def test_kvstore_type_and_rank():
     kv = mx.kv.create("local")
     assert kv.type == "local"
