@@ -752,6 +752,100 @@ def bench_kvstore(args):
     }
 
 
+def bench_fit(args):
+    """Module-fit step witnesses: the single-launch fused fit step
+    (module/fused_fit.py) vs the eager fwd_bwd + bucketed-kvstore pair
+    on a ResNet-50 fit configuration (SGD momentum + wd, device
+    kvstore, Accuracy metric — the Module path's default shape).
+
+    The headline numbers are hardware-independent launch/sync counters,
+    not wall clock: ``train_dispatches_per_step`` (profiler
+    DEVICE_DISPATCHES delta per step — fused target ≤ 2, eager ~32) and
+    ``host_syncs_per_step`` (metric-layer blocking readbacks — fused
+    target 0 between Speedometer/epoch boundaries). On the 1-core CPU
+    container both arms sit at the memory-bandwidth floor so step_ms
+    compresses toward 1x; on the tunneled TPU harness each dispatch
+    costs ~100 ms RTT (docs/PERF.md) and the launch count IS the step
+    time."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, nd
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu import profiler
+
+    image_shape = tuple(int(x) for x in args.fit_image_shape.split(","))
+    batch = args.fit_batch
+    steps = args.fit_steps
+    sym = models.get_symbol("resnet", num_classes=1000,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape, dtype="float32")
+    rng = np.random.RandomState(0)
+    c, h, w = image_shape
+    X = rng.uniform(-1, 1, (batch, c, h, w)).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.float32)
+
+    arms = {}
+    for arm in ("eager", "fused"):
+        mod = mx.Module(sym)
+        mod._fused_fit_enabled = (arm == "fused")
+        mod.bind(data_shapes=[("data", X.shape)],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        mod.init_optimizer(kvstore=mx.kv.create("device"), optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9, "wd": 1e-4})
+        m = metric_mod.Accuracy()
+        batch_nd = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+
+        def one_step():
+            mod.fit_step(batch_nd, m)
+            mod.update_metric(m, batch_nd.label)
+
+        def block():
+            mod._fit_sync()     # waits on a trainable param (step output)
+
+        one_step()                       # compile + warm
+        block()
+        d0 = profiler.DEVICE_DISPATCHES.value
+        h0 = metric_mod.HOST_SYNCS.value
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one_step()
+        block()
+        dt = time.perf_counter() - t0
+        # capture the loop deltas BEFORE the boundary get() below — that
+        # readback is the scheduled Speedometer-style sync, not a
+        # per-batch one
+        d_steps = profiler.DEVICE_DISPATCHES.value - d0
+        h_steps = metric_mod.HOST_SYNCS.value - h0
+        _name, val = m.get()             # boundary readback (liveness)
+        if not np.isfinite(val):
+            raise SystemExit("bench: non-finite fit metric (%s arm)" % arm)
+        arms[arm] = {
+            "dispatches_per_step": round(d_steps / steps, 2),
+            "host_syncs_per_step": round(h_steps / steps, 2),
+            "step_ms": round(dt / steps * 1000, 1),
+        }
+        if arm == "fused" and mod._fused_fit is None:
+            raise SystemExit("bench: fused arm fell back to eager — "
+                             "eligibility regression")
+    dev = jax.devices()[0]
+    return {
+        "metric": "train_dispatches_per_step",
+        "value": arms["fused"]["dispatches_per_step"],
+        "unit": "launches/step",
+        "device_kind": dev.device_kind,
+        "config": "resnet%d b%d %s sgd-mom kv=device 2bit=off" % (
+            args.num_layers, batch, args.fit_image_shape),
+        "train_dispatches_per_step": {
+            a: arms[a]["dispatches_per_step"] for a in arms},
+        "host_syncs_per_step": {
+            a: arms[a]["host_syncs_per_step"] for a in arms},
+        "fit_step_ms": {a: arms[a]["step_ms"] for a in arms},
+    }
+
+
 def bench_serving(args):
     """mx.serving throughput: concurrent clients against the in-process
     ModelServer (dynamic micro-batching + bucket padding over a jitted
@@ -842,7 +936,8 @@ def main():
     ap.add_argument("--model", type=str, default="all",
                     choices=["all", "resnet", "transformer"])
     ap.add_argument("--mode", type=str, default="train",
-                    choices=["train", "inference", "serving", "kvstore"])
+                    choices=["train", "inference", "serving", "kvstore",
+                             "fit"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
     ap.add_argument("--layout", type=str, default="NHWC",
@@ -880,6 +975,11 @@ def main():
     ap.add_argument("--kv-ndev", type=int, default=4,
                     help="simulated per-key device gradient streams for "
                          "the kvstore bench (the CommDevice reduce width)")
+    # fused fit step witnesses (--mode fit; also folded into the default
+    # line as train_dispatches_per_step / host_syncs_per_step)
+    ap.add_argument("--fit-batch", type=int, default=4)
+    ap.add_argument("--fit-image-shape", type=str, default="3,224,224")
+    ap.add_argument("--fit-steps", type=int, default=4)
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -897,6 +997,9 @@ def main():
         return
     if args.mode == "kvstore":
         print(json.dumps(bench_kvstore(args)))
+        return
+    if args.mode == "fit":
+        print(json.dumps(bench_fit(args)))
         return
     if args.mode == "inference":
         if args.quantized:
@@ -929,6 +1032,10 @@ def main():
     out["kvstore_push_pull_gbps"] = kvb["value"]
     out["kvstore_speedup_vs_eager"] = kvb["speedup_vs_eager"]
     out["kvstore_compress_ratio"] = kvb["kvstore_compress_ratio"]
+    fit = bench_fit(args)
+    out["train_dispatches_per_step"] = fit["train_dispatches_per_step"]
+    out["host_syncs_per_step"] = fit["host_syncs_per_step"]
+    out["fit_step_ms"] = fit["fit_step_ms"]
     print(json.dumps(out))
 
 

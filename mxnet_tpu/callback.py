@@ -52,7 +52,17 @@ class Speedometer:
 
     Log-line format matches the reference so log-parsing tools keep working:
     ``Epoch[e] Batch [n]\\tSpeed: r samples/sec\\tname=value...``
-    """
+
+    **Sync points** (docs/TRAINING.md): with the fused fit step active,
+    train metrics live in a device-resident accumulator and the fit loop
+    never blocks — this callback is the ONLY mid-epoch reader. Metric
+    values are read exclusively at the ``frequent`` gate (the early
+    return below), so the per-batch invocations between emissions touch
+    nothing device-resident and force no host sync; each emission costs
+    exactly one accumulator snapshot readback (plus a second device
+    round-trip for ``reset`` when ``auto_reset`` seeds fresh scalars).
+    The remaining scheduled syncs in ``fit`` are the epoch-end metric
+    log and the optional ``MXNET_FIT_SYNC_EVERY`` depth bound."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -63,6 +73,7 @@ class Speedometer:
     def __call__(self, param):
         rate = self._meter.tick(param.nbatch)
         if rate is None:
+            # between emissions: no metric access, no device readback
             return
         pairs = _metric_pairs(param.eval_metric)
         if pairs:

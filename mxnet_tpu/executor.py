@@ -30,6 +30,13 @@ from .ops import registry as _reg
 __all__ = ["Executor"]
 
 
+def _count_dispatch():
+    """Bump the global device-launch witness (profiler.DEVICE_DISPATCHES)
+    — bench.py --mode train reads deltas for train_dispatches_per_step."""
+    from . import profiler as _prof
+    _prof.DEVICE_DISPATCHES.increment()
+
+
 def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
                     group_devices=None, tap_cb=None, tap_stat=None):
     """Build a pure function (args, auxs, seed, is_train) ->
@@ -503,6 +510,7 @@ class Executor:
                 fwd = (self._stream_fns()["fwd_train"] if stream
                        else self._jit_fwd_train)
                 with self._prof_scope("Executor::forward"):
+                    _count_dispatch()
                     outs, new_auxs = fwd(self._args_values(), auxs, seed)
                 self._write_auxs(new_auxs)
             else:
@@ -512,6 +520,7 @@ class Executor:
                 fwd = (self._stream_fns()["fwd_eval"] if stream
                        else self._jit_fwd_eval)
                 with self._prof_scope("Executor::forward"):
+                    _count_dispatch()
                     outs = fwd(self._args_values(), self._auxs_values(),
                                seed)
             if stream:
@@ -563,6 +572,7 @@ class Executor:
             fwd_bwd = (self._stream_fns()["fwd_bwd"] if stream
                        else self._jit_fwd_bwd)
             with self._prof_scope("Executor::forward_backward"):
+                _count_dispatch()
                 outs, new_auxs, grads = fwd_bwd(
                     self._args_values(), auxs, seed, ograds)
             if stream:

@@ -161,6 +161,7 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         store_side = self._kvstore and self._update_on_kvstore
+        pull_keys, pull_outs = [], []
         for i, param in self._trainable():
             if param._data is None:
                 if ignore_stale_grad:
@@ -169,11 +170,17 @@ class Trainer:
                     f"Gradient of Parameter `{param.name}` has not been "
                     f"initialized")
             if store_side:
-                self._kvstore.pull(i, param.list_data())
+                pull_keys.append(i)
+                pull_outs.append(param.list_data())
                 continue
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)
+        if pull_keys:
+            # ONE batched pull over every trainable param (a per-key
+            # pull call per parameter would re-enter the kvstore sync
+            # point N times per step)
+            self._kvstore.pull(pull_keys, out=pull_outs)
 
     # ------------------------------------------------------------------
     def save_states(self, fname):

@@ -298,7 +298,14 @@ class PrefetchingIter(DataIter):
     step N, batch N+1 is already decoding AND transferring — the
     TPU-native analog of the reference's pinned-memory staging in
     iter_prefetcher.h (transfers are async in jax; dispatching them from
-    the worker overlaps them with compute)."""
+    the worker overlaps them with compute).
+
+    With ``ctx`` a LIST of contexts, the worker shards each batch along
+    its leading axis over a ``dp`` mesh of those devices at prefetch
+    time, so a multi-device training step (executor_group /
+    module/fused_fit.py) receives device-resident shards instead of
+    splitting the batch on the fit thread. A batch whose leading dim
+    does not divide the device count falls back to the first device."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2, ctx=None):
@@ -309,6 +316,15 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self._depth = prefetch_depth
+        self._mesh = None
+        if isinstance(ctx, (list, tuple)):
+            ctx = list(ctx)
+            if len(ctx) > 1:
+                import numpy as _np
+                from jax.sharding import Mesh
+                self._mesh = Mesh(_np.array([c.jax_device for c in ctx]),
+                                  ("dp",))
+            ctx = ctx[0] if ctx else None
         self._ctx = ctx
         self._queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
@@ -321,12 +337,31 @@ class PrefetchingIter(DataIter):
         import jax
         from ..ndarray.ndarray import NDArray
         dev = self._ctx.jax_device
+        mesh = self._mesh
 
-        def place(nd):
+        def place_dev0(nd):
             return NDArray(jax.device_put(nd._data, dev), self._ctx)
+
+        def _batch_place(b):
+            """Sharding is decided per BATCH, not per array: either every
+            array (data and label) shards over the mesh or the whole
+            batch stays on device 0 — a mixed batch would hand the
+            consuming jitted step a new input-sharding combination
+            (extra compile + resharding transfers)."""
+            if mesh is None:
+                return place_dev0
+            ndev = mesh.devices.size
+            arrays = list(b.data) + list(b.label or [])
+            if not all(a.shape and a.shape[0] % ndev == 0 for a in arrays):
+                return place_dev0
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            bsh = NamedSharding(mesh, P("dp"))
+            return lambda nd: NDArray(jax.device_put(nd._data, bsh),
+                                      self._ctx)
 
         out = []
         for b in batches:
+            place = _batch_place(b)
             out.append(DataBatch([place(d) for d in b.data],
                                  ([place(l) for l in b.label]
                                   if b.label is not None else None),

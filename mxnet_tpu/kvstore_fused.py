@@ -39,7 +39,40 @@ from jax import lax
 from .ndarray import NDArray
 from . import profiler
 
-__all__ = ["FusedBucketEngine", "bucket_byte_cap", "TRACE_COUNT"]
+__all__ = ["FusedBucketEngine", "bucket_byte_cap", "TRACE_COUNT",
+           "two_bit_quantize", "fused_sgd_apply"]
+
+
+def two_bit_quantize(residual, grad, threshold):
+    """Error-feedback 2-bit quantize for one device stream: returns
+    ``(q, new_residual)``. The op sequence (add, exact-constant selects,
+    subtract) matches TwoBitCompressor.compress_decompress bit-for-bit;
+    it is SHARED by the bucketed kvstore step and the fused fit step
+    (module/fused_fit.py) so cross-path parity is structural, not
+    maintained by hand in two places."""
+    t = jnp.asarray(threshold, dtype=grad.dtype)
+    acc = residual + grad
+    q = jnp.where(acc > t, t, jnp.where(acc < -t, -t, jnp.zeros_like(acc)))
+    return q, acc - q
+
+
+def fused_sgd_apply(w, g_reduced, state, lr, wd, rescale, momentum, clip,
+                    use_wd):
+    """One key's SGD(-momentum) apply, identical op sequence to
+    ops/optimizer_ops.py sgd(_mom)_update (rescale -> clip -> wd ->
+    momentum); shared by the bucket program and the fused fit step.
+    ``state`` None means plain SGD. Returns (new_w, new_state|None)."""
+    g = g_reduced.astype(jnp.float32) * rescale
+    if clip is not None and clip >= 0:
+        g = jnp.clip(g, -clip, clip)
+    if use_wd:
+        g = g + wd * w.astype(jnp.float32)
+    if state is not None:
+        new_mom = momentum * state.astype(jnp.float32) - lr * g
+        new_w = w.astype(jnp.float32) + new_mom
+        return new_w.astype(w.dtype), new_mom.astype(state.dtype)
+    new_w = w.astype(jnp.float32) - lr * g
+    return new_w.astype(w.dtype), None
 
 # incremented inside each bucket step function at trace time only; a
 # steady-state step that hits the jit cache leaves it untouched
@@ -126,11 +159,8 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
         for d in range(n_dev):
             g = grads[d][0].reshape(-1) if n_keys == 1 else jnp.concatenate(
                 [grads[d][i].reshape(-1) for i in range(n_keys)])
-            t = jnp.asarray(threshold, dtype=g.dtype)
-            acc = residuals[d] + g
-            q = jnp.where(acc > t, t,
-                          jnp.where(acc < -t, -t, jnp.zeros_like(acc)))
-            new_res.append(acc - q)
+            q, r = two_bit_quantize(residuals[d], g, threshold)
+            new_res.append(r)
             dev_q.append(q)
         flat = dev_q[0]
         for q in dev_q[1:]:
@@ -156,22 +186,11 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
         reduced, new_res = _reduce(residuals, grads)
         new_ws, new_ss = [], []
         for i in range(n_keys):
-            w = weights[i]
-            # identical op sequence to ops/optimizer_ops.py sgd(_mom)_update
-            g = reduced[i].astype(jnp.float32) * rescale
-            if clip is not None and clip >= 0:
-                g = jnp.clip(g, -clip, clip)
-            if use_wd:
-                g = g + wd_vec[i] * w.astype(jnp.float32)
-            if state_mask[i]:
-                new_mom = momentum * states[i].astype(jnp.float32) \
-                    - lr_vec[i] * g
-                new_w = w.astype(jnp.float32) + new_mom
-                new_ss.append(new_mom.astype(states[i].dtype))
-            else:
-                new_w = w.astype(jnp.float32) - lr_vec[i] * g
-                new_ss.append(None)
-            new_ws.append(new_w.astype(w.dtype))
+            new_w, new_s = fused_sgd_apply(
+                weights[i], reduced[i], states[i] if state_mask[i] else None,
+                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            new_ws.append(new_w)
+            new_ss.append(new_s)
         return tuple(new_ws), tuple(new_ss), new_res
     return jax.jit(step, donate_argnums=(1, 2))
 
@@ -345,6 +364,8 @@ class FusedBucketEngine:
         BUCKET_COUNT.set_value(len(buckets))
 
     def _dispatch(self, bucket, mode):
+        from .executor import _count_dispatch
+        _count_dispatch()       # one compiled bucket program per call
         kv = self._kv
         comp = kv._compression
         threshold = comp.threshold if comp is not None else None

@@ -1,4 +1,15 @@
-"""Evaluation metrics (reference parity: python/mxnet/metric.py, ~20 metrics)."""
+"""Evaluation metrics (reference parity: python/mxnet/metric.py, ~20 metrics).
+
+TPU-native addition (docs/TRAINING.md): metrics can accumulate ON DEVICE.
+A metric that implements :meth:`EvalMetric.device_fn` hands the fused fit
+step (module/fused_fit.py) a pure jnp function ``(labels, preds) ->
+(batch_sum, batch_num)``; the step folds it into the one compiled training
+program and keeps ``sum_metric``/``num_inst`` as device scalars. The host
+reads them back only when :meth:`get` is called (Speedometer frequency /
+epoch boundaries), so the per-batch fit loop never blocks on ``asnumpy``.
+``fit_host_syncs`` (profiler counter) witnesses every blocking readback
+the metric layer performs.
+"""
 from __future__ import annotations
 
 import math
@@ -6,6 +17,7 @@ import math
 import numpy as _np
 
 from .base import MXNetError
+from . import profiler as _profiler
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -61,11 +73,49 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
     return labels, preds
 
 
+# the fit loop's host-sync witness (bench.py --mode train
+# host_syncs_per_step): incremented on every blocking device->host
+# readback the metric layer performs — per-batch update() conversions on
+# the eager path, get()-time accumulator folds on the device path
+_fit_domain = _profiler.Domain("fit")
+HOST_SYNCS = _fit_domain.new_counter("fit_host_syncs")
+
+
+def consume_device_batch(metric):
+    """True — and clears the marker — when the fused fit step already
+    folded the current batch into ``metric``'s device accumulator;
+    callers must then skip the host update for this batch. The ONE
+    implementation of the consume-and-clear protocol (used by both
+    update_dict and executor_group.update_metric)."""
+    if getattr(metric, "_device_consumed", False):
+        metric._device_consumed = False
+        return True
+    return False
+
+
 def _asnp(x):
-    return x.asnumpy() if hasattr(x, "asnumpy") else _np.asarray(x)
+    """The one device-aware conversion helper: labels/preds/losses of any
+    flavor (NDArray, jax array, numpy, list) to numpy, counting a host
+    sync whenever the value was device-resident."""
+    if isinstance(x, _np.ndarray):
+        return x
+    if hasattr(x, "asnumpy"):
+        HOST_SYNCS.increment()
+        return x.asnumpy()
+    if hasattr(x, "devices"):        # bare jax.Array
+        HOST_SYNCS.increment()
+    return _np.asarray(x)
 
 
 class EvalMetric:
+    # device-resident accumulator (fed by the fused fit step); None means
+    # "host accumulation only". _device_consumed marks a batch the fused
+    # step already folded on device, so the fit loop's update_metric call
+    # must not convert the same preds again.
+    _dev_sum = None
+    _dev_num = None
+    _device_consumed = False
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
@@ -76,11 +126,18 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+        self._dev_num = None
+        self._device_consumed = False
 
     def update(self, labels, preds):
         raise NotImplementedError
 
     def update_dict(self, label, pred):
+        if consume_device_batch(self):
+            # the fused fit step already folded this batch into the
+            # device accumulator — don't convert the preds a second time
+            return
         if self.output_names is not None:
             pred = [pred[name] for name in self.output_names if name in pred]
         else:
@@ -91,10 +148,34 @@ class EvalMetric:
             label = list(label.values())
         self.update(label, pred)
 
+    # -- device-side accumulation (module/fused_fit.py) -----------------
+    def device_fn(self):
+        """A pure jnp function ``(labels, preds) -> (batch_sum,
+        batch_num)`` mirroring :meth:`update`, or None when this metric
+        must accumulate on the host. The fused fit step folds it into
+        the one compiled training program."""
+        return None
+
+    def device_sig(self):
+        """Hashable config distinguishing compiled metric variants (part
+        of the fused-step program cache key)."""
+        return None
+
+    def _totals(self):
+        """(sum, num) with the device accumulator folded in — a blocking
+        readback ONLY when device scalars are pending (get()-time, i.e.
+        Speedometer frequency / epoch boundaries)."""
+        if self._dev_sum is None:
+            return self.sum_metric, self.num_inst
+        HOST_SYNCS.increment()
+        return (self.sum_metric + float(self._dev_sum),
+                self.num_inst + float(self._dev_num))
+
     def get(self):
-        if self.num_inst == 0:
+        total, num = self._totals()
+        if num == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, total / num)
 
     def get_name_value(self):
         name, value = self.get()
@@ -159,12 +240,32 @@ class Accuracy(EvalMetric):
             label = _asnp(label).astype("int32")
             pred = _asnp(pred)
             if pred.ndim > label.ndim:
-                pred = pred.argmax(axis=self.axis).astype("int32")
-            else:
-                pred = pred.astype("int32")
-            label, pred = label.flat, pred.flat
-            self.sum_metric += (_np.asarray(label) == _np.asarray(pred)).sum()
-            self.num_inst += len(_np.asarray(label))
+                pred = pred.argmax(axis=self.axis)
+            label = label.reshape(-1)
+            pred = pred.astype("int32").reshape(-1)
+            self.sum_metric += (label == pred).sum()
+            self.num_inst += label.size
+
+    def device_fn(self):
+        import jax.numpy as jnp
+        axis = self.axis
+
+        def fn(labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for label, pred in zip(labels, preds):
+                label = label.astype(jnp.int32)
+                if pred.ndim > label.ndim:
+                    pred = jnp.argmax(pred, axis=axis)
+                label = label.reshape(-1)
+                pred = pred.astype(jnp.int32).reshape(-1)
+                s = s + (label == pred).sum().astype(jnp.float32)
+                n += label.size
+            return s, jnp.float32(n)
+        return fn
+
+    def device_sig(self):
+        return ("accuracy", self.axis)
 
 
 @register(None, "topkaccuracy", "top_k_accuracy")
@@ -184,6 +285,24 @@ class TopKAccuracy(EvalMetric):
             for j in range(self.top_k):
                 self.sum_metric += (topk[:, j].flatten() == label.flatten()).sum()
             self.num_inst += len(label.flatten())
+
+    def device_fn(self):
+        import jax.numpy as jnp
+        top_k = self.top_k
+
+        def fn(labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for label, pred in zip(labels, preds):
+                label = label.astype(jnp.int32).reshape(-1)
+                topk = jnp.argsort(pred, axis=-1)[:, -top_k:]
+                s = s + (topk == label[:, None]).sum().astype(jnp.float32)
+                n += label.size
+            return s, jnp.float32(n)
+        return fn
+
+    def device_sig(self):
+        return ("top_k_accuracy", self.top_k)
 
 
 def _binary_counts(label, pred, check_binary=False, metric_name=""):
@@ -288,7 +407,8 @@ class Perplexity(EvalMetric):
         num = 0
         for label, pred in zip(labels, preds):
             label = _asnp(label).astype("int32").flatten()
-            pred = _asnp(pred).reshape(-1, _asnp(pred).shape[-1])
+            pred = _asnp(pred)
+            pred = pred.reshape(-1, pred.shape[-1])
             probs = pred[_np.arange(label.size), label]
             if self.ignore_label is not None:
                 ignore = (label == self.ignore_label)
@@ -299,10 +419,34 @@ class Perplexity(EvalMetric):
         self.sum_metric += loss
         self.num_inst += num
 
+    def device_fn(self):
+        import jax.numpy as jnp
+        ignore_label = self.ignore_label
+
+        def fn(labels, preds):
+            loss = jnp.float32(0.0)
+            num = jnp.float32(0.0)
+            for label, pred in zip(labels, preds):
+                label = label.reshape(-1).astype(jnp.int32)
+                pred = pred.reshape(-1, pred.shape[-1])
+                probs = pred[jnp.arange(label.shape[0]), label]
+                num = num + jnp.float32(label.shape[0])
+                if ignore_label is not None:
+                    ignore = (label == ignore_label)
+                    probs = jnp.where(ignore, 1.0, probs)
+                    num = num - ignore.sum().astype(jnp.float32)
+                loss = loss - jnp.log(jnp.maximum(1e-10, probs)).sum()
+            return loss, num
+        return fn
+
+    def device_sig(self):
+        return ("perplexity", self.ignore_label)
+
     def get(self):
-        if self.num_inst == 0:
+        total, num = self._totals()
+        if num == 0:
             return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+        return (self.name, math.exp(total / num))
 
 
 @register
@@ -321,6 +465,23 @@ class MAE(EvalMetric):
             self.sum_metric += _np.abs(label - pred).mean()
             self.num_inst += 1
 
+    def device_fn(self):
+        import jax.numpy as jnp
+
+        def fn(labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for label, pred in zip(labels, preds):
+                if label.ndim == 1:
+                    label = label.reshape(label.shape[0], 1)
+                if pred.ndim == 1:
+                    pred = pred.reshape(pred.shape[0], 1)
+                s = s + jnp.abs(label - pred).mean().astype(jnp.float32)
+                n += 1
+            return s, jnp.float32(n)
+        return fn
+
+
 
 @register
 class MSE(EvalMetric):
@@ -338,16 +499,35 @@ class MSE(EvalMetric):
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
 
+    def device_fn(self):
+        import jax.numpy as jnp
+
+        def fn(labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for label, pred in zip(labels, preds):
+                if label.ndim == 1:
+                    label = label.reshape(label.shape[0], 1)
+                if pred.ndim == 1:
+                    pred = pred.reshape(pred.shape[0], 1)
+                s = s + ((label - pred) ** 2.0).mean().astype(jnp.float32)
+                n += 1
+            return s, jnp.float32(n)
+        return fn
+
+
 
 @register
 class RMSE(MSE):
     def __init__(self, name="rmse", output_names=None, label_names=None):
         EvalMetric.__init__(self, name, output_names, label_names)
 
+
     def get(self):
-        if self.num_inst == 0:
+        total, num = self._totals()
+        if num == 0:
             return (self.name, float("nan"))
-        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+        return (self.name, math.sqrt(total / num))
 
 
 @register(None, "crossentropy", "ce")
@@ -366,6 +546,24 @@ class CrossEntropy(EvalMetric):
             prob = pred[_np.arange(label.shape[0]), label]
             self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
+
+    def device_fn(self):
+        import jax.numpy as jnp
+        eps = self.eps
+
+        def fn(labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for label, pred in zip(labels, preds):
+                label = label.reshape(-1).astype(jnp.int32)
+                prob = pred[jnp.arange(label.shape[0]), label]
+                s = s + (-jnp.log(prob + eps)).sum().astype(jnp.float32)
+                n += label.shape[0]
+            return s, jnp.float32(n)
+        return fn
+
+    def device_sig(self):
+        return ("cross-entropy", self.eps)
 
 
 @register(None, "nll_loss", "negativeloglikelihood")
@@ -400,6 +598,19 @@ class Loss(EvalMetric):
             loss = _asnp(pred)
             self.sum_metric += loss.sum()
             self.num_inst += loss.size
+
+    def device_fn(self):
+        import jax.numpy as jnp
+
+        def fn(_labels, preds):
+            s = jnp.float32(0.0)
+            n = 0
+            for pred in preds:
+                s = s + pred.sum().astype(jnp.float32)
+                n += pred.size
+            return s, jnp.float32(n)
+        return fn
+
 
 
 @register

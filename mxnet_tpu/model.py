@@ -95,6 +95,15 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names,
     kvstore.pull(pull_names, out=pull_args)
 
 
+def _local_updater_key(index, num_device=1, device=0):
+    """Updater state key for worker-side updates (reference model.py:163
+    interleaves per-device: ``i * num_device + k``). Shared by
+    :func:`_update_params` and the fused fit step
+    (module/fused_fit.py) so optimizer state saved by one path loads
+    into the other."""
+    return index * num_device + device
+
+
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None, push_order=None):
     """(reference model.py:163) update on workers via the local updater;
@@ -111,7 +120,7 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             continue
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updates[k].append((i * num_device + k, g, w))
+            updates[k].append((_local_updater_key(i, num_device, k), g, w))
     for dev_updates in updates:
         for upd in dev_updates:
             updater(*upd)

@@ -15,6 +15,7 @@ compute→wait→update sequence.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as _np
@@ -124,6 +125,23 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def fit_step(self, data_batch, eval_metric=None):
+        """One training step: the eager pair — a fused fwd+bwd dispatch,
+        then the optimizer/kvstore update. Subclasses may fuse further
+        (Module routes eligible configs through module/fused_fit.py as
+        ONE donated program) and return True to signal the whole step —
+        including device-side metric accumulation — ran as a single
+        launch, making the loop's ``update_metric`` call a no-op."""
+        self.forward_backward(data_batch)
+        self.update()
+        return False
+
+    def _fit_sync(self):
+        """Block until in-flight device work completes — the bounded-
+        async-depth hook behind ``MXNET_FIT_SYNC_EVERY`` (overridden by
+        Module; a no-op for modules without device-resident state)."""
+        pass
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -176,21 +194,26 @@ class BaseModule:
 
     def _run_train_epoch(self, epoch, train_data, train_metric, monitor,
                          on_batch, sparse_row_id_fn):
-        """One epoch: keep the device queue full, read metrics back only at
-        callback boundaries."""
+        """One epoch: keep the device queue full, read metrics back only
+        at callback boundaries. With the fused fit step active, the loop
+        body performs ZERO blocking host syncs — metrics accumulate on
+        device and step N+1 dispatches while step N executes; the
+        ``MXNET_FIT_SYNC_EVERY`` env var (0 = unbounded, the default)
+        bounds how many steps may be in flight."""
         t0 = time.time()
         train_metric.reset()
         flow = _Prefetcher(train_data, self, sparse_row_id_fn)
+        sync_every = int(os.environ.get("MXNET_FIT_SYNC_EVERY", "0") or 0)
         nbatch = 0
         while flow.has_next:
             batch = flow.advance()
             if monitor is not None:
                 monitor.tic()
-            # forward+backward+update enqueue async XLA work; while the
-            # device runs, the host stages the (already-fetched) next batch
-            # and accumulates metrics on this step's future-valued outputs.
-            self.forward_backward(batch)
-            self.update()
+            # fit_step enqueues async XLA work (one donated program when
+            # fused); while the device runs, the host stages the
+            # (already-fetched) next batch. update_metric is a no-op for
+            # batches the fused step already folded on device.
+            self.fit_step(batch, train_metric)
             flow.stage_next()
             self.update_metric(train_metric, batch.label)
             if monitor is not None:
@@ -201,6 +224,9 @@ class BaseModule:
                 for cb in on_batch:
                     cb(info)
             nbatch += 1
+            if sync_every and nbatch % sync_every == 0:
+                self._fit_sync()
+        # epoch boundary: the one scheduled metric readback of the epoch
         for name, val in train_metric.get_name_value():
             self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
         self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - t0)

@@ -183,6 +183,12 @@ class DataParallelExecutorGroup:
                 self._exec.aux_dict[name].asnumpy(), ctx=cpu())
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
+        from ..metric import consume_device_batch
+        if consume_device_batch(eval_metric):
+            # the fused fit step (module/fused_fit.py) already folded
+            # this batch into the device accumulator — touching
+            # self._exec.outputs here would only force materialization
+            return
         eval_metric.update_dict(
             dict(zip(self.label_names, labels or [])),
             dict(zip(self.symbol.list_outputs(), list(self._exec.outputs))))

@@ -1,0 +1,430 @@
+"""Single-launch fit step (docs/TRAINING.md).
+
+The eager Module fit step costs ~32 device launches: one fused fwd+bwd
+program (executor.py) plus one compiled program per kvstore bucket
+(kvstore_fused.py), with a blocking ``asnumpy`` in ``update_metric``
+every batch. ``FusedFitStep`` collapses all of it into ONE jitted XLA
+program per step for eligible configurations:
+
+    forward + backward (jax.vjp over the compiled graph_fn)
+      -> 2-bit quantize with donated error-feedback residual (optional)
+      -> cross-device reduce (GSPMD psum when the batch is mesh-sharded)
+      -> fused optimizer apply (Optimizer._fused_fit_sig)
+      -> device-side metric accumulation (EvalMetric.device_fn)
+
+Parameters, optimizer state, residuals, aux states, and the metric
+accumulator are DONATED, so HBM holds one copy of the training state and
+a steady-state step is a single device launch with zero host syncs —
+the same shape as parallel/trainer.py's TrainStep, brought to the
+Module/kvstore path that ``fit``, ``model.py``, and user scripts use.
+
+Eligibility (checked once per optimizer init, cheaply re-checked per
+batch): dense f32 params with grad_req='write', a fusable optimizer
+(``_fused_fit_sig`` non-None — SGD; LBSGD/multi-precision opt out), a
+local/device kvstore (or none) with or without 2-bit compression, no
+installed monitor, no inputs_need_grad. Everything else falls back to
+the eager fwd_bwd + bucketed-kvstore path unchanged; error-feedback
+residuals move between the two paths through the same spill/reseed
+mechanism the bucketed engine uses, so no accumulated residual is lost.
+
+The compiled step is cached per SYMBOL (sharing executables across
+rebinds like executor._compiled_cache) and keyed by everything that
+changes the program — param set, compression threshold, optimizer
+signature, state mask, metric signature. ``rescale_grad``, lr, and wd
+ride as runtime arguments, and jax's shape-keyed jit cache handles
+ragged final batches: each distinct batch shape traces once
+(``TRACE_COUNT``), steady state never retraces.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from ..kvstore import KVStore, _updater_key
+from ..kvstore_fused import two_bit_quantize, fused_sgd_apply
+from ..executor import _compiled_cache, _count_dispatch
+from ..model import _local_updater_key
+
+__all__ = ["FusedFitStep", "TRACE_COUNT"]
+
+# incremented inside the step function at trace time only; steady-state
+# steps (including repeats of a ragged batch shape) leave it untouched
+TRACE_COUNT = 0
+
+
+def _metric_closure(metric, label_names, output_names):
+    """(metric_fn, cache_sig) folding ``metric``'s device accumulation
+    into the step program with ``update_dict``'s output/label selection
+    semantics; (None, None) when the metric accumulates on the host."""
+    fn = metric.device_fn() if metric is not None else None
+    if fn is None:
+        return None, None
+    out_sel = tuple(metric.output_names) if metric.output_names else None
+    lab_sel = tuple(metric.label_names) if metric.label_names else None
+    label_names = tuple(label_names)
+    output_names = tuple(output_names)
+
+    def metric_fn(inputs, outs):
+        pred_d = dict(zip(output_names, outs))
+        preds = ([pred_d[n] for n in out_sel if n in pred_d]
+                 if out_sel is not None else list(outs))
+        names = lab_sel if lab_sel is not None else label_names
+        labels = [inputs[n] for n in names if n in inputs]
+        return fn(labels, preds)
+
+    sig = (type(metric).__name__, metric.device_sig(), out_sel, lab_sel,
+           label_names, output_names)
+    return metric_fn, sig
+
+
+def _build_fit_program(graph_fn, param_order, threshold, mode, state_mask,
+                       use_wd, metric_fn, mirror):
+    """ONE jitted program: fwd+bwd+compress+reduce+update(+metric).
+
+    The compress and optimizer math are the SAME functions the bucketed
+    kvstore step compiles (kvstore_fused.two_bit_quantize /
+    fused_sgd_apply, themselves mirroring ops/optimizer_ops.py), so
+    fused weights match the eager path within FMA-contraction ulps
+    (tests/test_fused_fit.py pins the tolerance)."""
+    kind, momentum, clip = mode
+    assert kind == "sgd"
+
+    def step(params, states, residuals, macc, inputs, auxs,
+             lr_vec, wd_vec, rescale, seed):
+        global TRACE_COUNT
+        TRACE_COUNT += 1
+
+        def f(p):
+            outs, new_auxs = graph_fn({**inputs, **p}, auxs, seed, True)
+            return outs, new_auxs
+
+        if mirror:
+            # MXNET_BACKWARD_DO_MIRROR: rematerialize the forward
+            # (jax.checkpoint), matching executor._make_fwd_bwd
+            f = jax.checkpoint(f)
+        outs, vjp_fn, new_auxs = jax.vjp(f, params, has_aux=True)
+        cts = [jnp.ones_like(o) for o in outs]
+        (grads,) = vjp_fn(cts)
+
+        # 2-bit quantize with donated error-feedback residual; a mesh-
+        # sharded batch already yielded psum-reduced (replicated) grads
+        # from the vjp, so there is no separate reduce stage to launch
+        new_res, red = {}, {}
+        for name in param_order:
+            if threshold is not None:
+                red[name], new_res[name] = two_bit_quantize(
+                    residuals[name], grads[name], threshold)
+            else:
+                red[name] = grads[name]
+
+        new_ps, new_ss = {}, {}
+        for i, name in enumerate(param_order):
+            new_ps[name], new_ss[name] = fused_sgd_apply(
+                params[name], red[name],
+                states[name] if state_mask[i] else None,
+                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+
+        if metric_fn is not None:
+            bsum, bnum = metric_fn(inputs, outs)
+            macc = (macc[0] + bsum, macc[1] + bnum)
+        return new_ps, new_ss, new_res, macc, new_auxs, outs
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 5))
+
+
+class FusedFitStep:
+    """Per-Module driver for the single-launch fit step."""
+
+    _METRIC_UNSET = object()
+
+    def __init__(self, module, updater, kv, threshold, mode):
+        self._mod = module
+        self._updater = updater
+        self._kv = kv                 # None, or the plain local KVStore
+        self._threshold = threshold
+        self._mode = mode             # optimizer._fused_fit_sig() at build
+        self._residuals = None        # name -> jnp residual (2-bit arm)
+        # step-invariant caches (the whole FusedFitStep is rebuilt on
+        # rebind/init_optimizer, so these live as long as they are valid)
+        self._order = None            # trainable param names, arg order
+        self._ukeys = None            # matching updater state keys
+        self._metric_ref = FusedFitStep._METRIC_UNSET
+        self._metric_fn = None
+        self._msig = None
+        self.launches = 0
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def build(module):
+        """A FusedFitStep when ``module``'s configuration is eligible,
+        else None (the fit loop then keeps the eager path)."""
+        def no(reason):
+            dbg = getattr(module.logger, "debug", None)
+            if dbg:
+                dbg("fused fit step disabled: %s", reason)
+            return None
+
+        # the env kill-switch is snapshotted into _fused_fit_enabled by
+        # Module.__init__ — one source of truth for both knobs
+        if not getattr(module, "_fused_fit_enabled", True):
+            return no("disabled on this module")
+        group = module._exec_group
+        exe = group._exec
+        if exe._group_devices is not None:
+            return no("group2ctx-placed (model-parallel) executor")
+        if module.inputs_need_grad:
+            return no("inputs_need_grad")
+        optimizer = module._optimizer
+        sig = optimizer._fused_fit_sig()
+        if sig is None:
+            return no("optimizer %s has no fused signature"
+                      % type(optimizer).__name__)
+        if sig[0] != "sgd":
+            return no("unsupported fused kind %r" % (sig[0],))
+        kv = module._kvstore
+        if module._update_on_kvstore:
+            if type(kv) is not KVStore:
+                return no("update_on_kvstore with %s" % type(kv).__name__)
+            updater = kv._updater
+        else:
+            if kv is not None and type(kv) is not KVStore:
+                return no("dist kvstore")
+            updater = module._updater
+        if not isinstance(updater, opt_mod.Updater):
+            return no("custom updater")
+        if updater.optimizer is not optimizer:
+            return no("updater/optimizer mismatch")
+        threshold = None
+        comp = kv._compression if kv is not None else None
+        if comp is not None:
+            thr = getattr(comp, "threshold", None)
+            if thr is None:
+                return no("unsupported gradient compression")
+            threshold = float(thr)
+        for name in group.param_names:
+            arr = exe.arg_dict.get(name)
+            if arr is None or exe._grad_req.get(name, "null") == "null":
+                continue
+            if exe._grad_req[name] != "write":
+                return no("grad_req %r on %s" % (exe._grad_req[name], name))
+            if getattr(arr, "stype", "default") != "default" \
+                    or arr.dtype != _np.float32:
+                return no("non-dense-f32 param %s" % name)
+        step = FusedFitStep(module, updater, kv, threshold, sig)
+        if not step._param_order():
+            return no("no trainable parameters")
+        return step
+
+    # -- helpers --------------------------------------------------------
+    def _param_order(self):
+        group = self._mod._exec_group
+        exe = group._exec
+        return [n for n in group.param_names
+                if n in exe.arg_dict
+                and exe._grad_req.get(n, "null") != "null"]
+
+    def _ukey(self, index, name):
+        """Updater state key — matches what the eager path would use so
+        optimizer state saved by one path loads into the other."""
+        if self._mod._update_on_kvstore:
+            return _updater_key(name)
+        return _local_updater_key(index)
+
+    def _place(self, group, exe, name, value):
+        dst = exe.arg_dict[name]
+        data = value._data if isinstance(value, NDArray) \
+            else jnp.asarray(_np.asarray(value))
+        if data.dtype != dst._data.dtype:
+            data = data.astype(dst._data.dtype)
+        if group._mesh is not None:
+            return jax.device_put(data, group._batch_sharding())
+        return exe._to_ctx(data)
+
+    # -- residual spill/reseed (shared with the bucketed engine) --------
+    def _seed_residuals(self, order, exe):
+        # `order` is fixed for this FusedFitStep's lifetime, so any
+        # non-None residual dict matches it; _release() forces a reseed
+        if self._residuals is not None:
+            return self._residuals
+        kv = self._kv
+        if kv is not None and kv._engine is not None:
+            # flush pending buckets and spill their flat residuals back
+            # to the per-(key,dev) dict before we take ownership
+            kv._sync_engine()
+        res = {}
+        for n in order:
+            w = exe.arg_dict[n]
+            if kv is not None:
+                res[n] = kv._get_residual((n, 0), w)._data
+                kv._compression_residuals.pop((n, 0), None)
+            else:
+                res[n] = jnp.zeros(w.shape, w._data.dtype)
+        self._residuals = res
+        return res
+
+    def _release(self):
+        """Spill residual state back to the kvstore's per-(key,dev)
+        dict so the eager path (and the bucketed engine's reseed)
+        resumes with the exact accumulated error feedback."""
+        if self._residuals and self._kv is not None:
+            for n, r in self._residuals.items():
+                self._kv._compression_residuals[(n, 0)] = NDArray(r)
+        self._residuals = None
+
+    # -- the step -------------------------------------------------------
+    def step(self, data_batch, eval_metric=None):
+        """Run one single-launch training step. Returns False when this
+        batch can't take the fused path (residuals are spilled first so
+        the eager fallback continues exactly)."""
+        mod = self._mod
+        if getattr(mod, "_monitor_installed", False):
+            self._release()
+            return False
+        # re-check the mutable bits of build-time eligibility: a swapped
+        # updater (kv.set_updater after init) or a mutated optimizer
+        # hyperparameter must not silently keep the stale program
+        live_updater = mod._kvstore._updater if mod._update_on_kvstore \
+            else mod._updater
+        if live_updater is not self._updater:
+            self._release()
+            return False
+        mode = mod._optimizer._fused_fit_sig()
+        if mode is None or mode[0] != "sgd":
+            self._release()
+            return False
+        group = mod._exec_group
+        exe = group._exec
+        data = getattr(data_batch, "data", None)
+        labels = getattr(data_batch, "label", None) or []
+        if not data or len(data) != len(group.data_names) \
+                or (group.label_names
+                    and len(labels) < len(group.label_names)):
+            self._release()
+            return False
+        for v in list(data) + list(labels):
+            if isinstance(v, NDArray) \
+                    and getattr(v, "stype", "default") != "default":
+                self._release()
+                return False
+
+        inputs = {}
+        try:
+            for name, v in zip(group.data_names, data):
+                inputs[name] = self._place(group, exe, name, v)
+            for name, v in zip(group.label_names, labels):
+                inputs[name] = self._place(group, exe, name, v)
+        except Exception as e:              # e.g. unshardable ragged batch
+            dbg = getattr(mod.logger, "debug", None)
+            if dbg:
+                dbg("fused fit step falling back for this batch: %s", e)
+            self._release()
+            return False
+
+        if self._order is None:
+            self._order = self._param_order()
+            # keys use the param's position in the FULL param_names list
+            # — frozen params keep their index slots in the eager path
+            # (model._update_params / Module._param_index_names), and
+            # the keys must agree for lr/wd mults and state interchange
+            pos = {n: i for i, n in enumerate(group.param_names)}
+            self._ukeys = [self._ukey(pos[n], n) for n in self._order]
+        order, ukeys = self._order, self._ukeys
+        params = {n: exe.arg_dict[n]._data for n in order}
+        for n in exe._arg_names:
+            if n not in inputs and n not in params:
+                inputs[n] = exe.arg_dict[n]._data   # fixed/no-grad args
+
+        updater, optimizer = self._updater, mod._optimizer
+        # validate loaded states BEFORE any side effects: an abort here
+        # must not have advanced update counts or created state entries
+        for uk in ukeys:
+            st = updater.states.get(uk)
+            if st is not None and not isinstance(st, NDArray):
+                self._release()
+                return False       # e.g. loaded multi-precision tuple
+        states_nd = []
+        for n, uk in zip(order, ukeys):
+            if uk not in updater.states:
+                updater.states[uk] = optimizer.create_state_multi_precision(
+                    uk, exe.arg_dict[n])
+                updater.states_synced[uk] = True
+            states_nd.append(updater.states[uk])
+            optimizer._update_count(uk)
+        lr_vec = _np.asarray([optimizer._get_lr(uk) for uk in ukeys],
+                             _np.float32)
+        wd_vec = _np.asarray([optimizer._get_wd(uk) for uk in ukeys],
+                             _np.float32)
+        use_wd = bool(_np.any(wd_vec != 0.0))
+        state_mask = tuple(st is not None for st in states_nd)
+        states = {n: (st._data if st is not None else None)
+                  for n, st in zip(order, states_nd)}
+        residuals = self._seed_residuals(order, exe) \
+            if self._threshold is not None else {}
+
+        if eval_metric is not self._metric_ref:
+            self._metric_fn, self._msig = _metric_closure(
+                eval_metric, group.label_names, mod._symbol.list_outputs())
+            self._metric_ref = eval_metric
+        metric_fn, msig = self._metric_fn, self._msig
+        from .. import config as _config
+        mirror = _config.backward_do_mirror()
+        cache = _compiled_cache(mod._symbol).setdefault("fit_step", {})
+        # `mode` re-read above: mutating optimizer hyperparams mid-
+        # training switches programs (one retrace), like the eager path
+        key = (tuple(order), self._threshold, mode, state_mask,
+               use_wd, msig, mirror)
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _build_fit_program(
+                _compiled_cache(mod._symbol)["graph_fn"], tuple(order),
+                self._threshold, mode, state_mask, use_wd,
+                metric_fn, mirror)
+
+        macc = ()
+        if metric_fn is not None:
+            macc = (eval_metric._dev_sum
+                    if eval_metric._dev_sum is not None else jnp.float32(0.0),
+                    eval_metric._dev_num
+                    if eval_metric._dev_num is not None else jnp.float32(0.0))
+
+        seed = exe._next_seed()
+        rescale = _np.float32(optimizer.rescale_grad)
+        _count_dispatch()
+        try:
+            with exe._prof_scope("Module::fused_fit_step"):
+                new_ps, new_ss, new_res, macc, new_auxs, outs = fn(
+                    params, states, residuals, macc, inputs,
+                    exe._auxs_values(), lr_vec, wd_vec, rescale, seed)
+        except Exception:
+            # a runtime failure after donation consumes the donated
+            # buffers — drop our residual refs so a later spill doesn't
+            # resurrect deleted arrays, then surface the error (the
+            # module's device state is not recoverable at this point)
+            self._residuals = None
+            raise
+
+        # rebind every donated buffer to its new value
+        kv_store = self._kv._store \
+            if (self._kv is not None and mod._update_on_kvstore) else None
+        for n, st in zip(order, states_nd):
+            exe.arg_dict[n]._set_data(new_ps[n])
+            if kv_store is not None and n in kv_store:
+                kv_store[n]._set_data(new_ps[n])
+            if st is not None:
+                st._set_data(new_ss[n])
+        if self._threshold is not None:
+            self._residuals = dict(new_res)
+        exe._write_auxs(new_auxs)
+        exe._outputs = [NDArray(o, exe._ctx) for o in outs]
+        exe._pending_train_fwd = False
+        exe._train_seed = None
+        exe._train_auxs = None
+        if metric_fn is not None:
+            eval_metric._dev_sum, eval_metric._dev_num = macc
+            eval_metric._device_consumed = True
+        mod._params_dirty = True
+        self.launches += 1
+        return True

@@ -10,6 +10,7 @@ that cheap after the first time).
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 
 from ..base import MXNetError
@@ -81,6 +82,14 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # single-launch fit step (module/fused_fit.py, docs/TRAINING.md):
+        # built lazily on the first fit_step after init_optimizer;
+        # MXNET_FIT_FUSED=0 keeps every step on the eager path
+        self._fused_fit = None
+        self._fused_fit_tried = False
+        self._fused_fit_enabled = os.environ.get(
+            "MXNET_FIT_FUSED", "1") != "0"
+        self._monitor_installed = False
 
     # -- checkpointing --------------------------------------------------
     @staticmethod
@@ -274,6 +283,10 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # a re-bind may change grad_req / inputs_need_grad — fused-fit
+        # eligibility must be re-evaluated against the new executor
+        self._fused_fit = None
+        self._fused_fit_tried = False
 
     def reshape(self, data_shapes, label_shapes=None):
         """Re-bind to new input shapes, reusing weights (and the compiled
@@ -355,6 +368,8 @@ class Module(BaseModule):
         else:
             self._updater = opt.get_updater(optimizer)
         self.optimizer_initialized = True
+        self._fused_fit = None          # re-evaluate fused-fit eligibility
+        self._fused_fit_tried = False
 
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -369,6 +384,8 @@ class Module(BaseModule):
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self.optimizer_initialized = True
+        self._fused_fit = None
+        self._fused_fit_tried = False
 
     # -- execution ------------------------------------------------------
     def _batch_descs(self, data_batch, new_shapes):
@@ -409,10 +426,48 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)
 
+    def fit_step(self, data_batch, eval_metric=None):
+        """One training step. Eligible configurations (docs/TRAINING.md)
+        run forward+backward+compress+reduce+update — plus device-side
+        metric accumulation when ``eval_metric.device_fn()`` exists — as
+        ONE donated compiled program (module/fused_fit.py) and return
+        True; everything else falls back to the eager fwd_bwd + kvstore
+        pair."""
+        fused = self._get_fused_fit()
+        if fused is not None and fused.step(data_batch, eval_metric):
+            return True
+        return super().fit_step(data_batch, eval_metric)
+
+    def _get_fused_fit(self):
+        if not self._fused_fit_tried:
+            self._fused_fit_tried = True
+            if self.binded and self.params_initialized \
+                    and self.optimizer_initialized:
+                from .fused_fit import FusedFitStep
+                self._fused_fit = FusedFitStep.build(self)
+        return self._fused_fit
+
+    def _fit_sync(self):
+        """Bounded async depth (MXNET_FIT_SYNC_EVERY): block until the
+        last dispatched step's parameters are materialized. Must wait on
+        a TRAINABLE parameter — data/label buffers and frozen params are
+        plain program inputs, always ready."""
+        import jax
+        exe = self._exec_group._exec
+        for name in self._exec_group.param_names:
+            arr = exe.arg_dict.get(name)
+            if arr is not None and exe._grad_req.get(name, "null") != "null":
+                jax.block_until_ready(arr._data)
+                break
+
     def update(self):
         """Apply one optimizer step (kvstore push/pull or local updater)."""
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        if self._fused_fit is not None:
+            # an eager update between fused steps must see the exact
+            # accumulated error-feedback residuals — spill them back
+            self._fused_fit._release()
         self._params_dirty = True
         group = self._exec_group
         if self._update_on_kvstore:
@@ -464,6 +519,9 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        # monitor taps run through the executor programs; the fused fit
+        # step routes every batch back to the eager path while installed
+        self._monitor_installed = True
         self._exec_group.install_monitor(mon)
 
     def prepare(self, data_batch, sparse_row_id_fn=None):

@@ -108,6 +108,17 @@ class Optimizer:
         hyperparameter in it retraces exactly once."""
         return None
 
+    def _fused_fit_sig(self):
+        """Signature enabling the single-launch fit step
+        (module/fused_fit.py, docs/TRAINING.md): the whole
+        fwd+bwd+compress+reduce+update traces into ONE donated program
+        keyed partly by this tuple. Defaults to the bucket signature —
+        an optimizer whose bucket update is pure and shape-generic fuses
+        into the fit step the same way; override to opt in/out of
+        whole-step fusion separately (rescale_grad stays a runtime
+        argument in both, so ragged batches never retrace)."""
+        return self._fused_bucket_sig()
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
             inner_state, weight32 = state

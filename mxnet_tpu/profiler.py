@@ -300,6 +300,14 @@ class Counter:
         return self
 
 
+# global device-launch witness (docs/TRAINING.md): every compiled-program
+# dispatch on the training hot path increments this counter — executor
+# fwd / fused fwd+bwd launches, kvstore bucket programs, and the fused
+# fit-step program. bench.py --mode train reads deltas to report
+# train_dispatches_per_step independent of wall clock.
+DEVICE_DISPATCHES = Domain("device").new_counter("device_dispatches")
+
+
 class Marker:
     def __init__(self, domain, name):
         self.domain, self.name = domain, name

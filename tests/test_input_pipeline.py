@@ -174,6 +174,40 @@ def test_prefetching_iter_overlaps_decode_with_step():
         "pipeline ran serially: %.2fs vs serial %.2fs" % (total, serial)
 
 
+def test_prefetching_iter_shards_across_devices():
+    """With ``ctx`` a multi-device list, the prefetch worker shards each
+    batch over a dp mesh of those devices at prefetch time (the fused
+    fit step consumes the shards as-is), instead of splitting on the
+    fit thread. Values must round-trip unchanged."""
+    import jax
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest should force 8 host devices"
+    X = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    y = np.arange(16, dtype=np.float32)
+    ctxs = [mx.cpu(i) for i in range(8)]
+
+    base = mx.io.NDArrayIter(X, y, batch_size=16)
+    it = mx.io.PrefetchingIter(base, ctx=ctxs)
+    batch = next(iter(it))
+    assert set(batch.data[0]._data.devices()) == set(devs)
+    assert set(batch.label[0]._data.devices()) == set(devs)
+    np.testing.assert_array_equal(batch.data[0].asnumpy(), X)
+    np.testing.assert_array_equal(batch.label[0].asnumpy(), y)
+
+    # a batch not divisible by the device count falls back to device 0
+    base2 = mx.io.NDArrayIter(X[:6], y[:6], batch_size=6)
+    it2 = mx.io.PrefetchingIter(base2, ctx=ctxs)
+    b2 = next(iter(it2))
+    assert len(b2.data[0]._data.devices()) == 1
+    np.testing.assert_array_equal(b2.data[0].asnumpy(), X[:6])
+
+    # single-context behavior is unchanged
+    base3 = mx.io.NDArrayIter(X, y, batch_size=16)
+    it3 = mx.io.PrefetchingIter(base3, ctx=mx.cpu(0))
+    b3 = next(iter(it3))
+    assert len(b3.data[0]._data.devices()) == 1
+
+
 # ----------------------------------------------------------------------
 # (a) decode thread-scaling (real multi-core hosts only)
 # ----------------------------------------------------------------------
