@@ -26,6 +26,32 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC = 298.51
 
+
+def _step_hist():
+    """A fine-grained (factor-1.25 buckets) mx.telemetry Histogram for
+    per-step wall times — the latency-distribution source behind the
+    ``step_ms_p50``/``step_ms_p99`` JSON fields (docs/OBSERVABILITY.md)."""
+    from mxnet_tpu import telemetry
+    return telemetry.Histogram(
+        "bench_step_ms", unit="ms",
+        bounds=telemetry.exponential_buckets(0.01, 1.25, 72))
+
+
+def _round_opt(v, digits=3):
+    return None if v is None else round(v, digits)
+
+
+def _latency_fields(hist, compile_ms):
+    """step_ms_p50 / step_ms_p99 / compile_ms fields every bench mode
+    folds into its JSON line. ``compile_ms`` is first-trace wall time
+    (trace + XLA compile + first run of the measurement program)."""
+    have = hist is not None and hist.count > 0
+    return {
+        "step_ms_p50": _round_opt(hist.quantile(0.5)) if have else None,
+        "step_ms_p99": _round_opt(hist.quantile(0.99)) if have else None,
+        "compile_ms": _round_opt(compile_ms, 1),
+    }
+
 # Peak bf16 TFLOP/s per chip, keyed by substrings of jax device_kind.
 # MFU = achieved model FLOP/s over this peak.
 _PEAK_TFLOPS = [
@@ -102,16 +128,28 @@ def _make_pipeline_stream(args, image_shape):
 def _timed_steps(ts, next_batch, warmup, iters):
     """Host-fed timing loop (pipeline mode): warm up, time ``iters``
     python-dispatched steps. The synthetic benches use _fori_timed
-    instead (see there for why)."""
+    instead (see there for why). Returns ``(dt, info)`` where info
+    carries compile_ms (first warm-up step = trace+compile wall time)
+    and a per-step latency histogram (host step times incl. data)."""
     import jax
+    from mxnet_tpu import telemetry
 
-    for i in range(warmup):
+    compile_ms = None
+    for i in range(max(1, warmup)):   # >=1: keep compile out of the
+        t0 = time.perf_counter()      # measured (histogrammed) steps
         ts.step(next_batch(i))
+        if i == 0:
+            jax.block_until_ready(ts.params)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+            telemetry.JIT_COMPILE_MS.observe(compile_ms)
     jax.block_until_ready(ts.params)
 
+    hist = _step_hist()
     t0 = time.perf_counter()
     for i in range(iters):
+        t_s = time.perf_counter()
         ts.step(next_batch(i))
+        hist.observe((time.perf_counter() - t_s) * 1e3)
     jax.block_until_ready(ts.params)
     dt = time.perf_counter() - t0
 
@@ -122,7 +160,7 @@ def _timed_steps(ts, next_batch, warmup, iters):
         next(iter(ts.params.values())).ravel()[0]))
     if not np.isfinite(probe_w):
         raise SystemExit("bench: non-finite weights after timing loop")
-    return dt
+    return dt, {"compile_ms": compile_ms, "hist": hist}
 
 
 def _cost_flops(ts, flops_probe):
@@ -216,19 +254,36 @@ def _fori_timed(ts, batches, iters, lr, warmup=1):
             raise SystemExit("bench: non-finite weights in timing loop")
         return time.perf_counter() - t0
 
-    # compile + warm both programs (>= --warmup repetitions), measure
-    for _ in range(max(1, warmup)):
-        timed(short)
-        timed(long_)
-    t_short = min(timed(short) for _ in range(2))
-    t_long = min(timed(long_) for _ in range(2))
+    # compile + warm both programs (>= --warmup repetitions), measure.
+    # The first calls trace+compile: their wall time is the compile_ms
+    # witness (observed into the jit_compile_ms registry histogram too)
+    from mxnet_tpu import telemetry
+    compile_ms = None
+    for i in range(max(1, warmup)):
+        t_s = timed(short)
+        t_l = timed(long_)
+        if i == 0:
+            compile_ms = (t_s + t_l) * 1e3
+            telemetry.JIT_COMPILE_MS.observe(compile_ms)
+    shorts = [timed(short) for _ in range(2)]
+    longs = [timed(long_) for _ in range(2)]
+    t_short = min(shorts)
+    t_long = min(longs)
+    # per-step latency distribution: each long-program repetition gives
+    # one per-step estimate against the best short baseline (few samples
+    # by design — the tunnel forbids per-step dispatch timing, see above)
+    hist = _step_hist()
+    for t_l in longs:
+        est = (t_l - t_short) / iters * 1e3
+        if est > 0:
+            hist.observe(est)
     dt = t_long - t_short
     if dt <= 0:
         raise SystemExit(
             "bench: non-positive timing differential (%.4fs long vs "
             "%.4fs short) — wall-clock noise exceeded the measured "
             "work; rerun with more --iters" % (t_long, t_short))
-    return dt
+    return dt, {"compile_ms": compile_ms, "hist": hist}
 
 
 def bench_pipeline_scaling(args):
@@ -305,7 +360,7 @@ def bench_resnet(args):
             if args.layout == "NHWC":
                 d = np.transpose(d, (0, 2, 3, 1))
             return {"data": d, "softmax_label": b.label[0].asnumpy()}
-        dt = _timed_steps(ts, next_batch, args.warmup, args.iters)
+        dt, lat = _timed_steps(ts, next_batch, args.warmup, args.iters)
         flops_per_step = None
     else:
         # Synthetic device-resident batches (the reference's perf.md
@@ -319,8 +374,8 @@ def bench_resnet(args):
             batches.append({"data": data, "softmax_label": label})
         jax.block_until_ready(batches)
 
-        dt = _fori_timed(ts, batches, args.iters, lr=0.1,
-                         warmup=args.warmup)
+        dt, lat = _fori_timed(ts, batches, args.iters, lr=0.1,
+                              warmup=args.warmup)
         # abstract probe: lowering must not touch live (donated) buffers
         probe = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
@@ -349,6 +404,7 @@ def bench_resnet(args):
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "peak_bf16_tflops": peak,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        **_latency_fields(lat["hist"], lat["compile_ms"]),
     }
 
 
@@ -390,8 +446,8 @@ def bench_transformer(args):
         (ts.params, ts.states, ts.auxs, batches[0],
          jnp.float32(0.01), jnp.uint32(0)))
 
-    dt = _fori_timed(ts, batches, args.iters, lr=0.01,
-                     warmup=args.warmup)
+    dt, lat = _fori_timed(ts, batches, args.iters, lr=0.01,
+                          warmup=args.warmup)
     flops_per_step = _cost_flops(ts, probe)
     if flops_per_step:
         flops_per_step += _flash_attention_flops(args)
@@ -413,6 +469,7 @@ def bench_transformer(args):
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "peak_bf16_tflops": peak,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        **_latency_fields(lat["hist"], lat["compile_ms"]),
     }
 
 
@@ -678,7 +735,7 @@ def bench_kvstore(args):
     prios = [-i for i in range(len(keys))]
     blocks = max(2, args.iters // 4)
 
-    def run(bucketed, compress):
+    def run(bucketed, compress, want_latency=False):
         kv = mx.kv.create("device")
         kv.set_bucketing(bucketed)
         if compress:
@@ -702,19 +759,38 @@ def bench_kvstore(args):
             jax.block_until_ready([o._data for o in outs])
             return (time.perf_counter() - t0) / n
 
-        for _ in range(max(1, args.warmup)):
+        # first warm-up step traces + compiles every bucket program —
+        # its wall time is the arm's compile_ms witness
+        t0 = time.perf_counter()
+        step()
+        jax.block_until_ready([o._data for o in outs])
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        for _ in range(max(1, args.warmup) - 1):
             step()
         jax.block_until_ready([o._data for o in outs])
         per_step = min(timed_block(blocks) for _ in range(3))
+        # per-step latency distribution (headline arm only — the extra
+        # block of steps is not free on a bandwidth-bound host): host
+        # wall time of each push+pull pair with one block at the end
+        # (dispatch-dominated on the tunnel, bandwidth-bound on CPU —
+        # same caveat as the mean)
+        hist = None
+        if want_latency:
+            hist = _step_hist()
+            for _ in range(blocks):
+                t_s = time.perf_counter()
+                step()
+                hist.observe((time.perf_counter() - t_s) * 1e3)
+            jax.block_until_ready([o._data for o in outs])
         probe = float(outs[0].asnumpy().ravel()[0])
         if not np.isfinite(probe):
             raise SystemExit("bench: non-finite weights in kvstore loop")
-        return per_step, kv
+        return per_step, kv, {"compile_ms": compile_ms, "hist": hist}
 
-    eager_dt, _ = run(False, False)
-    fused_dt, kv = run(True, False)
-    eager2_dt, _ = run(False, True)
-    fused2_dt, kvc = run(True, True)
+    eager_dt, _, _ = run(False, False)
+    fused_dt, kv, lat = run(True, False, want_latency=True)
+    eager2_dt, _, _ = run(False, True)
+    fused2_dt, kvc, _ = run(True, True)
     # push (grad bytes in, per device stream) + pull (weight bytes out)
     step_bytes = total_bytes * (ndev + 1)
     gbps = lambda dt: step_bytes / dt / 1e9
@@ -749,6 +825,7 @@ def bench_kvstore(args):
         "bigarray_bound_bytes": kvstore_fused.bucket_byte_cap(),
         "dispatches_per_step": {"eager_2bit": eager_disp,
                                 "bucketed": buckets_per_step},
+        **_latency_fields(lat["hist"], lat["compile_ms"]),
     }
 
 
@@ -805,13 +882,18 @@ def bench_fit(args):
         def block():
             mod._fit_sync()     # waits on a trainable param (step output)
 
+        t_c = time.perf_counter()
         one_step()                       # compile + warm
         block()
+        compile_ms = (time.perf_counter() - t_c) * 1e3
         d0 = profiler.DEVICE_DISPATCHES.value
         h0 = metric_mod.HOST_SYNCS.value
+        hist = _step_hist()
         t0 = time.perf_counter()
         for _ in range(steps):
+            t_s = time.perf_counter()
             one_step()
+            hist.observe((time.perf_counter() - t_s) * 1e3)
         block()
         dt = time.perf_counter() - t0
         # capture the loop deltas BEFORE the boundary get() below — that
@@ -826,6 +908,7 @@ def bench_fit(args):
             "dispatches_per_step": round(d_steps / steps, 2),
             "host_syncs_per_step": round(h_steps / steps, 2),
             "step_ms": round(dt / steps * 1000, 1),
+            **_latency_fields(hist, compile_ms),
         }
         if arm == "fused" and mod._fused_fit is None:
             raise SystemExit("bench: fused arm fell back to eager — "
@@ -843,6 +926,9 @@ def bench_fit(args):
         "host_syncs_per_step": {
             a: arms[a]["host_syncs_per_step"] for a in arms},
         "fit_step_ms": {a: arms[a]["step_ms"] for a in arms},
+        "step_ms_p50": arms["fused"]["step_ms_p50"],
+        "step_ms_p99": arms["fused"]["step_ms_p99"],
+        "compile_ms": arms["fused"]["compile_ms"],
     }
 
 
@@ -875,12 +961,19 @@ def bench_serving(args):
         auxs[n] = (np.zeros(s, np.float32) if n.endswith("_mean")
                    else np.ones(s, np.float32))
 
+    from mxnet_tpu import telemetry
+
     n_req = args.serving_requests
+    # construction compiles every bucket on every replica (warmup=True):
+    # its wall time is the serving arm's compile_ms witness
+    t_c = time.perf_counter()
     srv = ModelServer(sym, params, auxs, {"data": image_shape},
                       num_replicas=args.serving_replicas,
                       max_batch_size=args.serving_max_batch,
                       max_latency_ms=args.serving_latency_ms,
                       queue_capacity=n_req + args.serving_max_batch)
+    compile_ms = (time.perf_counter() - t_c) * 1e3
+    telemetry.JIT_COMPILE_MS.observe(compile_ms)
     try:
         xs = [rng.uniform(-1, 1, image_shape).astype(np.float32)
               for _ in range(8)]
@@ -891,6 +984,10 @@ def bench_serving(args):
             srv.predict({"data": x})
         srv.drain(timeout=600)
         srv.reset_stats()
+        # registry latency histogram: percentiles over THIS run come
+        # from the delta against the post-warmup snapshot
+        lat_hist = telemetry.REGISTRY.get("serving_request_ms")
+        lat_snap0 = lat_hist.snapshot()
 
         futs = []
         lock = threading.Lock()
@@ -928,6 +1025,15 @@ def bench_serving(args):
         if st["batches"]["mean_occupancy"] else None,
         "latency_p50_ms": st["latency_ms"]["p50"],
         "latency_p99_ms": st["latency_ms"]["p99"],
+        # serving's "step" is one request end to end: percentiles from
+        # the serving_request_ms registry histogram, this run only
+        "step_ms_p50": _round_opt(
+            telemetry.hist_quantile(lat_hist.snapshot(), 0.5,
+                                    since=lat_snap0)),
+        "step_ms_p99": _round_opt(
+            telemetry.hist_quantile(lat_hist.snapshot(), 0.99,
+                                    since=lat_snap0)),
+        "compile_ms": round(compile_ms, 1),
     }
 
 

@@ -92,6 +92,7 @@ from . import config
 from . import predictor
 from . import serving
 from . import profiler
+from . import telemetry
 from . import monitor
 from .monitor import Monitor
 from . import test_utils

@@ -18,6 +18,7 @@ Module-style fit step costs exactly one compiled program launch.
 """
 from __future__ import annotations
 
+
 import numpy as _np
 import jax
 import jax.numpy as jnp
@@ -26,8 +27,27 @@ from .base import MXNetError
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray, zeros as nd_zeros
 from .ops import registry as _reg
+from . import telemetry as _telemetry
 
 __all__ = ["Executor"]
+
+# retrace witness: incremented at TRACE time inside every executor
+# program body (host code that only runs while jax traces), so a
+# steady-state launch leaves it untouched — same contract as the
+# kvstore/fused-fit TRACE_COUNTs (docs/OBSERVABILITY.md)
+EXECUTOR_RETRACES = _telemetry.REGISTRY.counter(
+    "executor_retraces",
+    "executor fwd/fwd_bwd/monitor program (re)traces", vital=True)
+EXECUTOR_DISPATCH_MS = _telemetry.REGISTRY.histogram(
+    "executor_dispatch_ms",
+    "host wall time to dispatch one executor program (async enqueue, "
+    "not device completion)", unit="ms")
+# dispatch + retrace instrumentation site (shared RetraceSite
+# semantics with kvstore_fused / fused_fit): traced bodies call
+# _note_retrace(); call sites dispatch through _timed_dispatch
+_SITE = _telemetry.RetraceSite(EXECUTOR_RETRACES,
+                               _telemetry.JIT_COMPILE_MS)
+_note_retrace = _SITE.note
 
 
 def _count_dispatch():
@@ -35,6 +55,13 @@ def _count_dispatch():
     — bench.py --mode train reads deltas for train_dispatches_per_step."""
     from . import profiler as _prof
     _prof.DEVICE_DISPATCHES.increment()
+
+
+def _timed_dispatch(fn, *args):
+    """Call one jitted executor program with telemetry: dispatch wall
+    time -> executor_dispatch_ms; calls during which this thread
+    (re)traced additionally observe into jit_compile_ms."""
+    return _SITE.timed(fn, *args, dispatch_hist=EXECUTOR_DISPATCH_MS)
 
 
 def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
@@ -145,10 +172,12 @@ def _compiled_cache(symbol):
 
         @jax.jit
         def _fwd_train(args, auxs, seed):
+            _note_retrace()
             return graph_fn(args, auxs, seed, True)
 
         @jax.jit
         def _fwd_eval(args, auxs, seed):
+            _note_retrace()
             outs, _ = graph_fn(args, auxs, seed, False)
             return outs
 
@@ -198,6 +227,7 @@ def _monitor_fn(symbol, is_train, monitor_all):
 
         @jax.jit
         def fn(args, auxs, seed):
+            _note_retrace()
             return tapped(args, auxs, seed, is_train)
 
         cache["fwd_monitor"][key] = fn
@@ -210,6 +240,7 @@ def _make_fwd_bwd(graph_fn, diff_names):
 
     @jax.jit
     def _fwd_bwd(args, auxs, seed, ograds):
+        _note_retrace()
         diff = {n: args[n] for n in diff_names}
         rest = {n: v for n, v in args.items() if n not in diff}
 
@@ -301,10 +332,12 @@ class Executor:
 
                 @jax.jit
                 def _fwd_train(args, auxs, seed):
+                    _note_retrace()
                     return graph_fn(args, auxs, seed, True)
 
                 @jax.jit
                 def _fwd_eval(args, auxs, seed):
+                    _note_retrace()
                     outs, _ = graph_fn(args, auxs, seed, False)
                     return outs
 
@@ -511,7 +544,8 @@ class Executor:
                        else self._jit_fwd_train)
                 with self._prof_scope("Executor::forward"):
                     _count_dispatch()
-                    outs, new_auxs = fwd(self._args_values(), auxs, seed)
+                    outs, new_auxs = _timed_dispatch(
+                        fwd, self._args_values(), auxs, seed)
                 self._write_auxs(new_auxs)
             else:
                 seed = self._next_seed()
@@ -521,8 +555,8 @@ class Executor:
                        else self._jit_fwd_eval)
                 with self._prof_scope("Executor::forward"):
                     _count_dispatch()
-                    outs = fwd(self._args_values(), self._auxs_values(),
-                               seed)
+                    outs = _timed_dispatch(
+                        fwd, self._args_values(), self._auxs_values(), seed)
             if stream:
                 jax.effects_barrier()   # flush in-flight tap callbacks
         finally:
@@ -573,8 +607,8 @@ class Executor:
                        else self._jit_fwd_bwd)
             with self._prof_scope("Executor::forward_backward"):
                 _count_dispatch()
-                outs, new_auxs, grads = fwd_bwd(
-                    self._args_values(), auxs, seed, ograds)
+                outs, new_auxs, grads = _timed_dispatch(
+                    fwd_bwd, self._args_values(), auxs, seed, ograds)
             if stream:
                 jax.effects_barrier()   # flush in-flight tap callbacks
         finally:

@@ -288,6 +288,18 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+from .. import telemetry as _telemetry
+
+# prefetch-pipeline health: occupancy sampled at every consumer get()
+# (how many decoded batches sat ready), plus a served-batch counter
+_PREFETCH_OCC = _telemetry.REGISTRY.gauge(
+    "io_prefetch_occupancy",
+    "decoded batches waiting in the PrefetchingIter queue at get() time",
+    unit="batches")
+_PREFETCH_BATCHES = _telemetry.REGISTRY.counter(
+    "io_prefetch_batches", "batches served through PrefetchingIter")
+
+
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference io.py:349 + C++
     iter_prefetcher.h): overlaps host-side batch prep with device compute.
@@ -419,8 +431,13 @@ class PrefetchingIter(DataIter):
 
     def next(self):
         batches = self._queue.get()
+        # occupancy AFTER the get: batches still staged for future steps
+        # — 0 here while the device is busy means the input pipeline is
+        # the bottleneck (docs/OBSERVABILITY.md)
+        _PREFETCH_OCC.set(self._queue.qsize())
         if batches is None:
             raise StopIteration
+        _PREFETCH_BATCHES.inc()
         batch = batches[0]
         if len(batches) > 1:
             data = sum([b.data for b in batches], [])

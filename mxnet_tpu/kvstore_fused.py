@@ -38,6 +38,7 @@ from jax import lax
 
 from .ndarray import NDArray
 from . import profiler
+from . import telemetry as _telemetry
 
 __all__ = ["FusedBucketEngine", "bucket_byte_cap", "TRACE_COUNT",
            "two_bit_quantize", "fused_sgd_apply"]
@@ -75,8 +76,30 @@ def fused_sgd_apply(w, g_reduced, state, lr, wd, rescale, momentum, clip,
     return new_w.astype(w.dtype), None
 
 # incremented inside each bucket step function at trace time only; a
-# steady-state step that hits the jit cache leaves it untouched
-TRACE_COUNT = 0
+# steady-state step that hits the jit cache leaves it untouched. The
+# count lives in the mx.telemetry registry (kvstore_bucket_retraces);
+# the module-level ``TRACE_COUNT`` name stays a live alias via
+# __getattr__ below, so existing zero-retrace pins keep working.
+BUCKET_RETRACES = _telemetry.REGISTRY.counter(
+    "kvstore_bucket_retraces",
+    "compiled bucket-program (re)traces (the TRACE_COUNT witness)",
+    vital=True)
+DISPATCH_MS = _telemetry.REGISTRY.histogram(
+    "kvstore_dispatch_ms",
+    "host wall time to dispatch one bucket program (async enqueue)",
+    unit="ms")
+# shared RetraceSite semantics with executor / fused_fit: step bodies
+# call _note_retrace() at trace time; _dispatch times through it
+_SITE = _telemetry.RetraceSite(BUCKET_RETRACES, _telemetry.JIT_COMPILE_MS)
+_note_retrace = _SITE.note
+
+
+def __getattr__(name):
+    if name == "TRACE_COUNT":
+        return int(BUCKET_RETRACES.value)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
 
 _DEFAULT_BUCKET_BYTES = 4 << 20
 
@@ -92,9 +115,9 @@ def bucket_byte_cap():
 # kvstore profiler counters (thread-safe Counter; emitted into the chrome
 # trace whenever the profiler is running, readable as .value always)
 _domain = profiler.Domain("kvstore")
-BYTES_PUSHED = _domain.new_counter("kvstore_bytes_pushed")
-COMPRESS_RATIO = _domain.new_counter("kvstore_compress_ratio")
-BUCKET_COUNT = _domain.new_counter("kvstore_bucket_count")
+BYTES_PUSHED = _domain.new_counter("kvstore_bytes_pushed", vital=True)
+COMPRESS_RATIO = _domain.new_counter("kvstore_compress_ratio", vital=True)
+BUCKET_COUNT = _domain.new_counter("kvstore_bucket_count", vital=True)
 
 
 def _single_device(x):
@@ -171,8 +194,7 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
 
     if mode is None:
         def step(residuals, grads):
-            global TRACE_COUNT
-            TRACE_COUNT += 1
+            _note_retrace()
             reduced, new_res = _reduce(residuals, grads)
             return tuple(reduced), new_res
         return jax.jit(step, donate_argnums=(0,))
@@ -181,8 +203,7 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
     assert kind == "sgd"
 
     def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale):
-        global TRACE_COUNT
-        TRACE_COUNT += 1
+        _note_retrace()
         reduced, new_res = _reduce(residuals, grads)
         new_ws, new_ss = [], []
         for i in range(n_keys):
@@ -366,6 +387,10 @@ class FusedBucketEngine:
     def _dispatch(self, bucket, mode):
         from .executor import _count_dispatch
         _count_dispatch()       # one compiled bucket program per call
+        return _SITE.timed(self._dispatch_inner, bucket, mode,
+                           dispatch_hist=DISPATCH_MS)
+
+    def _dispatch_inner(self, bucket, mode):
         kv = self._kv
         comp = kv._compression
         threshold = comp.threshold if comp is not None else None

@@ -76,9 +76,11 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 # the fit loop's host-sync witness (bench.py --mode train
 # host_syncs_per_step): incremented on every blocking device->host
 # readback the metric layer performs — per-batch update() conversions on
-# the eager path, get()-time accumulator folds on the device path
+# the eager path, get()-time accumulator folds on the device path.
+# Registry-backed (telemetry series ``fit_host_syncs``): this name is a
+# live alias over mx.telemetry — see docs/OBSERVABILITY.md.
 _fit_domain = _profiler.Domain("fit")
-HOST_SYNCS = _fit_domain.new_counter("fit_host_syncs")
+HOST_SYNCS = _fit_domain.new_counter("fit_host_syncs", vital=True)
 
 
 def consume_device_batch(metric):

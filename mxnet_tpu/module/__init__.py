@@ -1,4 +1,5 @@
 """Module API (reference parity: python/mxnet/module/)."""
+from . import fused_fit
 from .base_module import BaseModule
 from .module import Module
 from .executor_group import DataParallelExecutorGroup

@@ -22,11 +22,19 @@ import numpy as _np
 
 from .. import io as io_mod
 from .. import metric as metric_mod
+from .. import telemetry as _telemetry
 from ..initializer import Uniform
 from ..model import BatchEndParam
 from ..ndarray.ndarray import concatenate
 
 __all__ = ["BaseModule"]
+
+# per-step wall time of the fit loop body (dispatch + staging + metric
+# bookkeeping — NOT device completion, which is async; bench.py --mode
+# fit reports device-independent launch counters for that reason)
+FIT_STEP_MS = _telemetry.REGISTRY.histogram(
+    "fit_step_ms", "wall time of one fit-loop step (host side)",
+    unit="ms")
 
 
 def _callbacks(spec):
@@ -209,6 +217,7 @@ class BaseModule:
             batch = flow.advance()
             if monitor is not None:
                 monitor.tic()
+            t_step = time.perf_counter()
             # fit_step enqueues async XLA work (one donated program when
             # fused); while the device runs, the host stages the
             # (already-fetched) next batch. update_metric is a no-op for
@@ -216,6 +225,12 @@ class BaseModule:
             self.fit_step(batch, train_metric)
             flow.stage_next()
             self.update_metric(train_metric, batch.label)
+            # telemetry (all host-side, nothing enters traced code):
+            # step-time histogram, flight-recorder cadence, chrome-trace
+            # step marker — each a no-op-cheap call when idle
+            FIT_STEP_MS.observe((time.perf_counter() - t_step) * 1e3)
+            _telemetry.RECORDER.tick()
+            _telemetry.mark_step(nbatch)
             if monitor is not None:
                 monitor.toc_print()
             if on_batch:

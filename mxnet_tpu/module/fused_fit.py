@@ -37,12 +37,16 @@ ragged final batches: each distinct batch shape traces once
 """
 from __future__ import annotations
 
+import os
+import weakref
+
 import numpy as _np
 import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
+from .. import telemetry as _telemetry
 from ..kvstore import KVStore, _updater_key
 from ..kvstore_fused import two_bit_quantize, fused_sgd_apply
 from ..executor import _compiled_cache, _count_dispatch
@@ -51,8 +55,29 @@ from ..model import _local_updater_key
 __all__ = ["FusedFitStep", "TRACE_COUNT"]
 
 # incremented inside the step function at trace time only; steady-state
-# steps (including repeats of a ragged batch shape) leave it untouched
-TRACE_COUNT = 0
+# steps (including repeats of a ragged batch shape) leave it untouched.
+# The count lives in the mx.telemetry registry (fit_step_retraces); the
+# module-level ``TRACE_COUNT`` name stays a live alias via __getattr__.
+FIT_RETRACES = _telemetry.REGISTRY.counter(
+    "fit_step_retraces",
+    "fused fit-step program (re)traces (the TRACE_COUNT witness)",
+    vital=True)
+# shared RetraceSite semantics with executor / kvstore_fused: the step
+# body calls _note_retrace() at trace time; the launch times through it
+_SITE = _telemetry.RetraceSite(FIT_RETRACES, _telemetry.JIT_COMPILE_MS)
+_note_retrace = _SITE.note
+
+
+def __getattr__(name):
+    if name == "TRACE_COUNT":
+        return int(FIT_RETRACES.value)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
+# sample an HBM StepMemoryTracker every N fused launches (0 = off; a
+# live-array census per step is not free on the host)
+_MEM_EVERY = int(os.environ.get("MXNET_TELEMETRY_MEMORY_EVERY", "0") or 0)
 
 
 def _metric_closure(metric, label_names, output_names):
@@ -94,8 +119,7 @@ def _build_fit_program(graph_fn, param_order, threshold, mode, state_mask,
 
     def step(params, states, residuals, macc, inputs, auxs,
              lr_vec, wd_vec, rescale, seed):
-        global TRACE_COUNT
-        TRACE_COUNT += 1
+        _note_retrace()   # trace-time host side effect only
 
         def f(p):
             outs, new_auxs = graph_fn({**inputs, **p}, auxs, seed, True)
@@ -155,6 +179,43 @@ class FusedFitStep:
         self._metric_fn = None
         self._msig = None
         self.launches = 0
+        self._mem_tracker = _telemetry.StepMemoryTracker() \
+            if _MEM_EVERY else None
+        self._register_memory_groups()
+
+    def _register_memory_groups(self):
+        """Publish this step's donation sets to telemetry.memory so
+        ``memory_snapshot()`` can attribute HBM to params / optimizer
+        states / residuals / auxs (the 'one copy of training state'
+        breakdown). Providers hold a weakref: a dead step contributes
+        nothing, and the latest-built step wins the group names."""
+        ref = weakref.ref(self)
+
+        def provider(kind):
+            def arrays():
+                s = ref()
+                if s is None or s._order is None:
+                    return ()
+                try:
+                    exe = s._mod._exec_group._exec
+                    if kind == "params":
+                        return [exe.arg_dict[n]._data for n in s._order]
+                    if kind == "auxs":
+                        return list(exe._auxs_values().values())
+                    if kind == "residuals":
+                        return list((s._residuals or {}).values())
+                    if kind == "opt_states":
+                        states = (s._updater.states.get(uk)
+                                  for uk in (s._ukeys or ()))
+                        return [st._data for st in states
+                                if st is not None and hasattr(st, "_data")]
+                except Exception:
+                    return ()
+                return ()
+            return arrays
+
+        for kind in ("params", "opt_states", "residuals", "auxs"):
+            _telemetry.memory.track_group(kind, provider(kind))
 
     # -- construction ---------------------------------------------------
     @staticmethod
@@ -393,10 +454,14 @@ class FusedFitStep:
         seed = exe._next_seed()
         rescale = _np.float32(optimizer.rescale_grad)
         _count_dispatch()
+        track_mem = (self._mem_tracker is not None
+                     and self.launches % _MEM_EVERY == 0)
+        if track_mem:
+            self._mem_tracker.begin()
         try:
             with exe._prof_scope("Module::fused_fit_step"):
-                new_ps, new_ss, new_res, macc, new_auxs, outs = fn(
-                    params, states, residuals, macc, inputs,
+                new_ps, new_ss, new_res, macc, new_auxs, outs = _SITE.timed(
+                    fn, params, states, residuals, macc, inputs,
                     exe._auxs_values(), lr_vec, wd_vec, rescale, seed)
         except Exception:
             # a runtime failure after donation consumes the donated
@@ -405,6 +470,8 @@ class FusedFitStep:
             # module's device state is not recoverable at this point)
             self._residuals = None
             raise
+        if track_mem:
+            self._mem_tracker.end()
 
         # rebind every donated buffer to its new value
         kv_store = self._kv._store \

@@ -69,8 +69,14 @@ def _refresh_flags():
 
 def set_config(**kwargs):
     """Configure the profiler (reference profiler.py set_config). Accepts
-    the reference kwargs plus ``trace_dir`` for the device xplane trace."""
+    the reference kwargs plus ``trace_dir`` for the device xplane trace.
+
+    Setting ``trace_dir`` while the profiler is already running (or
+    paused — pause never ends the device trace) starts the device
+    xplane trace IMMEDIATELY; it used to silently wait for the next
+    stop/start cycle."""
     import logging
+    global _device_trace_on
     for k, v in kwargs.items():
         if k not in _config:
             # reference-valid options we don't distinguish (e.g.
@@ -80,6 +86,16 @@ def set_config(**kwargs):
             continue
         _config[k] = v
     _refresh_flags()
+    if _state in ("run", "pause") and _config["trace_dir"]:
+        if not _device_trace_on:
+            import jax
+            jax.profiler.start_trace(_config["trace_dir"])
+            _device_trace_on = True
+        elif "trace_dir" in kwargs:
+            logging.warning(
+                "profiler.set_config: a device trace is already running; "
+                "the new trace_dir takes effect at the next stop/start "
+                "cycle")
 
 
 def state():
@@ -173,11 +189,22 @@ def record_op(name, t0_us, t1_us):
 
 
 def dump(finished=True):
-    """Write the chrome-trace JSON to ``filename`` (reference dump())."""
+    """Write the chrome-trace JSON to ``filename`` (reference dump()).
+
+    Non-empty dumps also carry closing counter-track samples of every
+    mx.telemetry registry series (telemetry/chrome.py), so host metrics
+    line up with the trace without a separate scrape."""
     with _lock:
-        doc = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        events = list(_events)
         if finished:
             _events.clear()
+    if events:
+        try:
+            from .telemetry import chrome as _tchrome
+            events.extend(_tchrome.dump_events())
+        except Exception:
+            pass
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(doc, f)
 
@@ -211,8 +238,11 @@ class Domain:
     def new_frame(self, name):
         return Frame(name, self)
 
-    def new_counter(self, name, value=None):
-        return Counter(self, name, value)
+    def new_counter(self, name, value=None, vital=False):
+        """``vital=True`` marks a pinned correctness witness: its
+        registry series keeps counting through ``telemetry.disable()``
+        (which otherwise no-ops every instrument)."""
+        return Counter(self, name, value, vital=vital)
 
     def new_marker(self, name):
         return Marker(self, name)
@@ -256,18 +286,29 @@ class Frame(_Span):
 class Counter:
     """Thread-safe: serving replicas and user threads may bump the same
     counter concurrently (reference ProfileCounter is atomic too,
-    src/profiler/profiler.h)."""
+    src/profiler/profiler.h).
 
-    def __init__(self, domain, name, value=None):
+    Storage lives in the mx.telemetry registry (a Gauge — profiler
+    counters allow set/decrement): ``Domain.new_counter(name)`` is now
+    a live VIEW over ``telemetry.REGISTRY`` series ``name`` (dots map
+    to underscores), so ``DEVICE_DISPATCHES``/``HOST_SYNCS``/the
+    kvstore counters show up in ``GET /metrics`` and the flight
+    recorder while ``.value`` and chrome-trace emission behave exactly
+    as before. Two Counters with one name share one series."""
+
+    def __init__(self, domain, name, value=None, vital=False):
         self.domain, self.name = domain, name
         self._vlock = threading.Lock()
-        self._value = 0 if value is None else value
+        from . import telemetry as _tm
+        self._metric = _tm.REGISTRY.gauge(
+            name, "profiler counter (domain %s)"
+            % (domain.name if domain else "counter"), vital=vital)
         if value is not None:
-            self._emit(self._value)
+            self._emit(self._metric.set(value))
 
     @property
     def value(self):
-        return self._value
+        return self._metric.value
 
     def _emit(self, value):
         add_event(self.name, self.domain.name if self.domain else "counter",
@@ -278,18 +319,15 @@ class Counter:
     # add_event's module lock never takes _vlock, so no ordering cycle
     def set_value(self, value):
         with self._vlock:
-            self._value = value
-            self._emit(value)
+            self._emit(self._metric.set(value))
 
     def increment(self, delta=1):
         with self._vlock:
-            self._value += delta
-            self._emit(self._value)
+            self._emit(self._metric.inc(delta))
 
     def decrement(self, delta=1):
         with self._vlock:
-            self._value -= delta
-            self._emit(self._value)
+            self._emit(self._metric.dec(delta))
 
     def __iadd__(self, delta):
         self.increment(delta)
@@ -305,7 +343,8 @@ class Counter:
 # fwd / fused fwd+bwd launches, kvstore bucket programs, and the fused
 # fit-step program. bench.py --mode train reads deltas to report
 # train_dispatches_per_step independent of wall clock.
-DEVICE_DISPATCHES = Domain("device").new_counter("device_dispatches")
+DEVICE_DISPATCHES = Domain("device").new_counter("device_dispatches",
+                                                 vital=True)
 
 
 class Marker:

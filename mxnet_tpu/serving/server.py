@@ -53,7 +53,13 @@ class ServerStats:
 
     Latency/throughput track a sliding window of recent completions (the
     last ``window`` requests), counters are monotonic totals. The same
-    numbers feed ``stats()`` snapshots and the mx.profiler Counters.
+    numbers feed ``stats()`` snapshots, the mx.profiler Counters, AND
+    the mx.telemetry registry: every hook mirrors into process-wide
+    ``serving_*`` series (docs/OBSERVABILITY.md), which is what ``GET
+    /metrics`` scrapes. Registry series are shared across ModelServer
+    instances and are never reset by :meth:`reset` (Prometheus
+    counters must stay monotonic); per-instance ``stats()`` snapshots
+    keep their window/reset semantics unchanged.
     """
 
     def __init__(self, window=4096):
@@ -75,7 +81,9 @@ class ServerStats:
         # sliding windows
         self._latencies = deque(maxlen=window)      # seconds
         self._completions = deque(maxlen=window)    # monotonic timestamps
-        # profiler export (events only recorded while the profiler runs)
+        # profiler export (events only recorded while the profiler runs;
+        # the Counters are registry-backed, so these five also appear in
+        # /metrics as serving_queue_depth / serving_batch_occupancy / ...)
         dom = _prof.Domain("serving")
         self._c_depth = dom.new_counter("serving.queue_depth")
         self._c_occ = dom.new_counter("serving.batch_occupancy")
@@ -83,11 +91,34 @@ class ServerStats:
         self._c_p99 = dom.new_counter("serving.latency_p99_us")
         self._c_qps = dom.new_counter("serving.throughput_qps")
         self._m_reject = dom.new_marker("serving.reject")
+        # registry mirror: monotonic totals + the request-latency
+        # histogram behind the /metrics scrape
+        from .. import telemetry as _tm
+        reg = _tm.REGISTRY
+        self._r_admitted = reg.counter(
+            "serving_admitted", "requests accepted into the queue")
+        self._r_completed = reg.counter(
+            "serving_completed", "requests completed successfully")
+        self._r_rej_full = reg.counter(
+            "serving_rejected_queue_full", "requests rejected: queue full")
+        self._r_rej_deadline = reg.counter(
+            "serving_rejected_deadline", "requests expired before running")
+        self._r_failed = reg.counter(
+            "serving_failed", "requests failed in a batch")
+        self._r_cancelled = reg.counter(
+            "serving_cancelled", "requests cancelled by the client")
+        self._r_batches = reg.counter(
+            "serving_batches", "micro-batches dispatched to replicas")
+        self._r_latency = reg.histogram(
+            "serving_request_ms",
+            "end-to-end request latency (submit -> batch completion)",
+            unit="ms")
 
     # -- hooks ---------------------------------------------------------
     def record_admitted(self, depth):
         with self._lock:
             self.admitted += 1
+        self._r_admitted.inc()
         self._c_depth.set_value(depth)
 
     def record_depth(self, depth):
@@ -96,21 +127,25 @@ class ServerStats:
     def record_queue_full(self):
         with self._lock:
             self.rejected_queue_full += 1
+        self._r_rej_full.inc()
         self._m_reject.mark()
 
     def record_expired(self, req):
         with self.settled_cv:
             self.rejected_deadline += 1
             self.settled_cv.notify_all()
+        self._r_rej_deadline.inc()
         self._m_reject.mark()
 
     def record_cancelled(self, req):
         with self.settled_cv:
             self.cancelled += 1
             self.settled_cv.notify_all()
+        self._r_cancelled.inc()
 
     def record_batch(self, replica_idx, mb):
         now = time.monotonic()
+        done_latencies = []
         with self.settled_cv:
             self.batches += 1
             self.occupancy_sum += mb.n_real
@@ -122,13 +157,20 @@ class ServerStats:
                     self.completed += 1
                     self._latencies.append(now - req.t_submit)
                     self._completions.append(now)
+                    done_latencies.append(now - req.t_submit)
             self.settled_cv.notify_all()
+        self._r_batches.inc()
+        if done_latencies:
+            self._r_completed.inc(len(done_latencies))
+            for lat in done_latencies:
+                self._r_latency.observe(lat * 1e3)
         self._c_occ.set_value(mb.n_real)
 
     def record_failed_batch(self, replica_idx, mb, exc):
         with self.settled_cv:
             self.failed += mb.n_real
             self.settled_cv.notify_all()
+        self._r_failed.inc(mb.n_real)
 
     def reset(self):
         """Zero every counter and window (benchmarks reset after warmup
@@ -390,6 +432,8 @@ class ModelServer:
                     self._stats.failed += n_failed
                     self._stats.cancelled += n_raced
                     self._stats.settled_cv.notify_all()
+                self._stats._r_failed.inc(n_failed)
+                self._stats._r_cancelled.inc(n_raced)
         self._pool.join(timeout)
 
     def __enter__(self):
@@ -417,11 +461,14 @@ class ModelServer:
     # ------------------------------------------------------------------
     def start_http(self, port=8123, host="127.0.0.1"):
         """Serve ``POST /predict`` ({"inputs": {...}, "timeout_ms": n}),
-        ``GET /stats`` and ``GET /health`` on a daemon thread. Returns the
-        bound (host, port)."""
+        ``GET /stats``, ``GET /metrics`` (Prometheus text exposition of
+        the whole mx.telemetry registry — serving, kvstore, fit-step and
+        HBM series; docs/OBSERVABILITY.md) and ``GET /health`` on a
+        daemon thread. Returns the bound (host, port)."""
         if self._http is not None:
             raise MXNetError("HTTP endpoint already running")
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from .. import telemetry as _tm
 
         server = self
 
@@ -438,7 +485,15 @@ class ModelServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/stats":
+                if self.path == "/metrics":
+                    body = _tm.generate_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     _tm.export.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/stats":
                     self._reply(200, server.stats())
                 elif self.path == "/health":
                     self._reply(200 if not server._closed else 503,

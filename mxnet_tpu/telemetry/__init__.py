@@ -1,0 +1,59 @@
+"""mx.telemetry — unified metrics registry + export layer.
+
+One place to read every operational witness the framework emits
+(docs/OBSERVABILITY.md is the glossary):
+
+* :mod:`registry` — thread-safe Counter / Gauge / Histogram registry
+  (``telemetry.REGISTRY``); the old scattered witnesses
+  (``kvstore_fused.TRACE_COUNT``, ``module.fused_fit.TRACE_COUNT``,
+  ``profiler.DEVICE_DISPATCHES``, ``metric.HOST_SYNCS``, serving's
+  ``ServerStats``) are live views over it.
+* :mod:`export` — Prometheus text exposition: ``GET /metrics`` on a
+  running ``ModelServer`` and :func:`start_http_exporter` for training
+  jobs.
+* :mod:`flight` — ring-buffer flight recorder; JSON-lines dump on
+  crash/atexit (``MXNET_TELEMETRY_FLIGHT=<path>``).
+* :mod:`memory` — HBM accounting: :func:`memory_snapshot` over
+  ``jax.live_arrays``/allocator stats with a params/opt-states/
+  residuals/auxs breakdown keyed by the fused-fit donation sets.
+* :mod:`chrome` — injects per-step markers + counter tracks into the
+  ``mx.profiler`` chrome-trace dump.
+
+This package is stdlib-only at import (jax is touched lazily inside
+:mod:`memory`), so the registry is safe to import from anywhere in the
+framework without cycles.
+"""
+from . import registry
+from .registry import (Counter, Gauge, Histogram, Registry, REGISTRY,
+                       TraceTally, RetraceSite, counter, gauge, histogram,
+                       enable, disable, enabled, exponential_buckets,
+                       hist_quantile, sanitize_name)
+from . import export
+from .export import generate_text, parse_text, start_http_exporter
+from . import flight
+from .flight import FlightRecorder, RECORDER
+from . import memory
+from .memory import memory_snapshot, StepMemoryTracker
+from . import chrome
+from .chrome import mark_step
+
+__all__ = [
+    "registry", "export", "flight", "memory", "chrome",
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram", "enable", "disable", "enabled",
+    "exponential_buckets", "hist_quantile", "sanitize_name",
+    "generate_text", "parse_text", "start_http_exporter",
+    "FlightRecorder", "RECORDER",
+    "memory_snapshot", "StepMemoryTracker", "mark_step",
+    "JIT_COMPILE_MS",
+]
+
+# shared compile-time histogram: every dispatch site that detects a
+# retrace (executor, fused fit step, bucketed kvstore) observes the
+# wall time of the dispatching call here — "first-trace wall time",
+# i.e. trace + XLA compile + the first execution of the new program
+JIT_COMPILE_MS = REGISTRY.histogram(
+    "jit_compile_ms",
+    "wall time of dispatches that (re)traced a program "
+    "(trace + compile + first run)", unit="ms",
+    bounds=exponential_buckets(1.0, 2.0, 22))

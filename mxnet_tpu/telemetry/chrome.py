@@ -1,0 +1,77 @@
+"""Chrome-trace bridge: registry series inside the mx.profiler dump.
+
+Two injection points line the host metrics up with the device xplane
+timeline:
+
+* :func:`mark_step` — called by the fit loop once per step while the
+  profiler runs: an instant marker ("fit_step") plus counter-track
+  samples (ph="C") of the high-signal series, so the trace viewer
+  shows dispatch/retrace/sync counters advancing against the step
+  spans.
+* :func:`dump_events` — called by ``profiler.dump()``: one final
+  counter sample per scalar series, appended to the dump so every
+  trace carries closing values even when mark_step never ran.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .registry import REGISTRY, Histogram
+
+__all__ = ["mark_step", "dump_events", "TRACKED_SERIES"]
+
+# the counter tracks sampled per step (full registry would be noise)
+TRACKED_SERIES = (
+    "device_dispatches",
+    "fit_host_syncs",
+    "fit_step_retraces",
+    "kvstore_bucket_retraces",
+    "executor_retraces",
+    "kvstore_bytes_pushed",
+    "serving_queue_depth",
+    "io_prefetch_occupancy",
+    "hbm_live_bytes",
+)
+
+
+def mark_step(step=None, name="fit_step"):
+    """Inject a per-step marker + tracked counter samples into the
+    running profiler (no-op unless profiler state is 'run')."""
+    from .. import profiler
+    if profiler.state() != "run":
+        return
+    now = profiler._now_us()
+    profiler.add_event(name, "telemetry", now, 0, ph="i",
+                       args={"step": step})
+    for series in TRACKED_SERIES:
+        m = REGISTRY.get(series)
+        if m is None or isinstance(m, Histogram):
+            continue
+        profiler.add_event(m.name, "telemetry", now, 0, ph="C",
+                           args={m.name: m.value})
+
+
+def dump_events(registry=None):
+    """Closing counter-track events (chrome trace dicts) for every
+    scalar registry series — appended by ``profiler.dump()``."""
+    reg = registry if registry is not None else REGISTRY
+    from .. import profiler
+    now = profiler._now_us()
+    pid = os.getpid()
+    tid = threading.get_ident() & 0xFFFF
+    events = []
+    for m in reg.collect():
+        for s in [m] + m.children():
+            if isinstance(s, Histogram):
+                snap = s.snapshot()
+                if not snap["count"]:
+                    continue
+                args = {"count": snap["count"],
+                        "p50": snap["p50"], "p99": snap["p99"]}
+            else:
+                args = {s.name: s.value}
+            events.append({"name": s.name, "cat": "telemetry", "ph": "C",
+                           "ts": now, "pid": pid, "tid": tid,
+                           "args": args})
+    return events

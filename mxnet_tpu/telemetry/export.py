@@ -1,0 +1,180 @@
+"""Prometheus text exposition + standalone stdlib HTTP exporter.
+
+Two scrape surfaces share :func:`generate_text`:
+
+* the serving HTTP endpoint (``GET /metrics`` on ``ModelServer``,
+  serving/server.py) for inference deployments, and
+* :func:`start_http_exporter` — a daemon-thread stdlib server for
+  training jobs that have no HTTP surface of their own.
+
+The format is Prometheus text exposition 0.0.4 (HELP/TYPE comments,
+``name{labels} value`` samples, cumulative ``_bucket{le=...}`` +
+``_sum``/``_count`` for histograms).  :func:`parse_text` is the minimal
+inverse used by the round-trip tests and ``tools/check_telemetry.py``.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+
+from .registry import REGISTRY, Histogram
+
+__all__ = ["CONTENT_TYPE", "generate_text", "parse_text",
+           "start_http_exporter", "Exporter"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt_value(v):
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _label_str(names, values, extra=None):
+    parts = ['%s="%s"' % (n, _escape_label(v))
+             for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def generate_text(registry=None):
+    """The whole registry in Prometheus text exposition format."""
+    reg = registry if registry is not None else REGISTRY
+    lines = []
+    for m in reg.collect():
+        help_text = m.help or m.name
+        if m.unit:
+            help_text += " [%s]" % m.unit
+        lines.append("# HELP %s %s" % (m.name, _escape_help(help_text)))
+        lines.append("# TYPE %s %s" % (m.name, m.kind))
+        series = [m] + m.children()
+        for s in series:
+            if s is m and m.children() and isinstance(m, Histogram) \
+                    and m.count == 0:
+                continue   # labeled histogram: skip the empty parent
+            if isinstance(s, Histogram):
+                snap = s.snapshot()
+                cum = 0
+                for bound, c in zip(snap["bounds"], snap["counts"]):
+                    cum += c
+                    lines.append("%s_bucket%s %s" % (
+                        s.name,
+                        _label_str(s.label_names, s.label_values,
+                                   'le="%s"' % _fmt_value(bound)),
+                        _fmt_value(cum)))
+                cum += snap["counts"][-1]
+                lines.append("%s_bucket%s %s" % (
+                    s.name,
+                    _label_str(s.label_names, s.label_values, 'le="+Inf"'),
+                    _fmt_value(cum)))
+                labels = _label_str(s.label_names, s.label_values)
+                lines.append("%s_sum%s %s"
+                             % (s.name, labels, _fmt_value(snap["sum"])))
+                lines.append("%s_count%s %s"
+                             % (s.name, labels, _fmt_value(snap["count"])))
+            else:
+                lines.append("%s%s %s" % (
+                    s.name, _label_str(s.label_names, s.label_values),
+                    _fmt_value(s.value)))
+    return "\n".join(lines) + "\n"
+
+
+def parse_text(text):
+    """Minimal exposition parser: ``{name: {"type": kind, "samples":
+    {sample_name+labels: float}}}``.  Round-trip/validation use only."""
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            types[name] = kind.strip()
+            out.setdefault(name, {"type": kind.strip(), "samples": {}})
+            continue
+        if line.startswith("#"):
+            continue
+        # label VALUES may legally contain spaces ('x{host="node a"} 1'),
+        # so split after the closing brace, not at the last space
+        m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*(?:\{.*\})?)\s+(\S+)$",
+                     line)
+        if m is None:
+            raise ValueError("unparseable sample line: %r" % line)
+        key, value = m.group(1), m.group(2)
+        base = key.split("{", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            root = base[: -len(suffix)] if base.endswith(suffix) else None
+            if root and types.get(root) == "histogram":
+                base = root
+                break
+        fam = out.setdefault(base, {"type": types.get(base, "untyped"),
+                                    "samples": {}})
+        v = float("nan") if value == "NaN" else float(value)
+        fam["samples"][key] = v
+    return out
+
+
+class Exporter:
+    """Handle for a running metrics HTTP server."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.address = httpd.server_address
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_http_exporter(port=0, host="127.0.0.1", registry=None):
+    """Serve ``GET /metrics`` (+``/healthz``) on a daemon thread —
+    the scrape endpoint for training jobs.  ``port=0`` binds an
+    ephemeral port; read it back from ``exporter.address``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path in ("/metrics", "/"):
+                body = generate_text(registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+            elif self.path == "/healthz":
+                body = b"ok\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+            else:
+                body = b"not found\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="mx-telemetry-exporter", daemon=True)
+    thread.start()
+    return Exporter(httpd, thread)

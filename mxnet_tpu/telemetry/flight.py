@@ -1,0 +1,128 @@
+"""Flight recorder: bounded ring buffer of registry snapshots.
+
+Post-mortem observability for training jobs: sample the whole registry
+every N steps into a fixed-size ring, and dump the ring as JSON-lines
+when the process crashes (unhandled exception) or exits (atexit) — so
+a dead job leaves behind the last ~``capacity`` samples of dispatch
+counts, retraces, step times, queue depths and HBM gauges without any
+scrape infrastructure.
+
+Wire-up: ``RECORDER.install(path, every=N)`` (or env
+``MXNET_TELEMETRY_FLIGHT=<path>`` [+ ``MXNET_TELEMETRY_FLIGHT_EVERY``,
+default 50] at import).  The fit loop calls ``RECORDER.tick()`` once
+per step — a single attribute check when the recorder is idle.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+__all__ = ["FlightRecorder", "RECORDER"]
+
+
+class FlightRecorder:
+    def __init__(self, capacity=512, registry=None):
+        self._registry = registry
+        self._ring = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._every = 0          # 0 = tick() is a no-op
+        self._path = None
+        self._installed = False
+        self._steps = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from .registry import REGISTRY
+        return REGISTRY
+
+    # -- sampling ------------------------------------------------------
+    def sample(self, step=None, **extra):
+        """Append one registry snapshot to the ring."""
+        rec = {"t": time.time(), "step": step}
+        if extra:
+            rec.update(extra)
+        rec["metrics"] = self._reg().snapshot()
+        with self._lock:
+            self._ring.append(rec)
+        return rec
+
+    def tick(self):
+        """Per-step hook (BaseModule fit loop): samples every
+        ``every``-th call once installed; one attribute check when not."""
+        if not self._every:
+            return
+        self._steps += 1
+        if self._steps % self._every == 0:
+            self.sample(step=self._steps)
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+        self._steps = 0
+
+    # -- crash/atexit dump ---------------------------------------------
+    def install(self, path, every=50, capacity=None):
+        """Arm the recorder: sample every ``every`` ticks into the ring,
+        dump JSON-lines to ``path`` at process exit and on an unhandled
+        exception.  Idempotent re-arm updates path/cadence."""
+        if capacity is not None:
+            with self._lock:
+                self._ring = deque(self._ring, maxlen=capacity)
+        self._path = path
+        self._every = max(0, int(every))
+        if not self._installed:
+            self._installed = True
+            atexit.register(self._exit_dump)
+            prev_hook = sys.excepthook
+
+            def hook(exc_type, exc, tb):
+                try:
+                    self.sample(step=self._steps,
+                                crash=repr(exc_type.__name__))
+                    self.dump()
+                except Exception:
+                    pass
+                prev_hook(exc_type, exc, tb)
+
+            sys.excepthook = hook
+        return self
+
+    def _exit_dump(self):
+        try:
+            if self._path is not None:
+                self.dump()
+        except Exception:
+            pass
+
+    def dump(self, path=None):
+        """Write the ring (plus one fresh final sample) as JSON-lines;
+        returns the path written."""
+        path = path or self._path
+        if path is None:
+            raise ValueError("no dump path: pass one or install() first")
+        self.sample(step=self._steps, final=True)
+        with self._lock:
+            records = list(self._ring)
+        with open(path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+
+RECORDER = FlightRecorder()
+
+_env_path = os.environ.get("MXNET_TELEMETRY_FLIGHT")
+if _env_path:
+    RECORDER.install(
+        _env_path,
+        every=int(os.environ.get("MXNET_TELEMETRY_FLIGHT_EVERY", "50") or 50))

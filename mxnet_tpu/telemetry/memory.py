@@ -1,0 +1,210 @@
+"""HBM accounting: live-array census + device allocator stats.
+
+The fused fit step's "one copy of the training state" guarantee
+(docs/TRAINING.md) is invisible without device-memory accounting; this
+module provides it two ways:
+
+* **Live-array census** — :func:`memory_snapshot` walks
+  ``jax.live_arrays()`` and attributes bytes to the fused-fit donation
+  sets (params / optimizer states / 2-bit residuals / aux states;
+  registered by ``module/fused_fit.py`` via :func:`track_group`),
+  with the unattributed remainder reported as ``other`` (activations,
+  inputs, caches).  Works on every backend, CPU included.
+* **Allocator stats** — ``device.memory_stats()`` where the backend
+  exposes them (TPU: ``bytes_in_use`` / ``peak_bytes_in_use``; CPU
+  backends typically return nothing — the snapshot then reports None
+  and the census is the source of truth; see docs/OBSERVABILITY.md
+  for the CPU-vs-TPU caveats).
+
+:class:`StepMemoryTracker` brackets a step with begin()/end() and
+records the peak-delta into ``hbm_step_peak_delta_bytes``; the fused
+fit step drives one every ``MXNET_TELEMETRY_MEMORY_EVERY`` launches
+(0 = off, the default — a census per step is not free).
+"""
+from __future__ import annotations
+
+from .registry import REGISTRY
+
+__all__ = ["memory_snapshot", "track_group", "untrack_group",
+           "tracked_groups", "StepMemoryTracker"]
+
+# byte gauges refreshed by every memory_snapshot() call
+LIVE_BYTES = REGISTRY.gauge(
+    "hbm_live_bytes", "total bytes of live jax arrays", unit="bytes")
+LIVE_ARRAYS = REGISTRY.gauge(
+    "hbm_live_arrays", "number of live jax arrays", unit="arrays")
+PARAMS_BYTES = REGISTRY.gauge(
+    "hbm_params_bytes", "live bytes attributed to model parameters",
+    unit="bytes")
+OPT_STATES_BYTES = REGISTRY.gauge(
+    "hbm_opt_states_bytes", "live bytes attributed to optimizer state",
+    unit="bytes")
+RESIDUALS_BYTES = REGISTRY.gauge(
+    "hbm_residuals_bytes",
+    "live bytes attributed to 2-bit error-feedback residuals",
+    unit="bytes")
+AUXS_BYTES = REGISTRY.gauge(
+    "hbm_auxs_bytes", "live bytes attributed to aux states (BN stats)",
+    unit="bytes")
+OTHER_BYTES = REGISTRY.gauge(
+    "hbm_other_bytes",
+    "live bytes not attributed to a tracked group "
+    "(activations, inputs, caches)", unit="bytes")
+BYTES_IN_USE = REGISTRY.gauge(
+    "hbm_bytes_in_use", "allocator bytes_in_use (None-> 0 on backends "
+    "without memory_stats, e.g. CPU)", unit="bytes")
+PEAK_BYTES = REGISTRY.gauge(
+    "hbm_peak_bytes", "allocator peak_bytes_in_use (0 where unsupported)",
+    unit="bytes")
+STEP_PEAK_DELTA = REGISTRY.gauge(
+    "hbm_step_peak_delta_bytes",
+    "peak-memory delta across the last tracked step", unit="bytes")
+
+_GROUP_GAUGES = {"params": PARAMS_BYTES, "opt_states": OPT_STATES_BYTES,
+                 "residuals": RESIDUALS_BYTES, "auxs": AUXS_BYTES}
+
+# group name -> zero-arg provider returning an iterable of jax arrays
+# (the CURRENT donation-set contents; providers hold weakrefs so a dead
+# module stops contributing). Attribution precedence = insertion order.
+_groups = {}
+
+
+def track_group(name, provider):
+    """Register/replace the provider for one accounting group."""
+    _groups[name] = provider
+
+
+def untrack_group(name):
+    _groups.pop(name, None)
+
+
+def tracked_groups():
+    return sorted(_groups)
+
+
+def _device_stats():
+    import jax
+    per_dev, in_use, peak = [], 0, 0
+    have_any = False
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            have_any = True
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get("peak_bytes_in_use", 0))
+        per_dev.append({"device": str(d), "platform": d.platform,
+                        "stats": dict(stats) if stats else None})
+    return per_dev, (in_use if have_any else None), \
+        (peak if have_any else None)
+
+
+def memory_snapshot():
+    """One HBM census: totals, per-group attribution, allocator stats.
+
+    Returns a JSON-able dict and refreshes the ``hbm_*`` gauges.  On
+    CPU the allocator fields are None (census totals remain exact);
+    on TPU both views are populated and should roughly agree modulo
+    allocator slack.
+    """
+    import jax
+    live = jax.live_arrays()
+    total = 0
+    live_ids = set()
+    for a in live:
+        try:
+            total += int(a.nbytes)
+            live_ids.add(id(a))
+        except Exception:       # deleted between enumeration and read
+            continue
+
+    group_bytes = {}
+    claimed = set()
+    for name, provider in list(_groups.items()):
+        nbytes = 0
+        try:
+            arrays = provider() or ()
+        except Exception:
+            arrays = ()
+        for a in arrays:
+            if a is None:
+                continue
+            i = id(a)
+            # only count arrays that are actually live, once each,
+            # first-registered group wins (params > states > ...)
+            if i in claimed or i not in live_ids:
+                continue
+            claimed.add(i)
+            try:
+                nbytes += int(a.nbytes)
+            except Exception:
+                continue
+        group_bytes[name] = nbytes
+
+    other = max(0, total - sum(group_bytes.values()))
+    per_dev, in_use, peak = _device_stats()
+
+    LIVE_BYTES.set(total)
+    LIVE_ARRAYS.set(len(live))
+    for name, gauge in _GROUP_GAUGES.items():
+        gauge.set(group_bytes.get(name, 0))
+    OTHER_BYTES.set(other)
+    BYTES_IN_USE.set(in_use or 0)
+    PEAK_BYTES.set(peak or 0)
+
+    return {
+        "live_array_bytes": total,
+        "live_array_count": len(live),
+        "by_kind": {**{g: group_bytes.get(g, 0) for g in _GROUP_GAUGES},
+                    **{g: b for g, b in group_bytes.items()
+                       if g not in _GROUP_GAUGES},
+                    "other": other},
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "devices": per_dev,
+    }
+
+
+def _peak_or_live():
+    """Best available 'high-water' reading: allocator peak where the
+    backend reports one, else the live-array census total (CPU)."""
+    _, _, peak = _device_stats()
+    if peak is not None:
+        return peak, True
+    import jax
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            total += int(a.nbytes)
+        except Exception:
+            continue
+    return total, False
+
+
+class StepMemoryTracker:
+    """begin()/end() bracket recording the per-step peak delta.
+
+    With allocator stats (TPU) the delta is ``peak_bytes_in_use``
+    growth across the step; without them (CPU) it degrades to the
+    live-bytes delta at the two sample points, which misses transient
+    in-step peaks — a documented CPU caveat, not a bug.
+    """
+
+    def __init__(self):
+        self._base = None
+
+    def begin(self):
+        self._base, _ = _peak_or_live()
+        return self._base
+
+    def end(self):
+        if self._base is None:
+            return None
+        now, _ = _peak_or_live()
+        delta = now - self._base
+        self._base = None
+        STEP_PEAK_DELTA.set(delta)
+        return delta

@@ -86,6 +86,107 @@ def test_profiler_symbolic_span(tmp_path):
     assert "Executor::forward" in names
 
 
+def test_profiler_pause_events_do_not_leak(tmp_path):
+    """Events recorded while paused must not appear in the dump —
+    pause suspends ALL host-event recording (tasks, markers, counters),
+    not just the imperative/symbolic flags."""
+    fname = str(tmp_path / "pause.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    dom = profiler.Domain("pausedom")
+    with dom.new_task("visible_task"):
+        pass
+    profiler.pause()
+    with dom.new_task("hidden_task"):
+        pass
+    dom.new_marker("hidden_marker").mark()
+    dom.new_counter("hidden_counter").increment()
+    profiler.resume()
+    with dom.new_task("visible_after_resume"):
+        pass
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        evs = json.load(f)["traceEvents"]
+    # ignore the closing telemetry counter tracks dump() injects — the
+    # leak check is about RECORDED events (cat = the domain name)
+    names = [e["name"] for e in evs if e.get("cat") == "pausedom"]
+    all_names = [e["name"] for e in evs]
+    assert "visible_task" in names
+    assert "visible_after_resume" in names
+    assert "hidden_task" not in all_names
+    assert "hidden_marker" not in all_names
+    assert "hidden_counter" not in names
+
+
+def test_profiler_dumps_reset_clears_table(tmp_path):
+    """dumps(reset=True) returns the aggregate table AND clears it; a
+    following dumps() shows only the header."""
+    profiler.set_config(filename=str(tmp_path / "agg.json"),
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    with profiler.scope("agg_reset_span"):
+        pass
+    profiler.set_state("stop")
+    try:
+        table = profiler.dumps(reset=True)
+        assert "agg_reset_span" in table
+        again = profiler.dumps()
+        assert "agg_reset_span" not in again
+        assert "Name" in again            # header row survives
+    finally:
+        profiler.set_config(aggregate_stats=False)
+        profiler.dump()                   # clear leftover events
+
+
+def test_profiler_set_config_trace_dir_while_running(tmp_path, monkeypatch):
+    """Setting trace_dir while state == 'run' must start the device
+    xplane trace immediately (it used to wait for the next stop/start
+    cycle); stop() then ends it."""
+    import jax
+
+    started, stopped = [], []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: started.append(d))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    profiler.set_state("run")
+    try:
+        assert not started
+        profiler.set_config(trace_dir=str(tmp_path))
+        assert started == [str(tmp_path)], \
+            "trace must start immediately, not at the next cycle"
+        assert profiler._device_trace_on
+        # idempotent: a second set_config doesn't double-start
+        profiler.set_config(trace_dir=str(tmp_path))
+        assert len(started) == 1
+    finally:
+        profiler.set_state("stop")
+        profiler.set_config(trace_dir=None)
+    assert stopped and not profiler._device_trace_on
+
+
+def test_profiler_dump_carries_telemetry_counter_tracks(tmp_path):
+    """A non-empty dump is injected with closing mx.telemetry counter
+    tracks so host metrics line up with the trace."""
+    fname = str(tmp_path / "tm.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.scope("some_span"):
+        pass
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        evs = json.load(f)["traceEvents"]
+    tele = [e for e in evs if e.get("cat") == "telemetry"]
+    assert any(e["name"] == "device_dispatches" and e["ph"] == "C"
+               for e in tele)
+    # an EMPTY dump stays empty (no telemetry-only trace files)
+    profiler.dump()
+    with open(fname) as f:
+        assert json.load(f)["traceEvents"] == []
+
+
 def test_monitor_taps_intermediates():
     x = sym.Variable("x")
     h = sym.FullyConnected(x, num_hidden=3, name="fc1")

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Static telemetry-consistency check (runs inside tier-1 via
+tests/test_telemetry.py).
+
+Keeps ``telemetry.REGISTRY`` the single source of truth for
+operational witnesses:
+
+1. **No stray witness globals** — flags new module-level mutable
+   ALL-CAPS globals (``FOO = 0`` / ``= []`` / ``= {}`` / ``= set()``)
+   in ``mxnet_tpu/``; counters/state belong in the registry (the two
+   historical ``TRACE_COUNT`` ints are now registry-backed aliases).
+   Genuine constants go in the allowlist below with a reason.
+2. **Glossary coverage** — every metric name registered by literal in
+   ``mxnet_tpu/`` source (``REGISTRY.counter/gauge/histogram("name")``
+   and profiler ``new_counter("name")``) must appear in the
+   docs/OBSERVABILITY.md glossary, so the docs can never silently lag
+   the exported series.
+
+Stdlib-only, no package import: safe anywhere (including as a plain
+subprocess inside the test suite).
+"""
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "mxnet_tpu")
+GLOSSARY = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+
+# (relative path, name): why this module-level global is legitimate
+ALLOWED_GLOBALS = {
+    ("contrib/text/embedding.py", "UNKNOWN_IDX"):
+        "vocabulary layout constant, not a mutable witness",
+}
+
+_MUTABLE = re.compile(
+    r"^([A-Z][A-Z0-9_]*)\s*=\s*(?:0|0\.0|\[\]|\{\}|set\(\))\s*(?:#.*)?$")
+_REGISTER = re.compile(
+    r"""(?:\.|\b)(?:counter|gauge|histogram)\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
+_PROF_COUNTER = re.compile(
+    r"""new_counter\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
+
+
+def sanitize(name):
+    out = []
+    for i, ch in enumerate(name):
+        ok = ("a" <= ch <= "z") or ("A" <= ch <= "Z") or ch in "_:" \
+            or ("0" <= ch <= "9")
+        if i == 0 and "0" <= ch <= "9":
+            out.append("_")
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def glossary_names():
+    names = set()
+    with open(GLOSSARY) as f:
+        for line in f:
+            m = re.match(r"^\|\s*`([A-Za-z0-9_:]+)`\s*\|", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def scan():
+    bad_globals = []
+    registered = {}      # sanitized name -> first file:line
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, PKG)
+            with open(path) as f:
+                text = f.read()
+            for lineno, line in enumerate(text.splitlines(), 1):
+                m = _MUTABLE.match(line)
+                if m and (rel, m.group(1)) not in ALLOWED_GLOBALS:
+                    bad_globals.append("%s:%d: module-level mutable "
+                                      "global %s — use a telemetry "
+                                      "registry instrument (or allowlist "
+                                      "it in tools/check_telemetry.py)"
+                                      % (rel, lineno, m.group(1)))
+            for rx in (_REGISTER, _PROF_COUNTER):
+                for m in rx.finditer(text):
+                    name = sanitize(m.group(1))
+                    registered.setdefault(
+                        name, "%s (near offset %d)" % (rel, m.start()))
+    return bad_globals, registered
+
+
+def main():
+    errors, registered = scan()
+    if not os.path.exists(GLOSSARY):
+        errors.append("docs/OBSERVABILITY.md missing")
+        known = set()
+    else:
+        known = glossary_names()
+    for name in sorted(registered):
+        if name not in known:
+            errors.append(
+                "metric %r registered at %s is missing from the "
+                "docs/OBSERVABILITY.md glossary" % (name, registered[name]))
+    if errors:
+        print("check_telemetry: %d problem(s)" % len(errors))
+        for e in errors:
+            print("  " + e)
+        return 1
+    print("check_telemetry: OK (%d series in glossary, %d registered "
+          "by literal)" % (len(known), len(registered)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
