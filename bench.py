@@ -932,6 +932,119 @@ def bench_fit(args):
     }
 
 
+def bench_checkpoint(args):
+    """mx.checkpoint witnesses: async vs blocking save latency, bytes
+    per checkpoint, and — the headline — the training-thread BLOCK time
+    of an async save (``checkpoint_block_ms``: device→host snapshot +
+    enqueue; serialization and IO run on the writer thread).
+
+    Acceptance shape (docs/CHECKPOINT.md): ``checkpoint_block_ms`` p50
+    stays under the fit-step p50 — checkpointing never costs a full
+    step — and the fused-step / bucketed-kvstore retrace witnesses stay
+    flat with checkpointing enabled. Measured on the bench_fit model
+    (ResNet fit config, 2-bit compression ON so residual capture is
+    priced in)."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, nd, telemetry
+    from mxnet_tpu import checkpoint as ckpt
+
+    image_shape = tuple(int(x) for x in args.fit_image_shape.split(","))
+    batch = args.fit_batch
+    sym = models.get_symbol("resnet", num_classes=1000,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape, dtype="float32")
+    rng = np.random.RandomState(0)
+    c, h, w = image_shape
+    X = rng.uniform(-1, 1, (batch, c, h, w)).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    mod = mx.Module(sym, compression_params={"type": "2bit",
+                                             "threshold": 0.5})
+    mod.bind(data_shapes=[("data", X.shape)],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                   factor_type="in", magnitude=2))
+    mod.init_optimizer(kvstore=mx.kv.create("device"), optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9, "wd": 1e-4})
+    batch_nd = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+    mod.fit_step(batch_nd)               # compile + warm
+    mod._fit_sync()
+    r_fit0 = telemetry.REGISTRY.get("fit_step_retraces").value
+    r_kv0 = telemetry.REGISTRY.get("kvstore_bucket_retraces").value
+
+    step_hist = _step_hist()
+    for _ in range(args.fit_steps):
+        t_s = time.perf_counter()
+        mod.fit_step(batch_nd)
+        step_hist.observe((time.perf_counter() - t_s) * 1e3)
+    mod._fit_sync()
+
+    tmp = tempfile.mkdtemp(prefix="mx-bench-ckpt-")
+    n_saves = args.ckpt_saves
+    save_hist = telemetry.REGISTRY.get("checkpoint_save_ms")
+    bytes_ctr = telemetry.REGISTRY.get("checkpoint_bytes")
+    try:
+        mgr = ckpt.CheckpointManager(os.path.join(tmp, "ck"), module=mod,
+                                     keep=2, install_preemption=False)
+        # async arm: the training thread pays only the snapshot+enqueue
+        block_ms, t_c = [], time.perf_counter()
+        snap0, b0 = save_hist.snapshot(), bytes_ctr.value
+        for i in range(n_saves):
+            mod.fit_step(batch_nd)
+            t0 = time.perf_counter()
+            mgr.save(step=i + 1)
+            block_ms.append((time.perf_counter() - t0) * 1e3)
+        assert mgr.drain(600), "bench: checkpoint writer failed to drain"
+        async_wall_ms = (time.perf_counter() - t_c) * 1e3
+        async_save_p50 = telemetry.hist_quantile(
+            save_hist.snapshot(), 0.5, since=snap0)
+        per_save_bytes = (bytes_ctr.value - b0) // n_saves
+        # blocking arm: serialize + write + fsync + rename inline
+        sync_ms = []
+        for i in range(n_saves):
+            mod.fit_step(batch_nd)
+            t0 = time.perf_counter()
+            mgr.save(step=100 + i, block=True)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        mgr.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    block_ms.sort()
+    sync_ms.sort()
+    step_p50 = step_hist.quantile(0.5)
+    block_p50 = block_ms[len(block_ms) // 2]
+    retr_fit = telemetry.REGISTRY.get("fit_step_retraces").value - r_fit0
+    retr_kv = telemetry.REGISTRY.get("kvstore_bucket_retraces").value \
+        - r_kv0
+    dev = jax.devices()[0]
+    return {
+        "metric": "checkpoint_block_ms",
+        "value": _round_opt(block_p50),
+        "unit": "ms",
+        "device_kind": dev.device_kind,
+        "config": "resnet%d b%d %s sgd-mom kv=device 2bit=on" % (
+            args.num_layers, batch, args.fit_image_shape),
+        "checkpoint_save_ms": {
+            "async": _round_opt(async_save_p50),
+            "blocking": _round_opt(sync_ms[len(sync_ms) // 2]),
+        },
+        "checkpoint_bytes": int(per_save_bytes),
+        "checkpoint_async_wall_ms": _round_opt(async_wall_ms),
+        "fit_step_ms_p50": _round_opt(step_p50),
+        "block_lt_step_p50": bool(step_p50 is None
+                                  or block_p50 < step_p50),
+        "fit_step_retraces_delta": int(retr_fit),
+        "kvstore_bucket_retraces_delta": int(retr_kv),
+        "saves_per_arm": n_saves,
+    }
+
+
 def bench_serving(args):
     """mx.serving throughput: concurrent clients against the in-process
     ModelServer (dynamic micro-batching + bucket padding over a jitted
@@ -1042,7 +1155,8 @@ def main():
     ap.add_argument("--model", type=str, default="all",
                     choices=["all", "resnet", "transformer"])
     ap.add_argument("--mode", type=str, default="train",
-                    choices=["train", "inference", "serving", "kvstore",
+                    choices=["train", "inference", "serving", "checkpoint",
+                             "kvstore",
                              "fit"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
@@ -1086,6 +1200,8 @@ def main():
     ap.add_argument("--fit-batch", type=int, default=4)
     ap.add_argument("--fit-image-shape", type=str, default="3,224,224")
     ap.add_argument("--fit-steps", type=int, default=4)
+    ap.add_argument("--ckpt-saves", type=int, default=4,
+                    help="checkpoint saves per arm in --mode checkpoint")
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -1106,6 +1222,9 @@ def main():
         return
     if args.mode == "fit":
         print(json.dumps(bench_fit(args)))
+        return
+    if args.mode == "checkpoint":
+        print(json.dumps(bench_checkpoint(args)))
         return
     if args.mode == "inference":
         if args.quantized:
@@ -1142,6 +1261,10 @@ def main():
     out["train_dispatches_per_step"] = fit["train_dispatches_per_step"]
     out["host_syncs_per_step"] = fit["host_syncs_per_step"]
     out["fit_step_ms"] = fit["fit_step_ms"]
+    cp = bench_checkpoint(args)
+    out["checkpoint_block_ms"] = cp["value"]
+    out["checkpoint_save_ms"] = cp["checkpoint_save_ms"]
+    out["checkpoint_bytes"] = cp["checkpoint_bytes"]
     print(json.dumps(out))
 
 

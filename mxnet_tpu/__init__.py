@@ -93,6 +93,7 @@ from . import predictor
 from . import serving
 from . import profiler
 from . import telemetry
+from . import checkpoint
 from . import monitor
 from .monitor import Monitor
 from . import test_utils

@@ -101,28 +101,91 @@ class ProgressBar:
         logging.info("[%s] %s%s\r", bar, -(-int(frac * 1000) // 10), "%")
 
 
-def do_checkpoint(prefix, period=1):
+def do_checkpoint(prefix, period=1, async_write=False):
     """Epoch-end callback saving ``prefix-symbol.json`` + ``prefix-NNNN.params``
-    every ``period`` epochs via :func:`mxnet_tpu.model.save_checkpoint`."""
-    from .model import save_checkpoint
+    every ``period`` epochs via :func:`mxnet_tpu.model.save_checkpoint`.
+
+    ``async_write=True`` routes the save through mx.checkpoint's
+    background writer (docs/CHECKPOINT.md): the callback snapshots the
+    (already host-resident) params and returns immediately; the writer
+    commits the SAME epoch-numbered ``prefix-NNNN.params`` file
+    crash-safely (tmp + fsync + atomic rename) plus a checksum
+    manifest. Default stays the legacy blocking in-place write."""
     stride = max(int(period), 1)
+    writer = None
 
     def _on_epoch_end(epoch, sym, arg, aux):
+        nonlocal writer
         done = epoch + 1
         if done % stride == 0:
-            save_checkpoint(prefix, done, sym, arg, aux)
+            if not async_write:
+                from .model import save_checkpoint
+                save_checkpoint(prefix, done, sym, arg, aux)
+                return
+            from . import checkpoint as _ckpt
+            if writer is None:
+                writer = _ckpt.AsyncCheckpointWriter()
+            state = _ckpt.capture_params(arg, aux, symbol=sym, epoch=done)
+            writer.submit(state, prefix, done)
+
+    def _drain(timeout=None):
+        """Wait for queued async saves (call after fit() returns before
+        reading the files; a no-op in legacy blocking mode)."""
+        return True if writer is None else writer.drain(timeout)
+
+    def _close(timeout=None):
+        """Drain and stop the writer thread (long-lived processes that
+        build many callbacks should close each when done with it)."""
+        return True if writer is None else writer.close(timeout)
+
+    _on_epoch_end.drain = _drain
+    _on_epoch_end.close = _close
     return _on_epoch_end
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      async_write=False):
     """Epoch-end callback delegating to ``mod.save_checkpoint`` (optionally
-    with optimizer state) every ``period`` epochs."""
+    with optimizer state) every ``period`` epochs.
+
+    ``async_write=True`` upgrades the save to a FULL mx.checkpoint
+    snapshot committed on the background writer — params, optimizer
+    state (when ``save_optimizer_states``), error-feedback residuals,
+    RNG and lr position — while keeping the epoch-numbered
+    ``prefix-NNNN.params``/``.states`` filename contract, so
+    ``Module.load(prefix, epoch)`` keeps working on the result."""
     stride = max(int(period), 1)
+    manager = None
 
     def _on_epoch_end(epoch, sym=None, arg=None, aux=None):
+        nonlocal manager
         done = epoch + 1
         if done % stride == 0:
-            mod.save_checkpoint(prefix, done, save_optimizer_states)
+            if not async_write:
+                mod.save_checkpoint(prefix, done, save_optimizer_states)
+                return
+            if manager is None:
+                from .checkpoint import CheckpointManager
+                manager = CheckpointManager(
+                    prefix, module=mod, keep=0,
+                    save_optimizer=save_optimizer_states,
+                    install_preemption=False)
+            manager.save(epoch=done, tag=done)
+
+    def _drain(timeout=None):
+        """Wait for queued async saves (call after fit() returns before
+        reading the files; a no-op in legacy blocking mode)."""
+        return True if manager is None else manager.drain(timeout)
+
+    def _close(timeout=None):
+        """Drain and stop the manager's writer thread (long-lived
+        processes that build many callbacks should close each)."""
+        if manager is not None:
+            manager.close(timeout)
+        return True
+
+    _on_epoch_end.drain = _drain
+    _on_epoch_end.close = _close
     return _on_epoch_end
 
 

@@ -1,0 +1,437 @@
+"""Full-training-state capture and restore (docs/CHECKPOINT.md).
+
+``capture(module)`` reads every piece of live training state at a fit
+step sync boundary — parameters, aux states, updater-keyed optimizer
+state, the 2-bit error-feedback residuals (from whichever engine owns
+them right now: the fused fit step's donated dict, the bucketed
+kvstore's flat buffers, or the eager per-(key,dev) dict), the global
+RNG chain, the lr-scheduler position and update counts, epoch/step —
+and materializes it all as host numpy arrays. That device→host copy is
+the ONLY part that blocks the training thread; serialization and IO
+happen wherever the caller runs ``write_checkpoint`` (the async writer
+thread, normally).
+
+State keys are canonical **param names** regardless of which updater
+key scheme (kvstore name keys / local interleaved int keys) the saving
+module ran, so a checkpoint taken on one path resumes on the other —
+``Module.save/load_optimizer_states`` applies the same translation.
+
+File layout per checkpoint ``<prefix>``, tag ``<t>`` (``%04d``):
+
+* ``<prefix>-symbol.json``   — shared; the legacy symbol file
+* ``<prefix>-<t>.params``    — the LEGACY ``arg:``/``aux:`` params file
+  (loadable by ``Module.load`` / ``model.load_checkpoint`` unchanged)
+* ``<prefix>-<t>.states``    — legacy pickled optimizer-state dict,
+  canonically name-keyed
+* ``<prefix>-<t>.extra``     — pickle: residuals, host RNG state,
+  lr-scheduler, per-key update counts
+* ``<prefix>-<t>.ckpt.json`` — the manifest (commit point)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+import zlib
+
+import numpy as _np
+
+from . import manifest as _mf
+from .. import telemetry as _telemetry
+
+__all__ = ["capture", "capture_params", "write_checkpoint", "load",
+           "restore"]
+
+RESTORE_MS = _telemetry.REGISTRY.histogram(
+    "checkpoint_restore_ms",
+    "wall time of a full training-state restore (read + verify + place)",
+    unit="ms")
+
+
+def _asnumpy(v):
+    """Materialize one state value on the host (NDArray / jax / numpy /
+    tuple-of-those / None)."""
+    if v is None:
+        return None
+    if isinstance(v, tuple):
+        return tuple(_asnumpy(x) for x in v)
+    if hasattr(v, "asnumpy"):
+        return _np.asarray(v.asnumpy())
+    return _np.asarray(v)
+
+
+def _as_ndarray(v):
+    from ..ndarray import NDArray
+    if v is None:
+        return None
+    if isinstance(v, tuple):
+        return tuple(_as_ndarray(x) for x in v)
+    if isinstance(v, NDArray):
+        return v
+    return NDArray(_np.ascontiguousarray(v))
+
+
+def _plain_kvstore(module):
+    """The module's single-process KVStore, or None (dist stores keep
+    their own server-side persistence)."""
+    from ..kvstore import KVStore
+    kv = getattr(module, "_kvstore", None)
+    return kv if type(kv) is KVStore else None
+
+
+def _capture_residuals(module):
+    """Error-feedback residuals as {(key, dev): numpy}, read from
+    whichever engine currently owns them (fused step > bucketed flat
+    buffers > eager per-(key,dev) dict) WITHOUT disturbing ownership —
+    a checkpoint must not change what the next step computes."""
+    out = {}
+    ff = getattr(module, "_fused_fit", None)
+    if ff is not None and getattr(ff, "_residuals", None):
+        for name, r in ff._residuals.items():
+            # MUST copy: np.asarray of a CPU jax array is a zero-copy
+            # view, and this buffer is DONATED to the next fused step —
+            # an aliasing view would let the writer serialize
+            # reused-buffer garbage
+            out[(name, 0)] = _np.array(r, copy=True)
+    kv = _plain_kvstore(module)
+    if kv is not None:
+        eng = kv._engine
+        if eng is not None:
+            for keys_tuple, rec in eng._flat_res.items():
+                for d, flat in enumerate(rec["res"]):
+                    flat = _np.asarray(flat)
+                    for key, (off, size, shape) in zip(keys_tuple,
+                                                       rec["layout"]):
+                        out.setdefault(
+                            (key, d),
+                            flat[off:off + size].reshape(shape).copy())
+        for (key, d), arr in kv._compression_residuals.items():
+            out.setdefault((key, d), arr.asnumpy())
+    return out
+
+
+def _capture_optimizer(module):
+    """(name-keyed states, extra-dict pieces) from the live updater, or
+    (None, {}) when the module has no picklable optimizer state."""
+    from ..optimizer import Updater
+    updater = None
+    if getattr(module, "optimizer_initialized", False):
+        try:
+            updater = module._live_updater()
+        except AttributeError:
+            updater = getattr(module, "_updater", None)
+    if not isinstance(updater, Updater):
+        return None, {}
+    try:
+        name_to_live, _ = module._opt_state_key_maps()
+    except AttributeError:
+        name_to_live = {k: k for k in updater.states}
+    live_to_name = {lk: n for n, lk in name_to_live.items()}
+    states = {live_to_name.get(k, k): _asnumpy(v)
+              for k, v in updater.states.items()}
+    optimizer = updater.optimizer
+    counts = {live_to_name.get(k, k): int(v)
+              for k, v in optimizer._index_update_count.items()}
+    extra = {"index_update_count": counts,
+             "num_update": int(optimizer.num_update),
+             "lr_scheduler": optimizer.lr_scheduler}
+    return states, extra
+
+
+def capture(module, epoch=None, step=None, include_optimizer=True):
+    """Snapshot the complete training state of ``module`` as host
+    arrays. Runs on the training thread; blocks only for the
+    device→host copies (no IO, no serialization, no compiled-program
+    dispatch — the zero-retrace witnesses stay flat)."""
+    from .. import random as _random
+    kv = _plain_kvstore(module)
+    if kv is not None:
+        # flush pending async buckets so states/weights are post-step
+        kv._flush_pending()
+    arg_params, aux_params = module.get_params()
+    state = {
+        "symbol_json": (module.symbol.tojson()
+                        if getattr(module, "symbol", None) is not None
+                        else None),
+        "args": {k: _np.asarray(v.asnumpy())
+                 for k, v in (arg_params or {}).items()},
+        "auxs": {k: _np.asarray(v.asnumpy())
+                 for k, v in (aux_params or {}).items()},
+        "epoch": epoch, "step": step,
+        "rng": _rng_manifest_state(_random),
+    }
+    extra = {"host_rng": _rng_host_state(_random)}
+    if include_optimizer:
+        states, opt_extra = _capture_optimizer(module)
+        state["states"] = states
+        extra.update(opt_extra)
+    else:
+        state["states"] = None
+    residuals = _capture_residuals(module)
+    if residuals:
+        extra["residuals"] = residuals
+    state["extra"] = extra
+    return state
+
+
+def capture_params(arg_params, aux_params=None, symbol=None, epoch=None,
+                   step=None):
+    """A params-only snapshot from raw dicts (the ``do_checkpoint``
+    epoch-callback form — no module required)."""
+    return {
+        "symbol_json": symbol.tojson() if symbol is not None else None,
+        "args": {k: _asnumpy(v) for k, v in (arg_params or {}).items()},
+        "auxs": {k: _asnumpy(v) for k, v in (aux_params or {}).items()},
+        "states": None, "extra": {}, "epoch": epoch, "step": step,
+        "rng": None,
+    }
+
+
+def _rng_manifest_state(random_mod):
+    st = random_mod.get_state()
+    return {"seed": st["seed"], "key": st["key"]}
+
+
+def _rng_host_state(random_mod):
+    return random_mod.get_state()["host"]
+
+
+# ----------------------------------------------------------------------
+# serialization + crash-safe write (runs on the writer thread)
+# ----------------------------------------------------------------------
+def write_checkpoint(state, prefix, tag):
+    """Serialize ``state`` and publish checkpoint ``tag`` atomically.
+    Returns the committed manifest. Total bytes written are in
+    ``manifest["total_bytes"]``."""
+    from ..ndarray import NDArray
+    from ..serialization import save_ndarray_file
+    base_dir = os.path.dirname(prefix)
+    files, tensors, total = {}, {}, 0
+
+    if state.get("symbol_json"):
+        sym_path = "%s-symbol.json" % prefix
+        blob = state["symbol_json"].encode()
+        try:                    # shared file: skip the rewrite when
+            with open(sym_path, "rb") as f:      # content is unchanged
+                unchanged = f.read() == blob
+        except OSError:
+            unchanged = False
+        if unchanged:
+            nbytes = len(blob)
+            crc = zlib.crc32(blob) & 0xFFFFFFFF
+        else:
+            nbytes, crc = _mf.atomic_write(sym_path, blob)
+        files["symbol"] = {"file": os.path.relpath(sym_path, base_dir or "."),
+                           "bytes": nbytes, "crc32": crc}
+
+    save_dict = {"arg:%s" % k: v for k, v in state["args"].items()}
+    save_dict.update({"aux:%s" % k: v for k, v in state["auxs"].items()})
+    for key, v in save_dict.items():
+        raw = _np.ascontiguousarray(v)
+        # crc32 over the buffer protocol — no tobytes() copy of the
+        # whole model per save
+        tensors[key] = {"crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                        "bytes": raw.nbytes, "shape": list(raw.shape),
+                        "dtype": str(raw.dtype)}
+    params_path = "%s-%s.params" % (prefix, _mf.tag_str(tag))
+    nbytes, crc = _mf.atomic_write(
+        params_path,
+        writer=lambda tmp: save_ndarray_file(
+            tmp, {k: NDArray(_np.ascontiguousarray(v))
+                  for k, v in save_dict.items()}))
+    files["params"] = {"file": os.path.basename(params_path),
+                       "bytes": nbytes, "crc32": crc}
+    total += nbytes
+
+    if state.get("states") is not None:
+        blob = pickle.dumps({k: _as_ndarray(v)
+                             for k, v in state["states"].items()})
+        states_path = "%s-%s.states" % (prefix, _mf.tag_str(tag))
+        nbytes, crc = _mf.atomic_write(states_path, blob)
+        files["states"] = {"file": os.path.basename(states_path),
+                           "bytes": nbytes, "crc32": crc}
+        total += nbytes
+
+    extra = state.get("extra") or {}
+    if any(v is not None for v in extra.values()):
+        blob = pickle.dumps(extra)
+        extra_path = "%s-%s.extra" % (prefix, _mf.tag_str(tag))
+        nbytes, crc = _mf.atomic_write(extra_path, blob)
+        files["extra"] = {"file": os.path.basename(extra_path),
+                          "bytes": nbytes, "crc32": crc}
+        total += nbytes
+
+    meta = {"epoch": state.get("epoch"), "step": state.get("step"),
+            "rng": state.get("rng"), "time": time.time(),
+            "total_bytes": total, "library": "mxnet_tpu"}
+    return _mf.write_manifest(prefix, tag, files, tensors, meta)
+
+
+# ----------------------------------------------------------------------
+# load / restore
+# ----------------------------------------------------------------------
+def _resolve(prefix, tag):
+    if tag is None:
+        man = _mf.latest(prefix)
+        if man is None:
+            raise IOError("no intact checkpoint found for prefix %r"
+                          % prefix)
+        return man
+    man = _mf.read_manifest(prefix, tag)
+    if man is None or not _mf.validate(prefix, man):
+        raise IOError("checkpoint %s is missing or corrupt"
+                      % _mf.manifest_path(prefix, tag))
+    return man
+
+
+def _verify_tensors(manifest, arg_params, aux_params, prefix):
+    for kind, params in (("arg", arg_params), ("aux", aux_params)):
+        for name, v in params.items():
+            rec = manifest.get("tensors", {}).get("%s:%s" % (kind, name))
+            if rec is None:
+                continue
+            raw = _np.ascontiguousarray(v.asnumpy())
+            if (zlib.crc32(raw) & 0xFFFFFFFF) != rec["crc32"]:
+                raise IOError(
+                    "checkpoint %s: tensor %s:%s fails its manifest "
+                    "checksum" % (prefix, kind, name))
+
+
+def load(prefix, tag=None, verify=True):
+    """Load checkpoint content: ``(symbol|None, arg_params, aux_params,
+    manifest)``. ``tag=None`` resolves via :func:`manifest.latest`
+    (checksum-validated newest-intact fallback); per-tensor checksums
+    re-verify after parse unless ``verify=False``."""
+    from .. import model as _model
+    man = _resolve(prefix, tag)
+    arg_params, aux_params = _model.load_params(prefix, man["tag"])
+    if verify:
+        _verify_tensors(man, arg_params, aux_params, prefix)
+    symbol = None
+    if "symbol" in man.get("files", {}):
+        from .. import symbol as _sym
+        try:
+            symbol = _sym.load("%s-symbol.json" % prefix)
+        except Exception:
+            symbol = None
+    return symbol, arg_params, aux_params, man
+
+
+def _load_extra(prefix, man):
+    rec = man.get("files", {}).get("extra")
+    if rec is None:
+        return {}
+    path = os.path.join(os.path.dirname(prefix), rec["file"])
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _restore_residuals(module, residuals):
+    """Seed restored error-feedback residuals so EITHER path picks them
+    up: the kvstore per-(key,dev) dict is the shared reseed surface; a
+    live fused step drops its stale donated dict and reseeds from the
+    kvstore on its next launch."""
+    import jax.numpy as jnp
+    kv = _plain_kvstore(module)
+    ff = getattr(module, "_fused_fit", None)
+    if kv is not None:
+        from ..ndarray import NDArray
+        kv._sync_engine()          # flush + clear engine flat ownership
+        for (key, dev), arr in residuals.items():
+            kv._compression_residuals[(key, dev)] = NDArray(
+                jnp.asarray(arr))
+        if ff is not None:
+            # discard, do NOT spill: the restored values must win
+            ff._residuals = None
+    elif ff is not None and getattr(ff, "_threshold", None) is not None:
+        ff._residuals = {key: jnp.asarray(arr)
+                         for (key, dev), arr in residuals.items()
+                         if dev == 0}
+    else:
+        # e.g. resuming a compressed checkpoint on an uncompressed
+        # config: nothing will consume error feedback here — say so
+        # rather than dropping it silently
+        logging.warning(
+            "checkpoint.restore: checkpoint carries %d error-feedback "
+            "residuals but this module has no compression engine to "
+            "seed them into", len(residuals))
+
+
+def restore(module, prefix, tag=None, load_optimizer=True, verify=True,
+            logger=None):
+    """Restore the complete training state of ``module`` from the
+    newest intact checkpoint (or ``tag``). Returns the manifest (epoch/
+    step under ``manifest["epoch"]``/``["step"]``).
+
+    The module should be bound with its optimizer initialized for a
+    full restore; a bare module gets params plus a deferred
+    ``_preload_opt_states`` (the ``Module.load`` mechanism) and the
+    optimizer-position extras are skipped with a warning."""
+    log = logger or logging
+    t0 = time.perf_counter()
+    _, arg_params, aux_params, man = load(prefix, tag, verify=verify)
+    tag = man["tag"]
+
+    if getattr(module, "binded", False):
+        module.set_params(arg_params, aux_params, allow_missing=False,
+                          force_init=True, allow_extra=True)
+        kv = _plain_kvstore(module)
+        if kv is not None and getattr(module, "_update_on_kvstore", False):
+            # the kvstore's own weight store is what eager pulls (and
+            # fused rebinds) read — refresh it or the next update would
+            # clobber the restored params with pre-restore weights
+            for name, v in arg_params.items():
+                if name in kv._store:
+                    kv._store[name] = v.copy()
+    else:
+        module._arg_params = arg_params
+        module._aux_params = aux_params
+        module.params_initialized = True
+
+    states_rec = man.get("files", {}).get("states")
+    states_path = (os.path.join(os.path.dirname(prefix),
+                                states_rec["file"])
+                   if states_rec else None)
+    extra = _load_extra(prefix, man)
+
+    if load_optimizer and states_path is not None:
+        if getattr(module, "optimizer_initialized", False):
+            module.load_optimizer_states(states_path)
+            optimizer = getattr(module, "_optimizer", None)
+            if optimizer is not None:
+                counts = extra.get("index_update_count") or {}
+                try:
+                    name_to_live, _ = module._opt_state_key_maps()
+                except AttributeError:
+                    name_to_live = {}
+                for name, n in counts.items():
+                    optimizer._index_update_count[
+                        name_to_live.get(name, name)] = int(n)
+                optimizer.num_update = max(
+                    optimizer.num_update,
+                    int(extra.get("num_update", 0) or 0))
+                sched = extra.get("lr_scheduler")
+                if sched is not None:
+                    optimizer.lr_scheduler = sched
+        else:
+            module._preload_opt_states = states_path
+            log.warning("checkpoint.restore: optimizer not initialized; "
+                        "states will preload at init_optimizer, but the "
+                        "lr-scheduler position/update counts are only "
+                        "restored on an initialized module")
+
+    residuals = extra.get("residuals")
+    if residuals:
+        _restore_residuals(module, residuals)
+
+    rng = man.get("rng")
+    if rng is not None:
+        from .. import random as _random
+        _random.set_state({"seed": rng.get("seed", 0),
+                           "key": rng.get("key"),
+                           "host": extra.get("host_rng")})
+
+    RESTORE_MS.observe((time.perf_counter() - t0) * 1e3)
+    _telemetry.RECORDER.note("checkpoint_restore", tag=tag)
+    return man

@@ -157,10 +157,21 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
+            monitor=None, sparse_row_id_fn=None, checkpoint_every=None,
+            checkpoint_prefix=None):
         """Train for ``num_epoch`` epochs.  Signature parity with the
         reference ``fit`` (base_module.py:399); loop structure is the
-        prefetched design described in the module docstring."""
+        prefetched design described in the module docstring.
+
+        ``checkpoint_every``/``checkpoint_prefix`` (env:
+        ``MXNET_CHECKPOINT_EVERY`` / ``MXNET_CHECKPOINT_PREFIX``) arm
+        mx.checkpoint (docs/CHECKPOINT.md): every N steps the COMPLETE
+        training state — params, optimizer state, error-feedback
+        residuals, RNG, lr position — snapshots at the step boundary
+        and commits on a background writer; the loop blocks only for
+        the device→host copy, the fused-step zero-retrace guarantee is
+        untouched, and a SIGTERM triggers an emergency synchronous save
+        plus graceful drain before ``fit`` returns."""
         if num_epoch is None:
             raise ValueError("please specify number of epochs")
 
@@ -180,34 +191,68 @@ class BaseModule:
         on_batch = _callbacks(batch_end_callback)
         on_epoch = _callbacks(epoch_end_callback)
 
-        for epoch in range(begin_epoch, num_epoch):
-            self._run_train_epoch(
-                epoch, train_data, train_metric, monitor, on_batch,
-                sparse_row_id_fn)
-            # Sync params out of the device-side optimizer state once per
-            # epoch so epoch callbacks (checkpointing) see current values.
-            arg_now, aux_now = self.get_params()
-            self.set_params(arg_now, aux_now)
-            for cb in on_epoch:
-                cb(epoch, self.symbol, arg_now, aux_now)
-            if eval_data is not None:
-                scores = self.score(eval_data, val_metric,
-                                    score_end_callback=eval_end_callback,
-                                    batch_end_callback=eval_batch_end_callback,
-                                    epoch=epoch)
-                for name, val in scores:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-            train_data.reset()
+        ckpt = self._make_checkpointer(checkpoint_every, checkpoint_prefix)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                preempted = self._run_train_epoch(
+                    epoch, train_data, train_metric, monitor, on_batch,
+                    sparse_row_id_fn, ckpt)
+                if preempted:
+                    self.logger.warning(
+                        "Epoch[%d] preempted — emergency checkpoint "
+                        "committed, stopping fit", epoch)
+                    return
+                # Sync params out of the device-side optimizer state once
+                # per epoch so epoch callbacks (checkpointing) see current
+                # values.
+                arg_now, aux_now = self.get_params()
+                self.set_params(arg_now, aux_now)
+                for cb in on_epoch:
+                    cb(epoch, self.symbol, arg_now, aux_now)
+                if eval_data is not None:
+                    scores = self.score(
+                        eval_data, val_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in scores:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            if ckpt is not None:
+                ckpt.close()        # drain pending writes, restore signals
+
+    def _make_checkpointer(self, checkpoint_every, checkpoint_prefix):
+        """A CheckpointManager when step checkpointing is requested (arg
+        or env), else None."""
+        every = checkpoint_every if checkpoint_every is not None \
+            else int(os.environ.get("MXNET_CHECKPOINT_EVERY", "0") or 0)
+        if not every:
+            if checkpoint_prefix \
+                    or os.environ.get("MXNET_CHECKPOINT_PREFIX"):
+                self.logger.warning(
+                    "checkpoint prefix given but checkpoint_every/"
+                    "MXNET_CHECKPOINT_EVERY is unset — checkpointing is "
+                    "NOT armed")
+            return None
+        prefix = checkpoint_prefix \
+            or os.environ.get("MXNET_CHECKPOINT_PREFIX") or "checkpoint"
+        from ..checkpoint import CheckpointManager
+        return CheckpointManager(prefix, module=self, every=every,
+                                 logger=self.logger)
 
     def _run_train_epoch(self, epoch, train_data, train_metric, monitor,
-                         on_batch, sparse_row_id_fn):
+                         on_batch, sparse_row_id_fn, ckpt=None):
         """One epoch: keep the device queue full, read metrics back only
         at callback boundaries. With the fused fit step active, the loop
         body performs ZERO blocking host syncs — metrics accumulate on
         device and step N+1 dispatches while step N executes; the
         ``MXNET_FIT_SYNC_EVERY`` env var (0 = unbounded, the default)
-        bounds how many steps may be in flight."""
+        bounds how many steps may be in flight. ``ckpt`` (a
+        CheckpointManager) ticks at each step boundary; returns True
+        when the epoch stopped early on a preemption (emergency
+        checkpoint already committed)."""
         t0 = time.time()
         train_metric.reset()
         flow = _Prefetcher(train_data, self, sparse_row_id_fn)
@@ -241,10 +286,15 @@ class BaseModule:
             nbatch += 1
             if sync_every and nbatch % sync_every == 0:
                 self._fit_sync()
+            # checkpoint tick LAST: the step (and its metric fold) is
+            # fully dispatched, so the snapshot sees post-step handles
+            if ckpt is not None and ckpt.tick(epoch=epoch):
+                return True
         # epoch boundary: the one scheduled metric readback of the epoch
         for name, val in train_metric.get_name_value():
             self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
         self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - t0)
+        return False
 
     # ------------------------------------------------------------------
     # evaluation / inference

@@ -501,21 +501,97 @@ class Module(BaseModule):
         self._params_dirty = False
 
     # -- optimizer state persistence ------------------------------------
+    def _live_updater(self):
+        """The updater actually applying updates right now (kvstore's
+        when update_on_kvstore, else the worker-local one)."""
+        return self._kvstore._updater if self._update_on_kvstore \
+            else self._updater
+
+    def _opt_state_key_maps(self):
+        """(name→live updater key, any-scheme→live key) maps.
+
+        Two key schemes exist (docs/TRAINING.md): kvstore updaters key
+        state by param NAME (kvstore._updater_key), local updaters by
+        interleaved index (model._local_updater_key) — both shared with
+        the fused fit step since PR 3. Checkpoints persist states under
+        canonical param names; the alias map lets a states file written
+        under EITHER scheme load into the live one, so a checkpoint
+        taken with one kvstore config resumes under the other instead
+        of silently dropping all momentum."""
+        from ..kvstore import _updater_key
+        from ..model import _local_updater_key
+        names = self._exec_group.param_names
+        if self._update_on_kvstore:
+            name_to_live = {n: _updater_key(n) for n in names}
+        else:
+            name_to_live = {n: _local_updater_key(i)
+                            for i, n in enumerate(names)}
+        alias = {}
+        for i, n in enumerate(names):
+            alias[_updater_key(n)] = name_to_live[n]
+            alias[_local_updater_key(i)] = name_to_live[n]
+        return name_to_live, alias
+
+    def _states_use_kvstore_file(self):
+        """True when state persistence must stay delegated to the
+        kvstore (dist stores keep server-side optimizer state)."""
+        from ..kvstore import KVStore
+        return self._update_on_kvstore \
+            and type(self._kvstore) is not KVStore
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._states_use_kvstore_file():
             self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            return
+        from ..kvstore import KVStore
+        from ..optimizer import Updater
+        if type(self._kvstore) is KVStore:
+            self._kvstore._flush_pending()   # pending buckets touch state
+        updater = self._live_updater()
+        if not isinstance(updater, Updater):
+            with open(fname, "wb") as fout:   # custom updater: raw dump
+                fout.write(updater.get_states())
+            return
+        import pickle
+        name_to_live, _ = self._opt_state_key_maps()
+        live_to_name = {lk: n for n, lk in name_to_live.items()}
+        states = {live_to_name.get(k, k): v
+                  for k, v in updater.states.items()}
+        with open(fname, "wb") as fout:
+            fout.write(pickle.dumps(states))
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._states_use_kvstore_file():
             self._kvstore.load_optimizer_states(fname)
+            return
+        from ..kvstore import KVStore
+        from ..optimizer import Updater
+        if type(self._kvstore) is KVStore:
+            self._kvstore._flush_pending()   # pending buckets touch state
+        updater = self._live_updater()
+        with open(fname, "rb") as f:
+            blob = f.read()
+        if not isinstance(updater, Updater):
+            updater.set_states(blob)
+            return
+        import pickle
+        data = pickle.loads(blob)
+        _, alias = self._opt_state_key_maps()
+        if isinstance(data, tuple) and len(data) == 2:
+            # dump_optimizer=True form: (states, optimizer) — adopt the
+            # optimizer too, then translate the keys in place
+            updater.set_states(blob)
+            updater.states = {alias.get(k, k): v
+                              for k, v in updater.states.items()}
+            updater.states_synced = {k: False for k in updater.states}
+            # keep the module's optimizer handle pointing at the LIVE
+            # (unpickled) one — lr/schedule mutations must hit it
+            self._optimizer = updater.optimizer
         else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            updater.set_states({alias.get(k, k): v
+                                for k, v in data.items()})
 
     def install_monitor(self, mon):
         assert self.binded

@@ -9,9 +9,9 @@ from __future__ import annotations
 import jax
 import numpy as _np
 
-__all__ = ["seed", "next_key", "next_seed", "uniform", "normal", "randint",
-           "exponential", "gamma", "poisson", "multinomial", "shuffle",
-           "randn"]
+__all__ = ["seed", "next_key", "next_seed", "get_state", "set_state",
+           "uniform", "normal", "randint", "exponential", "gamma",
+           "poisson", "multinomial", "shuffle", "randn"]
 
 _STATE = {"key": None, "seed": 0, "host_rng": None}
 
@@ -42,6 +42,36 @@ def next_seed():
     if _STATE["host_rng"] is None:
         _STATE["host_rng"] = _np.random.RandomState()  # OS entropy
     return _np.uint32(_STATE["host_rng"].randint(0, 2 ** 31 - 1))
+
+
+def get_state():
+    """Snapshot the whole RNG chain (mx.checkpoint): the seed, the JAX
+    key chain position, and the host stream's Mersenne state. The key
+    comes back as a plain int list (JSON-able); the host state is the
+    numpy ``get_state()`` tuple."""
+    key = _STATE["key"]
+    host = _STATE["host_rng"]
+    return {"seed": int(_STATE["seed"]),
+            "key": None if key is None
+            else _np.asarray(key, dtype=_np.uint32).tolist(),
+            "host": None if host is None else host.get_state()}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot — a checkpointed-and-resumed
+    run continues the exact dropout/shuffle streams of the original."""
+    import jax.numpy as jnp
+    _STATE["seed"] = int(state.get("seed", 0) or 0)
+    key = state.get("key")
+    _STATE["key"] = None if key is None \
+        else jnp.asarray(_np.asarray(key, dtype=_np.uint32))
+    host = state.get("host")
+    if host is None:
+        _STATE["host_rng"] = None
+    else:
+        rng = _np.random.RandomState()
+        rng.set_state(tuple(host))
+        _STATE["host_rng"] = rng
 
 
 # ----------------------------------------------------------------------

@@ -37,6 +37,9 @@ class Replica:
         self._base = predictor
         self._thread = None
         self._inflight = 0
+        # serializes forwards against hot-reload weight swaps so a
+        # micro-batch never runs on a half-swapped parameter set
+        self._swap_lock = threading.Lock()
         self.batches_served = 0
         self.requests_served = 0
 
@@ -90,11 +93,37 @@ class Replica:
             finally:
                 self._inflight = 0
 
+    def swap_params(self, arg_params, aux_params=None):
+        """Hot-swap this replica's weights in place. All bucket rebinds
+        share the base Predictor's device-resident NDArrays
+        (``Executor.reshape``), so one ``_set_data`` per tensor updates
+        every compiled shape with ZERO recompiles; the swap lock only
+        excludes the replica's own forward, so queued requests keep
+        flowing and none are dropped."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        exe = self._base._exe
+        with self._swap_lock:
+            for params, live in ((arg_params, exe.arg_dict),
+                                 (aux_params or {}, exe.aux_dict)):
+                for name, v in params.items():
+                    dst = live.get(name)
+                    if dst is None or name in self._base._input_shapes:
+                        continue
+                    data = v._data if hasattr(v, "_data") \
+                        else jnp.asarray(np.asarray(v))
+                    if data.dtype != dst._data.dtype:
+                        data = data.astype(dst._data.dtype)
+                    dst._set_data(jax.device_put(
+                        data, self.ctx.jax_device))
+
     def _execute(self, mb):
         stats = self._stats
         try:
             pred = self._pred_for(mb.bucket)
-            outs = pred.forward(**mb.arrays)
+            with self._swap_lock:
+                outs = pred.forward(**mb.arrays)
         except Exception as exc:     # deliver, don't kill the worker
             for req in mb.requests:
                 settle_exception(req.future, exc)

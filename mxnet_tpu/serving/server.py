@@ -322,6 +322,14 @@ class ModelServer:
         self._closed = False
         self._http = None
         self._http_thread = None
+        # hot-reload bookkeeping (docs/CHECKPOINT.md): version of the
+        # weights currently served (checkpoint tag / epoch), reload count
+        self._model_version = None
+        self._reloads = 0
+        self._reload_lock = threading.Lock()
+        from .. import telemetry as _tm
+        self._r_reloads = _tm.REGISTRY.counter(
+            "serving_reloads", "successful hot weight reloads")
         self._pool.start()
 
     # ------------------------------------------------------------------
@@ -444,12 +452,91 @@ class ModelServer:
         return False
 
     # ------------------------------------------------------------------
+    def reload(self, prefix, tag=None, epoch=None):
+        """Hot-swap every replica to newer weights WITHOUT dropping
+        queued requests (docs/CHECKPOINT.md).
+
+        ``prefix`` names an mx.checkpoint prefix: ``tag=None`` resolves
+        the newest checksum-intact checkpoint via
+        ``checkpoint.latest`` (a torn in-progress write is skipped, not
+        an error); ``epoch`` instead loads a legacy
+        ``prefix-%04d.params`` file directly. Params are validated
+        against the bound model before any replica is touched, then
+        swapped in place per replica under its forward lock — compiled
+        executors, queue and in-flight batches all survive. Returns the
+        version served (tag/epoch)."""
+        from ..checkpoint import load as _ckpt_load
+        with self._reload_lock:
+            if epoch is not None:
+                from .. import model as _model
+                try:
+                    arg_params, aux_params = _model.load_params(prefix,
+                                                                epoch)
+                except OSError as e:
+                    raise MXNetError("reload: %s" % e) from e
+                version = int(epoch)
+            else:
+                try:
+                    _sym, arg_params, aux_params, man = _ckpt_load(
+                        prefix, tag)
+                except (IOError, OSError) as e:
+                    raise MXNetError("reload: %s" % e) from e
+                version = int(man["tag"])
+            base = self._pool.replicas[0]._base
+            missing = [n for n in base._exe.arg_dict
+                       if n not in arg_params
+                       and n not in self._example_shapes
+                       and not n.endswith("label")]
+            missing += [n for n in base._exe.aux_dict
+                        if n not in (aux_params or {})]
+            if missing:
+                raise MXNetError("reload: checkpoint is missing params %s"
+                                 % sorted(missing))
+            # shape-validate EVERYTHING before any replica is touched:
+            # a mid-swap failure would leave replicas half-swapped with
+            # no rollback, corrupting live traffic
+            bad = []
+            for params, live in ((arg_params, base._exe.arg_dict),
+                                 (aux_params or {}, base._exe.aux_dict)):
+                for name, v in params.items():
+                    dst = live.get(name)
+                    if dst is None or name in self._example_shapes:
+                        continue
+                    shape = getattr(v, "shape", None)
+                    if shape is None:
+                        shape = _np.shape(v)
+                    if tuple(shape) != tuple(dst.shape):
+                        bad.append(name)
+            if bad:
+                raise MXNetError(
+                    "reload: checkpoint shapes do not match the bound "
+                    "model for %s" % sorted(bad))
+            from ..ndarray import NDArray
+            arg_params = {k: v if isinstance(v, NDArray)
+                          else NDArray(_np.asarray(v))
+                          for k, v in arg_params.items()}
+            aux_params = {k: v if isinstance(v, NDArray)
+                          else NDArray(_np.asarray(v))
+                          for k, v in (aux_params or {}).items()}
+            for rep in self._pool.replicas:
+                rep.swap_params(arg_params, aux_params)
+            self._model_version = version
+            self._reloads += 1
+            self._r_reloads.inc()
+            return version
+
+    # ------------------------------------------------------------------
     def stats(self):
         """Metrics snapshot: queue depth, admission/served counters, batch
         occupancy, latency percentiles, throughput, per-replica detail
         (glossary in docs/SERVING.md)."""
-        return self._stats.snapshot(queue_depth=len(self._queue),
+        snap = self._stats.snapshot(queue_depth=len(self._queue),
                                     replicas=self._pool.snapshot())
+        snap["model_version"] = self._model_version
+        # per-instance count; the registry's serving_reloads series is
+        # process-global and shared across servers
+        snap["reloads"] = self._reloads
+        return snap
 
     def reset_stats(self):
         """Zero the metrics (e.g. after a warmup phase); the server must
@@ -503,6 +590,34 @@ class ModelServer:
                     self._reply(404, {"error": "unknown path %s" % self.path})
 
             def do_POST(self):
+                if self.path == "/reload":
+                    # admin endpoint: swap replicas to a newer checkpoint
+                    # ({"prefix": ..., "tag"|"epoch": optional})
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        try:
+                            doc = json.loads(self.rfile.read(n) or b"{}")
+                        except ValueError as e:
+                            self._reply(400, {"error": "invalid JSON: %s"
+                                              % e, "type": "bad_request"})
+                            return
+                        if not doc.get("prefix"):
+                            self._reply(400, {"error": "reload needs a "
+                                              "'prefix'",
+                                              "type": "bad_request"})
+                            return
+                        version = server.reload(doc["prefix"],
+                                                tag=doc.get("tag"),
+                                                epoch=doc.get("epoch"))
+                        self._reply(200, {"status": "ok",
+                                          "model_version": version})
+                    except MXNetError as e:
+                        self._reply(409, {"error": str(e),
+                                          "type": "reload_failed"})
+                    except Exception as e:   # noqa: BLE001
+                        self._reply(500, {"error": str(e),
+                                          "type": "internal"})
+                    return
                 if self.path != "/predict":
                     self._reply(404, {"error": "unknown path %s" % self.path})
                     return

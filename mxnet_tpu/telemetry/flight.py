@@ -61,6 +61,14 @@ class FlightRecorder:
         if self._steps % self._every == 0:
             self.sample(step=self._steps)
 
+    def note(self, event, **extra):
+        """Record a discrete event (checkpoint commit, restore, ...) as
+        a ring sample — only when the recorder is armed, so un-armed
+        processes pay one attribute check."""
+        if not self._every:
+            return
+        self.sample(step=self._steps, event=event, **extra)
+
     def records(self):
         with self._lock:
             return list(self._ring)

@@ -1,0 +1,520 @@
+"""mx.checkpoint — fault-tolerant async checkpointing (docs/CHECKPOINT.md).
+
+Pins the subsystem's contracts: full-training-state capture at a fit
+step boundary (params + updater-keyed optimizer state + 2-bit
+error-feedback residuals + RNG + lr position), crash-safe commits
+(tmp+fsync+rename, manifest-last) with checksum-validated
+newest-intact fallback, resume PARITY — a fused or eager 2-bit run
+resumed from a checkpoint matches the uninterrupted run bit-for-bit —
+the cross-config optimizer-state interchange fix, keep-N rotation,
+retry-with-backoff, the fit-loop hook's zero-retrace guarantee, the
+SIGTERM emergency save, the async do_checkpoint/module_checkpoint
+routing, and mx.serving's hot reload from a checkpoint manifest.
+"""
+import json
+import os
+import signal
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, checkpoint, telemetry
+from mxnet_tpu.checkpoint import manifest as mf
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    return sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4,
+                                                name="fc2"), name="softmax")
+
+
+def _batch(seed=0, n=8, d=10):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    y = rng.randint(0, 4, (n,)).astype(np.float32)
+    return mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+
+
+def _make_mod(fused=True, compress=0.5, kvstore="device", momentum=0.9):
+    m = mx.Module(_mlp(), context=mx.cpu(),
+                  compression_params={"type": "2bit", "threshold": compress}
+                  if compress else None)
+    m._fused_fit_enabled = fused
+    m.bind(data_shapes=[("data", (8, 10))],
+           label_shapes=[("softmax_label", (8,))])
+    m.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    kv = mx.kvstore.create(kvstore) if kvstore else None
+    m.init_optimizer(kvstore=kv, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": momentum})
+    return m
+
+
+def _params_np(mod):
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+def _run(mod, steps, batch):
+    for _ in range(steps):
+        mod.fit_step(batch)
+
+
+# ----------------------------------------------------------------------
+# capture / manifest / legacy format
+# ----------------------------------------------------------------------
+def test_full_state_roundtrip_and_legacy_format(tmp_path):
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mod = _make_mod()
+    _run(mod, 3, batch)
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       install_preemption=False)
+    man = mgr.save(epoch=0, step=3, block=True)
+    # manifest: the commit point, with file + per-tensor checksums
+    assert man["tag"] == 3
+    assert {"params", "states", "extra", "symbol"} <= set(man["files"])
+    assert man["tensors"]["arg:fc1_weight"]["dtype"] == "float32"
+    assert man["total_bytes"] > 0
+    # the params file IS the legacy format — Module.load reads it
+    loaded = mx.Module.load(prefix, 3, load_optimizer_states=True,
+                            context=mx.cpu())
+    assert loaded is not None
+    s2, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    ref = _params_np(mod)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], args[k].asnumpy())
+    # checkpoint.load verifies per-tensor checksums and returns meta
+    _sym2, args2, _auxs2, man2 = checkpoint.load(prefix)
+    assert man2["tag"] == 3 and man2["step"] == 3
+    mgr.close()
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_resume_parity_2bit(tmp_path, fused):
+    """The acceptance witness: a 2-bit error-feedback run checkpointed
+    mid-training and resumed on the same path matches the uninterrupted
+    run BIT-FOR-BIT (params are dense-SGD momentum), with nonzero
+    residuals restored."""
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mx.random.seed(0)
+    mod = _make_mod(fused=fused)
+    _run(mod, 3, batch)
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       install_preemption=False)
+    mgr.save(epoch=0, step=3, block=True)
+    mgr.close()
+    _run(mod, 3, batch)
+    ref = _params_np(mod)
+
+    mx.random.seed(99)              # restore must rewind the RNG chain
+    res_mod = _make_mod(fused=fused)
+    man = checkpoint.restore(res_mod, prefix)
+    assert man["step"] == 3
+    # residuals restored, and nonzero — the uncompressed tail of 3 real
+    # steps of error feedback (losing them silently biases training)
+    residuals = res_mod._kvstore._compression_residuals
+    assert residuals
+    assert any(float(np.abs(v.asnumpy()).sum()) > 0
+               for v in residuals.values())
+    _run(res_mod, 3, batch)
+    got = _params_np(res_mod)
+    assert sorted(got) == sorted(ref)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k]), k
+
+
+def test_async_snapshot_immune_to_continued_training(tmp_path,
+                                                     monkeypatch):
+    """The snapshot handed to the writer must be a deep host copy: the
+    fused step DONATES its residual buffers, so training steps that run
+    while the writer is still serializing would otherwise corrupt the
+    checkpoint through aliasing views. The writer is stalled to force
+    the overlap."""
+    import pickle
+    import time as _time
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mod = _make_mod()                       # fused + 2-bit
+    _run(mod, 3, batch)
+    ref_res = {k: np.array(v, copy=True)
+               for k, v in mod._fused_fit._residuals.items()}
+    ref_params = _params_np(mod)
+
+    real_write = checkpoint.snapshot.write_checkpoint
+
+    def slow_write(state, prefix_, tag):
+        _time.sleep(0.3)                    # steps below run first
+        return real_write(state, prefix_, tag)
+
+    monkeypatch.setattr(checkpoint.snapshot, "write_checkpoint",
+                        slow_write)
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       install_preemption=False)
+    mgr.save(step=3)                        # async
+    _run(mod, 4, batch)                     # donate/reuse the buffers
+    assert mgr.drain(60)
+    mgr.close()
+
+    with open(prefix + "-0003.extra", "rb") as f:
+        extra = pickle.load(f)
+    assert extra["residuals"]
+    for (key, dev), arr in extra["residuals"].items():
+        np.testing.assert_array_equal(arr, ref_res[key]), key
+    _sym3, args, _auxs, _man = checkpoint.load(prefix, 3)
+    for k in ref_params:
+        np.testing.assert_array_equal(ref_params[k], args[k].asnumpy())
+
+
+def test_dense_resume_parity_cross_path(tmp_path):
+    """Dense SGD (no compression): a checkpoint taken on the FUSED path
+    resumes on the EAGER path (and vice versa) — cross-program grads
+    differ by FMA-contraction ulps only (see tests/test_fused_fit.py),
+    so the resumed curve tracks within rtol."""
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    for save_fused in (True, False):
+        mod = _make_mod(fused=save_fused, compress=None)
+        _run(mod, 3, batch)
+        mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                           install_preemption=False)
+        mgr.save(step=3, block=True)
+        mgr.close()
+        _run(mod, 3, batch)
+        ref = _params_np(mod)
+        other = _make_mod(fused=not save_fused, compress=None)
+        checkpoint.restore(other, prefix)
+        _run(other, 3, batch)
+        got = _params_np(other)
+        for k in ref:
+            np.testing.assert_allclose(ref[k], got[k], rtol=2e-5,
+                                       atol=1e-6)
+
+
+def test_cross_config_optimizer_state_interchange(tmp_path):
+    """The PR-satellite bugfix: save_checkpoint(save_optimizer_states=
+    True) emits canonically name-keyed states, so a checkpoint taken
+    under one kvstore config (name-keyed updater) resumes bit-for-bit
+    under the other (int-keyed local updater) instead of silently
+    dropping all momentum."""
+    batch = _batch()
+    kvs = {"device": "device", "none": None}
+    for save_kv in kvs.values():
+        for resume_kv in kvs.values():
+            prefix = str(tmp_path / "x")
+            mod = _make_mod(compress=None, kvstore=save_kv)
+            _run(mod, 3, batch)
+            mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+            _run(mod, 3, batch)
+            ref = _params_np(mod)
+            res = mx.Module.load(prefix, 1, load_optimizer_states=True,
+                                 context=mx.cpu())
+            res.bind(data_shapes=[("data", (8, 10))],
+                     label_shapes=[("softmax_label", (8,))])
+            res.init_optimizer(
+                kvstore=mx.kvstore.create(resume_kv) if resume_kv else None,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+            _run(res, 3, batch)
+            got = _params_np(res)
+            for k in ref:
+                np.testing.assert_array_equal(ref[k], got[k]), \
+                    (save_kv, resume_kv, k)
+
+
+def test_rng_and_lr_schedule_restored(tmp_path):
+    """Scheduler position and the RNG chain survive a resume: the
+    restored optimizer continues the decayed lr, and next_seed()
+    continues the checkpointed host stream."""
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mod = _make_mod(compress=None)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                            base_lr=0.1)
+    mod._optimizer.lr_scheduler = sched
+    mx.random.seed(7)
+    _run(mod, 4, batch)
+    expected_seeds = [int(mx.random.next_seed()) for _ in range(3)]
+    # re-seed to the pre-draw point: capture happens BEFORE the draws
+    mx.random.seed(7)
+    _run_lr = mod._optimizer._get_lr(next(iter(
+        mod._live_updater().states)))
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       install_preemption=False)
+    mgr.save(step=4, block=True)
+    mgr.close()
+
+    mx.random.seed(12345)
+    res = _make_mod(compress=None)
+    checkpoint.restore(res, prefix)
+    opt = res._optimizer
+    assert opt.lr_scheduler is not None
+    assert opt.num_update == mod._optimizer.num_update
+    k0 = next(iter(res._live_updater().states))
+    assert opt._get_lr(k0) == _run_lr
+    got_seeds = [int(mx.random.next_seed()) for _ in range(3)]
+    assert got_seeds == expected_seeds
+
+
+# ----------------------------------------------------------------------
+# crash safety / fallback / rotation / retry
+# ----------------------------------------------------------------------
+def test_latest_falls_back_past_corruption(tmp_path):
+    """A truncated, bit-flipped, or torn-manifest newest checkpoint
+    never aborts resume: latest() checksum-validates and falls back to
+    the newest intact one."""
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mod = _make_mod()
+    mgr = checkpoint.CheckpointManager(prefix, module=mod, keep=0,
+                                       install_preemption=False)
+    for step in (1, 2, 3, 4):
+        _run(mod, 1, batch)
+        mgr.save(step=step, block=True)
+    mgr.close()
+    # tag 4: truncate mid-file (the crash-mid-write shape)
+    with open(prefix + "-0004.params", "r+b") as f:
+        f.truncate(64)
+    # tag 3: flip one byte, size unchanged (bit rot)
+    with open(prefix + "-0003.params", "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    # tag 2: torn manifest (crashed before the commit rename finished)
+    with open(mf.manifest_path(prefix, 2), "w") as f:
+        f.write('{"format": 1, "files": {"par')
+    man = checkpoint.latest(prefix)
+    assert man is not None and man["tag"] == 1
+    res = _make_mod()
+    assert checkpoint.restore(res, prefix)["tag"] == 1
+    # an explicitly-requested corrupt tag is an error, not silence
+    with pytest.raises(IOError):
+        checkpoint.load(prefix, tag=4)
+
+
+def test_keep_n_rotation(tmp_path):
+    prefix = str(tmp_path / "ck")
+    batch = _batch()
+    mod = _make_mod(compress=None)
+    mgr = checkpoint.CheckpointManager(prefix, module=mod, keep=2,
+                                       install_preemption=False)
+    for step in (1, 2, 3, 4, 5):
+        _run(mod, 1, batch)
+        mgr.save(step=step, block=True)
+    mgr.close()
+    assert mf.list_tags(prefix) == [4, 5]
+    leftovers = [f for f in os.listdir(str(tmp_path))
+                 if "-0001." in f or "-0002." in f or "-0003." in f]
+    assert leftovers == []
+    assert os.path.exists(prefix + "-symbol.json")   # shared, kept
+
+
+def test_async_write_retry_with_backoff(tmp_path, monkeypatch):
+    """Transient IO errors (flaky NFS rename) retry with backoff and
+    still commit; the failure counter stays untouched."""
+    prefix = str(tmp_path / "rt")
+    failures0 = telemetry.REGISTRY.get("checkpoint_failures").value
+    orig = os.replace
+    flaked = []
+
+    def flaky(src, dst):
+        if not flaked and dst.endswith(".params"):
+            flaked.append(dst)
+            raise OSError("transient blip")
+        return orig(src, dst)
+
+    monkeypatch.setattr(os, "replace", flaky)
+    man = checkpoint.save(prefix, 1, {"w": np.ones(3, np.float32)}, {},
+                          retries=3, backoff=0.001)
+    assert man["tag"] == 1 and flaked
+    assert telemetry.REGISTRY.get("checkpoint_failures").value == failures0
+    assert checkpoint.latest(prefix)["tag"] == 1
+
+    def always_down(src, dst):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(os, "replace", always_down)
+    with pytest.raises(OSError):
+        checkpoint.save(prefix, 2, {"w": np.ones(3, np.float32)}, {},
+                        retries=1, backoff=0.001)
+    assert telemetry.REGISTRY.get("checkpoint_failures").value \
+        == failures0 + 1
+
+
+# ----------------------------------------------------------------------
+# fit-loop integration
+# ----------------------------------------------------------------------
+def test_fit_checkpoint_every_async_zero_retraces(tmp_path):
+    """fit(checkpoint_every=N): checkpoints commit from the loop on the
+    background writer, the training thread's block time is recorded,
+    and the fused-step / bucketed-kvstore zero-retrace guarantees are
+    untouched by checkpointing (the snapshot never enters traced
+    code)."""
+    prefix = str(tmp_path / "fit")
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint_every=3, checkpoint_prefix=prefix)
+    assert mod._fused_fit is not None
+    saves0 = telemetry.REGISTRY.get("checkpoint_saves").value
+    blocks0 = telemetry.REGISTRY.get("checkpoint_block_ms").count
+    r_fit0 = telemetry.REGISTRY.get("fit_step_retraces").value
+    r_kv0 = telemetry.REGISTRY.get("kvstore_bucket_retraces").value
+    it.reset()
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint_every=3, checkpoint_prefix=prefix,
+            force_init=False)
+    # warm programs + checkpointing on => still zero retraces
+    assert telemetry.REGISTRY.get("fit_step_retraces").value == r_fit0
+    assert telemetry.REGISTRY.get("kvstore_bucket_retraces").value == r_kv0
+    assert telemetry.REGISTRY.get("checkpoint_saves").value > saves0
+    assert telemetry.REGISTRY.get("checkpoint_block_ms").count > blocks0
+    man = checkpoint.latest(prefix)
+    assert man is not None and man["files"].get("states") is not None
+    # writer drained at fit exit: queue gauge is back to zero
+    assert telemetry.REGISTRY.get("checkpoint_queue_depth").value == 0
+
+
+def test_sigterm_triggers_emergency_save(tmp_path):
+    """Preemption: SIGTERM mid-epoch produces a synchronous emergency
+    checkpoint at the next step boundary, fit returns gracefully, and
+    the original signal disposition is restored."""
+    prefix = str(tmp_path / "term")
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    prev = signal.getsignal(signal.SIGTERM)
+    sent = []
+
+    def bomb(param):
+        if param.nbatch == 2 and not sent:
+            sent.append(1)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    mod.fit(it, num_epoch=50, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            batch_end_callback=bomb,
+            checkpoint_every=1000, checkpoint_prefix=prefix)
+    assert sent, "callback never fired"
+    man = checkpoint.latest(prefix)
+    assert man is not None            # the emergency save, nothing else
+    assert man["step"] is not None
+    assert signal.getsignal(signal.SIGTERM) is prev
+    # the emergency checkpoint is a complete, resumable state
+    res = _make_mod(compress=None)
+    checkpoint.restore(res, prefix)
+    _run(res, 1, _batch())
+
+
+# ----------------------------------------------------------------------
+# callback routing (opt-in async, default legacy)
+# ----------------------------------------------------------------------
+def test_do_checkpoint_async_keeps_epoch_contract(tmp_path):
+    prefix = str(tmp_path / "cb")
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 10).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    cb = mx.callback.do_checkpoint(prefix, async_write=True)
+    mod.fit(it, num_epoch=2, optimizer="sgd", epoch_end_callback=cb)
+    assert cb.drain(30)
+    # epoch-numbered filename contract + legacy loadability
+    s, args, auxs = mx.model.load_checkpoint(prefix, 2)
+    assert "fc1_weight" in args
+    assert checkpoint.latest(prefix)["tag"] == 2
+
+
+def test_module_checkpoint_async_full_state(tmp_path):
+    prefix = str(tmp_path / "mc")
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 10).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    cb = mx.callback.module_checkpoint(mod, prefix,
+                                       save_optimizer_states=True,
+                                       async_write=True)
+    mod.fit(it, num_epoch=1, optimizer="sgd", epoch_end_callback=cb)
+    assert cb.drain(30)
+    man = checkpoint.latest(prefix)
+    assert man is not None and man["tag"] == 1
+    assert "states" in man["files"]        # full state, not params-only
+    assert os.path.exists(prefix + "-0001.states")
+    loaded = mx.Module.load(prefix, 1, load_optimizer_states=True,
+                            context=mx.cpu())
+    assert loaded is not None
+
+
+# ----------------------------------------------------------------------
+# serving hot reload
+# ----------------------------------------------------------------------
+def test_serving_hot_reload_from_manifest(tmp_path):
+    """ModelServer.reload swaps every replica to the newest intact
+    checkpoint without dropping queued requests; the /reload admin
+    endpoint drives the same path."""
+    from mxnet_tpu.serving import ModelServer
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    rng = np.random.RandomState(0)
+    w0 = {"fc_weight": rng.normal(0, 1, (3, 4)).astype(np.float32),
+          "fc_bias": np.zeros(3, np.float32)}
+    w1 = {"fc_weight": w0["fc_weight"] * 2.0,
+          "fc_bias": np.ones(3, np.float32)}
+    prefix = str(tmp_path / "m")
+    checkpoint.save(prefix, 7, w1, {}, symbol=net)
+
+    srv = ModelServer(net, w0, {}, {"data": (4,)}, num_replicas=2,
+                      max_batch_size=4, max_latency_ms=1.0)
+    try:
+        x = np.ones(4, np.float32)
+        np.testing.assert_allclose(srv.predict({"data": x})[0],
+                                   w0["fc_weight"].dot(x), rtol=1e-5)
+        stop, errs = [], []
+
+        def traffic():
+            while not stop:
+                try:
+                    srv.submit({"data": x}).result(timeout=30)
+                except Exception as e:     # noqa: BLE001
+                    errs.append(e)
+
+        th = threading.Thread(target=traffic)
+        th.start()
+        version = srv.reload(prefix)       # tag=None -> newest intact
+        stop.append(1)
+        th.join()
+        assert version == 7 and not errs   # no request dropped
+        np.testing.assert_allclose(
+            srv.predict({"data": x})[0],
+            w1["fc_weight"].dot(x) + w1["fc_bias"], rtol=1e-5)
+        st = srv.stats()
+        assert st["model_version"] == 7 and st["reloads"] == 1
+
+        host, port = srv.start_http(port=0)
+        req = urllib.request.Request(
+            "http://%s:%d/reload" % (host, port),
+            data=json.dumps({"prefix": prefix}).encode())
+        doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert doc == {"status": "ok", "model_version": 7}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                "http://%s:%d/reload" % (host, port),
+                data=b'{"prefix": "/nonexistent/x"}'), timeout=30)
+        assert ei.value.code == 409
+    finally:
+        srv.stop()
