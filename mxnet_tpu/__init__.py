@@ -91,6 +91,7 @@ from . import rtc
 from . import config
 from . import predictor
 from . import serving
+from . import decode
 from . import profiler
 from . import telemetry
 from . import checkpoint

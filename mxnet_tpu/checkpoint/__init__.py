@@ -53,9 +53,32 @@ from .writer import AsyncCheckpointWriter, write_with_retry
 from .preemption import PreemptionHandler
 
 __all__ = ["CheckpointManager", "AsyncCheckpointWriter",
-           "PreemptionHandler", "latest", "load", "restore", "save",
+           "PreemptionHandler", "latest", "load", "resolve_params",
+           "restore", "save",
            "capture", "capture_params", "manifest", "snapshot",
            "preemption"]
+
+
+def resolve_params(prefix, tag=None, epoch=None, what="reload"):
+    """Resolve a checkpoint reference to ``(arg_params, aux_params,
+    version)``: ``epoch`` loads the legacy ``prefix-%04d.params`` file
+    directly, otherwise ``tag`` (or the newest checksum-intact
+    checkpoint) resolves through the manifest.  IO/corruption failures
+    raise ``MXNetError`` prefixed with ``what`` — the one resolution
+    path shared by the serving and decode hot-reload surfaces."""
+    from ..base import MXNetError
+    if epoch is not None:
+        from .. import model as _model
+        try:
+            arg_params, aux_params = _model.load_params(prefix, epoch)
+        except OSError as e:
+            raise MXNetError("%s: %s" % (what, e)) from e
+        return arg_params, aux_params, int(epoch)
+    try:
+        _sym, arg_params, aux_params, man = load(prefix, tag)
+    except OSError as e:
+        raise MXNetError("%s: %s" % (what, e)) from e
+    return arg_params, aux_params, int(man["tag"])
 
 
 def _env_int(name, default):

@@ -1,0 +1,38 @@
+"""mx.decode — generative serving: paged KV cache + continuous batching.
+
+The decode engine turns the framework's decoder-only transformer
+(``models/transformer.py``) into a *generative* serving workload —
+the capability mx.serving's independent-forward batching cannot
+express.  The shape is the canonical one (Orca OSDI '22 iteration-level
+scheduling; vLLM/PagedAttention SOSP '23 block-paged KV memory),
+adapted to this repo's compiled-executor discipline: one fixed-shape
+jitted decode step per iteration, zero steady-state retraces, all
+sequence raggedness carried in runtime arrays.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.decode import DecodeEngine
+
+    cfg = dict(num_classes=32000, num_layers=12, d_model=2048,
+               num_heads=16, seq_len=1024)          # the training config
+    eng = DecodeEngine(arg_params, cfg, capacity=8,
+                       block_size=16, num_blocks=256)
+    handle = eng.submit(prompt_ids, max_new_tokens=128, eos_id=2)
+    for tok in handle:                               # streamed
+        ...
+    eng.stats()                                      # occupancy, ttft, ...
+    eng.stop()
+
+HTTP streaming rides the existing serving stack: pass
+``ModelServer(..., decode_engine=eng)`` and ``POST /generate`` streams
+chunked JSON-lines (docs/DECODE.md, docs/SERVING.md).
+"""
+from .cache import CacheOOMError, PagedKVCache
+from .engine import DecodeEngine
+from .scheduler import (DeadlineExceededError, QueueFullError, Scheduler,
+                        Sequence, StreamHandle)
+
+__all__ = ["DecodeEngine", "PagedKVCache", "CacheOOMError", "Scheduler",
+           "Sequence", "StreamHandle", "DeadlineExceededError",
+           "QueueFullError"]

@@ -1,0 +1,153 @@
+"""Paged KV-cache: fixed-size device blocks + a host-side free list.
+
+The device side is dumb on purpose: per layer, one K and one V array of
+shape ``(num_blocks, block_size, num_heads, head_dim)`` bound into the
+decode/prefill executors, addressed entirely through runtime block
+tables (``ops.nn.paged_decode_attention``).  All *policy* — which
+sequence owns which blocks, when to grow, when to evict — lives here on
+the host, where it costs integer bookkeeping instead of device
+launches.  This is the PagedAttention split (vLLM, SOSP '23): block
+tables turn the cache into virtual memory, so ragged sequences share
+one fixed-shape compiled step and fragmentation is impossible by
+construction (any free block serves any sequence).
+
+Accounting plugs into the PR 4 HBM census: the cache arrays register as
+the ``kv_cache`` group of ``telemetry.memory_snapshot()``, and the
+``decode_cache_*`` gauges track the free list in real time
+(docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import weakref
+
+from ..base import MXNetError
+from ..telemetry import REGISTRY
+
+__all__ = ["CacheOOMError", "PagedKVCache"]
+
+BLOCKS_USED = REGISTRY.gauge(
+    "decode_cache_blocks_used", "KV-cache blocks currently allocated",
+    unit="blocks")
+BLOCKS_FREE = REGISTRY.gauge(
+    "decode_cache_blocks_free", "KV-cache blocks on the free list",
+    unit="blocks")
+CACHE_OCCUPANCY = REGISTRY.gauge(
+    "decode_cache_occupancy", "allocated fraction of the KV cache (0..1)",
+    unit="ratio")
+CACHE_BYTES = REGISTRY.gauge(
+    "decode_cache_bytes", "device bytes reserved for the paged KV cache",
+    unit="bytes")
+
+# every live allocator contributes to the ONE set of process-wide
+# gauges / census group — a second engine in the same process must add
+# to the accounting, not clobber the first's
+_LIVE = weakref.WeakSet()
+
+
+def _census_provider():
+    _refresh_bytes()          # collected engines stop counting here too
+    bufs = []
+    for cache in list(_LIVE):
+        bufs += [nd._data for nd in getattr(cache, "_arrays", ())]
+    return bufs
+
+
+def _refresh_bytes():
+    total = 0
+    for cache in list(_LIVE):
+        for nd in getattr(cache, "_arrays", ()):
+            try:
+                total += int(nd._data.nbytes)
+            except Exception:
+                pass
+    CACHE_BYTES.set(total)
+
+
+class CacheOOMError(MXNetError):
+    """The free list cannot satisfy an allocation (after any eviction
+    the caller was willing to do)."""
+
+
+class PagedKVCache:
+    """Free-list allocator over ``num_blocks`` cache blocks.
+
+    Pure host state; the engine owns the device arrays and registers
+    them via :meth:`attach_arrays`.  Allocation is LIFO (hot blocks
+    stay hot), a ``free()`` of a block not currently allocated raises —
+    a double free would let two sequences share a block and silently
+    corrupt each other's context.
+    """
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks <= 0 or block_size <= 0:
+            raise MXNetError("PagedKVCache needs positive num_blocks/"
+                             "block_size (got %s, %s)"
+                             % (num_blocks, block_size))
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._allocated = set()
+        _LIVE.add(self)
+        self._update_gauges()
+
+    # -- sizing --------------------------------------------------------
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` cache rows."""
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def used_count(self):
+        return len(self._allocated)
+
+    @property
+    def occupancy(self):
+        return len(self._allocated) / float(self.num_blocks)
+
+    # -- alloc/free ----------------------------------------------------
+    def alloc(self, n):
+        """Take ``n`` blocks off the free list (all-or-nothing)."""
+        n = int(n)
+        if n < 0:
+            raise MXNetError("alloc(%d): negative block count" % n)
+        if n > len(self._free):
+            raise CacheOOMError(
+                "KV cache exhausted: need %d blocks, %d free of %d"
+                % (n, len(self._free), self.num_blocks))
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        self._update_gauges()
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._allocated:
+                raise MXNetError(
+                    "free(%r): block not allocated (double free would "
+                    "alias two sequences onto one block)" % (b,))
+            self._allocated.discard(b)
+            self._free.append(b)
+        self._update_gauges()
+
+    def _update_gauges(self):
+        used = free = total = 0
+        for cache in list(_LIVE):
+            used += len(cache._allocated)
+            free += len(cache._free)
+            total += cache.num_blocks
+        BLOCKS_USED.set(used)
+        BLOCKS_FREE.set(free)
+        CACHE_OCCUPANCY.set(used / float(total) if total else 0.0)
+
+    # -- HBM census ----------------------------------------------------
+    def attach_arrays(self, ndarrays):
+        """Register the engine's cache NDArrays as the ``kv_cache``
+        group of the HBM census (weakly — a collected engine stops
+        contributing)."""
+        from ..telemetry import memory as _mem
+        self._arrays = list(ndarrays)
+        _refresh_bytes()
+        _mem.track_group("kv_cache", _census_provider)

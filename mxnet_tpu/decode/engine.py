@@ -1,0 +1,752 @@
+"""DecodeEngine: continuous-batching autoregressive generation.
+
+One engine owns (a) a paged KV cache (``cache.PagedKVCache`` + the
+per-layer device arrays), (b) ONE compiled decode step bound at a fixed
+slot capacity — ``models.transformer.get_decode_step_symbol`` — and
+(c) a power-of-two ladder of prefill executors
+(``get_prefill_symbol``), all sharing the training checkpoint's device
+parameters through ``simple_bind(shared_exec=...)`` (zero weight
+copies, zero conversions).
+
+Execution discipline (the PR 2/3 invariant, extended to serving):
+
+* every decode iteration is exactly ONE device launch — the compiled
+  step runs all slots, padded slots ride along masked (position -1);
+* sequence raggedness (positions, lengths, block tables) enters as
+  runtime arrays, so steady state NEVER retraces — witnessed by
+  ``decode_retraces``, which counts only retraces after each program's
+  first (expected) compile;
+* the only per-iteration host sync is reading the sampled token back
+  (that readback *is* the streamed response).
+
+Scheduling policy lives in ``scheduler.py``; this module is the device
+half: prefill/step dispatch, cache threading (each step's new cache
+arrays replace the bound inputs via ``NDArray._set_data`` — shared by
+every executor, so prefill and decode always see one coherent cache),
+sampling, and telemetry.
+"""
+from __future__ import annotations
+
+import collections as _collections
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..serving.batcher import (DeadlineExceededError, QueueFullError,
+                               ServerClosedError, percentile as _percentile)
+from ..telemetry import REGISTRY
+from .cache import CacheOOMError, PagedKVCache
+from .scheduler import Scheduler, Sequence
+
+__all__ = ["DecodeEngine"]
+
+QUEUE_DEPTH = REGISTRY.gauge(
+    "decode_queue_depth", "sequences waiting for a decode slot",
+    unit="sequences")
+ACTIVE_SEQS = REGISTRY.gauge(
+    "decode_active_sequences", "sequences occupying decode slots",
+    unit="sequences")
+ADMITTED = REGISTRY.counter(
+    "decode_admitted", "sequences accepted into the wait queue")
+COMPLETED = REGISTRY.counter(
+    "decode_completed", "sequences finished (eos or length)")
+FAILED = REGISTRY.counter(
+    "decode_failed", "sequences failed (cache OOM, engine stop, error)")
+EXPIRED = REGISTRY.counter(
+    "decode_expired", "sequences expired before finishing (deadline)")
+CANCELLED = REGISTRY.counter(
+    "decode_cancelled", "sequences cancelled by the client "
+    "(StreamHandle.cancel / dropped HTTP stream)")
+PREFILLS = REGISTRY.counter(
+    "decode_prefills", "prompt prefill dispatches (admissions + "
+    "preemption recomputes)")
+PREEMPTIONS = REGISTRY.counter(
+    "decode_preemptions", "sequences preempted-by-recompute on cache "
+    "pressure")
+STEPS = REGISTRY.counter(
+    "decode_steps", "decode iterations dispatched (one compiled launch "
+    "each)")
+TOKENS = REGISTRY.counter(
+    "decode_tokens", "tokens generated (prefill first-tokens included)")
+STEP_MS = REGISTRY.histogram(
+    "decode_step_ms", "wall time of one decode iteration (dispatch + "
+    "token readback + bookkeeping)", unit="ms")
+TTFT_MS = REGISTRY.histogram(
+    "decode_ttft_ms", "time to first token (submit -> first streamed "
+    "token, queue wait included)", unit="ms")
+RETRACES = REGISTRY.counter(
+    "decode_retraces", "decode/prefill program retraces AFTER each "
+    "program's first compile — pinned at zero by tests", vital=True)
+RELOADS = REGISTRY.counter(
+    "decode_reloads", "successful hot weight reloads into a live engine")
+
+
+def _prefill_ladder(buckets, max_len):
+    """Power-of-two padded-prompt ladder capped/completed at max_len."""
+    if buckets:
+        out = sorted({int(b) for b in buckets if 0 < int(b) <= max_len})
+    else:
+        out, b = [], 8
+        while b < max_len:
+            out.append(b)
+            b *= 2
+    if not out or out[-1] < max_len:
+        out.append(int(max_len))
+    return out
+
+
+class DecodeEngine:
+    """Generative serving engine for the decoder-only transformer
+    (module docstring; knobs in docs/DECODE.md).
+
+    Parameters
+    ----------
+    arg_params : training-checkpoint parameters (name -> NDArray/numpy)
+    model_config : the ``transformer.get_symbol`` kwargs this checkpoint
+        was trained with (num_classes, num_layers, d_model, num_heads,
+        ffn_dim, seq_len, ...) — ``seq_len`` doubles as the maximum
+        context length a sequence may reach.
+    capacity : fixed decode batch slots (the compiled step's batch dim)
+    block_size, num_blocks : KV-cache geometry (per layer, K and V each
+        are ``(num_blocks, block_size, H, D)``)
+    max_prefill_len : longest admissible prompt (default: seq_len - 1)
+    prefill_buckets : padded-prompt ladder (default: 8, 16, ... pow2)
+    admission : 'continuous' (default) or 'static' (run-to-completion —
+        the A/B baseline for bench --mode decode)
+    eos_id : default end-of-sequence token id (None = length-stop only)
+    """
+
+    def __init__(self, arg_params, model_config, capacity=8, block_size=16,
+                 num_blocks=64, max_prefill_len=None, prefill_buckets=None,
+                 ctx=None, eos_id=None, max_waiting=256,
+                 admission="continuous", default_max_new_tokens=64,
+                 warmup=False, start=True):
+        from ..context import current_context
+        from ..models import transformer
+        from ..ndarray.ndarray import NDArray
+
+        self._cfg = dict(model_config)
+        self._cfg.pop("dropout", None)          # inference graphs
+        self._ctx = ctx if ctx is not None else current_context()
+        self.capacity = int(capacity)
+        self._eos = eos_id
+        self._default_max_new = int(default_max_new_tokens)
+        self._max_context = int(self._cfg.get("seq_len", 1024))
+        self._num_layers = int(self._cfg.get("num_layers", 12))
+        bs = int(block_size)
+        self._table_width = -(-self._max_context // bs)
+        self._max_prefill = int(max_prefill_len or self._max_context - 1)
+        if self._max_prefill >= self._max_context:
+            raise MXNetError("max_prefill_len %d leaves no room to "
+                             "generate within seq_len=%d"
+                             % (self._max_prefill, self._max_context))
+        # max_prefill_len bounds USER prompts; the ladder itself runs to
+        # the FULL context limit: a live sequence holds pos+1 tokens, so
+        # one preempted at pos == seq_len-1 recomputes from a seq_len-
+        # token prompt (the top bucket compiles lazily, only if a
+        # preemption actually reaches it)
+        self._buckets = _prefill_ladder(prefill_buckets, self._max_context)
+
+        self.cache = PagedKVCache(num_blocks, bs)
+        self._sched = Scheduler(self.capacity, self.cache,
+                                max_waiting=max_waiting,
+                                admission=admission)
+
+        # --- bind the decode step at fixed capacity ------------------
+        dsym = transformer.get_decode_step_symbol(
+            block_size=bs, num_blocks=int(num_blocks), **self._cfg)
+        self._exe = dsym.simple_bind(
+            ctx=self._ctx, grad_req="null", data=(self.capacity, 1),
+            positions=(self.capacity, 1),
+            block_table=(self.capacity, self._table_width))
+        self._cache_names = []
+        for i in range(self._num_layers):
+            self._cache_names += ["layer%d_k_cache" % i,
+                                  "layer%d_v_cache" % i]
+        self._cache_arrs = [self._exe.arg_dict[n] for n in self._cache_names]
+        self.cache.attach_arrays(self._cache_arrs)
+        self._inputs = ("data", "positions", "block_table", "prompt_len")
+        self._weight_names = [n for n in self._exe.arg_dict
+                              if n not in self._inputs
+                              and n not in self._cache_names]
+        self._check_params(arg_params)
+        self._exe.copy_params_from(
+            {k: v if isinstance(v, NDArray) else NDArray(_np.asarray(v))
+             for k, v in arg_params.items() if k in self._weight_names}, {},
+            allow_extra_params=True)
+
+        # --- prefill ladder, params + caches shared ------------------
+        self._prefill_exes = {}
+        self._prefill_sym = lambda S: transformer.get_prefill_symbol(
+            prefill_len=S, block_size=bs, num_blocks=int(num_blocks),
+            **self._cfg)
+
+        # accounting (instance state; registry series are process-wide)
+        self._warm = set()
+        self._n_steps = 0
+        self._n_prefills = 0
+        self._n_step_dispatches = 0
+        self._n_prefill_dispatches = 0
+        self._occ_sum = 0
+        self._cache_occ_sum = 0.0
+        self._steady_retraces = 0
+        self._n_tokens = 0
+        self._n_completed = 0
+        self._n_failed = 0
+        self._n_expired = 0
+        self._n_preemptions = 0
+        self._n_admitted = 0
+        self._n_cancelled = 0
+        # last-4096 window only: stats() p99 never reads further back,
+        # and a long-lived server must not accumulate one float/request
+        self._ttfts = _collections.deque(maxlen=4096)
+        self._rid = 0
+        self._model_version = None
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._mid_admission = 0
+        self._step_lock = threading.Lock()   # excludes step vs reload
+        self._closing = False
+        self._abort = False
+        self._thread = None
+        if warmup:
+            self.warmup()
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def _check_params(self, arg_params):
+        missing = [n for n in self._weight_names if n not in arg_params]
+        if missing:
+            raise MXNetError("decode: params missing for %s"
+                             % sorted(missing))
+        bad = []
+        for name in self._weight_names:
+            v = arg_params[name]
+            shape = getattr(v, "shape", None) or _np.shape(v)
+            if tuple(shape) != self._exe.arg_dict[name].shape:
+                bad.append(name)
+        if bad:
+            raise MXNetError("decode: param shapes do not match the bound "
+                             "model for %s (cache layout is preserved only "
+                             "across same-architecture reloads)"
+                             % sorted(bad))
+
+    def _prefill_exe(self, bucket):
+        exe = self._prefill_exes.get(bucket)
+        if exe is None:
+            psym = self._prefill_sym(bucket)
+            exe = psym.simple_bind(
+                ctx=self._ctx, grad_req="null", shared_exec=self._exe,
+                data=(1, bucket), prompt_len=(1,),
+                block_table=(1, self._table_width))
+            self._prefill_exes[bucket] = exe
+        return exe
+
+    def _bucket_for(self, n):
+        for b in self._buckets:
+            if b >= n:
+                return b
+        raise MXNetError("prompt of %d tokens exceeds max_prefill_len=%d"
+                         % (n, self._max_prefill))
+
+    # ------------------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="mx-decode-engine", daemon=True)
+            self._thread.start()
+
+    def warmup(self):
+        """Compile the decode step and every prefill bucket up front
+        (no allocator state is touched: the dummy prefill writes zero
+        rows and the dummy step runs all-slots-inactive)."""
+        zeros_tbl = _np.zeros((1, self._table_width), _np.float32)
+        for b in self._buckets:
+            exe = self._prefill_exe(b)
+            with self._step_lock:
+                outs = exe.forward(
+                    is_train=False, data=_np.zeros((1, b), _np.float32),
+                    prompt_len=_np.zeros((1,), _np.float32),
+                    block_table=zeros_tbl)
+                outs[1].asnumpy()
+            self._warm.add(("prefill", b))
+        with self._step_lock:
+            outs = self._exe.forward(
+                is_train=False,
+                data=_np.zeros((self.capacity, 1), _np.float32),
+                positions=_np.full((self.capacity, 1), -1.0, _np.float32),
+                block_table=_np.zeros((self.capacity, self._table_width),
+                                      _np.float32))
+            outs[1].asnumpy()
+        self._warm.add("decode")
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def submit(self, tokens, max_new_tokens=None, eos_id="default",
+               timeout_ms=None, temperature=0.0, seed=None, sampler=None,
+               collect_logits=False):
+        """Queue one generation; returns a :class:`StreamHandle`
+        (iterate it for streamed tokens, or ``.result()`` for the full
+        output).  Raises ``QueueFullError`` on backpressure and
+        ``MXNetError`` for an inadmissible prompt."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise MXNetError("decode: empty prompt")
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            raise MXNetError("decode: max_new_tokens must be >= 1 "
+                             "(got %s)" % (max_new_tokens,))
+        if len(tokens) > self._max_prefill:
+            raise MXNetError("decode: prompt of %d tokens exceeds "
+                             "max_prefill_len=%d"
+                             % (len(tokens), self._max_prefill))
+        if self.cache.blocks_for(len(tokens)) > self.cache.num_blocks:
+            raise MXNetError("decode: prompt needs %d cache blocks, the "
+                             "cache only has %d"
+                             % (self.cache.blocks_for(len(tokens)),
+                                self.cache.num_blocks))
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        with self._cv:
+            if self._closing:
+                raise ServerClosedError("decode engine is stopped")
+            self._rid += 1
+            seq = Sequence(
+                self._rid, tokens,
+                max_new_tokens if max_new_tokens is not None
+                else self._default_max_new,
+                eos_id=self._eos if eos_id == "default" else eos_id,
+                deadline=deadline, temperature=temperature, seed=seed,
+                sampler=sampler, collect_logits=collect_logits)
+            self._sched.enqueue(seq)          # may raise QueueFullError
+            self._n_admitted += 1
+            ADMITTED.inc()
+            QUEUE_DEPTH.set(len(self._sched.waiting))
+            self._cv.notify_all()
+        return seq.handle
+
+    def generate(self, tokens, timeout=None, **kwargs):
+        """Synchronous convenience: submit + wait; returns the
+        generated token list."""
+        return self.submit(tokens, **kwargs).result(timeout)
+
+    # ------------------------------------------------------------------
+    # engine thread
+    # ------------------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while (not self._closing
+                       and not self._sched.waiting
+                       and not self._sched.has_active()):
+                    self._cv.wait(0.1)
+                abort = self._abort
+                drained = (self._closing and not self._sched.waiting
+                           and not self._sched.has_active())
+            # _fail_everything re-acquires _cv (a plain Lock), so it
+            # must run OUTSIDE the monitor or abort deadlocks
+            if abort:
+                self._fail_everything(
+                    ServerClosedError("decode engine stopped"))
+                return
+            if drained:
+                return
+            try:
+                worked = self._tick()
+            except Exception as exc:   # noqa: BLE001 — engine must survive
+                self._fail_everything(exc)
+                continue
+            if not worked:
+                time.sleep(0.002)      # blocked on cache; don't spin hot
+
+    def _fail_everything(self, exc):
+        with self._cv:
+            seqs = list(self._sched.waiting)
+            self._sched.waiting.clear()
+        seqs += [s for _, s in self._sched.active()]
+        for seq in seqs:
+            self._finish(seq, error=exc)
+
+    def _tick(self):
+        """One scheduler iteration; returns False when nothing ran."""
+        now = time.monotonic()
+        with self._cv:
+            expired = self._sched.take_expired_waiting(now)
+            cancelled = [s for s in self._sched.waiting
+                         if s.handle.cancelled()]
+            for s in cancelled:
+                self._sched.waiting.remove(s)
+            QUEUE_DEPTH.set(len(self._sched.waiting))
+        for seq in expired:
+            self._finish(seq, error=DeadlineExceededError(
+                "request %d expired before a decode slot freed" % seq.rid))
+        for seq in cancelled:
+            self._finish(seq, reason="cancelled")
+        for _, seq in self._sched.active():
+            if seq.handle.cancelled():
+                self._finish(seq, reason="cancelled")
+            elif seq.expired(now):
+                self._finish(seq, error=DeadlineExceededError(
+                    "request %d deadline expired mid-generation" % seq.rid))
+        progressed = False
+        batch_open = not self._sched.has_active()
+        while True:
+            with self._cv:
+                if not self._sched.may_admit(batch_open):
+                    break
+                seq = self._sched.waiting[0]
+                need = self.cache.blocks_for(len(seq.tokens))
+                if need > self.cache.free_count:
+                    break             # FIFO: wait for blocks, no bypass
+                self._sched.waiting.popleft()
+                # visible to drain(): the sequence is in neither waiting
+                # nor slots until place(), and a cold prefill bucket can
+                # compile for seconds in that window
+                self._mid_admission += 1
+                QUEUE_DEPTH.set(len(self._sched.waiting))
+            slot = self._sched.free_slot()
+            try:
+                self._prefill(seq, slot)
+                progressed = True
+            except Exception as exc:   # noqa: BLE001 — the sequence is
+                # already off the wait queue and may not be placed yet,
+                # so _fail_everything would never see it: ANY failure
+                # here (device/jax errors included) must settle its
+                # handle and return its blocks, not just MXNetError
+                self._finish(seq, error=exc)
+            finally:
+                with self._cv:
+                    self._mid_admission -= 1
+        # grow every running sequence's block table BEFORE the step —
+        # the step writes cache position seq.pos, and a missing table
+        # entry would default to block 0 and corrupt whoever owns it.
+        # Growth may preempt (youngest first), so re-snapshot after.
+        for _, seq in self._sched.active():
+            if seq.slot is None:      # preempted by an earlier growth
+                continue
+            try:
+                self._ensure_blocks(seq, seq.pos // self.cache.block_size)
+            except CacheOOMError as exc:
+                self._finish(seq, error=exc)
+        active = self._sched.active()
+        ACTIVE_SEQS.set(len(active))
+        if active:
+            self._step(active)
+            progressed = True
+        return progressed
+
+    # ------------------------------------------------------------------
+    def _ensure_blocks(self, seq, block_idx):
+        """Make sure table entry ``block_idx`` exists, preempting the
+        youngest other sequence on cache pressure."""
+        while block_idx >= len(seq.blocks):
+            try:
+                seq.blocks += self.cache.alloc(1)
+            except CacheOOMError:
+                victim = self._sched.pick_victim(exclude=(seq,))
+                if victim is None:
+                    raise
+                self._preempt(victim)
+
+    def _preempt(self, victim):
+        with self._cv:
+            self._sched.preempt(victim)
+            QUEUE_DEPTH.set(len(self._sched.waiting))
+        self._n_preemptions += 1
+        PREEMPTIONS.inc()
+
+    def _commit_caches(self, outs, base):
+        for j, nd in enumerate(self._cache_arrs):
+            nd._set_data(outs[base + j]._data)
+
+    def _dispatch(self, exe, warm_key, **feeds):
+        """Forward with retrace/dispatch accounting: the first launch of
+        each program is the expected compile; anything after bumps the
+        steady-state witness ``decode_retraces``.  Both counts are read
+        from the executor's PER-THREAD tallies (jax traces and launches
+        on the dispatching thread — this one), so another thread
+        dispatching or compiling concurrently (a serving replica under
+        mixed /predict traffic) can never inflate the decode
+        witnesses."""
+        from ..executor import _DISPATCH_TALLY, _SITE
+        r0 = _SITE._tally.count
+        d0 = _DISPATCH_TALLY.count
+        outs = exe.forward(is_train=False, **feeds)
+        dd = _DISPATCH_TALLY.count - d0
+        rd = _SITE._tally.count - r0
+        if warm_key in self._warm:
+            if rd:
+                self._steady_retraces += rd
+                RETRACES.inc(rd)
+        else:
+            self._warm.add(warm_key)
+        return outs, dd
+
+    def _prefill(self, seq, slot):
+        P = len(seq.tokens)
+        bucket = self._bucket_for(P)
+        if not seq.blocks:
+            seq.blocks = self.cache.alloc(self.cache.blocks_for(P))
+        data = _np.zeros((1, bucket), _np.float32)
+        data[0, :P] = seq.tokens
+        table = _np.zeros((1, self._table_width), _np.float32)
+        table[0, :len(seq.blocks)] = seq.blocks
+        exe = self._prefill_exe(bucket)
+        with self._step_lock:
+            outs, dd = self._dispatch(
+                exe, ("prefill", bucket), data=data,
+                prompt_len=_np.asarray([float(P)], _np.float32),
+                block_table=table)
+            self._commit_caches(outs, base=2)
+        self._n_prefill_dispatches += dd
+        self._n_prefills += 1
+        PREFILLS.inc()
+        seq.pos = P
+        with self._cv:
+            self._sched.place(seq, slot)
+        # per-sequence containment: a bad user sampler must fail ONLY
+        # its own stream, never the engine or its neighbors
+        try:
+            tok = self._pick_token(seq, outs, 0)
+        except Exception as exc:   # noqa: BLE001
+            self._finish(seq, error=exc)
+            return
+        self._emit(seq, tok)
+        self._maybe_finish(seq, tok)
+
+    def _step(self, active):
+        t0 = time.perf_counter()
+        data = _np.zeros((self.capacity, 1), _np.float32)
+        pos = _np.full((self.capacity, 1), -1.0, _np.float32)
+        table = _np.zeros((self.capacity, self._table_width), _np.float32)
+        for slot, seq in active:
+            data[slot, 0] = seq.last_token
+            pos[slot, 0] = seq.pos
+            table[slot, :len(seq.blocks)] = seq.blocks
+        with self._step_lock:
+            outs, dd = self._dispatch(self._exe, "decode", data=data,
+                                      positions=pos, block_table=table)
+            self._commit_caches(outs, base=2)
+        self._n_steps += 1
+        self._n_step_dispatches += dd
+        self._occ_sum += len(active)
+        self._cache_occ_sum += self.cache.occupancy
+        STEPS.inc()
+        # ONE host copy of the (capacity, vocab) logits per step, shared
+        # by every sampling/temperature/collect_logits sequence (rows
+        # are per-slot, so a misbehaving user sampler can only touch its
+        # own row)
+        logits_host = None
+        if any(self._needs_logits(s) for _, s in active):
+            logits_host = outs[0].asnumpy()
+        # likewise ONE readback of the greedy-token output for the
+        # whole step, not one per active slot
+        next_host = outs[1].asnumpy()
+        for slot, seq in active:
+            seq.pos += 1
+            try:
+                tok = self._pick_token(seq, outs, slot, logits_host,
+                                       next_host)
+            except Exception as exc:   # noqa: BLE001 — user sampler;
+                self._finish(seq, error=exc)   # contain to this stream
+                continue
+            self._emit(seq, tok)
+            self._maybe_finish(seq, tok)
+        STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _needs_logits(seq):
+        return (seq.sampler is not None or seq.temperature > 0
+                or seq.handle.logits is not None)
+
+    def _pick_token(self, seq, outs, row, logits_host=None, next_host=None):
+        """Greedy reads the on-device argmax output; samplers and
+        temperature read the logits row.  Host-side on purpose: the
+        readback is the stream, and numpy sampling keeps the device
+        program fixed-shape."""
+        if self._needs_logits(seq):
+            if logits_host is None:
+                logits_host = outs[0].asnumpy()
+            logits = logits_host[row]
+            if seq.handle.logits is not None:
+                seq.handle.logits.append(_np.array(logits, copy=True))
+            if seq.sampler is not None:
+                return int(seq.sampler(logits))
+            if seq.temperature > 0:
+                z = logits / max(seq.temperature, 1e-6)
+                z = z - z.max()
+                p = _np.exp(z)
+                p /= p.sum()
+                return int(seq.rng().choice(len(p), p=p))
+            return int(logits.argmax())
+        if next_host is None:
+            next_host = outs[1].asnumpy()
+        return int(next_host[row])
+
+    def _emit(self, seq, tok):
+        now = time.monotonic()
+        seq.tokens.append(tok)
+        seq.last_token = tok
+        if seq.t_first is None:
+            seq.t_first = now
+            ttft = (now - seq.t_submit) * 1e3
+            seq.handle.ttft_ms = ttft
+            TTFT_MS.observe(ttft)
+            # under _cv: stats() iterates this deque from other threads
+            with self._cv:
+                self._ttfts.append(ttft)
+        seq.handle._emit(tok)
+        self._n_tokens += 1
+        TOKENS.inc()
+
+    def _maybe_finish(self, seq, tok):
+        if seq.eos_id is not None and tok == seq.eos_id:
+            self._finish(seq, reason="eos")
+        elif seq.n_generated >= seq.max_new_tokens:
+            self._finish(seq, reason="length")
+        elif seq.pos >= self._max_context:
+            self._finish(seq, reason="context")
+
+    def _finish(self, seq, reason=None, error=None):
+        with self._cv:
+            self._sched.release(seq)
+        if error is None and reason == "cancelled":
+            self._n_cancelled += 1
+            CANCELLED.inc()
+        elif error is None:
+            self._n_completed += 1
+            COMPLETED.inc()
+        elif isinstance(error, DeadlineExceededError):
+            self._n_expired += 1
+            EXPIRED.inc()
+        else:
+            self._n_failed += 1
+            FAILED.inc()
+        seq.handle._finish(reason=reason, error=error)
+
+    # ------------------------------------------------------------------
+    # weights: hot reload
+    # ------------------------------------------------------------------
+    def check_params(self, arg_params):
+        """Validate a candidate checkpoint against the bound model +
+        cache layout (server reload calls this BEFORE touching any
+        replica, so a bad checkpoint is a clean 409)."""
+        self._check_params(arg_params)
+
+    def swap_params(self, arg_params, aux_params=None, version=None):
+        """Hot-swap weights under the step lock: in-flight sequences
+        continue on the new weights at the next iteration, the KV cache
+        (and therefore every stream) is preserved.  ``version`` (a tag
+        or epoch) stamps ``stats()["model_version"]`` atomically with
+        the swap.  Raises ``MXNetError`` — without touching anything —
+        when shapes don't match."""
+        import jax
+        from ..ndarray.ndarray import NDArray
+        self._check_params(arg_params)
+        with self._step_lock:
+            for name in self._weight_names:
+                v = arg_params[name]
+                if not isinstance(v, NDArray):
+                    v = NDArray(_np.asarray(v))
+                dst = self._exe.arg_dict[name]
+                data = v._data
+                if data.dtype != dst._data.dtype:
+                    data = data.astype(dst._data.dtype)
+                dst._set_data(jax.device_put(data, self._ctx.jax_device))
+            if version is not None:
+                self._model_version = version
+        RELOADS.inc()
+
+    def reload(self, prefix, tag=None, epoch=None):
+        """Load an mx.checkpoint (``tag``/newest) or legacy
+        ``prefix-%04d.params`` (``epoch``) and hot-swap (docs/DECODE.md
+        + docs/CHECKPOINT.md)."""
+        from ..checkpoint import resolve_params
+        arg_params, _aux, version = resolve_params(
+            prefix, tag, epoch, what="decode reload")
+        self.swap_params(arg_params, version=version)
+        return version
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout=None):
+        """Wait until all submitted work has settled."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                idle = (not self._sched.waiting
+                        and not self._sched.has_active()
+                        and not self._mid_admission)
+            if idle:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the engine; ``drain=True`` finishes queued work first,
+        ``drain=False`` fails it with ``ServerClosedError``."""
+        with self._cv:
+            self._closing = True
+            if not drain:
+                self._abort = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            # a timed-out join leaves the loop running: keep _thread so
+            # start() can't spawn a SECOND loop over the same slots
+            if not self._thread.is_alive():
+                self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Operational snapshot (glossary in docs/DECODE.md)."""
+        with self._cv:
+            depth = len(self._sched.waiting)
+            active = sum(1 for s in self._sched.slots if s is not None)
+            ttfts = sorted(self._ttfts)
+        p99 = _percentile(ttfts, 0.99)
+        return {
+            "capacity": self.capacity,
+            "queue_depth": depth,
+            "active_sequences": active,
+            "admitted": self._n_admitted,
+            "completed": self._n_completed,
+            "failed": self._n_failed,
+            "expired": self._n_expired,
+            "cancelled": self._n_cancelled,
+            "tokens_generated": self._n_tokens,
+            "steps": self._n_steps,
+            "prefills": self._n_prefills,
+            "preemptions": self._n_preemptions,
+            "mean_slot_occupancy": (self._occ_sum / self._n_steps
+                                    if self._n_steps else None),
+            "mean_cache_occupancy": (self._cache_occ_sum / self._n_steps
+                                     if self._n_steps else None),
+            "steady_state_retraces": self._steady_retraces,
+            "decode_step_dispatches": self._n_step_dispatches,
+            "dispatches_per_step": (self._n_step_dispatches / self._n_steps
+                                    if self._n_steps else None),
+            "prefill_dispatches": self._n_prefill_dispatches,
+            "ttft_p99_ms": p99,
+            "model_version": self._model_version,
+            "cache": {
+                "num_blocks": self.cache.num_blocks,
+                "block_size": self.cache.block_size,
+                "blocks_used": self.cache.used_count,
+                "blocks_free": self.cache.free_count,
+                "occupancy": round(self.cache.occupancy, 4),
+            },
+            "prefill_buckets": list(self._buckets),
+        }

@@ -1,0 +1,247 @@
+"""Continuous-batching scheduler state (host-side policy, no device code).
+
+Iteration-level scheduling in the Orca (OSDI '22) sense: the unit of
+work is one *decode iteration* over a fixed array of batch slots, and
+sequences join/leave between iterations — a new request never waits for
+the batch to drain, a finished request never pads it.  The policies are
+deliberately simple and documented (docs/DECODE.md):
+
+* **Admission** — FIFO, no head-of-line bypass: the oldest waiting
+  sequence is admitted as soon as a slot AND its prompt's cache blocks
+  are free.  ``admission='static'`` degrades to run-to-completion
+  batching (admit only into an idle engine) — kept as the measured A/B
+  baseline for ``bench.py --mode decode``.
+* **Preemption** — on cache pressure the YOUNGEST running sequence is
+  preempted *by recompute*: its blocks are freed, its tokens so far
+  fold into a new prompt, and it rejoins the FRONT of the wait queue,
+  re-prefilling when memory frees up.  Streamed tokens are never
+  re-emitted.
+* **Expiry** — deadlines are checked while waiting and between
+  iterations; an expired sequence settles with
+  ``DeadlineExceededError`` exactly like a serving request.
+
+Everything here is plain-Python and single-owner: only the engine
+thread mutates slots/blocks, only ``submit`` (any thread, under the
+engine lock) appends to the wait queue — which is what makes the
+policy unit-testable without a device.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from collections import deque
+
+from ..serving.batcher import DeadlineExceededError, QueueFullError
+
+__all__ = ["Sequence", "StreamHandle", "Scheduler",
+           "DeadlineExceededError", "QueueFullError"]
+
+
+class StreamHandle:
+    """Client-side view of one generation: an iterator of streamed
+    tokens plus a synchronous :meth:`result`.
+
+    The engine appends every generated token to :attr:`tokens` *before*
+    publishing it to the event queue, so ``tokens`` is always a prefix-
+    consistent transcript; iteration consumes the queue.  ``ttft_ms``
+    is set at the first token (time-to-first-token, queue wait
+    included)."""
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.tokens = []
+        self.logits = None          # populated when collect_logits=True
+        self.finish_reason = None
+        self.error = None
+        self.ttft_ms = None
+        self.preemptions = 0
+        self._events = _queue.Queue()
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+
+    def cancel(self):
+        """Ask the engine to stop this generation (client went away).
+        Takes effect at the next scheduler iteration: the sequence's
+        slot and cache blocks are released and the stream settles with
+        ``finish_reason='cancelled'``.  Idempotent; a no-op once done."""
+        self._cancelled.set()
+
+    def cancelled(self):
+        return self._cancelled.is_set()
+
+    # engine side ------------------------------------------------------
+    def _emit(self, token):
+        self.tokens.append(token)
+        self._events.put(("token", token))
+
+    def _finish(self, reason=None, error=None):
+        self.finish_reason = reason if error is None else "error"
+        self.error = error
+        self._events.put(("done", reason) if error is None
+                         else ("error", error))
+        self._done.set()
+
+    # client side ------------------------------------------------------
+    def __iter__(self):
+        while True:
+            kind, payload = self._events.get()
+            if kind == "token":
+                yield payload
+            elif kind == "done":
+                return
+            else:
+                raise payload
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Wait for completion; returns the generated tokens (prompt
+        excluded).  Raises the stream's error (deadline, cache OOM,
+        server closed) if it failed."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation %d still running" % self.rid)
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+class Sequence:
+    """One request's full scheduler state."""
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_id=None,
+                 deadline=None, temperature=0.0, sampler=None, seed=None,
+                 collect_logits=False):
+        self.rid = rid
+        self.tokens = list(int(t) for t in prompt)   # prompt + generated
+        self.n_prompt = len(self.tokens)             # original prompt size
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.temperature = float(temperature)
+        self.sampler = sampler
+        self.seed = seed
+        self.handle = StreamHandle(rid)
+        if collect_logits:
+            self.handle.logits = []
+        self._rng = None
+        # engine-owned placement state
+        self.slot = None
+        self.blocks = []
+        self.pos = 0              # next cache position to be written
+        self.last_token = None    # token the next decode step consumes
+        self.t_submit = time.monotonic()
+        self.t_first = None
+        self.preemptions = 0
+
+    @property
+    def n_generated(self):
+        return len(self.tokens) - self.n_prompt
+
+    @property
+    def recompute_prompt(self):
+        """Prompt for (re-)prefill: everything produced so far."""
+        return self.tokens
+
+    def rng(self):
+        if self._rng is None:
+            import numpy as np
+            self._rng = np.random.RandomState(
+                self.seed if self.seed is not None else (self.rid * 9973 + 7))
+        return self._rng
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+
+class Scheduler:
+    """Slot/queue bookkeeping for the engine (module docstring)."""
+
+    def __init__(self, capacity, cache, max_waiting=256,
+                 admission="continuous"):
+        if admission not in ("continuous", "static"):
+            raise ValueError("admission=%r; use 'continuous' or 'static'"
+                             % (admission,))
+        self.capacity = int(capacity)
+        self.cache = cache
+        self.max_waiting = int(max_waiting)
+        self.admission = admission
+        self.waiting = deque()
+        self.slots = [None] * self.capacity
+
+    # -- queue side (called under the engine lock) ---------------------
+    def enqueue(self, seq, front=False):
+        if len(self.waiting) >= self.max_waiting:
+            raise QueueFullError(
+                "decode wait queue full (%d sequences)" % self.max_waiting)
+        (self.waiting.appendleft if front else self.waiting.append)(seq)
+
+    def take_expired_waiting(self, now=None):
+        now = time.monotonic() if now is None else now
+        expired = [s for s in self.waiting if s.expired(now)]
+        if expired:
+            self.waiting = deque(s for s in self.waiting
+                                 if not s.expired(now))
+        return expired
+
+    # -- slot side (engine thread only) --------------------------------
+    def has_active(self):
+        return any(s is not None for s in self.slots)
+
+    def active(self):
+        """[(slot_index, Sequence)] for occupied slots, slot order."""
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
+
+    def free_slot(self):
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def may_admit(self, batch_open=False):
+        """Admission policy gate: continuous admits into in-flight
+        iterations; static only fills an idle engine — ``batch_open``
+        is True while the current admission round started from idle, so
+        a static batch fills every slot before running to completion."""
+        if self.free_slot() is None or not self.waiting:
+            return False
+        if (self.admission == "static" and self.has_active()
+                and not batch_open):
+            return False
+        return True
+
+    def place(self, seq, slot):
+        assert self.slots[slot] is None
+        self.slots[slot] = seq
+        seq.slot = slot
+
+    def release(self, seq):
+        """Recycle the sequence's slot and cache blocks."""
+        if seq.slot is not None:
+            self.slots[seq.slot] = None
+            seq.slot = None
+        if seq.blocks:
+            self.cache.free(seq.blocks)
+            seq.blocks = []
+
+    def pick_victim(self, exclude=()):
+        """Preemption policy: youngest running sequence (largest rid)
+        not in ``exclude`` — it has the least recompute to lose and the
+        oldest requests keep their latency."""
+        cands = [s for _, s in self.active()
+                 if s is not None and s not in exclude]
+        return max(cands, key=lambda s: s.rid) if cands else None
+
+    def preempt(self, seq):
+        """Preempt-by-recompute: free everything, rejoin the queue
+        front.  The caller streams nothing; already-emitted tokens stay
+        emitted and the re-prefill continues from ``seq.tokens``."""
+        self.release(seq)
+        seq.pos = 0
+        seq.last_token = None
+        seq.preemptions += 1
+        seq.handle.preemptions = seq.preemptions
+        self.waiting.appendleft(seq)

@@ -50,11 +50,19 @@ _SITE = _telemetry.RetraceSite(EXECUTOR_RETRACES,
 _note_retrace = _SITE.note
 
 
+# per-thread launch tally next to the global one: lets a dispatcher
+# (the decode engine) attribute launch counts to ITS OWN calls even
+# while other threads (serving replicas, checkpoint) dispatch
+# concurrently — same rationale as RetraceSite's TraceTally
+_DISPATCH_TALLY = _telemetry.TraceTally()
+
+
 def _count_dispatch():
     """Bump the global device-launch witness (profiler.DEVICE_DISPATCHES)
     — bench.py --mode train reads deltas for train_dispatches_per_step."""
     from . import profiler as _prof
     _prof.DEVICE_DISPATCHES.increment()
+    _DISPATCH_TALLY.count += 1
 
 
 def _timed_dispatch(fn, *args):

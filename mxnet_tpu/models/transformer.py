@@ -103,3 +103,165 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
             * float(moe_aux_coeff), name="moe_aux_loss")
         return sym.Group([out, aux_head])
     return out
+
+
+# ----------------------------------------------------------------------
+# Generative serving graphs (mx.decode — docs/DECODE.md)
+#
+# Both symbols below SHARE every weight name with get_symbol(), so the
+# training checkpoint binds them with no conversion; they differ only
+# in how attention addresses the paged KV cache
+# (sym.contrib.PagedDecodeAttention / PagedPrefillAttention).  Cache
+# variables carry explicit shapes (they are engine configuration, not
+# inferable from data), and all sequence state — positions, lengths,
+# block tables — enters as runtime ARRAY inputs so ragged generation
+# never retraces the compiled step.
+# ----------------------------------------------------------------------
+def _decode_trunk_vars(pre):
+    """The attention sublayer's weight variables, training-graph names."""
+    return (sym.Variable(pre + "qkv_weight"),
+            sym.Variable(pre + "qkv_bias", init=_init.Zero()),
+            sym.Variable(pre + "proj_weight"),
+            sym.Variable(pre + "proj_bias", init=_init.Zero()))
+
+
+def _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, layer_idx):
+    """Post-attention FFN sublayer shared by the decode/prefill graphs
+    (inference form: MoE aux losses are dropped, dropout is off)."""
+    ln2 = sym.LayerNorm(data=x, name=pre + "ln2")
+    if moe_experts and (layer_idx + 1) % max(int(moe_every), 1) == 0:
+        w_up = sym.Variable(pre + "moe_expert_up_weight",
+                            init=_init.Normal(d ** -0.5))
+        w_down = sym.Variable(pre + "moe_expert_down_weight",
+                              init=_init.Normal(ffn ** -0.5))
+        moe = sym.contrib.SwitchMoE(
+            ln2, expert_up_weight=w_up, expert_down_weight=w_down,
+            num_experts=int(moe_experts), num_hidden=ffn,
+            k=1, name=pre + "moe")
+        return moe[0]
+    h = sym.FullyConnected(data=ln2, num_hidden=ffn,
+                           flatten=False, name=pre + "ffn_up")
+    h = sym.LeakyReLU(data=h, act_type="gelu_tanh", name=pre + "gelu")
+    return sym.FullyConnected(data=h, num_hidden=d, flatten=False,
+                              name=pre + "ffn_down")
+
+
+def get_decode_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
+                           num_heads=16, ffn_dim=None, seq_len=1024,
+                           dtype="float32", block_size=16, num_blocks=64,
+                           moe_experts=0, moe_every=2, **kwargs):
+    """One cached autoregressive decode step over C fixed batch slots.
+
+    Inputs (bound shapes set capacity C and table width M):
+      ``data`` (C, 1) current token ids; ``positions`` (C, 1) 0-based
+      position of that token (< 0 = inactive slot); ``block_table``
+      (C, M) per-slot cache block ids; plus per-layer
+      ``layer%d_k_cache`` / ``layer%d_v_cache`` paged caches of shape
+      (num_blocks, block_size, H, D) that the engine threads from step
+      to step.
+    Outputs: ``[logits (C, vocab), greedy next token (C,),
+    new_k_cache_0, new_v_cache_0, ...]`` — the greedy token ships as
+    its own output so a default decode step reads back C ints, not a
+    (C, vocab) logits matrix; samplers read output 0 instead.
+    """
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    H = int(num_heads)
+    D = d // H
+
+    data = sym.Variable("data")                      # (C, 1) token ids
+    positions = sym.Variable("positions")            # (C, 1)
+    table = sym.Variable("block_table")              # (C, M)
+    tok = sym.Embedding(data, input_dim=vocab, output_dim=d,
+                        name="tok_embed")
+    pos_w = sym.Variable("pos_embed_weight", shape=(1, int(seq_len), d))
+    pe = sym.take(sym.Reshape(pos_w, shape=(int(seq_len), d)), positions,
+                  name="pos_take")                   # (C, 1, d), clipped
+    x = tok + pe
+    if dtype in ("float16", "bfloat16"):
+        x = sym.Cast(data=x, dtype=dtype, name="cast_embed")
+
+    new_kv = []
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        ln1 = sym.LayerNorm(data=x, name=pre + "ln1")
+        kc = sym.Variable(pre + "k_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        vc = sym.Variable(pre + "v_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        att = sym.contrib.PagedDecodeAttention(
+            ln1, *_decode_trunk_vars(pre), kc, vc, table, positions,
+            num_heads=H, name=pre + "attn")
+        x = x + att[0]
+        new_kv += [att[1], att[2]]
+        x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i)
+
+    x = sym.LayerNorm(data=x, name="ln_f")
+    logits = sym.FullyConnected(data=x, num_hidden=vocab, flatten=False,
+                                name="lm_head")      # (C, 1, vocab)
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
+    flat = sym.Reshape(data=logits, shape=(-1, vocab), name="logits_2d")
+    nxt = sym.argmax(flat, axis=1, name="greedy_token")
+    return sym.Group([flat, nxt] + new_kv)
+
+
+def get_prefill_symbol(num_classes=16384, num_layers=12, d_model=2048,
+                       num_heads=16, ffn_dim=None, seq_len=1024,
+                       prefill_len=None, dtype="float32", block_size=16,
+                       num_blocks=64, moe_experts=0, moe_every=2, **kwargs):
+    """Prompt-phase forward that populates the paged KV cache.
+
+    ``prefill_len`` is this bucket's padded prompt length S_b (the
+    engine keeps a power-of-two ladder of these symbols, one compile
+    each — the decode analog of serving's batch-size buckets).  Inputs:
+    ``data`` (B, S_b) padded prompt ids, ``prompt_len`` (B,) real
+    lengths, ``block_table`` (B, M), plus the same per-layer cache
+    variables as the decode step.  Outputs: ``[last-token logits
+    (B, vocab), greedy next token (B,), new caches...]``.
+    """
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    H = int(num_heads)
+    D = d // H
+    S = int(prefill_len) if prefill_len else int(seq_len)
+    if S > int(seq_len):
+        raise ValueError("prefill_len %d exceeds the position-embedding "
+                         "range seq_len=%d" % (S, int(seq_len)))
+
+    data = sym.Variable("data")                      # (B, S) token ids
+    lengths = sym.Variable("prompt_len")             # (B,)
+    table = sym.Variable("block_table")              # (B, M)
+    tok = sym.Embedding(data, input_dim=vocab, output_dim=d,
+                        name="tok_embed")
+    pos_w = sym.Variable("pos_embed_weight", shape=(1, int(seq_len), d))
+    pe = pos_w.slice_axis(axis=1, begin=0, end=S)
+    x = sym.broadcast_add(tok, pe, name="embed_add")
+    if dtype in ("float16", "bfloat16"):
+        x = sym.Cast(data=x, dtype=dtype, name="cast_embed")
+
+    new_kv = []
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        ln1 = sym.LayerNorm(data=x, name=pre + "ln1")
+        kc = sym.Variable(pre + "k_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        vc = sym.Variable(pre + "v_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        att = sym.contrib.PagedPrefillAttention(
+            ln1, *_decode_trunk_vars(pre), kc, vc, table, lengths,
+            num_heads=H, name=pre + "attn")
+        x = x + att[0]
+        new_kv += [att[1], att[2]]
+        x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i)
+
+    x = sym.LayerNorm(data=x, name="ln_f")
+    last = sym.contrib.GatherTimestep(x, lengths - 1, name="last_token")
+    logits = sym.FullyConnected(data=last, num_hidden=vocab, flatten=False,
+                                name="lm_head")      # (B, vocab)
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
+    nxt = sym.argmax(logits, axis=1, name="greedy_token")
+    return sym.Group([logits, nxt] + new_kv)

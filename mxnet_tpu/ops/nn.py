@@ -826,6 +826,159 @@ def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
                       proj_weight.reshape(d, H, D)) + proj_bias
 
 
+# ----------------------------------------------------------------------
+# Paged-KV-cache attention (mx.decode — docs/DECODE.md)
+#
+# The generative-serving pair of FusedCausalSelfAttention: the KV cache
+# lives in fixed-size device blocks ((num_blocks, block_size, H, D) per
+# layer) and each sequence addresses it through a runtime block table —
+# PagedAttention (vLLM, SOSP '23) expressed as XLA gather/scatter so
+# one compiled program serves every ragged batch of sequences with
+# zero retraces.  Block tables / positions / lengths are ARRAY inputs,
+# never static attrs, so nothing about sequence state is baked into
+# the trace.  Out-of-range scatter indices (padded slots, positions
+# past a prompt) use ``num_blocks*block_size`` — one past the end —
+# with mode='drop': negative sentinels would WRAP to the last cache
+# row and corrupt a live block.
+# ----------------------------------------------------------------------
+def _paged_qkv_weights(qkv_weight, qkv_bias, d, H, D):
+    """Reference FullyConnected layout ((3d, d) packed rows) viewed
+    head-major so checkpoints from the training graph load unchanged."""
+    return qkv_weight.reshape(3, H, D, d), qkv_bias.reshape(3, H, D)
+
+
+@register("_contrib_PagedDecodeAttention",
+          aliases=("PagedDecodeAttention",), num_outputs=3)
+def paged_decode_attention(data, qkv_weight, qkv_bias, proj_weight,
+                           proj_bias, k_cache, v_cache, block_table,
+                           positions, *, num_heads, scale=None):
+    """One autoregressive decode step over a paged KV cache.
+
+    data (C, 1, d): current-token hidden states for C fixed batch
+    slots; k_cache/v_cache (num_blocks, block_size, H, D); block_table
+    (C, M) block ids per slot; positions (C, 1) the 0-based position of
+    the current token (< 0 marks an inactive/padded slot — its write is
+    dropped and its output is garbage the engine masks).  Outputs
+    (attn_out (C, 1, d), new_k_cache, new_v_cache): the current token's
+    K/V are scattered into the cache first, then attention runs over
+    the gathered context 0..position.  Weight names/layouts match
+    FusedCausalSelfAttention, so the training checkpoint serves decode
+    with no conversion."""
+    C, _, d = data.shape
+    H = int(num_heads)
+    if d % H:
+        raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
+    D = d // H
+    sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+
+    x = data.reshape(C, d)
+    Wqkv, bqkv = _paged_qkv_weights(qkv_weight, qkv_bias, d, H, D)
+    q = jnp.einsum("cd,hed->che", x, Wqkv[0]) + bqkv[0]
+    k = jnp.einsum("cd,hed->che", x, Wqkv[1]) + bqkv[1]
+    v = jnp.einsum("cd,hed->che", x, Wqkv[2]) + bqkv[2]
+
+    nb, bs = k_cache.shape[0], k_cache.shape[1]
+    kf = k_cache.reshape(nb * bs, H, D)
+    vf = v_cache.reshape(nb * bs, H, D)
+    pos = positions.reshape(C).astype(jnp.int32)
+    table = block_table.astype(jnp.int32)              # (C, M)
+    M = table.shape[1]
+
+    # scatter this token's K/V: flat row = table[pos // bs] * bs + pos % bs
+    blk = jnp.clip(pos // bs, 0, M - 1)
+    row_blk = jnp.take_along_axis(table, blk[:, None], axis=1)[:, 0]
+    widx = jnp.where(pos >= 0, row_blk * bs + pos % bs, nb * bs)
+    kf = kf.at[widx].set(k.astype(kf.dtype), mode="drop")
+    vf = vf.at[widx].set(v.astype(vf.dtype), mode="drop")
+
+    # gather the whole addressable context per slot and mask causally;
+    # padded table entries read block 0 but sit behind the mask
+    ctx = M * bs
+    j = jnp.arange(ctx)
+    ridx = table[:, j // bs] * bs + (j % bs)           # (C, ctx)
+    kctx = jnp.take(kf, ridx, axis=0, mode="clip")     # (C, ctx, H, D)
+    vctx = jnp.take(vf, ridx, axis=0, mode="clip")
+    s = jnp.einsum("che,cjhe->chj", q, kctx) * sc
+    mask = j[None, None, :] <= jnp.maximum(pos, 0)[:, None, None]
+    s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("chj,cjhe->che", p, vctx)
+    out = jnp.einsum("che,dhe->cd", o,
+                     proj_weight.reshape(d, H, D)) + proj_bias
+    return (out.reshape(C, 1, d), kf.reshape(k_cache.shape),
+            vf.reshape(v_cache.shape))
+
+
+@register("_contrib_PagedPrefillAttention",
+          aliases=("PagedPrefillAttention",), num_outputs=3)
+def paged_prefill_attention(data, qkv_weight, qkv_bias, proj_weight,
+                            proj_bias, k_cache, v_cache, block_table,
+                            lengths, *, num_heads, scale=None):
+    """Prompt-phase attention that also populates the paged KV cache.
+
+    data (B, S, d) is the padded prompt batch; lengths (B,) the real
+    prompt lengths; block_table (B, M) the destination blocks.  The
+    attention itself is the same head-major causal MHA as
+    FusedCausalSelfAttention (flash kernel when the TPU geometry
+    allows, fp32-softmax XLA path otherwise); additionally K/V rows for
+    positions < length are scattered into the cache so decode can
+    continue the sequence.  Outputs (hidden (B, S, d), new_k_cache,
+    new_v_cache)."""
+    B, S, d = data.shape
+    H = int(num_heads)
+    if d % H:
+        raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
+    D = d // H
+    sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+
+    Wqkv = qkv_weight.reshape(3, H, D, d)
+    bqkv = qkv_bias.reshape(3, H, 1, D)
+    q = jnp.einsum("bsd,hed->bhse", data, Wqkv[0]) + bqkv[0]
+    k = jnp.einsum("bsd,hed->bhse", data, Wqkv[1]) + bqkv[1]
+    v = jnp.einsum("bsd,hed->bhse", data, Wqkv[2]) + bqkv[2]
+
+    if _use_flash_attention(S, D, data.dtype):
+        o = _flash_attention(q, k, v, sc)
+    else:
+        s = jnp.einsum("bhqe,bhke->bhqk", q, k) * sc
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bhke->bhqe", p, v)
+    out = jnp.einsum("bhse,dhe->bsd", o,
+                     proj_weight.reshape(d, H, D)) + proj_bias
+
+    nb, bs = k_cache.shape[0], k_cache.shape[1]
+    kf = k_cache.reshape(nb * bs, H, D)
+    vf = v_cache.reshape(nb * bs, H, D)
+    table = block_table.astype(jnp.int32)              # (B, M)
+    L = lengths.reshape(B).astype(jnp.int32)
+    M = table.shape[1]
+    jpos = jnp.arange(S)
+    blk = jnp.clip(jpos // bs, 0, M - 1)
+    base = jnp.take_along_axis(table, jnp.broadcast_to(blk[None], (B, S)),
+                               axis=1)
+    widx = jnp.where(jpos[None, :] < L[:, None],
+                     base * bs + jpos % bs, nb * bs)   # OOB sentinel
+    kw = k.transpose(0, 2, 1, 3).reshape(B * S, H, D)
+    vw = v.transpose(0, 2, 1, 3).reshape(B * S, H, D)
+    kf = kf.at[widx.reshape(B * S)].set(kw.astype(kf.dtype), mode="drop")
+    vf = vf.at[widx.reshape(B * S)].set(vw.astype(vf.dtype), mode="drop")
+    return out, kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
+
+
+@register("_contrib_GatherTimestep", aliases=("GatherTimestep",))
+def gather_timestep(data, index):
+    """data (B, S, d), index (B,) or (B, 1) -> (B, d): data[b, index[b]]
+    with the index clipped into [0, S).  Used by the prefill graph to
+    read the last REAL token's hidden state (index = length - 1) so the
+    lm_head matmul runs on one row, not the whole padded sequence."""
+    B, S = data.shape[0], data.shape[1]
+    idx = jnp.clip(index.reshape(B).astype(jnp.int32), 0, S - 1)
+    idx3 = jnp.broadcast_to(idx[:, None, None], (B, 1, data.shape[2]))
+    return jnp.take_along_axis(data, idx3, axis=1)[:, 0]
+
+
 @register("_contrib_SwitchMoE", aliases=("SwitchMoE",), num_outputs=2,
           num_visible_outputs=2)
 def switch_moe_op(data, router_weight, expert_up_weight, expert_up_bias,

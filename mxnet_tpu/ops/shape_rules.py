@@ -121,6 +121,11 @@ def _fused_attn_shapes(known, attrs):
 
 
 _set("_contrib_FusedCausalSelfAttention", _fused_attn_shapes)
+# the paged decode/prefill ops share the fused op's projection-weight
+# layout; cache/table/position shapes come from bind-time inputs or
+# explicit Variable shapes, never from inference
+_set("_contrib_PagedDecodeAttention", _fused_attn_shapes)
+_set("_contrib_PagedPrefillAttention", _fused_attn_shapes)
 
 
 def _ln_shapes(known, attrs):

@@ -28,7 +28,17 @@ from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "ServerClosedError", "Request", "RequestQueue", "DynamicBatcher",
-           "MicroBatch", "bucketize", "default_buckets"]
+           "MicroBatch", "bucketize", "default_buckets", "percentile"]
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted sequence (None when
+    empty) — the one definition shared by ServerStats and decode
+    stats()."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
 
 
 class ServingError(MXNetError):

@@ -35,17 +35,10 @@ from ..base import MXNetError
 from .. import profiler as _prof
 from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
                       Request, RequestQueue, ServerClosedError, ServingError,
-                      normalize_buckets)
+                      normalize_buckets, percentile as _percentile)
 from .replica import ReplicaPool
 
 __all__ = ["ModelServer", "ServerStats"]
-
-
-def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
 
 
 class ServerStats:
@@ -279,7 +272,8 @@ class ModelServer:
     def __init__(self, symbol, arg_params, aux_params, input_shapes,
                  num_replicas=1, contexts=None, max_batch_size=8,
                  max_latency_ms=5.0, queue_capacity=None, timeout_ms=None,
-                 dtype="float32", buckets=None, warmup=True):
+                 dtype="float32", buckets=None, warmup=True,
+                 decode_engine=None):
         from ..predictor import Predictor
 
         for name, shape in input_shapes.items():
@@ -322,6 +316,11 @@ class ModelServer:
         self._closed = False
         self._http = None
         self._http_thread = None
+        # optional mx.decode generative engine: POST /generate streams
+        # chunked JSON-lines through it, reload() hot-swaps its weights
+        # in lockstep with the replicas (docs/DECODE.md). The caller
+        # owns the engine's lifecycle; stop() does not stop it.
+        self._decode_engine = decode_engine
         # hot-reload bookkeeping (docs/CHECKPOINT.md): version of the
         # weights currently served (checkpoint tag / epoch), reload count
         self._model_version = None
@@ -465,23 +464,10 @@ class ModelServer:
         swapped in place per replica under its forward lock — compiled
         executors, queue and in-flight batches all survive. Returns the
         version served (tag/epoch)."""
-        from ..checkpoint import load as _ckpt_load
+        from ..checkpoint import resolve_params
         with self._reload_lock:
-            if epoch is not None:
-                from .. import model as _model
-                try:
-                    arg_params, aux_params = _model.load_params(prefix,
-                                                                epoch)
-                except OSError as e:
-                    raise MXNetError("reload: %s" % e) from e
-                version = int(epoch)
-            else:
-                try:
-                    _sym, arg_params, aux_params, man = _ckpt_load(
-                        prefix, tag)
-                except (IOError, OSError) as e:
-                    raise MXNetError("reload: %s" % e) from e
-                version = int(man["tag"])
+            arg_params, aux_params, version = resolve_params(
+                prefix, tag, epoch, what="reload")
             base = self._pool.replicas[0]._base
             missing = [n for n in base._exe.arg_dict
                        if n not in arg_params
@@ -518,8 +504,16 @@ class ModelServer:
             aux_params = {k: v if isinstance(v, NDArray)
                           else NDArray(_np.asarray(v))
                           for k, v in (aux_params or {}).items()}
+            # the attached decode engine must accept the checkpoint too
+            # (same architecture => its paged-cache layout is preserved);
+            # validate BEFORE any replica swaps so a mismatch is a clean
+            # 409 with zero state touched
+            if self._decode_engine is not None:
+                self._decode_engine.check_params(arg_params)
             for rep in self._pool.replicas:
                 rep.swap_params(arg_params, aux_params)
+            if self._decode_engine is not None:
+                self._decode_engine.swap_params(arg_params, version=version)
             self._model_version = version
             self._reloads += 1
             self._r_reloads.inc()
@@ -536,6 +530,8 @@ class ModelServer:
         # per-instance count; the registry's serving_reloads series is
         # process-global and shared across servers
         snap["reloads"] = self._reloads
+        if self._decode_engine is not None:
+            snap["decode"] = self._decode_engine.stats()
         return snap
 
     def reset_stats(self):
@@ -560,6 +556,14 @@ class ModelServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 for chunked transfer on /generate; every other
+            # reply carries an exact Content-Length, so keep-alive is
+            # safe.  The timeout reaps idle persistent connections —
+            # without it every keep-alive client pins a server thread
+            # and fd forever
+            protocol_version = "HTTP/1.1"
+            timeout = 60
+
             def log_message(self, *a):   # keep pytest/console output clean
                 pass
 
@@ -570,6 +574,132 @@ class ModelServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _read_json(self):
+                """Parse the POST body; replies 400 and returns None
+                when it isn't a JSON object (callers just return)."""
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                try:
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError as e:
+                    self._reply(400, {"error": "invalid JSON: %s" % e,
+                                      "type": "bad_request"})
+                    return None
+                if not isinstance(doc, dict):
+                    self._reply(400, {"error": "body must be a JSON "
+                                      "object", "type": "bad_request"})
+                    return None
+                return doc
+
+            def _chunk(self, data):
+                self.wfile.write(b"%x\r\n" % len(data))
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+                self.wfile.flush()
+
+            def _do_generate(self, doc):
+                """POST /generate — streamed autoregressive generation
+                through the attached mx.decode engine.  Body:
+                ``{"tokens": [...], "max_new_tokens": n, "stream": true,
+                "eos_id"/"temperature"/"timeout_ms"/"seed": optional}``.
+                Streaming replies are chunked JSON-lines: one
+                ``{"index": i, "token": t}`` object per generated token
+                and a final ``{"done": true, ...}`` summary line (an
+                in-flight failure becomes a ``{"done": true, "error":
+                ...}`` tail instead of a broken connection)."""
+                eng = server._decode_engine
+                if eng is None:
+                    self._reply(404, {"error": "no decode engine attached "
+                                      "(ModelServer(decode_engine=...))",
+                                      "type": "no_decode"})
+                    return
+                tokens = doc.get("tokens")
+                if not isinstance(tokens, list) or not tokens:
+                    self._reply(400, {"error": "generate needs a non-empty "
+                                      "'tokens' list", "type": "bad_request"})
+                    return
+                kwargs = {}
+                if "eos_id" in doc:
+                    kwargs["eos_id"] = doc["eos_id"]
+                try:
+                    handle = eng.submit(
+                        tokens,
+                        max_new_tokens=doc.get("max_new_tokens"),
+                        timeout_ms=doc.get("timeout_ms"),
+                        temperature=float(doc.get("temperature", 0.0)),
+                        seed=doc.get("seed"), **kwargs)
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e), "type": "queue_full"})
+                    return
+                except ServerClosedError as e:
+                    self._reply(503, {"error": str(e), "type": "closed"})
+                    return
+                except (MXNetError, TypeError, ValueError) as e:
+                    # TypeError/ValueError: malformed field types
+                    # (non-int tokens, non-numeric temperature) — a
+                    # client error, same as any other validation miss
+                    self._reply(400, {"error": str(e), "type": "bad_request"})
+                    return
+                if not doc.get("stream", True):
+                    # a client-supplied timeout_ms is enforced BY THE
+                    # ENGINE (DeadlineExceededError below); the server
+                    # backstop only has to outlast it, it must never
+                    # undercut an explicit longer deadline
+                    t_ms = doc.get("timeout_ms")
+                    wait_s = 600.0 if t_ms is None else t_ms / 1e3 + 30.0
+                    try:
+                        toks = handle.result(timeout=wait_s)
+                    except DeadlineExceededError as e:
+                        self._reply(504, {"error": str(e),
+                                          "type": "deadline"})
+                        return
+                    except TimeoutError as e:
+                        # server-side backstop tripped: stop generating
+                        # into a handle nobody will read (frees the
+                        # slot + cache blocks at the next iteration)
+                        handle.cancel()
+                        self._reply(504, {"error": str(e),
+                                          "type": "deadline"})
+                        return
+                    except Exception as e:   # noqa: BLE001
+                        self._reply(500, {"error": str(e),
+                                          "type": "internal"})
+                        return
+                    self._reply(200, {"tokens": toks,
+                                      "finish_reason": handle.finish_reason,
+                                      "ttft_ms": handle.ttft_ms})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                tail = None
+                try:
+                    for i, tok in enumerate(handle):
+                        try:
+                            self._chunk((json.dumps(
+                                {"index": i, "token": tok}) + "\n").encode())
+                        except OSError:
+                            # client went away mid-stream: release the
+                            # slot + cache blocks instead of generating
+                            # the rest into a queue nobody reads
+                            handle.cancel()
+                            return
+                except Exception as e:   # noqa: BLE001 — error as a tail line
+                    tail = {"done": True, "error": str(e),
+                            "type": e.__class__.__name__,
+                            "tokens": handle.tokens}
+                if tail is None:
+                    tail = {"done": True,
+                            "finish_reason": handle.finish_reason,
+                            "tokens": handle.tokens,
+                            "ttft_ms": handle.ttft_ms}
+                try:
+                    self._chunk((json.dumps(tail) + "\n").encode())
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    handle.cancel()
 
             def do_GET(self):
                 if self.path == "/metrics":
@@ -590,16 +720,21 @@ class ModelServer:
                     self._reply(404, {"error": "unknown path %s" % self.path})
 
             def do_POST(self):
+                if self.path == "/generate":
+                    try:
+                        doc = self._read_json()
+                        if doc is not None:
+                            self._do_generate(doc)
+                    except Exception as e:   # noqa: BLE001
+                        self._reply(500, {"error": str(e),
+                                          "type": "internal"})
+                    return
                 if self.path == "/reload":
                     # admin endpoint: swap replicas to a newer checkpoint
                     # ({"prefix": ..., "tag"|"epoch": optional})
                     try:
-                        n = int(self.headers.get("Content-Length", 0))
-                        try:
-                            doc = json.loads(self.rfile.read(n) or b"{}")
-                        except ValueError as e:
-                            self._reply(400, {"error": "invalid JSON: %s"
-                                              % e, "type": "bad_request"})
+                        doc = self._read_json()
+                        if doc is None:
                             return
                         if not doc.get("prefix"):
                             self._reply(400, {"error": "reload needs a "
@@ -619,15 +754,16 @@ class ModelServer:
                                           "type": "internal"})
                     return
                 if self.path != "/predict":
+                    # HTTP/1.1 keep-alive: drain the unread body first
+                    # or its bytes desynchronize the next request on
+                    # this connection
+                    self.rfile.read(int(self.headers.get("Content-Length",
+                                                         0) or 0))
                     self._reply(404, {"error": "unknown path %s" % self.path})
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    try:
-                        doc = json.loads(self.rfile.read(n) or b"{}")
-                    except ValueError as e:   # malformed body = client error
-                        self._reply(400, {"error": "invalid JSON: %s" % e,
-                                          "type": "bad_request"})
+                    doc = self._read_json()
+                    if doc is None:
                         return
                     fut = server.submit(doc.get("inputs") or {},
                                         timeout_ms=doc.get("timeout_ms"))

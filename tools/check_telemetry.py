@@ -15,6 +15,11 @@ operational witnesses:
    and profiler ``new_counter("name")``) must appear in the
    docs/OBSERVABILITY.md glossary, so the docs can never silently lag
    the exported series.
+3. **Reverse coverage** — every glossary row must still have a
+   registration site in the source: a series whose instrumentation was
+   deleted or renamed must leave the glossary in the same commit
+   (stale docs are as misleading as missing ones).  Legitimately
+   derived/doc-only rows go in ``ALLOWED_DOC_ONLY`` with a reason.
 
 Stdlib-only, no package import: safe anywhere (including as a plain
 subprocess inside the test suite).
@@ -32,6 +37,9 @@ ALLOWED_GLOBALS = {
     ("contrib/text/embedding.py", "UNKNOWN_IDX"):
         "vocabulary layout constant, not a mutable witness",
 }
+
+# glossary name: why it has no literal registration site in mxnet_tpu/
+ALLOWED_DOC_ONLY = {}
 
 _MUTABLE = re.compile(
     r"^([A-Z][A-Z0-9_]*)\s*=\s*(?:0|0\.0|\[\]|\{\}|set\(\))\s*(?:#.*)?$")
@@ -102,6 +110,12 @@ def main():
             errors.append(
                 "metric %r registered at %s is missing from the "
                 "docs/OBSERVABILITY.md glossary" % (name, registered[name]))
+    for name in sorted(known):
+        if name not in registered and name not in ALLOWED_DOC_ONLY:
+            errors.append(
+                "glossary entry %r has no surviving registration site in "
+                "mxnet_tpu/ — remove the row or restore the series (or "
+                "allowlist it in ALLOWED_DOC_ONLY with a reason)" % name)
     if errors:
         print("check_telemetry: %d problem(s)" % len(errors))
         for e in errors:
