@@ -804,6 +804,8 @@ def bench_kvstore(args):
     # updater apply; bucketed: one program per bucket
     eager_disp = len(keys) * (ndev * 1 + (ndev - 1) + 1)
     dev = jax.devices()[0]
+    mh = bench_kvstore_multihost(args) if args.kv_hosts > 1 else {
+        "kvstore_hosts": 1, "crosshost_bytes_per_step": 0}
     return {
         "metric": "kvstore_push_pull_gbps",
         "value": round(gbps(fused_dt), 2),
@@ -826,7 +828,82 @@ def bench_kvstore(args):
         "dispatches_per_step": {"eager_2bit": eager_disp,
                                 "bucketed": buckets_per_step},
         **_latency_fields(lat["hist"], lat["compile_ms"]),
+        **mh,
     }
+
+
+def bench_kvstore_multihost(args):
+    """Multi-host arm of ``--mode kvstore``: spawn a ``--kv-hosts``-
+    process kvstore='tpu' world (tools/run_multihost.py env contract,
+    CPU jax.distributed backend) pushing a bucketed 2-bit key set, and
+    report what travels per step. CPU-container convention (CHANGES.md):
+    the numbers that matter are the dispatch-count witnesses and
+    ``crosshost_bytes_per_step`` — wall time on a 1-core host measures
+    process contention, not the collective. On this backend the engine
+    uses the host transport (2 launches + 1 coordination-service
+    allgather per bucket); a real pod rides GSPMD at 1 launch."""
+    import os
+    import subprocess
+    import sys as _sys
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "run_multihost.py"),
+         "-n", str(args.kv_hosts), "--",
+         _sys.executable, os.path.join(root, "bench.py"),
+         "--mode", "kvstore-mh-worker", "--iters", str(args.iters),
+         "--batch", str(args.batch)],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise SystemExit("bench: multi-host kvstore arm failed:\n%s"
+                         % proc.stderr[-2000:])
+    line = next(l for l in proc.stdout.splitlines()
+                if l.startswith("{") and "kvstore_hosts" in l)
+    return json.loads(line)
+
+
+def bench_kvstore_mh_worker(args):
+    """One rank of the multi-host kvstore arm (spawned by
+    bench_kvstore_multihost under the MXTPU_* env contract; also runs
+    standalone as a single-process world). Rank 0 prints the JSON."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, profiler, telemetry
+
+    kv = mx.kv.create("tpu")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05, momentum=0.9,
+                                      wd=1e-4,
+                                      rescale_grad=1.0 / args.batch))
+    shapes = [(256, 256), (512, 128), (1000,), (64, 3, 3, 3), (256,)]
+    keys = ["mh_p%d" % i for i in range(len(shapes))]
+    rng = np.random.RandomState(0)          # same init on every rank
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.array(rng.normal(0, 0.05, s).astype(np.float32)))
+    grng = np.random.RandomState(1 + kv.rank)   # per-rank gradients
+
+    def step():
+        kv.push(keys, [[nd.array(grng.normal(0, 0.01, s)
+                                 .astype(np.float32))] for s in shapes])
+    step()                                  # warmup: trace + compile
+    steps = max(4, min(args.iters, 16))
+    xb = telemetry.REGISTRY.get("kvstore_tpu_crosshost_bytes")
+    d0, x0 = profiler.DEVICE_DISPATCHES.value, xb.value
+    for _ in range(steps):
+        step()
+    kv.barrier()
+    if kv.rank == 0:
+        print(json.dumps({
+            "kvstore_hosts": kv.num_workers,
+            "crosshost_bytes_per_step":
+                int((xb.value - x0) / steps),
+            "kvstore_mh_dispatches_per_step":
+                round((profiler.DEVICE_DISPATCHES.value - d0) / steps, 2),
+            "kvstore_mh_transport":
+                "gspmd" if kv._gspmd_ok else "host",
+            "kvstore_mh_keys": len(keys),
+            "kvstore_mh_steps": steps,
+        }))
 
 
 def bench_fit(args):
@@ -1270,7 +1347,7 @@ def main():
                     choices=["all", "resnet", "transformer"])
     ap.add_argument("--mode", type=str, default="train",
                     choices=["train", "inference", "serving", "checkpoint",
-                             "kvstore",
+                             "kvstore", "kvstore-mh-worker",
                              "fit", "decode"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
@@ -1309,6 +1386,10 @@ def main():
     ap.add_argument("--kv-ndev", type=int, default=4,
                     help="simulated per-key device gradient streams for "
                          "the kvstore bench (the CommDevice reduce width)")
+    ap.add_argument("--kv-hosts", type=int, default=2,
+                    help="process count of the kvstore='tpu' multi-host "
+                         "arm (spawned via tools/run_multihost.py; 1 "
+                         "skips the arm)")
     # fused fit step witnesses (--mode fit; also folded into the default
     # line as train_dispatches_per_step / host_syncs_per_step)
     ap.add_argument("--fit-batch", type=int, default=4)
@@ -1352,6 +1433,9 @@ def main():
     if args.mode == "kvstore":
         print(json.dumps(bench_kvstore(args)))
         return
+    if args.mode == "kvstore-mh-worker":
+        bench_kvstore_mh_worker(args)
+        return
     if args.mode == "fit":
         print(json.dumps(bench_fit(args)))
         return
@@ -1392,6 +1476,8 @@ def main():
     out["kvstore_push_pull_gbps"] = kvb["value"]
     out["kvstore_speedup_vs_eager"] = kvb["speedup_vs_eager"]
     out["kvstore_compress_ratio"] = kvb["kvstore_compress_ratio"]
+    out["kvstore_hosts"] = kvb["kvstore_hosts"]
+    out["crosshost_bytes_per_step"] = kvb["crosshost_bytes_per_step"]
     fit = bench_fit(args)
     out["train_dispatches_per_step"] = fit["train_dispatches_per_step"]
     out["host_syncs_per_step"] = fit["host_syncs_per_step"]

@@ -15,29 +15,51 @@ __version__ = "0.1.0"
 
 
 def _maybe_init_distributed():
-    """When spawned by tools/launch.py, join the collective world BEFORE
-    anything touches the XLA backend (jax.distributed.initialize must run
-    first). The reference does the analogous bootstrap on import: a
-    DMLC_ROLE=server process enters the ps-lite server loop from
-    python/mxnet/kvstore_server.py."""
+    """When spawned by tools/launch.py (reference DMLC env) or
+    tools/run_multihost.py (MXTPU_NUM_PROCESSES env, the kvstore='tpu'
+    contract — see kvstore_tpu/dist.py), join the collective world
+    BEFORE anything touches the XLA backend (jax.distributed.initialize
+    must run first). The reference does the analogous bootstrap on
+    import: a DMLC_ROLE=server process enters the ps-lite server loop
+    from python/mxnet/kvstore_server.py.
+
+    DELIBERATE duplication of kvstore_tpu/dist.initialize_from_env:
+    this must run before ANY heavy import (importing kvstore_tpu pulls
+    jax.numpy/ndarray, touching the XLA backend we must precede), so
+    the env contract is restated here — keep the two in sync."""
     import os
-    if os.environ.get("DMLC_ROLE") != "worker":
+    is_worker = os.environ.get("DMLC_ROLE") == "worker"
+    n_tpu = int(os.environ.get("MXTPU_NUM_PROCESSES", "0") or 0)
+    if not is_worker and n_tpu <= 1:
         return
-    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    n = n_tpu if n_tpu > 1 else int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if n <= 1:
+        return
     uri = os.environ.get("MXTPU_COORDINATOR")
     if uri is None:
         root = os.environ.get("DMLC_PS_ROOT_URI")
         port = os.environ.get("DMLC_PS_ROOT_PORT")
         uri = "%s:%s" % (root, port) if root and port else None
-    if n <= 1 or uri is None:
-        return
-    rank = os.environ.get("MXTPU_WORKER_RANK")
+    if uri is None:
+        # same contract as dist.initialize_from_env: a promised world
+        # with no coordinator must fail HERE, before the XLA backend is
+        # live, not later at kvstore creation with a weaker message
+        raise ImportError(
+            "distributed worker env found (num processes %d) but no "
+            "coordinator address (MXTPU_COORDINATOR=host:port, or "
+            "DMLC_PS_ROOT_URI/_PORT). Launch workers via "
+            "tools/run_multihost.py or tools/launch.py, which set the "
+            "whole contract." % n)
+    rank = os.environ.get("MXTPU_PROCESS_ID")
+    if rank is None:
+        rank = os.environ.get("MXTPU_WORKER_RANK")
     if rank is None:
         raise ImportError(
-            "distributed worker env found (DMLC_ROLE=worker, "
-            "DMLC_NUM_WORKER=%d) but MXTPU_WORKER_RANK is unset. Launch "
-            "workers via tools/launch.py — a collective world needs ranks "
-            "pinned at spawn (ps-lite assigned them dynamically)." % n)
+            "distributed worker env found (num processes %d) but no rank "
+            "(MXTPU_PROCESS_ID / MXTPU_WORKER_RANK). Launch workers via "
+            "tools/run_multihost.py or tools/launch.py — a collective "
+            "world needs ranks pinned at spawn (ps-lite assigned them "
+            "dynamically)." % n)
     import jax
     jax.distributed.initialize(uri, num_processes=n, process_id=int(rank))
     # keep this process' eager/jit results on its own devices: without a
@@ -95,6 +117,7 @@ from . import decode
 from . import profiler
 from . import telemetry
 from . import checkpoint
+from . import kvstore_tpu
 from . import monitor
 from .monitor import Monitor
 from . import test_utils

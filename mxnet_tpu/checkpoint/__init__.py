@@ -44,6 +44,7 @@ import time
 
 from . import manifest
 from . import snapshot
+from . import multihost
 from . import writer as writer_mod
 from . import preemption
 from .manifest import latest
@@ -56,7 +57,7 @@ __all__ = ["CheckpointManager", "AsyncCheckpointWriter",
            "PreemptionHandler", "latest", "load", "resolve_params",
            "restore", "save",
            "capture", "capture_params", "manifest", "snapshot",
-           "preemption"]
+           "multihost", "preemption"]
 
 
 def resolve_params(prefix, tag=None, epoch=None, what="reload"):

@@ -1,0 +1,269 @@
+"""Multi-host checkpoint commit: per-host shards, one manifest.
+
+A pod-scale kvstore='tpu' run has N processes with replicated
+params/optimizer state but HOST-LOCAL error-feedback residuals and RNG
+chains. Saving everything from rank 0 would both serialize the IO on
+one host and silently drop every other host's residuals; saving
+independently per host would leave N uncoordinated commit points.
+The protocol here (Orbax/TensorStore shape, on the crash-safe
+primitives of ``manifest.py``):
+
+1. Every rank writes ITS OWN shard crash-safely (tmp+fsync+rename):
+   ``<prefix>-<t>.shard<r>.params`` — its slice of the (replicated)
+   param/aux keys, round-robin by sorted name so shard sizes balance;
+   ``.shard<r>.states`` — the matching optimizer-state slice;
+   ``.shard<r>.extra`` — its host-LOCAL extras (residuals, RNG) plus
+   the replicated scheduler position; and a per-shard manifest
+   ``.shard<r>.json`` recording sizes + CRC32s.
+2. A barrier: nobody proceeds until every shard is durably in place.
+   A host dying mid-write times the barrier out and NO manifest is
+   ever published — the previous checkpoint stays the newest intact.
+3. Rank 0 alone publishes the TOP manifest naming every shard file
+   with its checksum — the single commit point. ``latest()`` therefore
+   validates the FULL shard set: truncate or bit-flip any one host's
+   shard and the whole tag is skipped in favor of the newest intact
+   checkpoint.
+
+Loading merges all shards (params/states are a disjoint partition);
+each rank re-seeds its own residuals/RNG from its own shard. The
+functions take explicit ``rank``/``world`` so a single process can
+exercise the full protocol (tests), with the barrier injected only in
+real multi-process worlds.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+import zlib
+
+import numpy as _np
+
+from . import manifest as _mf
+from .. import telemetry as _telemetry
+
+__all__ = ["shard_names", "write_shard", "commit_sharded",
+           "write_checkpoint_sharded", "load_sharded",
+           "is_sharded_manifest"]
+
+SHARD_BYTES = _telemetry.REGISTRY.counter(
+    "checkpoint_shard_bytes",
+    "bytes this process committed to its own checkpoint shards",
+    unit="bytes")
+SHARD_WRITES = _telemetry.REGISTRY.counter(
+    "checkpoint_shard_writes",
+    "checkpoint shards durably written by this process")
+SHARD_BARRIER_MS = _telemetry.REGISTRY.histogram(
+    "checkpoint_shard_barrier_ms",
+    "wall time this process waited for the all-shards-durable barrier "
+    "before the rank-0 manifest commit", unit="ms")
+
+
+def shard_names(names, rank, world):
+    """Deterministic round-robin partition of sorted ``names`` — every
+    rank computes the same disjoint cover with balanced cardinality."""
+    return sorted(names)[rank::world]
+
+
+def _shard_manifest_path(prefix, tag, rank):
+    return "%s-%s.shard%d.json" % (prefix, _mf.tag_str(tag), rank)
+
+
+def is_sharded_manifest(man):
+    return bool(man) and int(man.get("world", 1) or 1) > 1
+
+
+def write_shard(state, prefix, tag, rank, world):
+    """Write rank ``rank``'s shard of ``state`` crash-safely and publish
+    its per-shard manifest. Returns the shard record. Pure-local: no
+    barrier, no rank-0 privilege (except the shared symbol file, which
+    only rank 0 writes)."""
+    from ..ndarray import NDArray
+    from ..serialization import save_ndarray_file
+    t = _mf.tag_str(tag)
+    files, tensors, total = {}, {}, 0
+
+    if rank == 0 and state.get("symbol_json"):
+        # same skip-if-unchanged treatment as the single-host writer
+        sym_path = "%s-symbol.json" % prefix
+        blob = state["symbol_json"].encode()
+        try:
+            with open(sym_path, "rb") as f:
+                unchanged = f.read() == blob
+        except OSError:
+            unchanged = False
+        if unchanged:
+            nbytes, crc = len(blob), zlib.crc32(blob) & 0xFFFFFFFF
+        else:
+            nbytes, crc = _mf.atomic_write(sym_path, blob)
+        files["symbol"] = {"file": os.path.basename(sym_path),
+                           "bytes": nbytes, "crc32": crc}
+
+    mine_args = shard_names(state["args"], rank, world)
+    mine_auxs = shard_names(state["auxs"], rank, world)
+    save_dict = {"arg:%s" % k: state["args"][k] for k in mine_args}
+    save_dict.update({"aux:%s" % k: state["auxs"][k] for k in mine_auxs})
+    for key, v in save_dict.items():
+        raw = _np.ascontiguousarray(v)
+        tensors[key] = {"crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                        "bytes": raw.nbytes, "shape": list(raw.shape),
+                        "dtype": str(raw.dtype)}
+    params_path = "%s-%s.shard%d.params" % (prefix, t, rank)
+    nbytes, crc = _mf.atomic_write(
+        params_path,
+        writer=lambda tmp: save_ndarray_file(
+            tmp, {k: NDArray(_np.ascontiguousarray(v))
+                  for k, v in save_dict.items()}))
+    files["params"] = {"file": os.path.basename(params_path),
+                       "bytes": nbytes, "crc32": crc}
+    total += nbytes
+
+    if state.get("states") is not None:
+        mine = shard_names(state["states"], rank, world)
+        blob = pickle.dumps({k: state["states"][k] for k in mine})
+        states_path = "%s-%s.shard%d.states" % (prefix, t, rank)
+        nbytes, crc = _mf.atomic_write(states_path, blob)
+        files["states"] = {"file": os.path.basename(states_path),
+                           "bytes": nbytes, "crc32": crc}
+        total += nbytes
+
+    extra = state.get("extra") or {}
+    if any(v is not None for v in extra.values()):
+        blob = pickle.dumps(extra)
+        extra_path = "%s-%s.shard%d.extra" % (prefix, t, rank)
+        nbytes, crc = _mf.atomic_write(extra_path, blob)
+        files["extra"] = {"file": os.path.basename(extra_path),
+                          "bytes": nbytes, "crc32": crc}
+        total += nbytes
+
+    rec = {"rank": rank, "world": world, "files": files,
+           "tensors": tensors, "total_bytes": total}
+    _mf.atomic_write(_shard_manifest_path(prefix, tag, rank),
+                     __import__("json").dumps(rec, sort_keys=True).encode())
+    SHARD_BYTES.inc(total)
+    SHARD_WRITES.inc()
+    return rec
+
+
+def commit_sharded(prefix, tag, world, meta=None):
+    """Rank 0's commit: fold every per-shard manifest into ONE top
+    manifest naming all shard files (the single commit point), then
+    drop the per-shard manifests (they were only the handoff). Raises
+    OSError when a shard manifest is missing/undecodable — the caller's
+    barrier guarantees that never happens in a healthy job."""
+    import json
+    files, tensors, total = {}, {}, 0
+    for r in range(world):
+        path = _shard_manifest_path(prefix, tag, r)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            raise OSError("checkpoint commit: shard manifest %s is "
+                          "missing or unreadable (%s)" % (path, e))
+        for role, file_rec in rec["files"].items():
+            name = role if role == "symbol" else "shard%d_%s" % (r, role)
+            files[name] = file_rec
+        tensors.update(rec.get("tensors", {}))
+        total += int(rec.get("total_bytes", 0))
+    base_meta = {"world": world, "total_bytes": total, "time": time.time(),
+                 "library": "mxnet_tpu"}
+    base_meta.update(meta or {})
+    man = _mf.write_manifest(prefix, tag, files, tensors, base_meta)
+    for r in range(world):
+        try:
+            os.unlink(_shard_manifest_path(prefix, tag, r))
+        except OSError:
+            pass
+    return man
+
+
+def write_checkpoint_sharded(state, prefix, tag):
+    """The real multi-process commit (called from
+    ``snapshot.write_checkpoint`` when the captured state spans a
+    world): write my shard -> barrier -> rank 0 publishes -> barrier.
+    Every rank returns the committed manifest."""
+    from ..kvstore_tpu import dist
+    rank = int(state.get("rank", 0) or 0)
+    world = int(state.get("world", 1) or 1)
+    write_shard(state, prefix, tag, rank, world)
+    t0 = time.perf_counter()
+    dist.barrier("ckpt-shards")
+    SHARD_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
+    if rank == 0:
+        meta = {"epoch": state.get("epoch"), "step": state.get("step"),
+                "rng": state.get("rng")}
+        commit_sharded(prefix, tag, world, meta)
+        _telemetry.RECORDER.note("checkpoint_sharded_commit",
+                                 tag=int(tag), world=world)
+    dist.barrier("ckpt-commit")
+    man = _mf.read_manifest(prefix, tag)
+    if man is None:
+        raise OSError("checkpoint %s tag %s: manifest did not appear "
+                      "after the commit barrier" % (prefix, tag))
+    return man
+
+
+# ----------------------------------------------------------------------
+# loading
+# ----------------------------------------------------------------------
+def _shard_roles(man, role):
+    """[(rank, file_rec)] for one role, ascending rank."""
+    out = []
+    for name, rec in man.get("files", {}).items():
+        if name.startswith("shard") and name.endswith("_" + role):
+            out.append((int(name[len("shard"):-len("_" + role)]), rec))
+    return sorted(out)
+
+
+def load_sharded(prefix, man, rank=None, want_params=True):
+    """Merge a sharded checkpoint: ``(arg_params, aux_params,
+    states|None, extra)``. Params/states merge across ALL shards (a
+    disjoint partition); ``extra`` (residuals, host RNG) comes from
+    shard ``rank``'s file — host-local state belongs to the rank that
+    wrote it. A ``rank`` beyond the saved world (resume with a
+    different topology) degrades to shard 0's extras minus residuals,
+    with a warning. ``want_params=False`` skips the param-shard reads
+    (callers that already merged them via ``checkpoint.load``)."""
+    from .. import ndarray as nd
+    base_dir = os.path.dirname(prefix)
+    arg_params, aux_params = {}, {}
+    if want_params:
+        for _r, rec in _shard_roles(man, "params"):
+            for k, v in nd.load(os.path.join(base_dir,
+                                             rec["file"])).items():
+                tp, name = k.split(":", 1)
+                if tp == "arg":
+                    arg_params[name] = v
+                elif tp == "aux":
+                    aux_params[name] = v
+    states = None
+    state_shards = _shard_roles(man, "states")
+    if state_shards:
+        states = {}
+        for _r, rec in state_shards:
+            with open(os.path.join(base_dir, rec["file"]), "rb") as f:
+                states.update(pickle.load(f))
+    extra = {}
+    extra_shards = dict(_shard_roles(man, "extra"))
+    world = int(man.get("world", 1) or 1)
+    if rank is None:
+        rank = 0
+    drop_residuals = False
+    if rank >= world or rank not in extra_shards:
+        if extra_shards:
+            logging.warning(
+                "checkpoint %s: restoring rank %d from a world-%d "
+                "checkpoint — host-local residuals cannot be remapped "
+                "and are dropped (replicated extras come from shard 0)",
+                prefix, rank, world)
+            rank = min(extra_shards)
+            drop_residuals = True
+    if rank in extra_shards:
+        with open(os.path.join(base_dir, extra_shards[rank]["file"]),
+                  "rb") as f:
+            extra = pickle.load(f)
+        if drop_residuals:
+            extra.pop("residuals", None)
+    return arg_params, aux_params, states, extra

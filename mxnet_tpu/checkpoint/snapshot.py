@@ -73,11 +73,12 @@ def _as_ndarray(v):
 
 
 def _plain_kvstore(module):
-    """The module's single-process KVStore, or None (dist stores keep
-    their own server-side persistence)."""
-    from ..kvstore import KVStore
+    """The module's KVStore when its weights/residuals are process-
+    local (or replicated-deterministic) state this checkpointer may
+    capture — the plain local stores AND kvstore='tpu'. Legacy dist
+    stores keep server-side persistence and return None."""
     kv = getattr(module, "_kvstore", None)
-    return kv if type(kv) is KVStore else None
+    return kv if getattr(kv, "_captures_local_state", False) else None
 
 
 def _capture_residuals(module):
@@ -139,6 +140,17 @@ def _capture_optimizer(module):
     return states, extra
 
 
+def _capture_world(kv):
+    """(world, rank) for the multi-host sharded commit — engaged only
+    when the module trains over a multi-process kvstore='tpu' (other
+    multi-process configs, e.g. async PS workers, keep per-process
+    full checkpoints under their own prefixes)."""
+    from ..kvstore_tpu import KVStoreTPU
+    if isinstance(kv, KVStoreTPU) and kv.num_workers > 1:
+        return kv.num_workers, kv.rank
+    return 1, 0
+
+
 def capture(module, epoch=None, step=None, include_optimizer=True):
     """Snapshot the complete training state of ``module`` as host
     arrays. Runs on the training thread; blocks only for the
@@ -150,6 +162,7 @@ def capture(module, epoch=None, step=None, include_optimizer=True):
         # flush pending async buckets so states/weights are post-step
         kv._flush_pending()
     arg_params, aux_params = module.get_params()
+    world, rank = _capture_world(kv)
     state = {
         "symbol_json": (module.symbol.tojson()
                         if getattr(module, "symbol", None) is not None
@@ -160,6 +173,7 @@ def capture(module, epoch=None, step=None, include_optimizer=True):
                  for k, v in (aux_params or {}).items()},
         "epoch": epoch, "step": step,
         "rng": _rng_manifest_state(_random),
+        "world": world, "rank": rank,
     }
     extra = {"host_rng": _rng_host_state(_random)}
     if include_optimizer:
@@ -203,7 +217,12 @@ def _rng_host_state(random_mod):
 def write_checkpoint(state, prefix, tag):
     """Serialize ``state`` and publish checkpoint ``tag`` atomically.
     Returns the committed manifest. Total bytes written are in
-    ``manifest["total_bytes"]``."""
+    ``manifest["total_bytes"]``. A state captured over a multi-process
+    kvstore='tpu' world commits through the sharded multi-host protocol
+    instead (one shard per host, rank-0 manifest — multihost.py)."""
+    if int(state.get("world", 1) or 1) > 1:
+        from . import multihost as _mh
+        return _mh.write_checkpoint_sharded(state, prefix, tag)
     from ..ndarray import NDArray
     from ..serialization import save_ndarray_file
     base_dir = os.path.dirname(prefix)
@@ -305,7 +324,12 @@ def load(prefix, tag=None, verify=True):
     re-verify after parse unless ``verify=False``."""
     from .. import model as _model
     man = _resolve(prefix, tag)
-    arg_params, aux_params = _model.load_params(prefix, man["tag"])
+    from . import multihost as _mh
+    if _mh.is_sharded_manifest(man):
+        arg_params, aux_params, _states, _extra = _mh.load_sharded(
+            prefix, man)
+    else:
+        arg_params, aux_params = _model.load_params(prefix, man["tag"])
     if verify:
         _verify_tensors(man, arg_params, aux_params, prefix)
     symbol = None
@@ -389,15 +413,47 @@ def restore(module, prefix, tag=None, load_optimizer=True, verify=True,
         module._aux_params = aux_params
         module.params_initialized = True
 
-    states_rec = man.get("files", {}).get("states")
-    states_path = (os.path.join(os.path.dirname(prefix),
-                                states_rec["file"])
-                   if states_rec else None)
-    extra = _load_extra(prefix, man)
+    from . import multihost as _mh
+    if _mh.is_sharded_manifest(man):
+        import jax
+        rank = jax.process_index()
+        # params already merged by load() above — read only the
+        # states/extra shards here
+        _a, _b, merged_states, extra = _mh.load_sharded(
+            prefix, man, rank=rank, want_params=False)
+        states_path = None
+        if load_optimizer and merged_states is not None:
+            # Module.load_optimizer_states consumes a FILE in the
+            # legacy pickle format — publish the merged partition
+            # crash-safely next to the shards (also serves the
+            # deferred _preload_opt_states path on a bare module).
+            # Rank-unique name: every rank of a shared-FS world
+            # restores concurrently, and atomic_write's tmp names are
+            # only pid/thread-unique WITHIN a host
+            states_path = "%s-%s.states.merged.r%d" % (
+                prefix, _mf.tag_str(tag), rank)
+            _mf.atomic_write(states_path, pickle.dumps(
+                {k: _as_ndarray(v) for k, v in merged_states.items()}))
+    else:
+        states_rec = man.get("files", {}).get("states")
+        states_path = (os.path.join(os.path.dirname(prefix),
+                                    states_rec["file"])
+                       if states_rec else None)
+        extra = _load_extra(prefix, man)
 
     if load_optimizer and states_path is not None:
         if getattr(module, "optimizer_initialized", False):
             module.load_optimizer_states(states_path)
+            if _mh.is_sharded_manifest(man):
+                # the merged-states file was only the handoff into
+                # load_optimizer_states — it is named in no manifest,
+                # so rotation would never collect it (the deferred
+                # _preload_opt_states branch below must keep it until
+                # init_optimizer consumes it)
+                try:
+                    os.unlink(states_path)
+                except OSError:
+                    pass
             optimizer = getattr(module, "_optimizer", None)
             if optimizer is not None:
                 counts = extra.get("index_update_count") or {}

@@ -24,6 +24,7 @@ applied on push (parallel/compression.py).
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 
@@ -34,16 +35,46 @@ from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import ndarray as nd
 from . import optimizer as opt
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create"]
 
+# every push (or whole store) that leaves the compiled hot path counts
+# here under a bounded reason label, plus ONE log warning per reason —
+# a dist config silently riding the eager per-key loop used to forfeit
+# the entire PR2/PR3 launch-count win with no signal at all
+FALLBACKS = _telemetry.REGISTRY.counter(
+    "kvstore_fallbacks",
+    "pushes (or stores) that left the compiled bucketed hot path, "
+    "labeled by reason", vital=True)
+_warned_fallbacks = set()
+
+
+def _note_fallback(reason, detail=None, level=logging.WARNING):
+    """Count a hot-path fallback and warn ONCE per reason."""
+    FALLBACKS.labels(reason=reason).inc()
+    if reason not in _warned_fallbacks:
+        _warned_fallbacks.add(reason)
+        logging.log(
+            level,
+            "kvstore: falling back to the eager per-key path (%s)%s — "
+            "this forfeits the compiled bucketed hot path "
+            "(docs/KVSTORE.md); further occurrences are counted in the "
+            "kvstore_fallbacks telemetry series without this warning",
+            reason, " [%s]" % detail if detail else "")
+
 
 def create(name="local"):
-    """Create a KVStore (reference kvstore.cc:40 string dispatch)."""
+    """Create a KVStore (reference kvstore.cc:40 string dispatch).
+    ``'tpu'``/``'tpu_device'`` (and the legacy ``'nccl'`` alias) build
+    the collective multi-host store (kvstore_tpu/, docs/KVSTORE.md)."""
     if not isinstance(name, str):
         raise TypeError("name must be str")
+    if name in ("nccl", "tpu", "tpu_device"):
+        from .kvstore_tpu import KVStoreTPU
+        return KVStoreTPU(name)
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
-                "local_allreduce_device", "device", "nccl", "tpu"):
+                "local_allreduce_device", "device"):
         return KVStore(name)
     if "async" in name and name.startswith("dist"):
         # real Hogwild-style parameter servers (kvstore_async.py):
@@ -58,6 +89,13 @@ def create(name="local"):
 
 class KVStore:
     """Single-process kvstore (reference kvstore_local.h:53)."""
+
+    # True when this store's weights/residuals are process-local (or
+    # replicated-deterministic) state that mx.checkpoint may capture and
+    # Module may key-translate; the legacy dist stores keep server-side
+    # state and override this to False (snapshot._plain_kvstore,
+    # Module._states_use_kvstore_file read it)
+    _captures_local_state = True
 
     def __init__(self, name="local"):
         self._type = name
@@ -118,9 +156,13 @@ class KVStore:
         eng = self._get_engine()
         mode = eng._updater_mode() if eng is not None else False
         for k, vlist, prio in zip(keys, values, prios):
-            if eng is not None and eng.eligible(k, vlist, mode):
+            reason = eng.ineligible_reason(k, vlist, mode) \
+                if eng is not None else None
+            if eng is not None and reason is None:
                 eng.enqueue(k, vlist, prio)
             else:
+                if eng is not None:
+                    _note_fallback(reason, detail="key %r" % (k,))
                 self._push_one(k, vlist)
         if eng is not None and not self._async_push:
             eng.flush()

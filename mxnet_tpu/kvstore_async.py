@@ -336,9 +336,18 @@ class KVStoreDistAsync(KVStore):
     contract)."""
 
     _next_app = [0]
+    _captures_local_state = False   # state lives on the servers
 
     def __init__(self, name="dist_async"):
         super().__init__(name)
+        # push is overridden: the compiled bucketed engine never
+        # engages and every push is an eager wire round-trip — signal
+        # it once + count it (kvstore_fallbacks), like kvstore_dist
+        from .kvstore import _note_fallback
+        _note_fallback(
+            "legacy_dist_kvstore:%s" % name,
+            detail="async parameter-server store, every push is eager "
+                   "per-key (Hogwild semantics need it)")
         self._app_id = KVStoreDistAsync._next_app[0]
         KVStoreDistAsync._next_app[0] += 1
         self._rank = int(os.environ.get("MXTPU_WORKER_RANK", "0"))

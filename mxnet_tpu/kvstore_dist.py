@@ -63,6 +63,9 @@ def _ensure_dist():
 class KVStoreDist(KVStore):
     """Multi-process synchronous kvstore (see module docstring)."""
 
+    _captures_local_state = False    # replicated-by-collective, but the
+    # legacy persistence contract keeps state behind the kvstore file API
+
     def __init__(self, name="dist_sync"):
         super().__init__(name)
         if "async" in name:
@@ -70,6 +73,15 @@ class KVStoreDist(KVStore):
                 "KVStoreDist is the collective (sync) transport; "
                 "'%s' must be created via mx.kv.create, which dispatches "
                 "async names to kvstore_async.KVStoreDistAsync" % name)
+        # this store overrides push, so the compiled bucketed engine
+        # never engages: every step rides the eager per-key loop — say
+        # so ONCE (and count it) instead of silently forfeiting the
+        # hot path; kvstore='tpu' is the compiled multi-host store
+        from .kvstore import _note_fallback
+        _note_fallback(
+            "legacy_dist_kvstore:%s" % name,
+            detail="ps-lite-shaped store, every push is eager per-key; "
+                   "use kvstore='tpu' for the compiled collective path")
         _ensure_dist()
         import jax
         self._rank = jax.process_index()

@@ -273,27 +273,41 @@ class FusedBucketEngine:
     def eligible(self, key, vlist, mode):
         """mode: the result of _updater_mode(), computed once per push
         call by the caller (it cannot change mid-call)."""
+        return self.ineligible_reason(key, vlist, mode) is None
+
+    def ineligible_reason(self, key, vlist, mode):
+        """None when the push may take the compiled bucketed path, else
+        a BOUNDED reason slug (it becomes a telemetry label on the
+        ``kvstore_fallbacks`` counter — keep key names and shapes out)."""
         if mode is False:
-            return False
+            from .optimizer import Updater
+            updater = self._kv._updater
+            if not isinstance(updater, Updater):
+                return "custom_updater"
+            return ("optimizer_no_fused_sig:%s"
+                    % type(updater.optimizer).__name__)
         for v in vlist:
             if not isinstance(v, NDArray):
-                return False
+                return "non_ndarray_value"
             if getattr(v, "stype", "default") != "default":
-                return False
+                return "sparse_value"
             if v.dtype != _np.float32:
-                return False
+                return "non_f32_dtype"
             if v.shape != vlist[0].shape:
-                return False
+                return "mismatched_device_shapes"
         if mode is not None:
             stored = self._kv._store.get(key)
-            if stored is None or stored.dtype != _np.float32 \
+            if stored is None:
+                return "key_not_initialized"
+            if stored.dtype != _np.float32 \
                     or stored.shape != vlist[0].shape:
-                return False
+                return "stored_value_mismatch"
             from .kvstore import _updater_key
             st = self._kv._updater.states.get(_updater_key(key))
             if st is not None and not isinstance(st, NDArray):
-                return False   # e.g. multi-precision (state, weight32) tuple
-        return True
+                # e.g. multi-precision (state, weight32) tuple
+                return "non_fusable_optimizer_state"
+        return None
 
     # -- queue ----------------------------------------------------------
     @property
@@ -390,6 +404,36 @@ class FusedBucketEngine:
         return _SITE.timed(self._dispatch_inner, bucket, mode,
                            dispatch_hist=DISPATCH_MS)
 
+    def _updater_inputs(self, bucket):
+        """Collect the live optimizer-apply inputs for one bucket (and
+        perform the per-key update-count side effects) — shared by the
+        single-process bucket program and the tpu kvstore's cross-host
+        programs (kvstore_tpu/engine.py) so keying/lr/wd semantics can
+        never drift between them."""
+        from .kvstore import _updater_key
+        kv = self._kv
+        updater = kv._updater
+        opt = updater.optimizer
+        ukeys = [_updater_key(it.key) for it in bucket]
+        weights_nd, states_nd = [], []
+        for it, uk in zip(bucket, ukeys):
+            w = kv._store[it.key]
+            if uk not in updater.states:
+                updater.states[uk] = opt.create_state_multi_precision(
+                    uk, w)
+                updater.states_synced[uk] = True
+            weights_nd.append(w)
+            states_nd.append(updater.states[uk])
+            opt._update_count(uk)
+        lr_vec = _np.asarray([opt._get_lr(uk) for uk in ukeys],
+                             _np.float32)
+        wd_vec = _np.asarray([opt._get_wd(uk) for uk in ukeys],
+                             _np.float32)
+        use_wd = bool(_np.any(wd_vec != 0.0))
+        state_mask = tuple(st is not None for st in states_nd)
+        return (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
+                state_mask, _np.float32(opt.rescale_grad))
+
     def _dispatch_inner(self, bucket, mode):
         kv = self._kv
         comp = kv._compression
@@ -426,26 +470,8 @@ class FusedBucketEngine:
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(out, ctx0)
         else:
-            from .kvstore import _updater_key
-            updater = kv._updater
-            opt = updater.optimizer
-            ukeys = [_updater_key(it.key) for it in bucket]
-            weights_nd, states_nd = [], []
-            for it, uk in zip(bucket, ukeys):
-                w = kv._store[it.key]
-                if uk not in updater.states:
-                    updater.states[uk] = opt.create_state_multi_precision(
-                        uk, w)
-                    updater.states_synced[uk] = True
-                weights_nd.append(w)
-                states_nd.append(updater.states[uk])
-                opt._update_count(uk)
-            lr_vec = _np.asarray([opt._get_lr(uk) for uk in ukeys],
-                                 _np.float32)
-            wd_vec = _np.asarray([opt._get_wd(uk) for uk in ukeys],
-                                 _np.float32)
-            use_wd = bool(_np.any(wd_vec != 0.0))
-            state_mask = tuple(st is not None for st in states_nd)
+            (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
+             state_mask, rescale) = self._updater_inputs(bucket)
             sig = (mode, threshold, n_dev, layout, state_mask, use_wd)
             fn = self._steps.get(sig)
             if fn is None:
@@ -454,7 +480,6 @@ class FusedBucketEngine:
             weights = tuple(w._data for w in weights_nd)
             states = tuple(st._data if st is not None else None
                            for st in states_nd)
-            rescale = _np.float32(opt.rescale_grad)
             new_ws, new_ss, new_res = fn(weights, states, residuals,
                                          grads, lr_vec, wd_vec, rescale)
             for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):

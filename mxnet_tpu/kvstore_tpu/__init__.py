@@ -1,0 +1,18 @@
+"""kvstore='tpu' — multi-host data parallelism over real ICI/DCN
+collectives (docs/KVSTORE.md, "The tpu kvstore").
+
+Created via ``mx.kv.create('tpu')`` (alias ``'tpu_device'``). Layout:
+
+* ``dist.py``   — world bootstrap (env-driven ``jax.distributed``
+  initialize), the global process mesh, and the coordination-service
+  collectives (allgather/broadcast/barrier) that work on every backend.
+* ``engine.py`` — the cross-host compiled bucket engine: 2-bit compress
+  -> cross-host all-reduce -> fused optimizer apply as ONE jitted GSPMD
+  program per bucket (with a two-program host transport on backends
+  whose XLA runtime cannot span processes, i.e. CPU).
+* ``store.py``  — the KVStore subclass gluing it together.
+"""
+from . import dist
+from .store import KVStoreTPU
+
+__all__ = ["KVStoreTPU", "dist"]

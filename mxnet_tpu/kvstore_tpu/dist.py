@@ -1,0 +1,275 @@
+"""Collective world bootstrap + host-side collectives for kvstore='tpu'.
+
+Two transports live behind this module (docs/KVSTORE.md):
+
+* **XLA/GSPMD** — on backends whose runtime executes multi-process
+  programs (TPU ICI/DCN, GPU NCCL), cross-host reduction happens INSIDE
+  the compiled bucket/fit programs; this module only bootstraps the
+  world (``jax.distributed.initialize``) and builds the process mesh.
+* **Coordination service** — the jax distributed runtime's gRPC
+  key-value store + barriers (the same channel jax uses to exchange
+  topology at startup). It works on EVERY backend, including the CPU
+  backend whose XLA runtime cannot run multi-process computations at
+  all (``Multiprocess computations aren't implemented on the CPU
+  backend`` — the root cause of the legacy ps-lite-shaped dist test
+  failures). ``allgather_bytes``/``broadcast_bytes``/``barrier`` here
+  are the portable fallback transport the tpu kvstore splices between
+  its local compiled programs on such backends.
+
+Environment contract (set by tools/run_multihost.py; reference DMLC
+names also honored for tools/launch.py compatibility):
+
+* ``MXTPU_COORDINATOR``   — ``host:port`` of process 0's coordinator
+  (fallback: ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``)
+* ``MXTPU_NUM_PROCESSES`` — world size (fallback ``DMLC_NUM_WORKER``)
+* ``MXTPU_PROCESS_ID``    — this process' rank (fallback
+  ``MXTPU_WORKER_RANK``)
+
+With none of these set, the world is this single process and every code
+path still runs (mesh of one device, collectives are identities) — the
+CPU container and tier-1 exercise the full subsystem that way.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..base import MXNetError
+
+__all__ = ["initialize_from_env", "ensure_initialized", "world_size",
+           "rank", "process_mesh", "barrier", "allgather_bytes",
+           "broadcast_bytes", "allreduce_sum_np"]
+
+_lock = threading.Lock()
+_state = {"checked": False, "seq": {}}
+
+_DEFAULT_TIMEOUT_MS = int(os.environ.get("MXTPU_COLLECTIVE_TIMEOUT_MS",
+                                         "120000"))
+
+
+def _env_coordinator():
+    uri = os.environ.get("MXTPU_COORDINATOR")
+    if uri:
+        return uri
+    root = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT")
+    return "%s:%s" % (root, port) if root and port else None
+
+
+def _env_world():
+    """(num_processes, process_id|None, coordinator) from the
+    environment; (1, 0, None) means single-process. ``process_id`` is
+    None when a multi-process world is promised without a rank — the
+    callers must raise, never default to 0 (two processes silently
+    joining as rank 0 hang at the coordinator; the package-import
+    bootstrap in mxnet_tpu/__init__.py enforces the same contract)."""
+    n = int(os.environ.get("MXTPU_NUM_PROCESSES")
+            or os.environ.get("DMLC_NUM_WORKER") or 1)
+    pid = os.environ.get("MXTPU_PROCESS_ID")
+    if pid is None:
+        pid = os.environ.get("MXTPU_WORKER_RANK")
+    if pid is None:
+        pid = 0 if n <= 1 else None
+    return n, int(pid) if pid is not None else None, _env_coordinator()
+
+
+def initialize_from_env():
+    """Join the collective world described by the environment. MUST run
+    before anything touches the XLA backend (mxnet_tpu's package import
+    calls it first thing); a no-op for a single-process environment or
+    when the world is already up."""
+    n, pid, uri = _env_world()
+    if n <= 1:
+        return False
+    if uri is None:
+        raise MXNetError(
+            "kvstore='tpu': MXTPU_NUM_PROCESSES=%d but no coordinator "
+            "address (set MXTPU_COORDINATOR=host:port, or launch via "
+            "tools/run_multihost.py which sets the whole contract)" % n)
+    if pid is None:
+        raise MXNetError(
+            "kvstore='tpu': MXTPU_NUM_PROCESSES=%d but no rank "
+            "(MXTPU_PROCESS_ID) — a collective world needs ranks pinned "
+            "at spawn; launch via tools/run_multihost.py" % n)
+    import jax
+    from jax._src import distributed as _jdist
+    if _jdist.global_state.client is not None:
+        return True       # already initialized (idempotent)
+    jax.distributed.initialize(uri, num_processes=n, process_id=pid)
+    # keep this process' eager/jit results on its own devices: without
+    # a default device, multi-controller jit replicates outputs across
+    # the whole world and host reads of them fail
+    jax.config.update("jax_default_device", jax.local_devices()[0])
+    return True
+
+
+def ensure_initialized():
+    """Validate (and if still possible, perform) world initialization at
+    kvstore-creation time. Raises with launch guidance when the env
+    promises a world the process never joined."""
+    with _lock:
+        if _state["checked"]:
+            return
+        n, _pid, _uri = _env_world()
+        import jax
+        from jax._src import distributed as _jdist
+        if n > 1 and _jdist.global_state.client is None:
+            # the backend may already be live, in which case
+            # jax.distributed.initialize raises — surface OUR contract
+            try:
+                initialize_from_env()
+            except MXNetError:
+                raise
+            except Exception as e:
+                raise MXNetError(
+                    "kvstore='tpu': MXTPU_NUM_PROCESSES=%d but the "
+                    "collective world was not initialized at import "
+                    "(%s). Launch workers via tools/run_multihost.py so "
+                    "jax.distributed.initialize precedes any XLA backend "
+                    "use." % (n, e)) from e
+        if n > 1 and jax.process_count() != n:
+            raise MXNetError(
+                "kvstore='tpu': MXTPU_NUM_PROCESSES=%d but "
+                "jax.process_count()=%d — rank/coordinator env is "
+                "inconsistent" % (n, jax.process_count()))
+        _state["checked"] = True
+
+
+def world_size():
+    import jax
+    return jax.process_count()
+
+
+def rank():
+    import jax
+    return jax.process_index()
+
+
+def process_mesh():
+    """1-D 'dp' Mesh with ONE device per process (each process' first
+    local device) — the cross-host reduction axis for the bucketed
+    kvstore programs. Local multi-device gradient streams are folded on
+    that device inside the bucket program, so the mesh shape is always
+    (num_processes,) and every per-process array shard lifts into a
+    global array metadata-only (no device copy)."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+    devs = [None] * jax.process_count()
+    for d in jax.devices():
+        if devs[d.process_index] is None:
+            devs[d.process_index] = d
+    if any(d is None for d in devs):
+        raise MXNetError("kvstore='tpu': some processes expose no devices")
+    return Mesh(_np.array(devs), ("dp",))
+
+
+def gspmd_supported():
+    """True when compiled programs may span processes on this backend.
+    The CPU XLA runtime cannot ('Multiprocess computations aren't
+    implemented on the CPU backend'); there the tpu kvstore splices the
+    coordination-service transport between local programs instead."""
+    import jax
+    return jax.process_count() == 1 or jax.default_backend() != "cpu"
+
+
+# ----------------------------------------------------------------------
+# coordination-service collectives (portable transport)
+# ----------------------------------------------------------------------
+def _client():
+    from jax._src import distributed as _jdist
+    c = _jdist.global_state.client
+    if c is None:
+        raise MXNetError(
+            "kvstore='tpu': coordination-service collective requested "
+            "but jax.distributed was never initialized (single-process "
+            "worlds must not reach this path)")
+    return c
+
+
+def _next_seq(tag):
+    """Deterministic per-tag sequence number. All processes issue
+    collectives in the same program order (SPMD discipline, enforced by
+    the kvstore's synchronous push semantics), so independent counters
+    agree across ranks."""
+    with _lock:
+        s = _state["seq"].get(tag, 0)
+        _state["seq"][tag] = s + 1
+    return s
+
+
+def barrier(tag, timeout_ms=None):
+    """Global barrier over all processes (no-op single-process)."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    _client().wait_at_barrier("mxtpu/b/%s/%d" % (tag, _next_seq("b" + tag)),
+                              timeout_ms or _DEFAULT_TIMEOUT_MS)
+
+
+def _cleanup(c, key):
+    try:
+        c.key_value_delete(key)
+    except Exception:
+        pass        # older jaxlib without delete: keys leak per step,
+    # bounded by the coordination service's process lifetime
+
+
+def allgather_bytes(tag, payload, timeout_ms=None):
+    """Gather one bytes payload per process, returned in rank order
+    (single-process: ``[payload]``). Rides the coordination service's
+    key-value store; a trailing barrier lets each rank delete its own
+    key so long runs don't grow the coordinator's store unboundedly."""
+    import jax
+    n = jax.process_count()
+    if n == 1:
+        return [payload]
+    c = _client()
+    r = jax.process_index()
+    t = timeout_ms or _DEFAULT_TIMEOUT_MS
+    base = "mxtpu/ag/%s/%d" % (tag, _next_seq("ag" + tag))
+    mine = "%s/%d" % (base, r)
+    c.key_value_set_bytes(mine, bytes(payload))
+    out = [c.blocking_key_value_get_bytes("%s/%d" % (base, i), t)
+           for i in range(n)]
+    c.wait_at_barrier(base + "/done", t)
+    _cleanup(c, mine)
+    return out
+
+
+def broadcast_bytes(tag, payload, root=0, timeout_ms=None):
+    """Broadcast ``payload`` from ``root`` to every process (identity
+    single-process)."""
+    import jax
+    n = jax.process_count()
+    if n == 1:
+        return payload
+    c = _client()
+    t = timeout_ms or _DEFAULT_TIMEOUT_MS
+    key = "mxtpu/bc/%s/%d" % (tag, _next_seq("bc" + tag))
+    if jax.process_index() == root:
+        c.key_value_set_bytes(key, bytes(payload))
+        out = bytes(payload)
+    else:
+        out = c.blocking_key_value_get_bytes(key, t)
+    c.wait_at_barrier(key + "/done", t)
+    if jax.process_index() == root:
+        _cleanup(c, key)
+    return out
+
+
+def allreduce_sum_np(tag, arr, timeout_ms=None):
+    """Sum a host numpy array across processes in RANK ORDER (the
+    deterministic reduction every rank replays identically, so
+    replicated optimizer state stays bit-identical). Identity for a
+    single process."""
+    import numpy as _np
+    import jax
+    if jax.process_count() == 1:
+        return arr
+    arr = _np.ascontiguousarray(arr)
+    parts = allgather_bytes(tag, arr.tobytes(), timeout_ms=timeout_ms)
+    total = _np.frombuffer(parts[0], arr.dtype).reshape(arr.shape).copy()
+    for p in parts[1:]:
+        total += _np.frombuffer(p, arr.dtype).reshape(arr.shape)
+    return total

@@ -1,0 +1,365 @@
+"""Cross-host compiled bucket engine for kvstore='tpu'.
+
+Extends the PR2 bucketed engine (kvstore_fused.FusedBucketEngine — the
+pending queue, priority packing, streaming flush, and flat
+error-feedback residual ownership are all inherited unchanged) with a
+cross-host reduction stage. Two transports (docs/KVSTORE.md):
+
+* **GSPMD** (TPU ICI/DCN; also every single-process world, so the CPU
+  container and tier-1 exercise this exact path): each bucket is ONE
+  jitted program spanning the process mesh —
+
+      2-bit quantize per (process, device-stream) against its own
+      DONATED flat error-feedback residual
+        -> sequential stream sum (same order as single-host)
+        -> cross-host all-reduce (``sum`` over the sharded 'dp' axis;
+           XLA lowers it onto ICI/DCN)
+        -> per-key fused optimizer apply on the replicated weights
+
+  Per-process arrays lift into global arrays METADATA-ONLY: the mesh
+  has one device per process, so a local ``(s0, ...)`` block is exactly
+  one shard of a global ``(P*s0, ...)`` array sharded on axis 0, and a
+  local replicated copy is exactly one shard of a ``P()``-sharded
+  global array. No extra device launches, no copies.
+
+* **Host** (multi-process on the CPU backend, whose XLA runtime cannot
+  execute cross-process programs): the same quantize+local-reduce runs
+  as one LOCAL jitted program per bucket, the flat contribution crosses
+  hosts through the coordination-service allgather (rank-order
+  deterministic sum), and a second local program applies the optimizer.
+  2 launches + 1 host sync per bucket — the portability path, priced in
+  ``kvstore_tpu_allgather_ms``; on real accelerator backends the GSPMD
+  path is chosen automatically.
+
+Semantics match single-host 2-bit training bit-for-bit modulo reduction
+order: the quantize op sequence is the shared ``two_bit_quantize`` and
+residuals stay host-local per (process, device-stream).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ndarray import NDArray
+from .. import telemetry as _telemetry
+from ..kvstore_fused import (FusedBucketEngine, two_bit_quantize,
+                             fused_sgd_apply, _note_retrace, _SITE,
+                             DISPATCH_MS, _on_device)
+from . import dist
+
+__all__ = ["TPUBucketEngine"]
+
+HOSTS = _telemetry.REGISTRY.gauge(
+    "kvstore_tpu_hosts", "process count of the tpu kvstore's world")
+CROSSHOST_BYTES = _telemetry.REGISTRY.counter(
+    "kvstore_tpu_crosshost_bytes",
+    "bytes this process contributed to cross-host gradient reduction "
+    "(0 in a single-process world)", unit="bytes")
+ALLGATHER_MS = _telemetry.REGISTRY.histogram(
+    "kvstore_tpu_allgather_ms",
+    "host wall time of one coordination-service allgather (the CPU-"
+    "backend transport; unused when reduction rides GSPMD)", unit="ms")
+
+
+def _build_tpu_step(layout, n_dev, nproc, threshold, mode, state_mask,
+                    use_wd):
+    """ONE GSPMD program per bucket: compress -> cross-host all-reduce
+    -> optimizer apply. Inputs arrive as global arrays over the process
+    mesh: grads/residuals sharded on axis 0 ('dp'), weights/states
+    replicated. For nproc == 1 this is semantically identical to the
+    single-host bucket program (kvstore_fused._build_step): the same
+    ``two_bit_quantize`` per stream, the same sequential stream-sum
+    order, and ``sum(axis=0)`` over one process is exact."""
+    n_keys = len(layout)
+
+    def _reduce(residuals, grads):
+        """(per-key replicated reduced list, new sharded residuals)."""
+        if threshold is None:
+            reduced = []
+            for i, (_off, _size, shape) in enumerate(layout):
+                acc = grads[0][i]
+                for d in range(1, n_dev):
+                    acc = acc + grads[d][i]
+                # (P*s0, ...) -> (P, s0, ...) is a local reshape (row-
+                # major blocks == shards); the axis-0 sum is the cross-
+                # host all-reduce
+                reduced.append(acc.reshape((nproc,) + tuple(shape))
+                               .sum(axis=0))
+            return reduced, ()
+        dev_q, new_res = [], []
+        for d in range(n_dev):
+            g = grads[d][0].reshape(nproc, -1) if n_keys == 1 \
+                else jnp.concatenate(
+                    [grads[d][i].reshape(nproc, -1) for i in range(n_keys)],
+                    axis=1)
+            q, r = two_bit_quantize(residuals[d].reshape(nproc, -1), g,
+                                    threshold)
+            new_res.append(r.reshape(-1))
+            dev_q.append(q)
+        flat = dev_q[0]
+        for q in dev_q[1:]:
+            flat = flat + q
+        flat = flat.sum(axis=0)          # cross-host all-reduce
+        reduced = [lax.slice(flat, (off,), (off + size,)).reshape(shape)
+                   for off, size, shape in layout]
+        return reduced, tuple(new_res)
+
+    if mode is None:
+        def step(residuals, grads):
+            _note_retrace()
+            reduced, new_res = _reduce(residuals, grads)
+            return tuple(reduced), new_res
+        return jax.jit(step, donate_argnums=(0,))
+
+    kind, momentum, clip = mode
+    assert kind == "sgd"
+
+    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale):
+        _note_retrace()
+        reduced, new_res = _reduce(residuals, grads)
+        new_ws, new_ss = [], []
+        for i in range(n_keys):
+            new_w, new_s = fused_sgd_apply(
+                weights[i], reduced[i], states[i] if state_mask[i] else None,
+                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            new_ws.append(new_w)
+            new_ss.append(new_s)
+        return tuple(new_ws), tuple(new_ss), new_res
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def _build_local_reduce(layout, n_dev, threshold):
+    """Host-transport stage 1 (one LOCAL program): quantize per stream
+    against the donated flat residuals, sequential stream sum, flat
+    output ready for the wire. Dense buckets flatten too — the payload
+    must be one buffer either way."""
+    n_keys = len(layout)
+
+    def step(residuals, grads):
+        _note_retrace()
+        if threshold is None:
+            dev_flat = []
+            for d in range(n_dev):
+                dev_flat.append(
+                    grads[d][0].reshape(-1) if n_keys == 1
+                    else jnp.concatenate([grads[d][i].reshape(-1)
+                                          for i in range(n_keys)]))
+            flat = dev_flat[0]
+            for f in dev_flat[1:]:
+                flat = flat + f
+            return flat, ()
+        dev_q, new_res = [], []
+        for d in range(n_dev):
+            g = grads[d][0].reshape(-1) if n_keys == 1 else jnp.concatenate(
+                [grads[d][i].reshape(-1) for i in range(n_keys)])
+            q, r = two_bit_quantize(residuals[d], g, threshold)
+            new_res.append(r)
+            dev_q.append(q)
+        flat = dev_q[0]
+        for q in dev_q[1:]:
+            flat = flat + q
+        return flat, tuple(new_res)
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _build_local_apply(layout, state_mask, use_wd, mode):
+    """Host-transport stage 2 (one LOCAL program): slice the globally
+    reduced flat gradient per key and run the fused optimizer apply."""
+    kind, momentum, clip = mode
+    assert kind == "sgd"
+
+    def step(weights, states, red_flat, lr_vec, wd_vec, rescale):
+        _note_retrace()
+        new_ws, new_ss = [], []
+        for i, (off, size, shape) in enumerate(layout):
+            g = lax.slice(red_flat, (off,), (off + size,)).reshape(shape)
+            new_w, new_s = fused_sgd_apply(
+                weights[i], g, states[i] if state_mask[i] else None,
+                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            new_ws.append(new_w)
+            new_ss.append(new_s)
+        return tuple(new_ws), tuple(new_ss)
+    return jax.jit(step, donate_argnums=(1,))
+
+
+class TPUBucketEngine(FusedBucketEngine):
+    """FusedBucketEngine + cross-host reduction over the process mesh."""
+
+    def __init__(self, kv):
+        super().__init__(kv)
+        self._nproc = dist.world_size()
+        self._gspmd = dist.gspmd_supported()
+        self._mesh = dist.process_mesh() if self._gspmd else None
+        self._local_dev = jax.local_devices()[0]
+        HOSTS.set(self._nproc)
+
+    # -- global-array lifting (metadata-only, no device launches) ------
+    def _shard_spec(self):
+        return NamedSharding(self._mesh, P("dp"))
+
+    def _repl_spec(self):
+        return NamedSharding(self._mesh, P())
+
+    def _lift_shard(self, x):
+        """Local (s0, ...) block -> global (P*s0, ...) sharded on axis 0."""
+        if self._nproc == 1 and not x.shape:
+            x = x.reshape(1)
+        gshape = (self._nproc * x.shape[0],) + tuple(x.shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            gshape, self._shard_spec(), [x])
+
+    def _lift_repl(self, x):
+        """Local full copy -> global replicated array."""
+        return jax.make_array_from_single_device_arrays(
+            x.shape, self._repl_spec(), [x])
+
+    def _unlift(self, x):
+        """Back to this process' addressable single-device view."""
+        return x.addressable_data(0) if self._nproc > 1 else x
+
+    # -- eligibility ----------------------------------------------------
+    def ineligible_reason(self, key, vlist, mode):
+        reason = super().ineligible_reason(key, vlist, mode)
+        if reason is None and self._gspmd and not vlist[0].shape:
+            # a 0-d value has no axis to shard the process dimension
+            # onto; the eager path cross-host-reduces it correctly
+            return "scalar_value"
+        return reason
+
+    # -- dispatch -------------------------------------------------------
+    def _dispatch_inner(self, bucket, mode):
+        # normalize every stream onto this process' mesh device FIRST so
+        # residual seeding and global-array lifting see one placement
+        for it in bucket:
+            it.data = [_on_device(d, self._local_dev) for d in it.data]
+        if self._gspmd:
+            self._dispatch_gspmd(bucket, mode)
+        else:
+            self._dispatch_host(bucket, mode)
+
+    def _bucket_layout(self, bucket):
+        layout, off = [], 0
+        for it in bucket:
+            layout.append((off, it.size, it.shape))
+            off += it.size
+        return tuple(layout), off
+
+    def _wire_bytes(self, nbytes):
+        if self._nproc > 1:
+            CROSSHOST_BYTES.inc(nbytes)
+
+    def _dispatch_gspmd(self, bucket, mode):
+        kv = self._kv
+        comp = kv._compression
+        threshold = comp.threshold if comp is not None else None
+        n_dev = bucket[0].n_dev
+        layout, flat_len = self._bucket_layout(bucket)
+
+        grads = tuple(tuple(self._lift_shard(it.data[d]) for it in bucket)
+                      for d in range(n_dev))
+        residuals, keys_tuple = (), None
+        if comp is not None:
+            keys_tuple = tuple(it.key for it in bucket)
+            residuals = tuple(
+                self._lift_shard(r) for r in self._flat_residuals(
+                    keys_tuple, layout, n_dev, bucket))
+        self._wire_bytes(flat_len * bucket[0].itemsize)
+
+        ctx0 = bucket[0].likes[0].context
+        if mode is None:
+            sig = ("tpu", None, threshold, n_dev, layout)
+            fn = self._steps.get(sig)
+            if fn is None:
+                fn = self._steps[sig] = _build_tpu_step(
+                    layout, n_dev, self._nproc, threshold, None, None,
+                    False)
+            outs, new_res = fn(residuals, grads)
+            for it, out in zip(bucket, outs):
+                kv._store[it.key] = NDArray(self._unlift(out), ctx0)
+        else:
+            (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
+             state_mask, rescale) = self._updater_inputs(bucket)
+            sig = ("tpu", mode, threshold, n_dev, layout, state_mask,
+                   use_wd)
+            fn = self._steps.get(sig)
+            if fn is None:
+                fn = self._steps[sig] = _build_tpu_step(
+                    layout, n_dev, self._nproc, threshold, mode,
+                    state_mask, use_wd)
+            weights = tuple(self._lift_repl(
+                _on_device(w._data, self._local_dev)) for w in weights_nd)
+            states = tuple(
+                self._lift_repl(_on_device(st._data, self._local_dev))
+                if st is not None else None for st in states_nd)
+            new_ws, new_ss, new_res = fn(weights, states, residuals,
+                                         grads, lr_vec, wd_vec, rescale)
+            for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
+                w._set_data(self._unlift(nw))
+                if st is not None:
+                    st._set_data(self._unlift(ns))
+        if keys_tuple is not None:
+            self._flat_res[keys_tuple]["res"] = [self._unlift(r)
+                                                 for r in new_res]
+
+    def _dispatch_host(self, bucket, mode):
+        """CPU-backend multi-process transport: local program -> host
+        allgather (rank-order sum) -> local apply program."""
+        import time
+        from ..executor import _count_dispatch
+        kv = self._kv
+        comp = kv._compression
+        threshold = comp.threshold if comp is not None else None
+        n_dev = bucket[0].n_dev
+        layout, flat_len = self._bucket_layout(bucket)
+
+        grads = tuple(tuple(it.data[d] for it in bucket)
+                      for d in range(n_dev))
+        residuals, keys_tuple = (), None
+        if comp is not None:
+            keys_tuple = tuple(it.key for it in bucket)
+            residuals = tuple(self._flat_residuals(keys_tuple, layout,
+                                                   n_dev, bucket))
+
+        sig = ("tpu-host-reduce", threshold, n_dev, layout)
+        fn = self._steps.get(sig)
+        if fn is None:
+            fn = self._steps[sig] = _build_local_reduce(layout, n_dev,
+                                                        threshold)
+        flat_q, new_res = fn(residuals, grads)
+        if keys_tuple is not None:
+            self._flat_res[keys_tuple]["res"] = list(new_res)
+
+        payload = _np.ascontiguousarray(_np.asarray(flat_q))
+        self._wire_bytes(payload.nbytes)
+        t0 = time.perf_counter()
+        red_np = dist.allreduce_sum_np("kvpush", payload)
+        ALLGATHER_MS.observe((time.perf_counter() - t0) * 1e3)
+
+        ctx0 = bucket[0].likes[0].context
+        if mode is None:
+            for it, (off, size, shape) in zip(bucket, layout):
+                kv._store[it.key] = NDArray(
+                    jnp.asarray(red_np[off:off + size].reshape(shape)),
+                    ctx0)
+            return
+        (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
+         state_mask, rescale) = self._updater_inputs(bucket)
+        sig = ("tpu-host-apply", mode, layout, state_mask, use_wd)
+        fn = self._steps.get(sig)
+        if fn is None:
+            fn = self._steps[sig] = _build_local_apply(layout, state_mask,
+                                                       use_wd, mode)
+        _count_dispatch()       # the apply is a second device launch
+        weights = tuple(w._data for w in weights_nd)
+        states = tuple(st._data if st is not None else None
+                       for st in states_nd)
+        new_ws, new_ss = _SITE.timed(
+            fn, weights, states, jnp.asarray(red_np), lr_vec, wd_vec,
+            rescale, dispatch_hist=DISPATCH_MS)
+        for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
+            w._set_data(nw)
+            if st is not None:
+                st._set_data(ns)

@@ -1,0 +1,127 @@
+"""KVStoreTPU: multi-host data parallelism over real collectives.
+
+The paper's stated layer-6 design goal: ``kvstore='tpu'`` maps push/pull
+onto ICI collectives instead of ps-lite's ZPush/ZPull parameter server.
+There are no server processes — the "server state" (weights + optimizer
+state) is replicated deterministically on every process (same reduced
+gradient, same updater, same result), so pull never needs a wire
+transfer, and push is the only collective.
+
+Single-process worlds get the exact same code (process mesh of one
+device, collectives are identities), so the CPU container and tier-1
+exercise every path the pod runs. See kvstore_tpu/engine.py for the
+transport split (GSPMD one-program-per-bucket vs coordination-service
+host transport) and docs/KVSTORE.md for the operator story.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..kvstore import KVStore, _key_value, _updater_key
+from ..ndarray import NDArray
+from . import dist
+
+__all__ = ["KVStoreTPU"]
+
+
+class KVStoreTPU(KVStore):
+    """Collective kvstore over ``jax.distributed`` + a GSPMD process
+    mesh. Accepts every base-KVStore surface (bucketing, 2-bit
+    compression, async push, priorities); the bucketed hot path runs
+    cross-host (engine.TPUBucketEngine), the eager per-key fallback
+    cross-host-reduces through the coordination service."""
+
+    # mx.checkpoint may capture/restore this store's residuals and
+    # weights like a local store's (state is process-local + replicated)
+    _captures_local_state = True
+
+    def __init__(self, name="tpu"):
+        super().__init__(name)
+        dist.ensure_initialized()
+        self._rank = dist.rank()
+        self._nproc = dist.world_size()
+        self._gspmd_ok = dist.gspmd_supported()
+        from .engine import HOSTS
+        HOSTS.set(self._nproc)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def _get_engine(self):
+        if not self._bucketed:
+            return None
+        if self._engine is None:
+            from .engine import TPUBucketEngine
+            self._engine = TPUBucketEngine(self)
+        return self._engine
+
+    # -- init: every process starts from rank 0's values ---------------
+    def init(self, key, value):
+        """Initialize keys from rank 0's values (the reference's
+        init-from-worker-0 contract, kvstore_dist.h:181). The broadcast
+        rides the coordination service — it works on every backend and
+        runs once per key, not per step."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                continue
+            v = vlist[0]
+            if self._nproc > 1:
+                payload = None
+                if self._rank == 0:
+                    payload = _np.ascontiguousarray(v.asnumpy()).tobytes()
+                raw = dist.broadcast_bytes("kvinit", payload or b"")
+                arr = _np.frombuffer(raw, dtype=v.dtype).reshape(v.shape)
+                self._store[k] = NDArray(jnp.asarray(arr), v.context)
+            else:
+                self._store[k] = v.copy()
+
+    # -- eager fallback: still collective ------------------------------
+    def _push_one(self, k, vlist):
+        """Per-key fallback (sparse, non-f32, custom updaters, 0-d
+        values): local compress+reduce exactly like the base store, then
+        a cross-host rank-order sum through the coordination service so
+        ineligible keys keep dist_sync semantics."""
+        if self._nproc == 1:
+            return super()._push_one(k, vlist)
+        if self._compression is not None:
+            vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
+        reduced = self._local_reduce(vlist)
+        from .engine import CROSSHOST_BYTES
+        local = _np.ascontiguousarray(reduced.asnumpy())
+        CROSSHOST_BYTES.inc(local.nbytes)
+        total = dist.allreduce_sum_np("kveager", local)
+        reduced = NDArray(jnp.asarray(total), reduced.context)
+        if self._updater is not None:
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            self._updater(_updater_key(k), reduced, self._store[k])
+        else:
+            self._store[k] = reduced
+
+    def barrier(self):
+        self._flush_pending()
+        dist.barrier("kv")
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """jax's coordination service fails the whole job on a dead
+        process, so the live view is always 0 (kvstore_dist parity)."""
+        return 0
+
+    @property
+    def is_recovery(self):
+        return False
+
+    def __reduce__(self):
+        raise pickle.PicklingError(
+            "KVStoreTPU holds a process-bound collective world and "
+            "cannot be pickled")

@@ -30,7 +30,12 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, kvs.KVStore):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        if num_device == 1 and "dist" not in kvstore \
+                and not kvstore.startswith("tpu") and kvstore != "nccl":
+            # 'tpu' (and its 'nccl' alias) stays a real store even on
+            # one local device: the world may span processes, and the
+            # single-process path must exercise the same code the pod
+            # runs (kvstore_tpu/)
             kv = None
         else:
             kv = kvs.create(kvstore)

@@ -54,6 +54,36 @@ from ..model import _local_updater_key
 
 __all__ = ["FusedFitStep", "TRACE_COUNT"]
 
+
+def _fusable_kv(kv):
+    """Stores whose reduce can live INSIDE the fit program: the plain
+    local store, and kvstore='tpu' when compiled programs may span its
+    world (every single-process world; multi-process only on backends
+    whose XLA runtime executes cross-process programs — on the CPU
+    backend a multi-process tpu kvstore keeps the eager fwd_bwd +
+    collective-push path instead)."""
+    from ..kvstore_tpu import KVStoreTPU
+    if type(kv) is KVStore:
+        return True
+    return isinstance(kv, KVStoreTPU) and kv._gspmd_ok
+
+
+def _global_fit_mesh(kv, n_local):
+    """The 'dp' mesh of a multi-process fused fit step: every process
+    contributes its first ``n_local`` devices, so the global batch
+    shards process-major and the vjp's gradient psum spans hosts."""
+    from ..kvstore_tpu import KVStoreTPU
+    if not isinstance(kv, KVStoreTPU) or kv.num_workers == 1:
+        return None
+    from jax.sharding import Mesh
+    devs = []
+    for p in range(jax.process_count()):
+        mine = [d for d in jax.devices() if d.process_index == p][:n_local]
+        if len(mine) < n_local:
+            return False        # a process with fewer devices: not fusable
+        devs.extend(mine)
+    return Mesh(_np.array(devs), ("dp",))
+
 # incremented inside the step function at trace time only; steady-state
 # steps (including repeats of a ragged batch shape) leave it untouched.
 # The count lives in the mx.telemetry registry (fit_step_retraces); the
@@ -164,12 +194,17 @@ class FusedFitStep:
 
     _METRIC_UNSET = object()
 
-    def __init__(self, module, updater, kv, threshold, mode):
+    def __init__(self, module, updater, kv, threshold, mode, pmesh=None):
         self._mod = module
         self._updater = updater
-        self._kv = kv                 # None, or the plain local KVStore
+        self._kv = kv                 # None, plain local KVStore, or tpu
         self._threshold = threshold
         self._mode = mode             # optimizer._fused_fit_sig() at build
+        # multi-process tpu kvstore on an accelerator backend: the fit
+        # program runs over this global 'dp' mesh — the vjp's gradient
+        # reduction becomes the cross-host psum, keeping one launch and
+        # zero host syncs per step on a pod (None single-process)
+        self._pmesh = pmesh or None
         self._residuals = None        # name -> jnp residual (2-bit arm)
         # step-invariant caches (the whole FusedFitStep is rebuilt on
         # rebind/init_optimizer, so these live as long as they are valid)
@@ -247,13 +282,16 @@ class FusedFitStep:
             return no("unsupported fused kind %r" % (sig[0],))
         kv = module._kvstore
         if module._update_on_kvstore:
-            if type(kv) is not KVStore:
+            if not _fusable_kv(kv):
                 return no("update_on_kvstore with %s" % type(kv).__name__)
             updater = kv._updater
         else:
-            if kv is not None and type(kv) is not KVStore:
+            if kv is not None and not _fusable_kv(kv):
                 return no("dist kvstore")
             updater = module._updater
+        pmesh = _global_fit_mesh(kv, len(module._context))
+        if pmesh is False:
+            return no("uneven device counts across tpu kvstore processes")
         if not isinstance(updater, opt_mod.Updater):
             return no("custom updater")
         if updater.optimizer is not optimizer:
@@ -274,7 +312,8 @@ class FusedFitStep:
             if getattr(arr, "stype", "default") != "default" \
                     or arr.dtype != _np.float32:
                 return no("non-dense-f32 param %s" % name)
-        step = FusedFitStep(module, updater, kv, threshold, sig)
+        step = FusedFitStep(module, updater, kv, threshold, sig,
+                            pmesh=pmesh)
         if not step._param_order():
             return no("no trainable parameters")
         return step
@@ -296,6 +335,15 @@ class FusedFitStep:
 
     def _place(self, group, exe, name, value):
         dst = exe.arg_dict[name]
+        if self._pmesh is not None:
+            # each process contributes its LOCAL batch as its rows of
+            # the global batch, sharded over the cross-host 'dp' mesh
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            host = value.asnumpy() if isinstance(value, NDArray) \
+                else _np.asarray(value)
+            host = _np.ascontiguousarray(host, dtype=dst._data.dtype)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self._pmesh, P("dp")), host)
         data = value._data if isinstance(value, NDArray) \
             else jnp.asarray(_np.asarray(value))
         if data.dtype != dst._data.dtype:
@@ -303,6 +351,17 @@ class FusedFitStep:
         if group._mesh is not None:
             return jax.device_put(data, group._batch_sharding())
         return exe._to_ctx(data)
+
+    def _lift_repl(self, x):
+        """Pod path: make a process-local array a replicated global
+        array over the cross-host mesh. Arrays already carrying the
+        target sharding (every output of the previous step) pass
+        through jax.device_put as a no-op."""
+        if x is None or self._pmesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(jnp.asarray(x),
+                              NamedSharding(self._pmesh, P()))
 
     # -- residual spill/reseed (shared with the bucketed engine) --------
     def _seed_residuals(self, order, exe):
@@ -451,6 +510,21 @@ class FusedFitStep:
                     eval_metric._dev_num
                     if eval_metric._dev_num is not None else jnp.float32(0.0))
 
+        auxs = exe._auxs_values()
+        if self._pmesh is not None:
+            # lift every program input onto the cross-host mesh (no-op
+            # for arrays the previous step already left there)
+            params = {n: self._lift_repl(v) for n, v in params.items()}
+            states = {n: self._lift_repl(v) for n, v in states.items()}
+            residuals = {n: self._lift_repl(v)
+                         for n, v in residuals.items()}
+            auxs = {n: self._lift_repl(v) for n, v in auxs.items()}
+            inputs = {n: (v if getattr(getattr(v, "sharding", None),
+                                       "mesh", None) is self._pmesh
+                          else self._lift_repl(v))
+                      for n, v in inputs.items()}
+            macc = tuple(self._lift_repl(m) for m in macc)
+
         seed = exe._next_seed()
         rescale = _np.float32(optimizer.rescale_grad)
         _count_dispatch()
@@ -462,7 +536,7 @@ class FusedFitStep:
             with exe._prof_scope("Module::fused_fit_step"):
                 new_ps, new_ss, new_res, macc, new_auxs, outs = _SITE.timed(
                     fn, params, states, residuals, macc, inputs,
-                    exe._auxs_values(), lr_vec, wd_vec, rescale, seed)
+                    auxs, lr_vec, wd_vec, rescale, seed)
         except Exception:
             # a runtime failure after donation consumes the donated
             # buffers — drop our residual refs so a later spill doesn't

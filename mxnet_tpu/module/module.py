@@ -305,7 +305,9 @@ class Module(BaseModule):
         first = self._exec_group.data_shapes[0]
         batch = first.shape[0] if isinstance(first, DataDesc) \
             else first[1][0]
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+        if kvstore and (("dist" in kvstore.type and "_sync" in kvstore.type)
+                        or kvstore.type.startswith("tpu")
+                        or kvstore.type == "nccl"):
             batch *= kvstore.num_workers
         return batch
 
@@ -534,19 +536,19 @@ class Module(BaseModule):
 
     def _states_use_kvstore_file(self):
         """True when state persistence must stay delegated to the
-        kvstore (dist stores keep server-side optimizer state)."""
-        from ..kvstore import KVStore
+        kvstore (dist stores keep server-side optimizer state; local
+        and tpu stores hold process-local/replicated state that the
+        canonical name-key translation below may rewrite)."""
         return self._update_on_kvstore \
-            and type(self._kvstore) is not KVStore
+            and not getattr(self._kvstore, "_captures_local_state", False)
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._states_use_kvstore_file():
             self._kvstore.save_optimizer_states(fname)
             return
-        from ..kvstore import KVStore
         from ..optimizer import Updater
-        if type(self._kvstore) is KVStore:
+        if getattr(self._kvstore, "_captures_local_state", False):
             self._kvstore._flush_pending()   # pending buckets touch state
         updater = self._live_updater()
         if not isinstance(updater, Updater):
@@ -566,9 +568,8 @@ class Module(BaseModule):
         if self._states_use_kvstore_file():
             self._kvstore.load_optimizer_states(fname)
             return
-        from ..kvstore import KVStore
         from ..optimizer import Updater
-        if type(self._kvstore) is KVStore:
+        if getattr(self._kvstore, "_captures_local_state", False):
             self._kvstore._flush_pending()   # pending buckets touch state
         updater = self._live_updater()
         with open(fname, "rb") as f:

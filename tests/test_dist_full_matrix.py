@@ -7,7 +7,19 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_RETIRED = pytest.mark.skip(reason=(
+    "retired with kvstore='tpu' (ISSUE 7): the dist_sync arms of the "
+    "matrix ride cross-process XLA collectives the CPU XLA runtime "
+    "cannot execute ('Multiprocess computations aren't implemented on "
+    "the CPU backend') — pre-existing environment failures. Dense/2-bit "
+    "multi-process coverage now lives in tests/tpu_kvstore_worker.py "
+    "(test_kvstore_tpu.py::test_two_process_smoke); fp16/row_sparse "
+    "keys stay eager-path and are covered single-process in "
+    "tests/test_kvstore.py"))
 
 
 def _launch(n, s, script, extra_env=None, timeout=420):
@@ -25,6 +37,7 @@ def _launch(n, s, script, extra_env=None, timeout=420):
     return proc
 
 
+@_RETIRED
 def test_full_matrix_4workers_2servers():
     proc = _launch(4, 2, "dist_full_matrix_worker.py")
     assert proc.returncode == 0, proc.stderr[-3000:]
@@ -33,6 +46,7 @@ def test_full_matrix_4workers_2servers():
         (proc.stdout[-1500:], proc.stderr[-1500:])
 
 
+@_RETIRED
 def test_full_matrix_8process():
     """8 processes total (6 workers + 2 servers) on the CPU mesh."""
     proc = _launch(6, 2, "dist_full_matrix_worker.py", timeout=600)

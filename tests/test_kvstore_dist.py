@@ -13,6 +13,15 @@ import pytest
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
+@pytest.mark.skip(reason=(
+    "retired with kvstore='tpu' (ISSUE 7): dist_sync rides XLA "
+    "collectives (process_allgather) that the CPU XLA runtime cannot "
+    "execute cross-process ('Multiprocess computations aren't "
+    "implemented on the CPU backend') — a pre-existing environment "
+    "failure, not a kvstore bug. The analytic rank-sum / init-from-"
+    "rank-0 / multi-device / 2-bit assertions are ported to the "
+    "collective kvstore in tests/tpu_kvstore_worker.py and run in "
+    "test_kvstore_tpu.py::test_two_process_smoke"))
 def test_dist_sync_kvstore_4_workers():
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     # workers must not inherit the single-process test mesh flags
@@ -64,6 +73,14 @@ def test_dist_async_training_2_workers():
     assert proc.stdout.count("async dist training converged") == 2
 
 
+@pytest.mark.skip(reason=(
+    "retired with kvstore='tpu' (ISSUE 7): dist_sync training needs "
+    "cross-process XLA collectives the CPU backend cannot run (pre-"
+    "existing failure). The Module.fit data-parallel parity assertion "
+    "is ported — strengthened to gradient-sum parity against the "
+    "single-process global-batch reference — in "
+    "tests/tpu_kvstore_worker.py (test_kvstore_tpu.py::"
+    "test_two_process_smoke)"))
 def test_dist_training_2_workers():
     """Data-parallel Module.fit over dist_sync: params stay identical
     across workers and the model converges (dist_lenet.py analog)."""

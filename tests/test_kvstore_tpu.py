@@ -1,0 +1,389 @@
+"""kvstore='tpu' — the collective multi-host kvstore (kvstore_tpu/).
+
+Single-process tests exercise the exact GSPMD one-program-per-bucket
+path a pod runs (the process mesh is just one device wide); the @slow
+2-process test spawns a real jax.distributed world via
+tools/run_multihost.py and reruns the ported dist_sync assertions plus
+training parity and the sharded-checkpoint protocol
+(tests/tpu_kvstore_worker.py).
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.kvstore_tpu import KVStoreTPU
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_create_and_registration():
+    for name in ("tpu", "tpu_device", "nccl"):
+        kv = mx.kv.create(name)
+        assert isinstance(kv, KVStoreTPU)
+        assert kv.type == name
+        assert kv.rank == 0 and kv.num_workers == 1
+        assert kv.get_num_dead_node() == 0 and not kv.is_recovery
+
+
+def test_module_create_kvstore_single_device():
+    """'tpu' must stay a real store on one local device (the world may
+    span processes) — unlike 'local', which collapses to None."""
+    from mxnet_tpu.model import _create_kvstore
+    arg = {"w": nd.zeros((4, 4))}
+    for name in ("tpu", "tpu_device", "nccl"):
+        kv, update_on = _create_kvstore(name, 1, arg)
+        assert isinstance(kv, KVStoreTPU) and update_on, name
+    kv2, update_on2 = _create_kvstore("local", 1, arg)
+    assert kv2 is None and not update_on2
+
+
+def _run_store(name, steps=4, compress=None, ndev=2):
+    kv = mx.kv.create(name)
+    if compress is not None:
+        kv.set_gradient_compression({"type": "2bit",
+                                     "threshold": compress})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                      wd=1e-4, rescale_grad=1.0 / 8))
+    rng = np.random.RandomState(0)
+    shapes = {"w0": (13, 7), "w1": (5,), "w2": (3, 2, 4)}
+    for k, s in shapes.items():
+        kv.init(k, nd.array(rng.normal(0, 0.1, s).astype(np.float32)))
+    for _ in range(steps):
+        keys = list(shapes)
+        grads = [[nd.array(rng.normal(0, 0.1, shapes[k])
+                           .astype(np.float32)) for _ in range(ndev)]
+                 for k in keys]
+        kv.push(keys, grads, priority=[-i for i in range(len(keys))])
+    outs = {k: nd.zeros(s) for k, s in shapes.items()}
+    kv.pull(list(shapes), out=[outs[k] for k in shapes])
+    kv._sync_engine()
+    res = {k: v.asnumpy() for k, v in kv._compression_residuals.items()}
+    return {k: v.asnumpy() for k, v in outs.items()}, res
+
+
+def test_parity_dense_vs_device():
+    """Single-process tpu == device kvstore on dense SGD-momentum
+    training (different XLA programs: FMA-contraction ulps only)."""
+    a, _ = _run_store("device")
+    b, _ = _run_store("tpu")
+    for k in a:
+        np.testing.assert_allclose(b[k], a[k], rtol=5e-7, atol=1e-8)
+
+
+def test_parity_2bit_bit_for_bit_residuals():
+    """2-bit semantics are the SAME quantize op sequence: weights agree
+    to FMA ulps and the error-feedback residuals are bit-identical per
+    (key, device-stream)."""
+    a, ares = _run_store("device", compress=0.05)
+    b, bres = _run_store("tpu", compress=0.05)
+    for k in a:
+        np.testing.assert_allclose(b[k], a[k], rtol=5e-7, atol=1e-8)
+    assert set(ares) == set(bres) and ares
+    for k in ares:
+        assert np.array_equal(ares[k], bres[k]), \
+            "residual %s not bit-for-bit" % (k,)
+
+
+def test_zero_steady_state_retraces():
+    kv = mx.kv.create("tpu")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+    rng = np.random.RandomState(1)
+    for k, s in (("a", (64, 32)), ("b", (128,))):
+        kv.init(k, nd.array(rng.normal(0, 0.1, s).astype(np.float32)))
+
+    def step():
+        kv.push(["a", "b"],
+                [[nd.array(rng.normal(0, 0.1, (64, 32))
+                           .astype(np.float32))],
+                 [nd.array(rng.normal(0, 0.1, (128,))
+                           .astype(np.float32))]])
+    step()                                  # traces the bucket program
+    before = telemetry.REGISTRY.get("kvstore_bucket_retraces").value
+    for _ in range(3):
+        step()
+    after = telemetry.REGISTRY.get("kvstore_bucket_retraces").value
+    assert after == before, "steady-state pushes retraced"
+
+
+def test_scalar_value_falls_back_with_reason():
+    kv = mx.kv.create("tpu")
+    kv.init("s", nd.array(np.float32(0.0)))
+    c = telemetry.REGISTRY.get("kvstore_fallbacks").labels(
+        reason="scalar_value")
+    before = c.value
+    kv.push("s", nd.array(np.float32(2.0)))
+    assert c.value == before + 1
+    out = nd.zeros(())
+    kv.pull("s", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_fused_fit_dispatch_witness():
+    """kvstore='tpu' keeps the PR3 single-launch fit step:
+    train_dispatches_per_step == 1, zero steady-state retraces."""
+    from mxnet_tpu import profiler, io
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (40, 10)).astype(np.float32)
+    y = rng.randint(0, 3, (40,)).astype(np.float32)
+    it = io.NDArrayIter(X, y, batch_size=8)
+
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),
+                                         ("momentum", 0.9)))
+    assert isinstance(mod._kvstore, KVStoreTPU)
+    metric = mx.metric.Accuracy()
+    batches = list(it)
+    mod.fit_step(batches[0], metric)        # warmup traces
+    assert mod._fused_fit is not None, "fused fit did not engage"
+    d0 = profiler.DEVICE_DISPATCHES.value
+    r0 = telemetry.REGISTRY.get("fit_step_retraces").value
+    for b in batches[1:]:
+        mod.fit_step(b, metric)
+    steps = len(batches) - 1
+    assert profiler.DEVICE_DISPATCHES.value - d0 == steps, \
+        "expected exactly 1 dispatch per steady-state step"
+    assert telemetry.REGISTRY.get("fit_step_retraces").value == r0
+
+
+def test_fused_fit_2bit_parity_vs_device_kvstore():
+    """Module-level 2-bit parity: fit over kvstore='tpu' matches fit
+    over a REAL device kvstore (same fused program shape, same residual
+    ownership). The baseline is passed as an instance — the string
+    'device' on one local device collapses to kv=None, which never
+    compresses."""
+    from mxnet_tpu import io
+
+    def run(kv_name):
+        kv_arg = mx.kv.create(kv_name) if kv_name != "tpu" else kv_name
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=6, name="fc1")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        rng = np.random.RandomState(3)
+        X = rng.normal(0, 1, (24, 5)).astype(np.float32)
+        y = rng.randint(0, 6, (24,)).astype(np.float32)
+        it = io.NDArrayIter(X, y, batch_size=8)
+        mod = mx.mod.Module(net, context=mx.cpu(0),
+                            compression_params={"type": "2bit",
+                                                "threshold": 0.01})
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        prng = np.random.RandomState(5)
+        mod.init_params(arg_params={
+            "fc1_weight": nd.array(prng.uniform(-0.1, 0.1, (6, 5))
+                                   .astype(np.float32)),
+            "fc1_bias": nd.zeros((6,))})
+        mod.init_optimizer(kvstore=kv_arg, optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        for b in it:
+            mod.fit_step(b)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    a = run("device")
+    b = run("tpu")
+    for k in a:
+        np.testing.assert_allclose(b[k], a[k], rtol=5e-7, atol=1e-8)
+
+
+def test_gluon_trainer_with_tpu_kvstore():
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(4, in_units=6)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="tpu")
+    x = nd.array(np.random.RandomState(0)
+                 .normal(0, 1, (8, 6)).astype(np.float32))
+    from mxnet_tpu import autograd
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    before = {k: v.data().asnumpy().copy()
+              for k, v in net.collect_params().items()}
+    trainer.step(8)
+    assert isinstance(trainer._kvstore, KVStoreTPU)
+    changed = any(
+        not np.allclose(v.data().asnumpy(), before[k])
+        for k, v in net.collect_params().items())
+    assert changed, "trainer.step over kvstore='tpu' updated nothing"
+
+
+def test_dist_legacy_fallback_counter():
+    """kv.create('dist*') is the ps-lite-shaped eager path — creating
+    one now signals it (one-time warning + kvstore_fallbacks)."""
+    c = telemetry.REGISTRY.get("kvstore_fallbacks").labels(
+        reason="legacy_dist_kvstore:dist_sync")
+    before = c.value
+    mx.kv.create("dist_sync")
+    assert c.value == before + 1
+
+
+@pytest.mark.slow
+def test_resnet_keyset_parity_and_dispatches():
+    """The acceptance workload: the real resnet18 key set (59 keys,
+    ~45 MB) trains through kvstore='tpu' with 2-bit compression at ONE
+    dispatch per bucket program, zero steady-state retraces, and 2-bit
+    parity vs the device kvstore."""
+    from mxnet_tpu import models, profiler
+
+    sym = models.get_symbol("resnet", num_classes=10, num_layers=18,
+                            image_shape=(3, 32, 32), dtype="float32")
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 3, 32, 32),
+                                       softmax_label=(1,))
+    keys, shapes = [], []
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n not in ("data", "softmax_label"):
+            keys.append(n)
+            shapes.append(s)
+    rng = np.random.RandomState(0)
+    weights = [rng.normal(0, 0.05, s).astype(np.float32) for s in shapes]
+    grads = [[rng.normal(0, 0.01, s).astype(np.float32)] for s in shapes]
+
+    def run(name):
+        kv = mx.kv.create(name)
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                          momentum=0.9, wd=1e-4,
+                                          rescale_grad=1.0 / 8))
+        for k, w in zip(keys, weights):
+            kv.init(k, nd.array(w))
+        gl = [[nd.array(g) for g in gs] for gs in grads]
+        kv.push(keys, gl)                   # warmup traces the buckets
+        kv._sync_engine()
+        d0 = profiler.DEVICE_DISPATCHES.value
+        r0 = telemetry.REGISTRY.get("kvstore_bucket_retraces").value
+        steps = 3
+        for _ in range(steps):
+            kv.push(keys, gl)
+        kv._sync_engine()
+        disp = (profiler.DEVICE_DISPATCHES.value - d0) / steps
+        assert telemetry.REGISTRY.get(
+            "kvstore_bucket_retraces").value == r0, "steady-state retrace"
+        outs = [nd.zeros(s) for s in shapes]
+        kv.pull(keys, out=outs)
+        return {k: o.asnumpy() for k, o in zip(keys, outs)}, disp
+
+    want, disp_dev = run("device")
+    got, disp_tpu = run("tpu")
+    assert disp_tpu == disp_dev, \
+        "tpu engine dispatches/step %s != device %s (one per bucket)" \
+        % (disp_tpu, disp_dev)
+    assert disp_tpu < len(keys) / 2, \
+        "bucketing collapsed: %s dispatches for %d keys" \
+        % (disp_tpu, len(keys))
+    for k in keys:
+        np.testing.assert_allclose(got[k], want[k], rtol=5e-7, atol=1e-8,
+                                   err_msg="2-bit parity diverged on %s"
+                                   % k)
+
+
+# ----------------------------------------------------------------------
+# multi-host checkpoint protocol (single-process simulation of 3 hosts)
+# ----------------------------------------------------------------------
+def _mh_state(rank, world, tag):
+    rng = np.random.RandomState(tag)
+    return {
+        "symbol_json": None,
+        "args": {"w%d" % i: rng.normal(0, 1, (4, 3)).astype(np.float32)
+                 + tag for i in range(5)},
+        "auxs": {"bn_mean": np.ones((3,), np.float32) * tag},
+        "states": {"w%d" % i: rng.normal(0, 1, (4, 3))
+                   .astype(np.float32) for i in range(5)},
+        "extra": {"residuals": {("w0", 0): np.full((4, 3), rank + tag,
+                                                   np.float32)},
+                  "num_update": tag * 10},
+        "epoch": 0, "step": tag, "rng": {"seed": 0, "key": None},
+        "world": world, "rank": rank,
+    }
+
+
+def test_sharded_checkpoint_protocol(tmp_path):
+    from mxnet_tpu.checkpoint import multihost as mh, manifest as mf
+    prefix = str(tmp_path / "run")
+    for tag in (1, 2):
+        for r in (1, 2, 0):     # commit order must not matter pre-barrier
+            mh.write_shard(_mh_state(r, 3, tag), prefix, tag, r, 3)
+        mh.commit_sharded(prefix, tag, 3,
+                          {"epoch": 0, "step": tag,
+                           "rng": {"seed": 0, "key": None}})
+    man = mf.latest(prefix)
+    assert man["tag"] == 2 and man["world"] == 3
+
+    # merge covers the whole key set; extras are per-rank host-local
+    args, auxs, states, extra = mh.load_sharded(prefix, man, rank=2)
+    assert sorted(args) == ["w%d" % i for i in range(5)]
+    assert sorted(auxs) == ["bn_mean"] and len(states) == 5
+    assert extra["residuals"][("w0", 0)][0, 0] == 4.0   # rank2 + tag2
+    want = _mh_state(0, 3, 2)["args"]["w3"]
+    assert np.array_equal(args["w3"].asnumpy(), want)
+
+    # shard partition is disjoint and balanced
+    names = mh.shard_names(args, 0, 3) + mh.shard_names(args, 1, 3) \
+        + mh.shard_names(args, 2, 3)
+    assert sorted(names) == sorted(args)
+
+    # any host's shard corrupted -> the WHOLE tag is skipped
+    with open(prefix + "-0002.shard1.params", "r+b") as f:
+        f.truncate(17)
+    assert mf.latest(prefix)["tag"] == 1
+
+    # checkpoint.load() resolves + merges transparently
+    from mxnet_tpu import checkpoint
+    _sym, a2, x2, m2 = checkpoint.load(prefix)
+    assert m2["tag"] == 1 and len(a2) == 5 and len(x2) == 1
+
+    # a host dying mid-write never publishes: shards but no manifest
+    for r in (0, 1):
+        mh.write_shard(_mh_state(r, 3, 3), prefix, 3, r, 3)
+    assert mf.latest(prefix)["tag"] == 1
+
+
+def test_sharded_restore_world_mismatch_drops_residuals(tmp_path):
+    from mxnet_tpu.checkpoint import multihost as mh, manifest as mf
+    prefix = str(tmp_path / "run")
+    for r in range(2):
+        mh.write_shard(_mh_state(r, 2, 1), prefix, 1, r, 2)
+    mh.commit_sharded(prefix, 1, 2, {"rng": None})
+    man = mf.latest(prefix)
+    _args, _auxs, _states, extra = mh.load_sharded(prefix, man, rank=5)
+    assert "residuals" not in extra     # unmappable host-local state
+    assert extra["num_update"] == 10    # replicated extras survive
+
+
+# ----------------------------------------------------------------------
+# the real 2-process world (CPU jax.distributed backend)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_process_smoke(tmp_path):
+    """Spawn a real 2-process kvstore='tpu' world: ported dist_sync
+    assertions, Module.fit gradient-sum parity with single-process
+    training, sharded checkpoint round-trip, and resume after one
+    host's shard is corrupted (tests/tpu_kvstore_worker.py)."""
+    prefix = str(tmp_path / "mh" / "run")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "run_multihost.py"),
+         "-n", "2", "--env", "MXTPU_CKPT_PREFIX=%s" % prefix,
+         sys.executable, os.path.join(ROOT, "tests",
+                                      "tpu_kvstore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("all tpu kvstore checks passed") == 2

@@ -1,0 +1,231 @@
+"""Worker script for the kvstore='tpu' multi-process smoke test.
+
+Ports the assertions of the retired ps-lite-shaped
+tests/dist_sync_kvstore.py (analytic rank-sum checks, init-from-rank-0,
+multi-device lists, 2-bit wire compression) to the collective tpu
+kvstore, and adds what the legacy test never had: gradient-sum parity
+of a real 2-process ``Module.fit`` against the single-process reference,
+plus a sharded multi-host checkpoint round-trip with a
+corrupted-shard fallback (any host can die mid-write).
+
+Run via:  python tools/run_multihost.py -n 2 python tests/tpu_kvstore_worker.py
+Each process asserts and prints the sentinel; exit code 0 means pass.
+"""
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore_tpu import dist
+
+SHAPE = (4, 5)
+
+
+def check(name, got, expect, rtol=1e-5, atol=1e-6):
+    got = got.asnumpy() if hasattr(got, "asnumpy") else np.asarray(got)
+    if not np.allclose(got, expect, rtol=rtol, atol=atol):
+        raise AssertionError("%s: got %s expected %s" % (name, got, expect))
+
+
+def kv_checks():
+    kv = mx.kv.create("tpu")
+    n, rank = kv.num_workers, kv.rank
+    assert n == int(os.environ["MXTPU_NUM_PROCESSES"]), n
+    assert kv.type == "tpu"
+
+    # --- init comes from rank 0 (reference kvstore_dist.h:181-197) ---
+    kv.init("a", nd.full(SHAPE, rank + 10.0))
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    check("init-from-rank0", out, 10.0)
+
+    # --- push sums across workers: sum(rank+1) = n(n+1)/2 ---
+    kv.push("a", nd.full(SHAPE, rank + 1.0))
+    kv.pull("a", out=out)
+    check("push-sum", out, n * (n + 1) / 2.0)
+
+    # --- multi-device list push: local stream reduce then global ---
+    kv.push("a", [nd.ones(SHAPE), nd.ones(SHAPE)])
+    kv.pull("a", out=out)
+    check("multidev-push", out, 2.0 * n)
+
+    # --- int keys + batched list API ---
+    kv.init([3, 5], [nd.zeros(SHAPE), nd.zeros(SHAPE)])
+    kv.push([3, 5], [nd.full(SHAPE, 1.0), nd.full(SHAPE, 2.0)],
+            priority=[0, -1])
+    o3, o5 = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    kv.pull([3, 5], out=[o3, o5])
+    check("int-key-3", o3, 1.0 * n)
+    check("int-key-5", o5, 2.0 * n)
+
+    # --- 2-bit compression with per-(rank,stream) error feedback ---
+    kvc = mx.kv.create("tpu")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    kvc.init("c", nd.zeros(SHAPE))
+    kvc.push("c", nd.ones(SHAPE))          # acc 1.0 < 2.0 -> q=0
+    outc = nd.zeros(SHAPE)
+    kvc.pull("c", out=outc)
+    check("2bit-under-threshold", outc, 0.0)
+    kvc.push("c", nd.full(SHAPE, 1.5))     # acc 2.5 > 2.0 -> q=+2/rank
+    kvc.pull("c", out=outc)
+    check("2bit-over-threshold", outc, 2.0 * n)
+
+    # --- eager fallback stays collective (custom updater) ---
+    kve = mx.kv.create("tpu")
+    kve.init("e", nd.zeros(SHAPE))
+    kve.set_updater(lambda k, g, w: w.__iadd__(g))
+    kve.push("e", nd.full(SHAPE, rank + 1.0))
+    oute = nd.zeros(SHAPE)
+    kve.pull("e", out=oute)
+    check("eager-fallback-sum", oute, n * (n + 1) / 2.0)
+
+    kv.barrier()
+    return kv
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _init_params():
+    rng = np.random.RandomState(7)
+    return {
+        "fc1_weight": nd.array(rng.normal(0, 0.1, (8, 6)).astype(np.float32)),
+        "fc1_bias": nd.zeros((8,)),
+        "fc2_weight": nd.array(rng.normal(0, 0.1, (3, 8)).astype(np.float32)),
+        "fc2_bias": nd.zeros((3,)),
+    }
+
+
+def _global_data(steps, batch):
+    rng = np.random.RandomState(11)
+    X = rng.normal(0, 1, (steps, batch, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (steps, batch)).astype(np.float32)
+    return X, y
+
+
+def _train(mod, kvstore, X, y, compression=None):
+    from mxnet_tpu.io import DataBatch
+    mod.bind(data_shapes=[("data", X.shape[1:])],
+             label_shapes=[("softmax_label", y.shape[1:])],
+             for_training=True)
+    mod.init_params(arg_params=_init_params(), aux_params={},
+                    allow_missing=False)
+    mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    for s in range(X.shape[0]):
+        mod.fit_step(DataBatch(data=[nd.array(X[s])],
+                               label=[nd.array(y[s])]))
+    return mod
+
+
+def training_parity(rank, n):
+    """2-process data-parallel fit matches the single-process fit on
+    the concatenated global batch (gradient-sum parity): the tpu
+    kvstore's cross-host reduce + replicated update IS the big-batch
+    step, modulo reduction order."""
+    steps, local_b = 4, 4
+    X, y = _global_data(steps, local_b * n)
+    lo, hi = rank * local_b, (rank + 1) * local_b
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    _train(mod, "tpu", X[:, lo:hi], y[:, lo:hi])
+    got, _ = mod.get_params()
+
+    # reference: same global batch, single process, device kvstore.
+    # rescale_grad differs (1/(local_b*n) vs 1/global_b) — identical.
+    ref = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    _train(ref, mx.kv.create("device"), X, y)
+    want, _ = ref.get_params()
+    for k in want:
+        np.testing.assert_allclose(
+            got[k].asnumpy(), want[k].asnumpy(), rtol=2e-5, atol=1e-6,
+            err_msg="training parity diverged on %s" % k)
+    return mod
+
+
+def checkpoint_roundtrip(mod, rank, n):
+    """Sharded multi-host commit: two tags, then corrupt one host's
+    shard of the newest and prove BOTH ranks fall back to the previous
+    intact checkpoint."""
+    from mxnet_tpu import checkpoint
+    from mxnet_tpu.checkpoint import manifest as mf
+    prefix = os.environ["MXTPU_CKPT_PREFIX"]
+
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       async_write=False, keep=0,
+                                       install_preemption=False)
+    man1 = mgr.save(epoch=0, step=1, block=True)
+    assert int(man1["world"]) == n, man1
+    params_at_1 = {k: v.asnumpy().copy()
+                   for k, v in mod.get_params()[0].items()}
+
+    # advance the model so tag 2 differs, then save again
+    X, y = _global_data(2, 4 * n)
+    from mxnet_tpu.io import DataBatch
+    lo, hi = rank * 4, (rank + 1) * 4
+    for s in range(2):
+        mod.fit_step(DataBatch(data=[nd.array(X[s, lo:hi])],
+                               label=[nd.array(y[s, lo:hi])]))
+    mgr.save(epoch=0, step=2, block=True)
+    mgr.close()
+
+    # both ranks see tag 2 as newest and can merge all shards
+    man = mf.latest(prefix)
+    assert int(man["tag"]) == 2, man
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    mod2.bind(data_shapes=[("data", (4, 6))],
+              label_shapes=[("softmax_label", (4,))], for_training=True)
+    mod2.init_params(arg_params=_init_params(), aux_params={})
+    mod2.init_optimizer(kvstore="tpu", optimizer="sgd",
+                        optimizer_params=(("learning_rate", 0.1),
+                                          ("momentum", 0.9)))
+    got = checkpoint.restore(mod2, prefix)
+    assert int(got["tag"]) == 2
+    for k, v in mod.get_params()[0].items():
+        np.testing.assert_allclose(mod2.get_params()[0][k].asnumpy(),
+                                   v.asnumpy(), rtol=1e-6)
+
+    # any-host-can-die: rank 1 truncates ITS OWN shard of tag 2; both
+    # ranks must then resolve tag 1 (the shard set no longer validates)
+    dist.barrier("corrupt-start")
+    if rank == 1 or n == 1:
+        with open("%s-0002.shard%d.params" % (prefix, rank), "r+b") as f:
+            f.truncate(10)
+    dist.barrier("corrupt-done")
+    mod3 = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    mod3.bind(data_shapes=[("data", (4, 6))],
+              label_shapes=[("softmax_label", (4,))], for_training=True)
+    mod3.init_params(arg_params=_init_params(), aux_params={})
+    got = checkpoint.restore(mod3, prefix, load_optimizer=False)
+    assert int(got["tag"]) == 1, got
+    for k, v in params_at_1.items():
+        np.testing.assert_allclose(mod3.get_params()[0][k].asnumpy(), v,
+                                   rtol=1e-6)
+    dist.barrier("corrupt-verified")
+
+
+def main():
+    kv = kv_checks()
+    n, rank = kv.num_workers, kv.rank
+    mod = training_parity(rank, n)
+    checkpoint_roundtrip(mod, rank, n)
+    from mxnet_tpu import telemetry
+    xb = telemetry.REGISTRY.get("kvstore_tpu_crosshost_bytes")
+    assert xb is not None and (n == 1 or xb.value > 0), \
+        "cross-host bytes counter never moved"
+    print("all tpu kvstore checks passed (rank %d of %d)" % (rank, n))
+
+
+if __name__ == "__main__":
+    main()
