@@ -52,18 +52,6 @@ def _latency_fields(hist, compile_ms):
         "compile_ms": _round_opt(compile_ms, 1),
     }
 
-# Peak bf16 TFLOP/s per chip, keyed by substrings of jax device_kind.
-# MFU = achieved model FLOP/s over this peak.
-_PEAK_TFLOPS = [
-    ("v6", 918.0),      # Trillium
-    ("v5p", 459.0),
-    ("v5", 197.0),      # v5e / "v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
 def _check_sane(achieved, peak):
     """Refuse to report throughput above the chip's physical peak — a
     wedged tunnel/OOM can make the timing loop "complete" instantly."""
@@ -75,11 +63,53 @@ def _check_sane(achieved, peak):
 
 
 def _peak_tflops(device_kind):
-    kind = device_kind.lower()
-    for key, peak in _PEAK_TFLOPS:
-        if key in kind:
-            return peak
-    return None
+    """Peak bf16 TFLOP/s — the one table lives in the compiled-program
+    registry (telemetry/programs.py PEAK_TFLOPS_TABLE)."""
+    from mxnet_tpu import telemetry
+    return telemetry.programs.peak_tflops(device_kind)
+
+
+def _mfu_fields(flops_hand, flops_measured, iters, dt, device_kind):
+    """The hand-math vs compiler-measured MFU pair every training bench
+    folds into its JSON: ``mfu`` from the analytic FLOP count (the
+    numerator docs/PERF.md derives by hand — known to drop attention
+    matmuls on the transformer arm), ``mfu_measured`` from XLA
+    ``cost_analysis()`` via the compiled-program registry.  A >10%
+    FLOP-count disagreement warns on stderr (time cancels, so the
+    check runs on the CPU container too) — the measured number is the
+    trustworthy one.  Also refreshes the ``mfu_measured`` gauge."""
+    import sys
+    from mxnet_tpu import telemetry
+
+    peak = _peak_tflops(device_kind)
+    sec = dt / iters if iters else None
+    ach_hand = (flops_hand / sec / 1e12
+                if flops_hand and sec else None)
+    ach_meas = (flops_measured / sec / 1e12
+                if flops_measured and sec else None)
+    _check_sane(ach_meas if ach_meas is not None else ach_hand, peak)
+    mfu_hand = (ach_hand / peak) if ach_hand and peak else None
+    mfu_meas = (ach_meas / peak) if ach_meas and peak else None
+    if flops_hand and flops_measured \
+            and abs(flops_hand - flops_measured) > 0.10 * flops_measured:
+        print("bench: WARNING hand-math FLOPs/step %.3g disagree with "
+              "compiler-measured %.3g by %.0f%% — trust mfu_measured "
+              "(the hand numerator is known to drop attention matmuls)"
+              % (flops_hand, flops_measured,
+                 100.0 * abs(flops_hand - flops_measured)
+                 / flops_measured), file=sys.stderr)
+    if flops_measured and sec:
+        telemetry.programs.mfu_measured(flops_measured, sec, device_kind)
+    ach = ach_meas if ach_meas is not None else ach_hand
+    mfu = mfu_hand if mfu_hand is not None else mfu_meas
+    return {
+        "achieved_tflops": round(ach, 2) if ach else None,
+        "peak_bf16_tflops": peak,
+        "mfu": round(mfu, 4) if mfu else None,
+        "mfu_measured": round(mfu_meas, 4) if mfu_meas else None,
+        "flops_per_step_hand": flops_hand,
+        "flops_per_step_measured": flops_measured,
+    }
 
 
 def _make_pipeline_stream(args, image_shape):
@@ -163,19 +193,33 @@ def _timed_steps(ts, next_batch, warmup, iters):
     return dt, {"compile_ms": compile_ms, "hist": hist}
 
 
-def _cost_flops(ts, flops_probe):
+def _cost_flops(ts, flops_probe, site="bench_train_step"):
     """Per-step FLOPs from XLA cost analysis (abstract-probe lowering,
     run after timing — a second live executable alongside the timing
-    loop has been seen to wedge tunneled harnesses)."""
+    loop has been seen to wedge tunneled harnesses).  The compiled
+    probe registers in the compiled-program registry
+    (``telemetry.programs()``), which is also where the FLOP number is
+    read back from — one analysis pipeline for bench, roofline and the
+    flight recorder."""
     if flops_probe is None:
         return None
     try:
-        cost = ts._step_fn.lower(*flops_probe).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost.get("flops", 0.0)) or None
+        compiled = ts._step_fn.lower(*flops_probe).compile()
     except Exception:
         return None
+    try:
+        from mxnet_tpu import telemetry
+        entry = telemetry.programs.register_compiled(
+            site, compiled, fn_name="train_step")
+        return float(entry.get("flops") or 0.0) or None
+    except Exception:
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return float(cost.get("flops", 0.0)) or None
+        except Exception:
+            return None
 
 
 def _flash_attention_flops(args):
@@ -361,7 +405,7 @@ def bench_resnet(args):
                 d = np.transpose(d, (0, 2, 3, 1))
             return {"data": d, "softmax_label": b.label[0].asnumpy()}
         dt, lat = _timed_steps(ts, next_batch, args.warmup, args.iters)
-        flops_per_step = None
+        flops_measured = None
     else:
         # Synthetic device-resident batches (the reference's perf.md
         # numbers are synthetic-data benchmarks of the training step).
@@ -381,17 +425,14 @@ def bench_resnet(args):
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             (ts.params, ts.states, ts.auxs, batches[0],
              jnp.float32(0.1), jnp.uint32(0)))
-        flops_per_step = _cost_flops(ts, probe)
-    if flops_per_step is None and args.num_layers == 50:
-        # ResNet-50 fwd ≈ 4.1 GMACs = 8.2 GFLOP/img; training ≈ 3x fwd
-        flops_per_step = 24.6e9 * args.batch
+        flops_measured = _cost_flops(ts, probe, site="bench_resnet")
+    # hand numerator (docs/PERF.md): ResNet-50 fwd ≈ 4.1 GMACs =
+    # 8.2 GFLOP/img; training ≈ 3x fwd — `mfu` reports this, the
+    # compiler-measured count reports as `mfu_measured` beside it
+    flops_hand = 24.6e9 * args.batch if args.num_layers == 50 else None
 
     img_per_sec = args.batch * args.iters / dt
     dev = jax.devices()[0]
-    peak = _peak_tflops(dev.device_kind)
-    achieved = (flops_per_step * args.iters / dt / 1e12
-                if flops_per_step else None)
-    _check_sane(achieved, peak)
     return {
         "metric": ("resnet50_train_img_per_sec_pipeline" if args.pipeline
                    else "resnet50_train_img_per_sec"),
@@ -401,9 +442,8 @@ def bench_resnet(args):
         "device_kind": dev.device_kind,
         "layout": args.layout,
         "fused": n_fused,
-        "achieved_tflops": round(achieved, 2) if achieved else None,
-        "peak_bf16_tflops": peak,
-        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        **_mfu_fields(flops_hand, flops_measured, args.iters, dt,
+                      dev.device_kind),
         **_latency_fields(lat["hist"], lat["compile_ms"]),
     }
 
@@ -448,16 +488,19 @@ def bench_transformer(args):
 
     dt, lat = _fori_timed(ts, batches, args.iters, lr=0.01,
                           warmup=args.warmup)
-    flops_per_step = _cost_flops(ts, probe)
-    if flops_per_step:
-        flops_per_step += _flash_attention_flops(args)
+    flops_measured = _cost_flops(ts, probe, site="bench_transformer")
+    if flops_measured:
+        # XLA reports 0 FLOPs for custom calls: when the Pallas flash-
+        # attention kernel is active its matmuls are counted analytically
+        flops_measured += _flash_attention_flops(args)
+    # hand numerator: the classic 6 * params * tokens training estimate
+    # — it DROPS the attention matmuls entirely (the known bug), which
+    # is exactly what the >10% mfu-vs-mfu_measured warning surfaces
+    n_params = sum(int(np.prod(p.shape)) for p in ts.params.values())
+    flops_hand = 6.0 * n_params * B * S
 
     tok_per_sec = B * S * args.iters / dt
     dev = jax.devices()[0]
-    peak = _peak_tflops(dev.device_kind)
-    achieved = (flops_per_step * args.iters / dt / 1e12
-                if flops_per_step else None)
-    _check_sane(achieved, peak)
     return {
         "metric": "transformer_lm_tokens_per_sec",
         "value": round(tok_per_sec, 1),
@@ -466,9 +509,8 @@ def bench_transformer(args):
         "config": "L%d d%d h%d S%d B%d vocab%d" % (
             args.lm_layers, args.lm_d_model, args.lm_heads, S, B,
             args.lm_vocab),
-        "achieved_tflops": round(achieved, 2) if achieved else None,
-        "peak_bf16_tflops": peak,
-        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        **_mfu_fields(flops_hand, flops_measured, args.iters, dt,
+                      dev.device_kind),
         **_latency_fields(lat["hist"], lat["compile_ms"]),
     }
 
@@ -1466,6 +1508,7 @@ def main():
     lm = bench_transformer(args)
     out["transformer_tokens_per_sec"] = lm["value"]
     out["transformer_mfu"] = lm["mfu"]
+    out["transformer_mfu_measured"] = lm["mfu_measured"]
     out["transformer_achieved_tflops"] = lm["achieved_tflops"]
     out["transformer_config"] = lm["config"]
     sv = bench_serving(args)

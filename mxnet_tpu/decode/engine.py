@@ -36,7 +36,7 @@ import numpy as _np
 from ..base import MXNetError
 from ..serving.batcher import (DeadlineExceededError, QueueFullError,
                                ServerClosedError, percentile as _percentile)
-from ..telemetry import REGISTRY
+from ..telemetry import REGISTRY, tracing as _tracing
 from .cache import CacheOOMError, PagedKVCache
 from .scheduler import Scheduler, Sequence
 
@@ -212,6 +212,13 @@ class DecodeEngine:
         self._closing = False
         self._abort = False
         self._thread = None
+        # hang watchdog over decode iterations (MXNET_WATCHDOG_FACTOR;
+        # 0 = off, the default — docs/OBSERVABILITY.md)
+        self._watchdog = None
+        import os as _os
+        if float(_os.environ.get("MXNET_WATCHDOG_FACTOR", "0") or 0) > 0:
+            from ..telemetry import Watchdog
+            self._watchdog = Watchdog("decode")
         if warmup:
             self.warmup()
         if start:
@@ -323,6 +330,16 @@ class DecodeEngine:
                 deadline=deadline, temperature=temperature, seed=seed,
                 sampler=sampler, collect_logits=collect_logits)
             self._sched.enqueue(seq)          # may raise QueueFullError
+            if _tracing.enabled():
+                # submit -> finish span, parented under the submitting
+                # thread's context (the /generate handler's http span —
+                # W3C traceparent already joined upstream callers there)
+                seq.trace_span = _tracing.start_span(
+                    "decode.request", rid=seq.rid,
+                    prompt_len=len(tokens),
+                    max_new_tokens=seq.max_new_tokens)
+                seq.queue_span = _tracing.start_span(
+                    "decode.queued", parent=seq.trace_span.context)
             self._n_admitted += 1
             ADMITTED.inc()
             QUEUE_DEPTH.set(len(self._sched.waiting))
@@ -489,6 +506,15 @@ class DecodeEngine:
     def _prefill(self, seq, slot):
         P = len(seq.tokens)
         bucket = self._bucket_for(P)
+        if seq.queue_span is not None:
+            seq.queue_span.end()
+            seq.queue_span = None
+        pf_span = _tracing.start_span(
+            "decode.prefill",
+            parent=getattr(seq.trace_span, "context", None),
+            bucket=bucket, prompt_len=P,
+            preemptions=seq.preemptions) if seq.trace_span is not None \
+            else None
         if not seq.blocks:
             seq.blocks = self.cache.alloc(self.cache.blocks_for(P))
         data = _np.zeros((1, bucket), _np.float32)
@@ -496,12 +522,16 @@ class DecodeEngine:
         table = _np.zeros((1, self._table_width), _np.float32)
         table[0, :len(seq.blocks)] = seq.blocks
         exe = self._prefill_exe(bucket)
-        with self._step_lock:
-            outs, dd = self._dispatch(
-                exe, ("prefill", bucket), data=data,
-                prompt_len=_np.asarray([float(P)], _np.float32),
-                block_table=table)
-            self._commit_caches(outs, base=2)
+        try:
+            with self._step_lock:
+                outs, dd = self._dispatch(
+                    exe, ("prefill", bucket), data=data,
+                    prompt_len=_np.asarray([float(P)], _np.float32),
+                    block_table=table)
+                self._commit_caches(outs, base=2)
+        finally:
+            if pf_span is not None:
+                pf_span.end()
         self._n_prefill_dispatches += dd
         self._n_prefills += 1
         PREFILLS.inc()
@@ -520,6 +550,20 @@ class DecodeEngine:
 
     def _step(self, active):
         t0 = time.perf_counter()
+        if self._watchdog is not None:
+            self._watchdog.begin()
+        # per-sequence per-iteration spans: each live stream's trace
+        # gets its own decode.iteration child (duration = this compiled
+        # launch + readback), so one request renders submit -> prefill
+        # -> N iterations -> done as a single connected tree
+        it_spans = None
+        if _tracing.enabled():
+            it_spans = [
+                _tracing.start_span(
+                    "decode.iteration",
+                    parent=getattr(s.trace_span, "context", None),
+                    step=self._n_steps, slot=slot, pos=s.pos)
+                for slot, s in active if s.trace_span is not None]
         data = _np.zeros((self.capacity, 1), _np.float32)
         pos = _np.full((self.capacity, 1), -1.0, _np.float32)
         table = _np.zeros((self.capacity, self._table_width), _np.float32)
@@ -556,6 +600,11 @@ class DecodeEngine:
                 continue
             self._emit(seq, tok)
             self._maybe_finish(seq, tok)
+        if it_spans:
+            for sp in it_spans:
+                sp.end()
+        if self._watchdog is not None:
+            self._watchdog.end()
         STEP_MS.observe((time.perf_counter() - t0) * 1e3)
 
     # ------------------------------------------------------------------
@@ -615,6 +664,16 @@ class DecodeEngine:
     def _finish(self, seq, reason=None, error=None):
         with self._cv:
             self._sched.release(seq)
+        if seq.queue_span is not None:       # finished while waiting
+            seq.queue_span.end()
+            seq.queue_span = None
+        if seq.trace_span is not None:
+            seq.trace_span.end(
+                finish_reason=(reason if error is None else "error"),
+                error=(type(error).__name__ if error is not None
+                       else None),
+                tokens=seq.n_generated, preemptions=seq.preemptions)
+            seq.trace_span = None
         if error is None and reason == "cancelled":
             self._n_cancelled += 1
             CANCELLED.inc()
@@ -695,6 +754,8 @@ class DecodeEngine:
             if not drain:
                 self._abort = True
             self._cv.notify_all()
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         if self._thread is not None:
             self._thread.join(timeout)
             # a timed-out join leaves the loop running: keep _thread so

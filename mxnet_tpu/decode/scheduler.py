@@ -134,6 +134,10 @@ class Sequence:
         self.t_submit = time.monotonic()
         self.t_first = None
         self.preemptions = 0
+        # mx.trace spans (None when tracing is off): trace_span covers
+        # submit -> finish, queue_span covers submit -> first prefill
+        self.trace_span = None
+        self.queue_span = None
 
     @property
     def n_generated(self):

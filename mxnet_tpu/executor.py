@@ -46,7 +46,8 @@ EXECUTOR_DISPATCH_MS = _telemetry.REGISTRY.histogram(
 # semantics with kvstore_fused / fused_fit): traced bodies call
 # _note_retrace(); call sites dispatch through _timed_dispatch
 _SITE = _telemetry.RetraceSite(EXECUTOR_RETRACES,
-                               _telemetry.JIT_COMPILE_MS)
+                               _telemetry.JIT_COMPILE_MS,
+                               site="executor")
 _note_retrace = _SITE.note
 
 

@@ -298,6 +298,11 @@ _PREFETCH_OCC = _telemetry.REGISTRY.gauge(
     unit="batches")
 _PREFETCH_BATCHES = _telemetry.REGISTRY.counter(
     "io_prefetch_batches", "batches served through PrefetchingIter")
+_DATA_WAIT_MS = _telemetry.REGISTRY.histogram(
+    "io_data_wait_ms",
+    "time the consumer blocked waiting for a prefetched batch — the "
+    "per-step data-wait the fit loop's io.data_wait trace span renders",
+    unit="ms")
 
 
 class PrefetchingIter(DataIter):
@@ -430,7 +435,13 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        import time as _time
+        sp = _telemetry.tracing.start_span("io.data_wait")
+        t0 = _time.perf_counter()
         batches = self._queue.get()
+        wait_ms = (_time.perf_counter() - t0) * 1e3
+        _DATA_WAIT_MS.observe(wait_ms)
+        sp.end(occupancy=self._queue.qsize())
         # occupancy AFTER the get: batches still staged for future steps
         # — 0 here while the device is busy means the input pipeline is
         # the bottleneck (docs/OBSERVABILITY.md)

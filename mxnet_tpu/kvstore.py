@@ -153,19 +153,20 @@ class KVStore:
             prios = list(priority)
         else:
             prios = [priority] * len(keys)
-        eng = self._get_engine()
-        mode = eng._updater_mode() if eng is not None else False
-        for k, vlist, prio in zip(keys, values, prios):
-            reason = eng.ineligible_reason(k, vlist, mode) \
-                if eng is not None else None
-            if eng is not None and reason is None:
-                eng.enqueue(k, vlist, prio)
-            else:
-                if eng is not None:
-                    _note_fallback(reason, detail="key %r" % (k,))
-                self._push_one(k, vlist)
-        if eng is not None and not self._async_push:
-            eng.flush()
+        with _telemetry.tracing.span("kvstore.push", keys=len(keys)):
+            eng = self._get_engine()
+            mode = eng._updater_mode() if eng is not None else False
+            for k, vlist, prio in zip(keys, values, prios):
+                reason = eng.ineligible_reason(k, vlist, mode) \
+                    if eng is not None else None
+                if eng is not None and reason is None:
+                    eng.enqueue(k, vlist, prio)
+                else:
+                    if eng is not None:
+                        _note_fallback(reason, detail="key %r" % (k,))
+                    self._push_one(k, vlist)
+            if eng is not None and not self._async_push:
+                eng.flush()
 
     def _push_one(self, k, vlist):
         """Eager per-key push (the reference shape; also the fallback for
@@ -217,14 +218,15 @@ class KVStore:
         self._async_push = bool(enabled)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        self._flush_pending()
         keys, outs = _key_value(key, out)
-        for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %s not initialized" % k)
-            src = self._store[k]
-            for o in olist:
-                o._set_data(src._data)
+        with _telemetry.tracing.span("kvstore.pull", keys=len(keys)):
+            self._flush_pending()
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % k)
+                src = self._store[k]
+                for o in olist:
+                    o._set_data(src._data)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows as row_sparse arrays (reference

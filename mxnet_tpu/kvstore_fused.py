@@ -89,8 +89,11 @@ DISPATCH_MS = _telemetry.REGISTRY.histogram(
     "host wall time to dispatch one bucket program (async enqueue)",
     unit="ms")
 # shared RetraceSite semantics with executor / fused_fit: step bodies
-# call _note_retrace() at trace time; _dispatch times through it
-_SITE = _telemetry.RetraceSite(BUCKET_RETRACES, _telemetry.JIT_COMPILE_MS)
+# call _note_retrace() at trace time; _dispatch times through it.
+# _dispatch wraps a non-jitted inner, so bucket programs register with
+# the compiled-program registry at their cache-miss sites below
+_SITE = _telemetry.RetraceSite(BUCKET_RETRACES, _telemetry.JIT_COMPILE_MS,
+                               site="kvstore_bucket")
 _note_retrace = _SITE.note
 
 
@@ -466,6 +469,8 @@ class FusedBucketEngine:
             if fn is None:
                 fn = self._steps[sig] = _build_step(
                     layout, n_dev, threshold, None, None, False)
+                _telemetry.programs.record("kvstore_bucket", fn,
+                                           (residuals, grads))
             outs, new_res = fn(residuals, grads)
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(out, ctx0)
@@ -474,12 +479,18 @@ class FusedBucketEngine:
              state_mask, rescale) = self._updater_inputs(bucket)
             sig = (mode, threshold, n_dev, layout, state_mask, use_wd)
             fn = self._steps.get(sig)
-            if fn is None:
+            fresh = fn is None
+            if fresh:
                 fn = self._steps[sig] = _build_step(
                     layout, n_dev, threshold, mode, state_mask, use_wd)
             weights = tuple(w._data for w in weights_nd)
             states = tuple(st._data if st is not None else None
                            for st in states_nd)
+            if fresh:
+                _telemetry.programs.record(
+                    "kvstore_bucket", fn,
+                    (weights, states, residuals, grads, lr_vec, wd_vec,
+                     rescale))
             new_ws, new_ss, new_res = fn(weights, states, residuals,
                                          grads, lr_vec, wd_vec, rescale)
             for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):

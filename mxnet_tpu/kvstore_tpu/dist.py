@@ -43,6 +43,19 @@ __all__ = ["initialize_from_env", "ensure_initialized", "world_size",
 _lock = threading.Lock()
 _state = {"checked": False, "seq": {}}
 
+
+def _barrier_ms():
+    """Lazy histogram handle (this module must stay importable before
+    telemetry — the package-import bootstrap runs first thing)."""
+    h = _state.get("barrier_ms")
+    if h is None:
+        from .. import telemetry as _telemetry
+        h = _state["barrier_ms"] = _telemetry.REGISTRY.histogram(
+            "kvstore_tpu_barrier_ms",
+            "wall time this rank waited at a coordination-service "
+            "barrier (rank skew; the straggler signal)", unit="ms")
+    return h
+
 _DEFAULT_TIMEOUT_MS = int(os.environ.get("MXTPU_COLLECTIVE_TIMEOUT_MS",
                                          "120000"))
 
@@ -199,12 +212,18 @@ def _next_seq(tag):
 
 
 def barrier(tag, timeout_ms=None):
-    """Global barrier over all processes (no-op single-process)."""
+    """Global barrier over all processes (no-op single-process). Wall
+    time lands in ``kvstore_tpu_barrier_ms`` — on a healthy pod it
+    measures rank skew; a fat tail here is the straggler signal
+    (docs/OBSERVABILITY.md)."""
+    import time
     import jax
     if jax.process_count() == 1:
         return
+    t0 = time.perf_counter()
     _client().wait_at_barrier("mxtpu/b/%s/%d" % (tag, _next_seq("b" + tag)),
                               timeout_ms or _DEFAULT_TIMEOUT_MS)
+    _barrier_ms().observe((time.perf_counter() - t0) * 1e3)
 
 
 def _cleanup(c, key):

@@ -276,6 +276,8 @@ class TPUBucketEngine(FusedBucketEngine):
                 fn = self._steps[sig] = _build_tpu_step(
                     layout, n_dev, self._nproc, threshold, None, None,
                     False)
+                _telemetry.programs.record("kvstore_tpu", fn,
+                                           (residuals, grads))
             outs, new_res = fn(residuals, grads)
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(self._unlift(out), ctx0)
@@ -285,7 +287,8 @@ class TPUBucketEngine(FusedBucketEngine):
             sig = ("tpu", mode, threshold, n_dev, layout, state_mask,
                    use_wd)
             fn = self._steps.get(sig)
-            if fn is None:
+            fresh = fn is None
+            if fresh:
                 fn = self._steps[sig] = _build_tpu_step(
                     layout, n_dev, self._nproc, threshold, mode,
                     state_mask, use_wd)
@@ -294,6 +297,11 @@ class TPUBucketEngine(FusedBucketEngine):
             states = tuple(
                 self._lift_repl(_on_device(st._data, self._local_dev))
                 if st is not None else None for st in states_nd)
+            if fresh:
+                _telemetry.programs.record(
+                    "kvstore_tpu", fn,
+                    (weights, states, residuals, grads, lr_vec, wd_vec,
+                     rescale))
             new_ws, new_ss, new_res = fn(weights, states, residuals,
                                          grads, lr_vec, wd_vec, rescale)
             for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
@@ -328,6 +336,8 @@ class TPUBucketEngine(FusedBucketEngine):
         if fn is None:
             fn = self._steps[sig] = _build_local_reduce(layout, n_dev,
                                                         threshold)
+            _telemetry.programs.record("kvstore_tpu", fn,
+                                       (residuals, grads))
         flat_q, new_res = fn(residuals, grads)
         if keys_tuple is not None:
             self._flat_res[keys_tuple]["res"] = list(new_res)

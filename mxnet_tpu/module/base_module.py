@@ -192,11 +192,17 @@ class BaseModule:
         on_epoch = _callbacks(epoch_end_callback)
 
         ckpt = self._make_checkpointer(checkpoint_every, checkpoint_prefix)
+        # pod health (straggler exchange) + hang watchdog — both no-ops
+        # unless armed (multi-process world / env; docs/OBSERVABILITY.md)
+        health = _telemetry.PodHealthMonitor.maybe_create(self.logger)
+        watchdog = None
+        if float(os.environ.get("MXNET_WATCHDOG_FACTOR", "0") or 0) > 0:
+            watchdog = _telemetry.Watchdog("fit")
         try:
             for epoch in range(begin_epoch, num_epoch):
                 preempted = self._run_train_epoch(
                     epoch, train_data, train_metric, monitor, on_batch,
-                    sparse_row_id_fn, ckpt)
+                    sparse_row_id_fn, ckpt, health, watchdog)
                 if preempted:
                     self.logger.warning(
                         "Epoch[%d] preempted — emergency checkpoint "
@@ -220,6 +226,8 @@ class BaseModule:
                                          epoch, name, val)
                 train_data.reset()
         finally:
+            if watchdog is not None:
+                watchdog.disarm()
             if ckpt is not None:
                 ckpt.close()        # drain pending writes, restore signals
 
@@ -243,7 +251,8 @@ class BaseModule:
                                  logger=self.logger)
 
     def _run_train_epoch(self, epoch, train_data, train_metric, monitor,
-                         on_batch, sparse_row_id_fn, ckpt=None):
+                         on_batch, sparse_row_id_fn, ckpt=None,
+                         health=None, watchdog=None):
         """One epoch: keep the device queue full, read metrics back only
         at callback boundaries. With the fused fit step active, the loop
         body performs ZERO blocking host syncs — metrics accumulate on
@@ -252,28 +261,49 @@ class BaseModule:
         bounds how many steps may be in flight. ``ckpt`` (a
         CheckpointManager) ticks at each step boundary; returns True
         when the epoch stopped early on a preemption (emergency
-        checkpoint already committed)."""
+        checkpoint already committed). ``health`` (PodHealthMonitor)
+        exchanges per-rank step times on its cadence; ``watchdog``
+        heartbeats around each step (both host-only; mx.trace spans
+        bracket the step and its children when tracing is enabled —
+        docs/OBSERVABILITY.md)."""
         t0 = time.time()
         train_metric.reset()
         flow = _Prefetcher(train_data, self, sparse_row_id_fn)
         sync_every = int(os.environ.get("MXNET_FIT_SYNC_EVERY", "0") or 0)
+        tracing = _telemetry.tracing
         nbatch = 0
         while flow.has_next:
-            batch = flow.advance()
-            if monitor is not None:
-                monitor.tic()
-            t_step = time.perf_counter()
-            # fit_step enqueues async XLA work (one donated program when
-            # fused); while the device runs, the host stages the
-            # (already-fetched) next batch. update_metric is a no-op for
-            # batches the fused step already folded on device.
-            self.fit_step(batch, train_metric)
-            flow.stage_next()
-            self.update_metric(train_metric, batch.label)
+            # the fit.step span parents every child opened inside —
+            # prefetch data-wait (flow.advance may block on the input
+            # pipeline), fused dispatch, kvstore push/pull — so one
+            # step renders as one subtree. FIT_STEP_MS keeps its
+            # historical meaning (dispatch + staging + bookkeeping,
+            # data-wait excluded — that one has io_data_wait_ms).
+            with tracing.span("fit.step", epoch=epoch, step=nbatch) as sp:
+                batch = flow.advance()
+                if monitor is not None:
+                    monitor.tic()
+                t_step = time.perf_counter()
+                if watchdog is not None:
+                    watchdog.begin()
+                # fit_step enqueues async XLA work (one donated program
+                # when fused); while the device runs, the host stages
+                # the (already-fetched) next batch. update_metric is a
+                # no-op for batches the fused step already folded on
+                # device.
+                self.fit_step(batch, train_metric)
+                flow.stage_next()
+                self.update_metric(train_metric, batch.label)
+                step_ctx = getattr(sp, "context", None)
+            if watchdog is not None:
+                watchdog.end()
             # telemetry (all host-side, nothing enters traced code):
             # step-time histogram, flight-recorder cadence, chrome-trace
             # step marker — each a no-op-cheap call when idle
-            FIT_STEP_MS.observe((time.perf_counter() - t_step) * 1e3)
+            step_ms = (time.perf_counter() - t_step) * 1e3
+            FIT_STEP_MS.observe(step_ms)
+            if health is not None:
+                health.step(step_ms)
             _telemetry.RECORDER.tick()
             _telemetry.mark_step(nbatch)
             if monitor is not None:
@@ -287,9 +317,13 @@ class BaseModule:
             if sync_every and nbatch % sync_every == 0:
                 self._fit_sync()
             # checkpoint tick LAST: the step (and its metric fold) is
-            # fully dispatched, so the snapshot sees post-step handles
-            if ckpt is not None and ckpt.tick(epoch=epoch):
-                return True
+            # fully dispatched, so the snapshot sees post-step handles.
+            # Its span parents under the (already-ended) step span —
+            # parent links are ids, a closed parent is fine.
+            if ckpt is not None:
+                with tracing.span("checkpoint.tick", parent=step_ctx):
+                    if ckpt.tick(epoch=epoch):
+                        return True
         # epoch boundary: the one scheduled metric readback of the epoch
         for name, val in train_metric.get_name_value():
             self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -94,7 +94,8 @@ FIT_RETRACES = _telemetry.REGISTRY.counter(
     vital=True)
 # shared RetraceSite semantics with executor / kvstore_fused: the step
 # body calls _note_retrace() at trace time; the launch times through it
-_SITE = _telemetry.RetraceSite(FIT_RETRACES, _telemetry.JIT_COMPILE_MS)
+_SITE = _telemetry.RetraceSite(FIT_RETRACES, _telemetry.JIT_COMPILE_MS,
+                               site="fit_step")
 _note_retrace = _SITE.note
 
 
@@ -533,7 +534,8 @@ class FusedFitStep:
         if track_mem:
             self._mem_tracker.begin()
         try:
-            with exe._prof_scope("Module::fused_fit_step"):
+            with exe._prof_scope("Module::fused_fit_step"), \
+                    _telemetry.tracing.span("fit.fused_dispatch"):
                 new_ps, new_ss, new_res, macc, new_auxs, outs = _SITE.timed(
                     fn, params, states, residuals, macc, inputs,
                     auxs, lr_vec, wd_vec, rescale, seed)

@@ -30,11 +30,28 @@ __all__ = ["attention_reference", "ring_attention", "ulysses_attention"]
 _NEG_INF = -1e30
 
 def _shard_map():
+    """shard_map with the replication checker OFF.  The causal ring
+    skips fully-masked blocks with a ``lax.cond`` whose predicate
+    (``src <= rank``) is device-varying; both branches produce values
+    varying over the same mesh axes, but the static rep/vma checker
+    cannot type a varying-predicate cond and rejects the (correct)
+    program — jax's own error message prescribes ``check_rep=False``
+    as the workaround.  Gradient parity against the single-device
+    oracle is pinned by tests/test_ring_attention.py."""
+    import functools
+    import inspect
     try:
-        return jax.shard_map          # jax >= 0.8
+        sm = jax.shard_map              # jax >= 0.8
     except AttributeError:
-        from jax.experimental.shard_map import shard_map
-        return shard_map
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):
+        params = ()
+    for kw in ("check_rep", "check_vma"):   # renamed across versions
+        if kw in params:
+            return functools.partial(sm, **{kw: False})
+    return sm
 
 
 def attention_reference(q, k, v, causal=False, scale=None):

@@ -111,7 +111,8 @@ def bucketize(n, buckets):
 
 class Request:
     """One single-example inference request riding the queue."""
-    __slots__ = ("rid", "inputs", "future", "deadline", "t_submit")
+    __slots__ = ("rid", "inputs", "future", "deadline", "t_submit",
+                 "span")
     _ids = itertools.count()
 
     def __init__(self, inputs, future, deadline=None):
@@ -120,6 +121,11 @@ class Request:
         self.future = future          # concurrent.futures.Future
         self.deadline = deadline      # monotonic seconds, or None
         self.t_submit = time.monotonic()
+        # mx.trace span covering admission -> settle (None when tracing
+        # is off); opened by ModelServer.submit, ended by the future's
+        # done callback — the request's identity across queue/batcher/
+        # replica threads
+        self.span = None
 
     def expired(self, now=None):
         return (self.deadline is not None

@@ -120,16 +120,29 @@ class Replica:
 
     def _execute(self, mb):
         stats = self._stats
+        # batch-level forward span, parented under the OLDEST member
+        # request's span (requests are in arrival order) so each trace
+        # renders admission -> forward; member rids ride as attrs
+        from .. import telemetry as _tm
+        lead = next((r.span for r in mb.requests if r.span is not None),
+                    None)
+        fwd_span = _tm.tracing.start_span(
+            "serving.forward", parent=getattr(lead, "context", None),
+            replica=self.index, bucket=mb.bucket, n_real=mb.n_real,
+            rids=[r.rid for r in mb.requests]) if lead is not None \
+            else _tm.tracing.NULL_SPAN
         try:
             pred = self._pred_for(mb.bucket)
             with self._swap_lock:
                 outs = pred.forward(**mb.arrays)
         except Exception as exc:     # deliver, don't kill the worker
+            fwd_span.end(error=type(exc).__name__)
             for req in mb.requests:
                 settle_exception(req.future, exc)
             if stats is not None:
                 stats.record_failed_batch(self.index, mb, exc)
             return
+        fwd_span.end()
         # slice the padding off before delivery — rows [n_real:] are
         # replicas of row 0 and must never leak into any result
         for i, req in enumerate(mb.requests):

@@ -391,10 +391,23 @@ class ModelServer:
                     if timeout_ms is not None else None)
         fut = Future()
         req = Request(arrays, fut, deadline)
+        from .. import telemetry as _tm
+        if _tm.tracing.enabled():
+            # admission -> settle span; parent = the submitting thread's
+            # context (the HTTP handler's span, or a caller's trace)
+            span = _tm.tracing.start_span("serving.request", rid=req.rid)
+            req.span = span
+            fut.add_done_callback(
+                lambda f: span.end(
+                    outcome=("cancelled" if f.cancelled() else
+                             type(f.exception()).__name__
+                             if f.exception() is not None else "ok")))
         try:
             self._queue.put(req)
         except QueueFullError:
             self._stats.record_queue_full()
+            if req.span is not None:
+                req.span.end(outcome="queue_full")
             raise
         self._stats.record_admitted(len(self._queue))
         return fut
@@ -724,7 +737,14 @@ class ModelServer:
                     try:
                         doc = self._read_json()
                         if doc is not None:
-                            self._do_generate(doc)
+                            # W3C traceparent joins the caller's trace;
+                            # the span parents the whole decode
+                            # lifecycle submitted inside it
+                            with _tm.tracing.span(
+                                    "http.generate",
+                                    parent=_tm.tracing.extract(
+                                        self.headers) or "current"):
+                                self._do_generate(doc)
                     except Exception as e:   # noqa: BLE001
                         self._reply(500, {"error": str(e),
                                           "type": "internal"})
@@ -765,9 +785,14 @@ class ModelServer:
                     doc = self._read_json()
                     if doc is None:
                         return
-                    fut = server.submit(doc.get("inputs") or {},
-                                        timeout_ms=doc.get("timeout_ms"))
-                    outs = fut.result()
+                    with _tm.tracing.span(
+                            "http.predict",
+                            parent=_tm.tracing.extract(self.headers)
+                            or "current"):
+                        fut = server.submit(
+                            doc.get("inputs") or {},
+                            timeout_ms=doc.get("timeout_ms"))
+                        outs = fut.result()
                     self._reply(200, {"outputs": [o.tolist() for o in outs]})
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e), "type": "queue_full"})

@@ -18,10 +18,20 @@ One place to read every operational witness the framework emits
   residuals/auxs breakdown keyed by the fused-fit donation sets.
 * :mod:`chrome` — injects per-step markers + counter tracks into the
   ``mx.profiler`` chrome-trace dump.
+* :mod:`tracing` — mx.trace: Dapper-style request/step spans with W3C
+  ``traceparent`` propagation, exported into the flight recorder and
+  chrome-trace surfaces (near-zero cost when disabled, the default).
+* :mod:`programs` — compiled-program registry: per-program FLOPs /
+  bytes / peak HBM / compile time from XLA ``cost_analysis()`` /
+  ``memory_analysis()`` for every RetraceSite jit site
+  (``telemetry.programs()``), plus the ``mfu_measured`` gauge.
+* :mod:`health` — pod-scale straggler detection over the coordination-
+  service collectives and a hang watchdog (flight note + faulthandler
+  stack dump).
 
 This package is stdlib-only at import (jax is touched lazily inside
-:mod:`memory`), so the registry is safe to import from anywhere in the
-framework without cycles.
+:mod:`memory`/:mod:`programs`), so the registry is safe to import from
+anywhere in the framework without cycles.
 """
 from . import registry
 from .registry import (Counter, Gauge, Histogram, Registry, REGISTRY,
@@ -36,14 +46,34 @@ from . import memory
 from .memory import memory_snapshot, StepMemoryTracker
 from . import chrome
 from .chrome import mark_step
+from . import tracing
+from . import health
+from . import programs as _programs_mod
+from .health import PodHealthMonitor, Watchdog
+
+
+class _ProgramsFacade:
+    """``telemetry.programs`` is both the module (attribute access —
+    ``telemetry.programs.record``) and the query (``telemetry.
+    programs()`` returns the per-program cost table)."""
+
+    def __call__(self, analyze=True, site=None):
+        return _programs_mod.programs(analyze=analyze, site=site)
+
+    def __getattr__(self, name):
+        return getattr(_programs_mod, name)
+
+
+programs = _ProgramsFacade()
 
 __all__ = [
-    "registry", "export", "flight", "memory", "chrome",
+    "registry", "export", "flight", "memory", "chrome", "tracing",
+    "health", "programs",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "exponential_buckets", "hist_quantile", "sanitize_name",
     "generate_text", "parse_text", "start_http_exporter",
-    "FlightRecorder", "RECORDER",
+    "FlightRecorder", "RECORDER", "PodHealthMonitor", "Watchdog",
     "memory_snapshot", "StepMemoryTracker", "mark_step",
     "JIT_COMPILE_MS",
 ]

@@ -54,13 +54,21 @@ def mark_step(step=None, name="fit_step"):
 
 def dump_events(registry=None):
     """Closing counter-track events (chrome trace dicts) for every
-    scalar registry series — appended by ``profiler.dump()``."""
+    scalar registry series, plus the finished mx.trace spans still in
+    the tracing ring (``ph='X'`` with trace/span/parent ids) — appended
+    by ``profiler.dump()`` so request/step spans render against the
+    device timeline."""
     reg = registry if registry is not None else REGISTRY
     from .. import profiler
     now = profiler._now_us()
     pid = os.getpid()
     tid = threading.get_ident() & 0xFFFF
     events = []
+    try:
+        from . import tracing as _tracing
+        events.extend(_tracing.chrome_events())
+    except Exception:
+        pass
     for m in reg.collect():
         for s in [m] + m.children():
             if isinstance(s, Histogram):

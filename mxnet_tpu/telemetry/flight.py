@@ -113,16 +113,35 @@ class FlightRecorder:
             pass
 
     def dump(self, path=None):
-        """Write the ring (plus one fresh final sample) as JSON-lines;
-        returns the path written."""
+        """Write the dump as JSON-lines; returns the path written.
+
+        Line order: mx.trace spans first (``{"span": {...}}`` — one per
+        finished span still in the tracing ring), then the compiled-
+        program top-K table (``{"programs": [...]}`` — already-analyzed
+        entries only: a crash dump must never trigger an XLA compile),
+        then the metric ring, ending with one fresh final sample."""
         path = path or self._path
         if path is None:
             raise ValueError("no dump path: pass one or install() first")
+        extra = []
+        try:
+            from . import tracing as _tracing
+            for rec in _tracing.spans():
+                extra.append({"span": rec})
+        except Exception:
+            pass
+        try:
+            from . import programs as _programs
+            top = _programs.top_programs(8, analyze=False)
+            if top:
+                extra.append({"programs": top})
+        except Exception:
+            pass
         self.sample(step=self._steps, final=True)
         with self._lock:
             records = list(self._ring)
         with open(path, "w") as f:
-            for rec in records:
+            for rec in extra + records:
                 f.write(json.dumps(rec) + "\n")
         return path
 

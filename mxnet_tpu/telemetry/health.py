@@ -1,0 +1,254 @@
+"""Pod-scale health: straggler detection + hang watchdog.
+
+MegaScale-style triage for multi-host training (docs/OBSERVABILITY.md):
+when one rank of a pod drags, aggregate throughput falls with no local
+signal on the healthy ranks; when one rank wedges, everyone else blocks
+inside a collective with no signal at all.  Two host-side tools:
+
+* :class:`PodHealthMonitor` — every ``every`` fit steps, each rank
+  contributes its recent step-time p50 over the PR 7 coordination-
+  service collectives (``kvstore_tpu.dist.allgather_bytes`` — works on
+  every backend, single-process worlds included, where the exchange is
+  an identity).  A rank whose p50 exceeds ``factor`` × the world
+  median is flagged: ``straggler_rank`` gauge (-1 = healthy), a
+  per-rank ``pod_step_ms_p50`` gauge (labeled by ``rank``), and a
+  flight-recorder note.  The fit loop drives it automatically in
+  multi-process worlds (``MXNET_HEALTH_EVERY``, default 50; 0
+  disables; setting it in a single-process world also arms the
+  monitor — that's how tier-1 exercises the path).
+* :class:`Watchdog` — a daemon thread watching a begin()/end()
+  heartbeat around each fit step / decode iteration.  When a step
+  stays open longer than ``factor`` × its rolling p50 (and past a
+  floor), it fires ONCE per incident: a flight-recorder note
+  (``hang_suspected``) plus a ``faulthandler`` all-thread stack dump —
+  the "where is every thread stuck" artifact that turns a silent pod
+  hang into a bug report.  Armed via ``MXNET_WATCHDOG_FACTOR`` (0 =
+  off, the default) or explicitly by the embedding loop.
+
+Everything here is host-side and collective-light: the monitor costs
+one small allgather per ``every`` steps, the watchdog one clock read
+per step plus a sleepy poll thread.  Neither ever touches traced code.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import sys
+import threading
+import time
+from collections import deque
+
+from .registry import REGISTRY
+
+__all__ = ["PodHealthMonitor", "Watchdog", "STRAGGLER_RANK"]
+
+STRAGGLER_RANK = REGISTRY.gauge(
+    "straggler_rank", "rank whose step-time p50 exceeds the straggler "
+    "factor times the world median (-1 = no straggler)", unit="rank")
+POD_STEP_P50 = REGISTRY.gauge(
+    "pod_step_ms_p50", "per-rank fit-step p50 from the last health "
+    "exchange, labeled by `rank`", unit="ms")
+HEALTH_EXCHANGES = REGISTRY.counter(
+    "health_exchanges", "pod step-time health exchanges completed")
+WATCHDOG_STALLS = REGISTRY.counter(
+    "watchdog_stalls", "watchdog incidents: a fit step or decode "
+    "iteration exceeded its stall threshold (flight note + stack dump)")
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return None
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class PodHealthMonitor:
+    """Per-rank step-time exchange + straggler detector (module doc).
+
+    ``step(step_ms)`` is the per-step hook: records the sample and, on
+    every ``every``-th call, runs one exchange.  Returns the detected
+    straggler rank (or -1) on exchange steps, None otherwise.
+    """
+
+    def __init__(self, every=None, factor=1.5, window=128, logger=None):
+        if every is None:
+            every = int(os.environ.get("MXNET_HEALTH_EVERY", "50") or 0)
+        self.every = max(0, int(every))
+        self.factor = float(factor)
+        self._window = deque(maxlen=window)
+        self._steps = 0
+        self._logger = logger
+        self.last_exchange = None      # [(rank, p50_ms)] of the last run
+
+    @classmethod
+    def maybe_create(cls, logger=None):
+        """The fit loop's constructor: a monitor when the world is
+        multi-process (default cadence) or ``MXNET_HEALTH_EVERY`` is
+        set explicitly; else None (single-process default = off)."""
+        env = os.environ.get("MXNET_HEALTH_EVERY")
+        try:
+            import jax
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        if env is None and not multi:
+            return None
+        mon = cls(logger=logger)
+        return mon if mon.every else None
+
+    def step(self, step_ms):
+        self._window.append(float(step_ms))
+        self._steps += 1
+        if not self.every or self._steps % self.every:
+            return None
+        return self.exchange()
+
+    def exchange(self):
+        """One allgather of local step-time p50s; flags the straggler.
+        Collective discipline: every rank must call this at the same
+        step (the fit loop's fixed cadence guarantees it)."""
+        p50 = _median(self._window)
+        if p50 is None:
+            return None
+        from ..kvstore_tpu import dist
+        try:
+            parts = dist.allgather_bytes("health_step",
+                                         struct.pack("<d", p50))
+        except Exception as e:                      # noqa: BLE001
+            if self._logger is not None:
+                self._logger.warning("pod health exchange failed: %s", e)
+            return None
+        p50s = [struct.unpack("<d", p)[0] for p in parts]
+        self.last_exchange = list(enumerate(p50s))
+        med = _median(p50s)
+        worst = max(range(len(p50s)), key=lambda r: p50s[r])
+        straggler = -1
+        if med and len(p50s) > 1 and p50s[worst] > self.factor * med:
+            straggler = worst
+        STRAGGLER_RANK.set(straggler)
+        for r, v in enumerate(p50s):
+            POD_STEP_P50.labels(rank=r).set(round(v, 3))
+        HEALTH_EXCHANGES.inc()
+        if straggler >= 0:
+            from .flight import RECORDER
+            RECORDER.note("straggler", rank=straggler,
+                          p50_ms=round(p50s[straggler], 3),
+                          world_median_ms=round(med, 3))
+            if self._logger is not None:
+                self._logger.warning(
+                    "pod straggler: rank %d step p50 %.1f ms vs world "
+                    "median %.1f ms", straggler, p50s[straggler], med)
+        return straggler
+
+
+class Watchdog:
+    """Hang detector over a begin()/end() heartbeat (module doc).
+
+    The monitored loop calls ``begin()`` when a step starts and
+    ``end()`` when it finishes; a daemon poll thread fires when a step
+    stays open past ``max(min_s, factor × rolling p50)``.  It never
+    fires during warm-up (needs ``min_samples`` completed steps first,
+    so first-step compiles can't trip it) and at most once per
+    incident.
+    """
+
+    def __init__(self, name, factor=None, min_s=5.0, poll_s=0.5,
+                 min_samples=8, window=256, stream=None):
+        if factor is None:
+            factor = float(os.environ.get("MXNET_WATCHDOG_FACTOR", "0")
+                           or 0.0)
+        self.name = name
+        self.factor = float(factor)
+        self.min_s = float(min_s)
+        self.poll_s = float(poll_s)
+        self.min_samples = int(min_samples)
+        self._durs = deque(maxlen=window)
+        self._t_begin = None
+        self._fired = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._stream = stream          # faulthandler target (def stderr)
+        self.stalls = 0
+        if self.factor > 0:
+            self.arm()
+
+    @property
+    def armed(self):
+        return self._thread is not None
+
+    def arm(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mx-watchdog-%s" % self.name,
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def disarm(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.poll_s + 1)
+
+    # -- heartbeat (monitored-loop side) -------------------------------
+    def begin(self):
+        with self._lock:
+            self._t_begin = time.monotonic()
+            self._fired = False
+
+    def end(self):
+        with self._lock:
+            t0, self._t_begin = self._t_begin, None
+            if t0 is not None:
+                self._durs.append(time.monotonic() - t0)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    # -- poll thread ---------------------------------------------------
+    def _threshold(self):
+        if len(self._durs) < self.min_samples:
+            return None
+        p50 = _median(self._durs)
+        return max(self.min_s, self.factor * p50)
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                t0, fired = self._t_begin, self._fired
+                thr = self._threshold() if t0 is not None else None
+            if t0 is None or fired or thr is None:
+                continue
+            elapsed = time.monotonic() - t0
+            if elapsed > thr:
+                with self._lock:
+                    self._fired = True
+                self._fire(elapsed, thr)
+
+    def _fire(self, elapsed, threshold):
+        self.stalls += 1
+        WATCHDOG_STALLS.inc()
+        from .flight import RECORDER
+        RECORDER.note("hang_suspected", loop=self.name,
+                      elapsed_s=round(elapsed, 3),
+                      threshold_s=round(threshold, 3))
+        stream = self._stream if self._stream is not None else sys.stderr
+        try:
+            print("\n=== mx.trace watchdog: %s step open for %.1fs "
+                  "(threshold %.1fs) — all-thread stacks follow ==="
+                  % (self.name, elapsed, threshold),
+                  file=stream, flush=True)
+            import faulthandler
+            faulthandler.dump_traceback(file=stream, all_threads=True)
+        except Exception:
+            pass

@@ -1,0 +1,317 @@
+"""Compiled-program registry: per-program cost attribution from XLA.
+
+Every jit site that already reports retraces through
+:class:`registry.RetraceSite` — the executor fwd/fwd_bwd programs, the
+fused fit step, the bucketed kvstore programs (single-host and tpu),
+and therefore the decode engine's prefill/step executors — registers
+the program it just compiled here, keyed by ``(site, fn, abstract
+argument signature)``.  The registry answers the question bench.py's
+hand FLOP math cannot: what does the COMPILER say each live program
+costs?
+
+* **Recording is compile-path-only.**  ``RetraceSite.timed`` calls
+  :func:`record` only on calls during which its thread (re)traced, so
+  steady-state dispatches never touch this module.  ``record`` captures
+  the jitted callable plus a ``ShapeDtypeStruct`` skeleton of the
+  arguments (metadata only — safe even for donated buffers, whose
+  shapes/dtypes survive donation) and the first-trace wall time.
+* **Analysis is lazy and memoized.**  ``cost_analysis()`` /
+  ``memory_analysis()`` need a compiled executable; re-lowering the
+  jitted callable over the recorded abstract arguments costs one extra
+  XLA compile the FIRST time a program is inspected (the same
+  ``lower().compile()`` idiom bench.py has always used) and nothing
+  after.  :func:`programs` with ``analyze=False`` (the flight-recorder
+  dump path) reports only already-computed analyses — a crash dump
+  must never compile.
+
+Exported surfaces: ``telemetry.programs()`` (list of dicts),
+``top_programs(k)`` (by FLOPs — the flight-dump table),
+``mfu_measured(flops_per_step, seconds)`` (gauge ``mfu_measured``:
+compiler-reported model FLOP/s over the chip's peak), and
+``peak_tflops()`` — the one device-kind → peak-bf16-TFLOP/s table,
+shared with bench.py.
+"""
+from __future__ import annotations
+
+import threading
+
+from .registry import REGISTRY
+
+__all__ = ["record", "register_compiled", "programs", "top_programs",
+           "analyze", "clear", "peak_tflops", "mfu_measured",
+           "MFU_MEASURED"]
+
+PROGRAMS_REGISTERED = REGISTRY.gauge(
+    "trace_programs", "distinct compiled programs currently in the "
+    "program registry", unit="programs")
+MFU_MEASURED = REGISTRY.gauge(
+    "mfu_measured", "model FLOP utilization from compiler-reported "
+    "FLOPs (cost_analysis) over the chip's peak bf16 throughput — the "
+    "measured counterpart of bench.py's hand-math `mfu`", unit="ratio")
+
+# Peak bf16 TFLOP/s per chip, keyed by substrings of jax device_kind —
+# the ONE table (bench.py imports it; keep in sync with vendor specs)
+PEAK_TFLOPS_TABLE = (
+    ("v6", 918.0),      # Trillium
+    ("v5p", 459.0),
+    ("v5", 197.0),      # v5e / "v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+_lock = threading.Lock()
+_programs = {}          # key -> entry dict
+_order = []             # insertion order of keys
+
+
+def peak_tflops(device_kind=None):
+    """Peak bf16 TFLOP/s for ``device_kind`` (default: device 0); None
+    for chips not in the table (CPU containers)."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(device_kind).lower()
+    for key, peak in PEAK_TFLOPS_TABLE:
+        if key in kind:
+            return peak
+    return None
+
+
+def _abstractify(args):
+    """ShapeDtypeStruct skeleton of a call's argument pytree (hashable
+    fingerprint + relowerable spec).  Shape/dtype metadata is readable
+    even off donated (already-deleted) arrays."""
+    import jax
+    import numpy as _np
+
+    def one(a):
+        if a is None:
+            return None
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:
+            a = _np.asarray(a)
+            shape, dtype = a.shape, a.dtype
+        return jax.ShapeDtypeStruct(tuple(shape), _np.dtype(dtype))
+
+    return jax.tree.map(one, args, is_leaf=lambda x: x is None)
+
+
+def _fingerprint(abstract):
+    import jax
+    leaves, treedef = jax.tree.flatten(
+        abstract, is_leaf=lambda x: x is None)
+    return (str(treedef),
+            tuple((l.shape, str(l.dtype)) if l is not None else None
+                  for l in leaves))
+
+
+def record(site, fn, args, compile_ms=None):
+    """Register one just-compiled program (called by RetraceSite.timed
+    on the compile path only).  Never raises — attribution must not be
+    able to fail a training step."""
+    try:
+        abstract = _abstractify(args)
+        key = (site, id(fn)) + _fingerprint(abstract)
+    except Exception:
+        return None
+    with _lock:
+        entry = _programs.get(key)
+        if entry is None:
+            entry = {
+                "site": site,
+                "fn_name": getattr(fn, "__name__",
+                                   None) or str(type(fn).__name__),
+                "fn": fn,
+                "abstract": abstract,
+                "arg_shapes": _shape_summary(abstract),
+                "retraces": 0,
+                "compile_ms": None,
+                "analysis": None,       # filled lazily by analyze()
+                "analysis_error": None,
+            }
+            _programs[key] = entry
+            _order.append(key)
+            PROGRAMS_REGISTERED.set(len(_order))
+        entry["retraces"] += 1
+        if compile_ms is not None:
+            # keep the FIRST trace's wall time (trace+compile+first run);
+            # later shape-variant retraces are tracked by the count
+            if entry["compile_ms"] is None:
+                entry["compile_ms"] = round(float(compile_ms), 3)
+    return key
+
+
+def register_compiled(site, compiled, fn_name=None, compile_ms=None):
+    """Register an ALREADY-compiled executable (``jitted.lower(...)
+    .compile()``) — the AOT path tools/roofline.py and bench.py use, so
+    their measurement programs appear in ``telemetry.programs()`` and
+    their analyses never recompile.  Returns the entry dict."""
+    key = (site, id(compiled), "aot")
+    with _lock:
+        entry = _programs.get(key)
+        if entry is None:
+            entry = {
+                "site": site,
+                "fn_name": fn_name or "compiled",
+                "fn": None,
+                "abstract": None,
+                "arg_shapes": None,
+                "retraces": 1,
+                "compile_ms": (round(float(compile_ms), 3)
+                               if compile_ms is not None else None),
+                "analysis": None,
+                "analysis_error": None,
+            }
+            _programs[key] = entry
+            _order.append(key)
+            PROGRAMS_REGISTERED.set(len(_order))
+    _analyze_entry(entry, compiled=compiled)
+    return _public(entry)
+
+
+def _shape_summary(abstract, limit=8):
+    import jax
+    leaves = [l for l in jax.tree.leaves(
+        abstract, is_leaf=lambda x: x is None) if l is not None]
+    shapes = ["%s%s" % (str(l.dtype), list(l.shape)) for l in leaves]
+    if len(shapes) > limit:
+        shapes = shapes[:limit] + ["... +%d" % (len(shapes) - limit)]
+    return shapes
+
+
+def _analyze_entry(entry, compiled=None):
+    """Compute + cache cost/memory analysis for one entry. One extra
+    compile for RetraceSite-recorded entries the first time (AOT
+    lowering is a separate cache from the dispatch path); zero for
+    register_compiled entries."""
+    if entry["analysis"] is not None or entry["analysis_error"] is not None:
+        return entry["analysis"]
+    try:
+        if compiled is None:
+            from .registry import RETRACE_SUPPRESS
+            args = entry["abstract"]
+            # re-materialize the recorded pytree call: sites call their
+            # jitted fn positionally, so the skeleton is an args tuple.
+            # Lowering usually hits the cached jaxpr; on a miss the
+            # traced body re-runs — mute its retrace note() so analysis
+            # can never move the zero-retrace witnesses it reports on
+            RETRACE_SUPPRESS.on = True
+            try:
+                compiled = entry["fn"].lower(*args).compile()
+            finally:
+                RETRACE_SUPPRESS.on = False
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = dict(cost or {})
+        mem = None
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            mem = None
+        analysis = {
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)
+                                    or 0.0),
+            "transcendentals": float(cost.get("transcendentals", 0.0)
+                                     or 0.0),
+        }
+        if mem is not None:
+            arg_b = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+            out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+            tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+            analysis.update({
+                "argument_bytes": arg_b,
+                "output_bytes": out_b,
+                "temp_bytes": tmp_b,
+                # the executable's device high-water mark: resident
+                # args + outputs + scratch (alias'd bytes counted once
+                # on the argument side)
+                "peak_hbm_bytes": arg_b + out_b + tmp_b
+                - int(getattr(mem, "alias_size_in_bytes", 0) or 0),
+                "generated_code_bytes": int(getattr(
+                    mem, "generated_code_size_in_bytes", 0) or 0),
+            })
+        entry["analysis"] = analysis
+        return analysis
+    except Exception as e:                          # noqa: BLE001
+        entry["analysis_error"] = "%s: %s" % (type(e).__name__, e)
+        return None
+
+
+def analyze(entry_or_index):
+    """Force analysis of one entry (``programs(analyze=False)`` rows
+    carry ``index``)."""
+    with _lock:
+        keys = list(_order)
+    if isinstance(entry_or_index, int):
+        entry = _programs[keys[entry_or_index]]
+    else:
+        entry = entry_or_index
+    return _analyze_entry(entry)
+
+
+def _public(entry, index=None):
+    out = {k: entry[k] for k in ("site", "fn_name", "arg_shapes",
+                                 "retraces", "compile_ms")}
+    if index is not None:
+        out["index"] = index
+    a = entry["analysis"]
+    if a is not None:
+        out.update(a)
+    elif entry["analysis_error"] is not None:
+        out["analysis_error"] = entry["analysis_error"]
+    return out
+
+
+def programs(analyze=True, site=None):
+    """Every registered program as a list of dicts (registration
+    order).  ``analyze=True`` (default) runs the lazy cost/memory
+    analysis for rows that don't have one yet; ``analyze=False`` (the
+    crash-dump path) reports only cached analyses."""
+    with _lock:
+        entries = [(_programs[k], i) for i, k in enumerate(_order)]
+    out = []
+    for entry, i in entries:
+        if site is not None and entry["site"] != site:
+            continue
+        if analyze:
+            _analyze_entry(entry)
+        out.append(_public(entry, index=i))
+    return out
+
+
+def top_programs(k=5, analyze=False, by="flops"):
+    """Top-``k`` programs by ``by`` (default FLOPs) — the flight-dump
+    table.  With ``analyze=False`` only already-analyzed rows rank."""
+    rows = [r for r in programs(analyze=analyze) if r.get(by)]
+    rows.sort(key=lambda r: -r[by])
+    return rows[:k]
+
+
+def mfu_measured(flops_per_step, seconds_per_step, device_kind=None):
+    """Set (and return) the ``mfu_measured`` gauge from compiler-
+    reported FLOPs: ``flops/s / peak``.  None (gauge untouched) when
+    the chip has no known peak (CPU containers) or inputs are
+    missing."""
+    if not flops_per_step or not seconds_per_step:
+        return None
+    peak = peak_tflops(device_kind)
+    if not peak:
+        return None
+    mfu = (flops_per_step / seconds_per_step) / (peak * 1e12)
+    MFU_MEASURED.set(round(mfu, 6))
+    return mfu
+
+
+def clear():
+    """Tests/teardown only."""
+    with _lock:
+        _programs.clear()
+        del _order[:]
+        PROGRAMS_REGISTERED.set(0)

@@ -37,6 +37,20 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
            "TraceTally", "RetraceSite"]
 
 
+class _RetraceSuppress(threading.local):
+    """Thread-local mute for retrace accounting: the compiled-program
+    registry's lazy AOT re-lowering (programs.py) may re-run a traced
+    body whose ``note()`` would otherwise bump the vital zero-retrace
+    witnesses tests pin.  Analysis is observation — it must not move
+    what it observes."""
+
+    def __init__(self):
+        self.on = False
+
+
+RETRACE_SUPPRESS = _RetraceSuppress()
+
+
 class TraceTally(threading.local):
     """Per-thread (re)trace tally for exact compile detection at a
     dispatch site. jax traces ON the dispatching thread, so bumping
@@ -61,14 +75,22 @@ class RetraceSite:
       ``dispatch_hist`` (when given), and calls during which THIS
       thread (re)traced also observe into ``compile_hist``
       (trace + compile + first run), exception or not.
+
+    With a ``site`` name, calls that (re)traced a directly-dispatched
+    jitted callable also register the program in the compiled-program
+    registry (telemetry/programs.py) — compile-path-only, so the
+    steady state never touches it.
     """
 
-    def __init__(self, counter, compile_hist=None):
+    def __init__(self, counter, compile_hist=None, site=None):
         self.counter = counter
         self._compile_hist = compile_hist
+        self.site = site
         self._tally = TraceTally()
 
     def note(self):
+        if RETRACE_SUPPRESS.on:
+            return
         self.counter.inc()
         self._tally.count += 1
 
@@ -84,6 +106,14 @@ class RetraceSite:
                 dispatch_hist.observe(dt_ms)
             if self._compile_hist is not None and self._tally.count > r0:
                 self._compile_hist.observe(dt_ms)
+            if (self.site is not None and self._tally.count > r0
+                    and hasattr(fn, "lower")):
+                # jitted callables dispatched directly register the
+                # freshly-compiled program; wrapper callables (the
+                # bucketed kvstore's _dispatch_inner) register at
+                # their own cache-miss sites instead
+                from . import programs as _programs
+                _programs.record(self.site, fn, args, compile_ms=dt_ms)
 
 _ENABLED = True
 

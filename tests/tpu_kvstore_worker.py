@@ -215,11 +215,45 @@ def checkpoint_roundtrip(mod, rank, n):
     dist.barrier("corrupt-verified")
 
 
+def straggler_checks(rank, n):
+    """Pod health: exchange synthetic step-time p50s over the
+    coordination-service collectives — rank n-1 reports 10x the others
+    and every rank must agree it is the straggler; then a healthy
+    exchange must clear the flag back to -1 on every rank."""
+    from mxnet_tpu import telemetry
+
+    mon = telemetry.PodHealthMonitor(every=1, factor=1.5)
+    slow = (rank == n - 1)
+    for _ in range(4):
+        mon._window.append(1000.0 if slow else 100.0)
+    got = mon.exchange()
+    want = n - 1 if n > 1 else -1
+    assert got == want, "straggler: got %r want %r" % (got, want)
+    assert telemetry.REGISTRY.get("straggler_rank").value == want
+    if n > 1:
+        p50s = dict(mon.last_exchange)
+        assert p50s[n - 1] == 1000.0 and p50s[0] == 100.0, p50s
+    # healthy follow-up exchange clears the flag
+    mon._window.clear()
+    for _ in range(4):
+        mon._window.append(100.0)
+    got = mon.exchange()
+    assert got == -1, got
+    assert telemetry.REGISTRY.get("straggler_rank").value == -1
+    # barrier skew shows up in the kvstore_tpu_barrier_ms histogram
+    dist.barrier("health-done")
+    if n > 1:
+        hist = telemetry.REGISTRY.get("kvstore_tpu_barrier_ms")
+        assert hist is not None and hist.count > 0, \
+            "barrier wall time was never observed"
+
+
 def main():
     kv = kv_checks()
     n, rank = kv.num_workers, kv.rank
     mod = training_parity(rank, n)
     checkpoint_roundtrip(mod, rank, n)
+    straggler_checks(rank, n)
     from mxnet_tpu import telemetry
     xb = telemetry.REGISTRY.get("kvstore_tpu_crosshost_bytes")
     assert xb is not None and (n == 1 or xb.value > 0), \

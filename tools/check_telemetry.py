@@ -20,6 +20,11 @@ operational witnesses:
    deleted or renamed must leave the glossary in the same commit
    (stale docs are as misleading as missing ones).  Legitimately
    derived/doc-only rows go in ``ALLOWED_DOC_ONLY`` with a reason.
+4. **Label coverage** — every label key used at a ``.labels(key=...)``
+   call site in ``mxnet_tpu/`` must be documented in the glossary as a
+   backticked ``\\`key\\``` (convention: the owning series' row says
+   "labeled by `key`"), so a dashboard reader can learn every label
+   dimension from the docs alone.
 
 Stdlib-only, no package import: safe anywhere (including as a plain
 subprocess inside the test suite).
@@ -47,6 +52,7 @@ _REGISTER = re.compile(
     r"""(?:\.|\b)(?:counter|gauge|histogram)\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
 _PROF_COUNTER = re.compile(
     r"""new_counter\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
+_LABEL_USE = re.compile(r"""\.labels\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*=""")
 
 
 def sanitize(name):
@@ -73,6 +79,7 @@ def glossary_names():
 def scan():
     bad_globals = []
     registered = {}      # sanitized name -> first file:line
+    labels_used = {}     # label key -> first use site
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in filenames:
@@ -95,16 +102,22 @@ def scan():
                     name = sanitize(m.group(1))
                     registered.setdefault(
                         name, "%s (near offset %d)" % (rel, m.start()))
-    return bad_globals, registered
+            for m in _LABEL_USE.finditer(text):
+                labels_used.setdefault(
+                    m.group(1), "%s (near offset %d)" % (rel, m.start()))
+    return bad_globals, registered, labels_used
 
 
 def main():
-    errors, registered = scan()
+    errors, registered, labels_used = scan()
     if not os.path.exists(GLOSSARY):
         errors.append("docs/OBSERVABILITY.md missing")
         known = set()
+        glossary_text = ""
     else:
         known = glossary_names()
+        with open(GLOSSARY) as f:
+            glossary_text = f.read()
     for name in sorted(registered):
         if name not in known:
             errors.append(
@@ -116,13 +129,21 @@ def main():
                 "glossary entry %r has no surviving registration site in "
                 "mxnet_tpu/ — remove the row or restore the series (or "
                 "allowlist it in ALLOWED_DOC_ONLY with a reason)" % name)
+    for key in sorted(labels_used):
+        if "`%s`" % key not in glossary_text:
+            errors.append(
+                "label key %r (used at %s) is not documented in the "
+                "docs/OBSERVABILITY.md glossary — its series' row must "
+                "name it as a backticked `%s`"
+                % (key, labels_used[key], key))
     if errors:
         print("check_telemetry: %d problem(s)" % len(errors))
         for e in errors:
             print("  " + e)
         return 1
     print("check_telemetry: OK (%d series in glossary, %d registered "
-          "by literal)" % (len(known), len(registered)))
+          "by literal, %d label keys documented)"
+          % (len(known), len(registered), len(labels_used)))
     return 0
 
 

@@ -338,6 +338,15 @@ def main():
                                  lr, seed).compile()
     hlo = HloIndex(compiled.as_text())
 
+    # program-level totals come from the compiled-program registry
+    # (telemetry/programs.py): XLA's own cost/memory analysis of THIS
+    # executable — no hand HLO-text math for whole-program numbers, the
+    # per-op parse below only fills in what the registry can't (per-
+    # instruction split)
+    from mxnet_tpu import telemetry
+    prog = telemetry.programs.register_compiled(
+        "roofline", compiled, fn_name="%s_train_step" % args.model)
+
     p, s, a = ts.params, ts.states, ts.auxs
     for _ in range(2):
         p, s, a, _outs = compiled(p, s, a, batch, lr, seed)
@@ -356,6 +365,20 @@ def main():
     dev = jax.devices()[0]
     print("# roofline: %s on %s (plane %s, line 'XLA Ops'), %d steps"
           % (args.model, dev.device_kind, plane_name, args.iters))
+    if prog.get("flops"):
+        sec = total_ps / 1e12 / args.iters if total_ps else None
+        print("# program (compiler cost analysis, telemetry.programs()):"
+              " %.2f GFLOP/step, %.2f GB accessed/step, peak HBM %s"
+              % (prog["flops"] / 1e9,
+                 prog.get("bytes_accessed", 0.0) / 1e9,
+                 ("%.2f GB" % (prog["peak_hbm_bytes"] / 1e9))
+                 if prog.get("peak_hbm_bytes") else "n/a"))
+        if sec:
+            print("# program intensity %.1f FLOP/B; achieved "
+                  "%.2f TFLOP/s over the traced device time"
+                  % ((prog["flops"] / prog["bytes_accessed"])
+                     if prog.get("bytes_accessed") else float("nan"),
+                     prog["flops"] / sec / 1e12))
     print("# ridge point v5e: 197e12 / 819e9 = 240 FLOP/B — ops far "
           "below it are HBM-bandwidth-bound.")
     print("# GB/s marked '>=' count only shapes visible in the trace "
