@@ -1,0 +1,53 @@
+"""mx.analyze — hot-path hazard analyzer (docs/ANALYZE.md).
+
+An AST-based, multi-pass static analyzer over the ``mxnet_tpu/`` tree,
+wired into tier-1 via ``tools/check_static.py`` (and
+``tests/test_analyze.py``).  The passes encode the invariants the
+dynamic suite can only witness per-config:
+
+========== ==========================================================
+hostsync    no device->host syncs in the declared hot-path modules
+retrace     every jax.jit site registers with a RetraceSite; no
+            per-call jits; no environment-dependent closure captures
+donation    donated buffers are never read after dispatch
+threads     thread-shared state is lock-guarded; one lock order
+collective  dist collectives: distinct literal tags, never
+            rank-branched
+telemetry   registry/glossary/label coverage (ex check_telemetry)
+envknobs    MXNET_*/MXTPU_* knob table coverage (docs/CONFIG.md)
+========== ==========================================================
+
+Violations are waived per site with ``# analyze: ok(<pass>) <reason>``
+and every waiver is mirrored in ``tools/static_baseline.json``.  This
+package is stdlib-only — it never imports jax or the runtime modules
+it analyzes — so the CLI is fast and safe anywhere.
+"""
+from .core import (Context, Finding, Module, Pass, apply_waivers,
+                   diff_baseline, load_baseline, load_package, run,
+                   save_baseline)
+from .hostsync import HostSyncPass
+from .retrace import RetracePass
+from .donation import DonationPass
+from .threads import ThreadsPass
+from .collective import CollectivePass
+from .telemetry import TelemetryPass
+from .envknobs import EnvKnobsPass
+
+__all__ = ["Context", "Finding", "Module", "Pass", "PASSES",
+           "all_passes", "apply_waivers", "diff_baseline",
+           "load_baseline", "load_package", "run", "save_baseline",
+           "HostSyncPass", "RetracePass", "DonationPass",
+           "ThreadsPass", "CollectivePass", "TelemetryPass",
+           "EnvKnobsPass"]
+
+PASS_CLASSES = (HostSyncPass, RetracePass, DonationPass, ThreadsPass,
+                CollectivePass, TelemetryPass, EnvKnobsPass)
+
+
+def all_passes():
+    """Fresh instances of every registered pass, in order."""
+    return [cls() for cls in PASS_CLASSES]
+
+
+def PASSES():   # noqa: N802 — legacy-style accessor kept callable
+    return all_passes()

@@ -1,0 +1,144 @@
+"""Pass 5 — collective-divergence lint over the dist.py collectives.
+
+On a pod, the coordination-service collectives
+(``kvstore_tpu.dist.barrier/allgather_bytes/broadcast_bytes/
+allreduce_sum_np``) are SPMD: every rank must issue the same
+collectives, with the same tags, in the same program order — a
+rank-divergent collective is a silent pod hang, the exact class PR 8's
+watchdog only catches at runtime (and only after the fact).  Three
+statically-checkable rules per call site:
+
+* ``dynamic-tag`` — the tag must be a distinct string LITERAL.  The
+  per-tag sequence numbers (``dist._next_seq``) that keep concurrent
+  epochs of one logical collective apart assume each call site owns
+  its tag; a computed tag can collide across sites or diverge across
+  ranks.
+* ``tag-reuse`` — two different call sites sharing one literal tag
+  interleave their sequence numbers: rank A's barrier 3 of site X
+  pairs with rank B's barrier 3 of site Y and both "succeed" against
+  the wrong partner.
+* ``rank-branch`` — the call must not sit under a branch conditioned
+  on the process identity (``jax.process_index()``, ``dist.rank()``,
+  ``self._rank``, a ``rank`` variable...).  Rank-conditional *work*
+  around an unconditional collective is fine (the multihost
+  checkpoint commit does exactly that); the collective itself under
+  the branch hangs every other rank.
+
+``dist.py`` itself (the transport implementation, where rank branches
+are the mechanism) is exempt.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass, parents
+
+COLLECTIVES = {"barrier", "allgather_bytes", "broadcast_bytes",
+               "allreduce_sum_np"}
+DIST_MODULE = "mxnet_tpu.kvstore_tpu.dist"
+RANK_ATTRS = {"process_index", "process_id", "rank", "_rank"}
+RANK_NAMES = {"rank", "_rank", "pid", "process_id", "process_index"}
+
+
+def _is_collective(mod, call):
+    res = mod.resolve(call.func)
+    if res is None:
+        return None
+    parts = res.split(".")
+    if parts[-1] not in COLLECTIVES:
+        return None
+    # resolved through the import map to kvstore_tpu.dist, or a
+    # `dist.X(...)` attribute call on a name imported as the module
+    if res.startswith(DIST_MODULE + "."):
+        return parts[-1]
+    if len(parts) >= 2:
+        base = ".".join(parts[:-1])
+        if base == DIST_MODULE or base.endswith(".dist") \
+                or base == "dist":
+            return parts[-1]
+    return None
+
+
+def _mentions_rank(mod, test):
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in RANK_ATTRS:
+            return True
+        if isinstance(node, ast.Name) and node.id in RANK_NAMES:
+            return True
+        if isinstance(node, ast.Call):
+            res = mod.resolve(node.func)
+            if res and res.split(".")[-1] in RANK_ATTRS:
+                return True
+    return False
+
+
+class CollectivePass(Pass):
+    name = "collective"
+    doc = ("dist collectives use distinct literal tags and never sit "
+           "under rank-conditional branches")
+
+    def run(self, ctx):
+        findings = []
+        seen_tags = {}     # (kind, tag) -> first site "path:line"
+        for mod in ctx.modules:
+            if mod.dotted == DIST_MODULE:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _is_collective(mod, node)
+                if kind is None:
+                    continue
+                findings.extend(self._check_site(mod, node, kind,
+                                                 seen_tags))
+        return findings
+
+    def _check_site(self, mod, node, kind, seen_tags):
+        out = []
+        tag = node.args[0] if node.args else None
+        if kind == "barrier" and tag is None:
+            # KVStore.barrier()-style wrappers take no tag; only the
+            # dist-level barrier does. Resolve ambiguity by module.
+            return out
+        if not (isinstance(tag, ast.Constant)
+                and isinstance(tag.value, str)):
+            out.append(self.finding(
+                mod, node, "dynamic-tag",
+                "collective %s tag is not a string literal — per-tag "
+                "sequence numbering needs each call site to own a "
+                "static tag" % kind,
+                fix_hint="use a distinct literal tag per call site",
+                detail=kind))
+        else:
+            key = (kind, tag.value)
+            site = "%s:%d" % (mod.path, node.lineno)
+            first = seen_tags.setdefault(key, site)
+            if first != site:
+                out.append(self.finding(
+                    mod, node, "tag-reuse",
+                    "collective tag %r for %s is already used at %s "
+                    "— two sites sharing a tag interleave their "
+                    "sequence numbers across ranks" % (
+                        tag.value, kind, first),
+                    fix_hint="give this call site its own literal tag",
+                    detail="%s:%s" % (kind, tag.value)))
+        for p in parents(node):
+            test = None
+            if isinstance(p, (ast.If, ast.While)):
+                test = p.test
+            elif isinstance(p, ast.IfExp):
+                test = p.test
+            elif isinstance(p, ast.Assert):
+                test = p.test
+            if test is not None and _mentions_rank(mod, test):
+                out.append(self.finding(
+                    mod, node, "rank-branch",
+                    "collective %s sits under a branch conditioned "
+                    "on the process rank — ranks that skip it hang "
+                    "every rank that reaches it" % kind,
+                    fix_hint="issue the collective unconditionally "
+                             "on every rank; keep only the "
+                             "surrounding WORK rank-conditional",
+                    detail=kind))
+                break
+        return out

@@ -1,0 +1,360 @@
+"""mx.analyze core: module loader, alias resolution, findings, waivers.
+
+The analyzer is a multi-pass AST linter over the ``mxnet_tpu/`` tree
+that enforces the hot-path invariants the dynamic test suite can only
+witness per-config (docs/ANALYZE.md): zero steady-state retraces, zero
+host syncs per step, donation safety, thread-shared-state discipline,
+and rank-symmetric collective order.  This module is the shared
+infrastructure every pass builds on:
+
+* :class:`Module` — one parsed source file: AST (with parent links),
+  import-alias resolution (``jnp`` -> ``jax.numpy``, relative imports
+  resolved to full dotted paths), raw lines, and parsed waivers;
+* :class:`Finding` — one diagnostic: file:line + a stable slug + a
+  fix hint.  Identity (for the committed baseline) is
+  ``pass|path|slug|detail`` — line numbers are NOT part of identity,
+  so unrelated edits don't churn the baseline;
+* waivers — ``# analyze: ok(<pass>) <reason>`` on the flagged line or
+  the line directly above silences one pass at one site.  A waiver
+  MUST carry a reason, an unused waiver is itself an error, and the
+  set of live waivers must match the committed baseline file
+  (``tools/static_baseline.json``) exactly — so every accepted
+  violation is explicit in one reviewable place;
+* :func:`run` — load, run passes, apply waivers, diff the baseline.
+
+Stdlib-only and import-free with respect to the package under
+analysis: nothing here (or in any pass) imports jax or mxnet_tpu
+runtime modules, so ``tools/check_static.py`` is safe and fast
+anywhere, including as a tier-1 subprocess.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+PKG_NAME = "mxnet_tpu"
+
+WAIVER_RE = re.compile(r"#\s*analyze:\s*ok\(([a-z_*]+)\)\s*(.*?)\s*$")
+
+
+class Waiver:
+    __slots__ = ("path", "line", "pass_name", "reason", "used")
+
+    def __init__(self, path, line, pass_name, reason):
+        self.path = path
+        self.line = line
+        self.pass_name = pass_name
+        self.reason = reason
+        self.used = False
+
+
+class Finding:
+    """One diagnostic. ``detail`` disambiguates multiple findings of
+    the same slug in one file (an attribute name, a tag, a variable)
+    and is part of the baseline identity."""
+
+    __slots__ = ("pass_name", "path", "line", "end_line", "slug",
+                 "message", "fix_hint", "detail", "waived",
+                 "waiver_reason")
+
+    def __init__(self, pass_name, path, line, slug, message,
+                 fix_hint="", detail="", end_line=None):
+        self.pass_name = pass_name
+        self.path = path
+        self.line = int(line)
+        self.end_line = int(end_line) if end_line else self.line
+        self.slug = slug
+        self.message = message
+        self.fix_hint = fix_hint
+        self.detail = detail
+        self.waived = False
+        self.waiver_reason = None
+
+    @property
+    def key(self):
+        return "%s|%s|%s|%s" % (self.pass_name, self.path, self.slug,
+                                self.detail)
+
+    def format(self):
+        txt = "%s:%d: [%s/%s] %s" % (self.path, self.line,
+                                     self.pass_name, self.slug,
+                                     self.message)
+        if self.fix_hint:
+            txt += "  (fix: %s)" % self.fix_hint
+        return txt
+
+
+def _attach_parents(tree):
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._parent = node
+    tree._parent = None
+
+
+def parents(node):
+    """Ancestors of ``node``, innermost first."""
+    node = getattr(node, "_parent", None)
+    while node is not None:
+        yield node
+        node = getattr(node, "_parent", None)
+
+
+def enclosing_function(node):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+class Module:
+    """One parsed source file with alias resolution and waivers."""
+
+    def __init__(self, root, relpath, text=None):
+        self.root = root
+        self.path = relpath                      # posix, repo-relative
+        if text is None:
+            with open(os.path.join(root, relpath)) as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=relpath)
+        _attach_parents(self.tree)
+        # dotted module path: mxnet_tpu/kvstore_tpu/engine.py ->
+        # mxnet_tpu.kvstore_tpu.engine (fixture modules get a flat name)
+        parts = relpath.replace("\\", "/").split("/")
+        if parts[-1] == "__init__.py":
+            parts = parts[:-1]
+        else:
+            parts[-1] = parts[-1][:-3]
+        self.dotted = ".".join(parts)
+        self.imports = {}                        # local name -> dotted
+        self._scan_imports()
+        self.waivers = self._scan_waivers()
+
+    # -- imports / aliasing --------------------------------------------
+    def _rel_base(self, level):
+        """Dotted prefix for a level-``level`` relative import."""
+        parts = self.dotted.split(".")
+        if self.path.endswith("__init__.py"):
+            parts = parts + ["_"]                # __init__ is the pkg
+        base = parts[:-level] if level <= len(parts) else []
+        return ".".join(base)
+
+    def _scan_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        # plain `import jax.numpy` binds `jax`
+                        top = a.name.split(".")[0]
+                        self.imports[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    rel = self._rel_base(node.level)
+                    base = (rel + "." + base).strip(".") if base else rel
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = \
+                        (base + "." + a.name).strip(".")
+
+    def resolve(self, node):
+        """Best-effort dotted name of an expression: resolves import
+        aliases (``jnp.asarray`` -> ``jax.numpy.asarray``); returns
+        the raw dotted text for unresolvable bases; None for
+        non-name expressions (calls, subscripts, literals)."""
+        if isinstance(node, ast.Name):
+            return self.imports.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            return base + "." + node.attr
+        return None
+
+    # -- waivers --------------------------------------------------------
+    def _scan_waivers(self):
+        # tokenize so only REAL comments count (a docstring quoting the
+        # waiver syntax — e.g. in the analyzer's own sources — doesn't)
+        out = []
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = WAIVER_RE.search(tok.string)
+                if m:
+                    out.append(Waiver(self.path, tok.start[0],
+                                      m.group(1), m.group(2)))
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def waiver_for(self, pass_name, line, end_line=None):
+        """The waiver covering a finding anchored at ``line`` (same
+        line, the line above, or any line of a multi-line construct)."""
+        lo, hi = line - 1, max(line, end_line or line)
+        for w in self.waivers:
+            if w.pass_name == pass_name and lo <= w.line <= hi:
+                return w
+        return None
+
+
+class Pass:
+    """Base class: subclasses set ``name``/``doc`` and implement
+    ``run(ctx) -> [Finding]``."""
+
+    name = "base"
+    doc = ""
+
+    def run(self, ctx):
+        raise NotImplementedError
+
+    def finding(self, module, node, slug, message, fix_hint="",
+                detail=""):
+        return Finding(self.name, module.path, node.lineno, slug,
+                       message, fix_hint=fix_hint, detail=detail,
+                       end_line=getattr(node, "end_lineno", None))
+
+
+class Context:
+    """Everything a pass may look at: the loaded package modules plus
+    repo-level docs paths."""
+
+    def __init__(self, root, modules, report_paths=None):
+        self.root = root
+        self.modules = modules
+        self._by_path = {m.path: m for m in modules}
+        # --changed mode: only findings in these paths are REPORTED
+        # (analysis always sees the whole package, so cross-file rules
+        # stay sound); None = report everything
+        self.report_paths = report_paths
+
+    def module(self, relpath):
+        return self._by_path.get(relpath)
+
+    def doc_path(self, name):
+        return os.path.join(self.root, "docs", name)
+
+
+def load_package(root, pkg_dir=PKG_NAME):
+    """Parse every .py under ``root/pkg_dir`` (skipping __pycache__)."""
+    modules = []
+    base = os.path.join(root, pkg_dir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            rel = rel.replace(os.sep, "/")
+            modules.append(Module(root, rel))
+    return modules
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+def load_baseline(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("waived", [])
+
+
+def save_baseline(path, findings):
+    waived = [{"key": f.key, "reason": f.waiver_reason or ""}
+              for f in sorted((f for f in findings if f.waived),
+                              key=lambda f: f.key)]
+    with open(path, "w") as f:
+        json.dump({"comment": "mx.analyze waived-findings baseline — "
+                              "regenerate with tools/check_static.py "
+                              "--update-baseline",
+                   "waived": waived}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_baseline(findings, baseline_entries):
+    """Errors when the live waived set drifts from the committed
+    baseline: new waivers must be committed, dead entries removed,
+    and every baseline entry must carry a reason."""
+    errors = []
+    live = {f.key: f for f in findings if f.waived}
+    base = {e["key"]: e for e in baseline_entries}
+    for key in sorted(set(live) - set(base)):
+        errors.append("waiver not in baseline (run tools/check_static"
+                      ".py --update-baseline and commit): %s" % key)
+    for key in sorted(set(base) - set(live)):
+        errors.append("stale baseline entry (the waived site is gone "
+                      "— remove it via --update-baseline): %s" % key)
+    for key, e in sorted(base.items()):
+        if key in live and not (e.get("reason") or "").strip():
+            errors.append("baseline entry has no reason string: %s"
+                          % key)
+    return errors
+
+
+# ----------------------------------------------------------------------
+# runner
+# ----------------------------------------------------------------------
+def apply_waivers(ctx, findings):
+    """Mark findings waived where a matching waiver covers them; turn
+    unused or reason-less waivers into findings of the ``waiver``
+    pseudo-pass."""
+    for f in findings:
+        m = ctx.module(f.path)
+        if m is None:
+            continue
+        w = m.waiver_for(f.pass_name, f.line, f.end_line)
+        if w is not None:
+            w.used = True
+            f.waived = True
+            f.waiver_reason = w.reason
+    extra = []
+    for m in ctx.modules:
+        for w in m.waivers:
+            if not w.reason:
+                extra.append(Finding(
+                    "waiver", m.path, w.line, "missing-reason",
+                    "waiver for pass %r has no reason string"
+                    % w.pass_name,
+                    fix_hint="write WHY the violation is acceptable "
+                             "after the closing paren",
+                    detail="%s:%d" % (w.pass_name, w.line)))
+            if not w.used:
+                extra.append(Finding(
+                    "waiver", m.path, w.line, "unused",
+                    "waiver for pass %r matches no finding — remove "
+                    "it (or the violation it excused was fixed)"
+                    % w.pass_name,
+                    fix_hint="delete the `# analyze: ok(%s)` comment"
+                             % w.pass_name,
+                    detail="%s:%d" % (w.pass_name, w.line)))
+    return findings + extra
+
+
+def run(root, passes, report_paths=None, modules=None):
+    """Run ``passes`` over the package; returns (ctx, findings) with
+    waivers applied.  ``report_paths`` filters which files' findings
+    are REPORTED (analysis is always whole-package)."""
+    if modules is None:
+        modules = load_package(root)
+    ctx = Context(root, modules, report_paths=report_paths)
+    findings = []
+    for p in passes:
+        findings.extend(p.run(ctx))
+    findings = apply_waivers(ctx, findings)
+    if report_paths is not None:
+        keep = set(report_paths)
+        findings = [f for f in findings
+                    if f.path in keep or f.path.startswith("docs/")]
+    findings.sort(key=lambda f: (f.path, f.line, f.slug, f.detail))
+    return ctx, findings

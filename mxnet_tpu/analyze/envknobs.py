@@ -1,0 +1,113 @@
+"""Pass 7 — env-knob registry: every MXNET_*/MXTPU_* knob is in
+docs/CONFIG.md, and every documented knob still has a read site.
+
+Same both-directions discipline as the telemetry glossary: a knob read
+in code but absent from the table is invisible to operators; a table
+row whose read site was deleted is a lie.  Read sites are collected by
+AST — any string literal matching ``^(MXNET|MXTPU)_[A-Z0-9_]+$``
+passed to ``os.environ.get`` / ``os.environ[...]`` / ``os.getenv`` /
+``config.env_bool``, plus the keys of ``config._KNOWN`` (the
+accepted-but-inert reference-compat table, consulted dynamically by
+``config.summary()``).
+
+``tools/check_static.py --update-config`` regenerates the table,
+preserving hand-written Description cells by knob name.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, Pass
+
+ENV_NAME = re.compile(r"^(MXNET|MXTPU)_[A-Z0-9_]+$")
+READERS = {"os.environ.get", "os.getenv", "environ.get", "env_bool",
+           "mxnet_tpu.config.env_bool"}
+DOC = "docs/CONFIG.md"
+_ROW = re.compile(r"^\|\s*`((?:MXNET|MXTPU)_[A-Z0-9_]+)`\s*\|")
+
+
+def collect_env_reads(ctx):
+    """{knob: [(path, line), ...]} over the whole package."""
+    reads = {}
+
+    def note(name, mod, node):
+        if ENV_NAME.match(name):
+            reads.setdefault(name, []).append((mod.path, node.lineno))
+
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                res = mod.resolve(node.func) or ""
+                if res in READERS or res.endswith(".env_bool") \
+                        or res.endswith("environ.get") \
+                        or res.endswith(".getenv"):
+                    if node.args and isinstance(node.args[0],
+                                                ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        note(node.args[0].value, mod, node)
+            elif isinstance(node, ast.Subscript):
+                base = mod.resolve(node.value) or ""
+                if base.endswith("environ"):
+                    s = node.slice
+                    if isinstance(s, ast.Constant) \
+                            and isinstance(s.value, str):
+                        note(s.value, mod, node)
+        # config._KNOWN: documented-inert knobs consulted via summary()
+        if mod.path.endswith("mxnet_tpu/config.py"):
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "_KNOWN"
+                        for t in node.targets) \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            note(k.value, mod, k)
+    return reads
+
+
+def documented_knobs(root):
+    path = os.path.join(root, DOC)
+    if not os.path.exists(path):
+        return None
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            m = _ROW.match(line)
+            if m:
+                out.setdefault(m.group(1), i)
+    return out
+
+
+class EnvKnobsPass(Pass):
+    name = "envknobs"
+    doc = ("every MXNET_*/MXTPU_* read is documented in "
+           "docs/CONFIG.md and vice versa")
+
+    def run(self, ctx):
+        reads = collect_env_reads(ctx)
+        known = documented_knobs(ctx.root)
+        if known is None:
+            return [Finding(self.name, DOC, 1, "config-doc-missing",
+                            "docs/CONFIG.md missing — run "
+                            "tools/check_static.py --update-config")]
+        findings = []
+        for name in sorted(set(reads) - set(known)):
+            path, line = reads[name][0]
+            findings.append(Finding(
+                self.name, path, line, "undocumented-knob",
+                "env knob %r is read here but missing from the "
+                "docs/CONFIG.md table" % name,
+                fix_hint="tools/check_static.py --update-config, "
+                         "then fill in the Description cell",
+                detail=name))
+        for name in sorted(set(known) - set(reads)):
+            findings.append(Finding(
+                self.name, DOC, known[name], "stale-knob-row",
+                "documented knob %r has no surviving read site in "
+                "mxnet_tpu/" % name,
+                fix_hint="remove the row (--update-config) or "
+                         "restore the knob", detail=name))
+        return findings

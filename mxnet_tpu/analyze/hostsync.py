@@ -1,0 +1,269 @@
+"""Pass 1 — host-sync lint over the declared hot-path modules.
+
+The stack's steady-state contract is ``host_syncs_per_step == 0``
+(docs/TRAINING.md): a training step or decode iteration must enqueue
+device work and return, never block on a device value.  This pass
+flags host-synchronizing constructs inside the modules on that
+contract:
+
+* ``.item()`` / ``.asnumpy()`` anywhere — the two unambiguous
+  device->host readback APIs;
+* ``numpy.asarray`` / ``numpy.array`` / ``numpy.ascontiguousarray``
+  on a bare name/attribute or a device-tainted expression — the
+  classic *implicit* sync (numpy conversion of a jax array blocks);
+* ``float()`` / ``int()`` / ``bool()`` on a device-tainted
+  expression;
+* ``if``/``while``/``assert``/boolean tests whose operand is
+  device-tainted — the implicit ``__bool__`` sync.
+
+"Device-tainted" is a per-function forward dataflow approximation:
+``X._data`` attribute reads, results of dispatch calls
+(``.forward(...)``, ``.timed(...)``, ``_timed_dispatch``,
+``_dispatch*``) and of jax array constructors (``jax.device_put``,
+``jax.numpy.*``, ``jax.make_array_*``) seed the taint; assignment
+propagates it; metadata accessors (``.shape``/``.dtype``/...) strip
+it (reading metadata never syncs); explicit host readbacks
+(``.asnumpy()``/``np.asarray``) strip it too — the sync is charged at
+the readback site, not downstream.
+
+Legitimate syncs (the decode token readback IS the streamed response;
+input staging crosses the host by contract) carry
+``# analyze: ok(hostsync) <reason>`` waivers, each mirrored in the
+committed baseline.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass, enclosing_function
+
+# the modules under the zero-host-sync contract (ISSUE/TRAINING.md)
+HOT_MODULES = (
+    "mxnet_tpu/module/fused_fit.py",
+    "mxnet_tpu/decode/engine.py",
+    "mxnet_tpu/kvstore_fused.py",
+    "mxnet_tpu/kvstore_tpu/engine.py",
+    "mxnet_tpu/serving/replica.py",
+    "mxnet_tpu/executor.py",
+)
+
+# calls whose RESULT is a device value (basename match on methods,
+# prefix match on dotted jax constructors)
+DISPATCH_BASENAMES = {"forward", "timed", "_timed_dispatch",
+                      "_dispatch", "_dispatch_inner"}
+JAX_ARRAY_PREFIXES = ("jax.numpy.", "jax.device_put",
+                      "jax.make_array_from_single_device_arrays",
+                      "jax.make_array_from_process_local_data",
+                      "jax.random.")
+# attribute reads that yield host metadata, not the buffer
+METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize",
+                  "sharding", "context", "stype", "device",
+                  "devices", "nbytes"}
+NUMPY_CONVERTERS = {"numpy.asarray", "numpy.array",
+                    "numpy.ascontiguousarray"}
+SCALARIZERS = {"float", "int", "bool"}
+
+
+def _call_basename(mod, call):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+class _FunctionTaint(ast.NodeVisitor):
+    """Single forward walk of one function body collecting tainted
+    local names (no fixpoint — good enough for a lint)."""
+
+    def __init__(self, mod, func):
+        self.mod = mod
+        self.tainted = set()
+        for stmt in func.body:
+            self.visit(stmt)
+
+    # nested defs/lambdas have their own scopes — don't descend
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def expr_tainted(self, node):
+        return _tainted(self.mod, node, self.tainted)
+
+    def _bind(self, target, tainted):
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._bind(t, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    def visit_Assign(self, node):
+        t = self.expr_tainted(node.value)
+        for target in node.targets:
+            self._bind(target, t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._bind(node.target, self.expr_tainted(node.value))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self.expr_tainted(node.value):
+            self._bind(node.target, True)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._bind(node.target, self.expr_tainted(node.iter))
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._bind(item.optional_vars,
+                           self.expr_tainted(item.context_expr))
+        self.generic_visit(node)
+
+
+def _tainted(mod, node, tainted_names):
+    """Is this expression a (potential) device value?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted_names
+    if isinstance(node, ast.Attribute):
+        if node.attr == "_data":
+            return True
+        if node.attr in METADATA_ATTRS:
+            return False
+        return _tainted(mod, node.value, tainted_names)
+    if isinstance(node, ast.Subscript):
+        return _tainted(mod, node.value, tainted_names)
+    if isinstance(node, ast.Call):
+        res = mod.resolve(node.func)
+        if res is not None:
+            if res in NUMPY_CONVERTERS or res.startswith("numpy."):
+                return False          # host value; sync charged there
+            if any(res == p or res.startswith(p)
+                   for p in JAX_ARRAY_PREFIXES):
+                return True
+        base = _call_basename(mod, node)
+        if base == "asnumpy":
+            return False              # explicit readback (flagged)
+        if base in DISPATCH_BASENAMES:
+            return True
+        if base in METADATA_ATTRS:
+            return False
+        if isinstance(node.func, ast.Attribute):
+            # method on a tainted object stays tainted (e.g. .astype)
+            return _tainted(mod, node.func.value, tainted_names)
+        return False
+    if isinstance(node, (ast.BinOp,)):
+        return (_tainted(mod, node.left, tainted_names)
+                or _tainted(mod, node.right, tainted_names))
+    if isinstance(node, ast.UnaryOp):
+        return _tainted(mod, node.operand, tainted_names)
+    if isinstance(node, ast.IfExp):
+        return (_tainted(mod, node.body, tainted_names)
+                or _tainted(mod, node.orelse, tainted_names))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_tainted(mod, e, tainted_names) for e in node.elts)
+    if isinstance(node, ast.Starred):
+        return _tainted(mod, node.value, tainted_names)
+    return False
+
+
+class HostSyncPass(Pass):
+    name = "hostsync"
+    doc = "no device->host syncs inside the hot-path modules"
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.modules:
+            if mod.path not in HOT_MODULES:
+                continue
+            findings.extend(self._scan_module(mod))
+        return findings
+
+    def _scan_module(self, mod):
+        out = []
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        taints = {id(f): _FunctionTaint(mod, f) for f in funcs}
+        for node in ast.walk(mod.tree):
+            func = enclosing_function(node)
+            taint = taints.get(id(func)) if func is not None else None
+            names = taint.tainted if taint is not None else set()
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(mod, node, names))
+            elif isinstance(node, (ast.If, ast.While)):
+                if _tainted(mod, node.test, names):
+                    out.append(self._flag(
+                        node.test, mod, node, "implicit-bool",
+                        "truth test on a device value blocks on the "
+                        "device (implicit __bool__ sync)"))
+            elif isinstance(node, ast.Assert):
+                if _tainted(mod, node.test, names):
+                    out.append(self._flag(
+                        node.test, mod, node, "implicit-bool",
+                        "assert on a device value blocks on the "
+                        "device (implicit __bool__ sync)"))
+        return out
+
+    def _flag(self, expr, mod, node, slug, message):
+        # enclosing function + expression text: keeps baseline keys
+        # distinct when one pattern appears at several sites in a file
+        func = enclosing_function(node)
+        try:
+            detail = ast.unparse(expr)[:48]
+        except Exception:
+            detail = expr.id if isinstance(expr, ast.Name) else (
+                expr.attr if isinstance(expr, ast.Attribute) else "")
+        if func is not None:
+            detail = "%s:%s" % (func.name, detail)
+        return self.finding(
+            mod, node, slug, message,
+            fix_hint="keep the value on device (fold it into the "
+                     "compiled program / device metric) or waive "
+                     "with `# analyze: ok(hostsync) <why this sync "
+                     "is the contract>`",
+            detail=detail)
+
+    def _check_call(self, mod, node, names):
+        out = []
+        base = _call_basename(mod, node)
+        if base == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args:
+            out.append(self._flag(
+                node.func.value, mod, node, "item",
+                ".item() forces a device->host readback"))
+        elif base == "asnumpy" and isinstance(node.func, ast.Attribute):
+            out.append(self._flag(
+                node.func.value, mod, node, "asnumpy",
+                ".asnumpy() forces a device->host readback"))
+        res = mod.resolve(node.func)
+        if res in NUMPY_CONVERTERS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.Name, ast.Attribute)) \
+                    or _tainted(mod, arg, names):
+                out.append(self._flag(
+                    arg, mod, node, "np-convert",
+                    "%s() on a (potential) device value is an "
+                    "implicit host sync" % res))
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in SCALARIZERS and node.args:
+            if _tainted(mod, node.args[0], names):
+                out.append(self._flag(
+                    node.args[0], mod, node, "scalarize",
+                    "%s() on a device value blocks on the device"
+                    % node.func.id))
+        return out

@@ -1,0 +1,354 @@
+"""Pass 2 — retrace-hazard lint over every ``jax.jit`` site.
+
+Zero steady-state retraces is the stack's central perf invariant
+(docs/TRAINING.md, docs/KVSTORE.md, docs/DECODE.md); the dynamic
+witnesses (``*_retraces`` counters) only see configs the tests run.
+This pass checks the static preconditions at every jit construction
+site in the package:
+
+* ``unregistered`` — the traced body must thread a
+  :class:`telemetry.RetraceSite` registration (a ``_note_retrace()``
+  / ``<site>.note()`` call inside the jitted function), so its
+  (re)traces land in a vital counter and the compiled-program
+  registry (PR 8 ``telemetry/programs.py``).  Debug-only or
+  per-shape-by-design caches waive with a reason.
+* ``per-call-jit`` — ``jax.jit`` evaluated inside a loop, or
+  immediately invoked (``jax.jit(f)(x)``), constructs a fresh
+  callable per call and defeats jax's jit cache entirely: every call
+  retraces.
+* ``env-capture`` — the jitted body closes over a name bound from a
+  *call result that does not derive from the builder's parameters*
+  (e.g. a config/env read).  Such captures are invisible to any
+  cache key computed from the builder's arguments: if the captured
+  value changes, the stale program keeps running (the
+  ``MXNET_BACKWARD_DO_MIRROR`` class of bug).  Thread them as
+  builder parameters and key the cache on them.
+
+Allowed capture provenance: the builder's own parameters, literals,
+module-level names, nested ``def``s, and pure-builtin derivations of
+those (``len``/``tuple``/``sorted``/...).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass, enclosing_function, parents
+
+PURE_BUILTINS = {"len", "tuple", "list", "dict", "set", "frozenset",
+                 "sorted", "int", "float", "bool", "str", "min", "max",
+                 "sum", "abs", "range", "zip", "enumerate", "reversed",
+                 "repr", "round", "any", "all", "isinstance", "getattr",
+                 "hasattr", "id", "type"}
+
+
+def _is_jit_call(mod, node):
+    """True for ``jax.jit(...)`` and ``functools.partial(jax.jit,...)``
+    call expressions."""
+    if not isinstance(node, ast.Call):
+        return False
+    res = mod.resolve(node.func)
+    if res == "jax.jit":
+        return True
+    if res in ("functools.partial", "partial") and node.args:
+        return mod.resolve(node.args[0]) == "jax.jit"
+    return False
+
+
+def _jitted_target(mod, node, local_defs):
+    """The FunctionDef wrapped by a jit call/decorator, if local."""
+    args = node.args
+    if mod.resolve(node.func) in ("functools.partial", "partial"):
+        return None      # decorator form handles the def directly
+    if args and isinstance(args[0], ast.Name):
+        return local_defs.get(args[0].id)
+    if args and isinstance(args[0], (ast.FunctionDef, ast.Lambda)):
+        return args[0]
+    return None
+
+
+def _collect_note_names(ctx):
+    """Dotted names that count as a RetraceSite registration call:
+    ``X.note`` bound at module level (``_note_retrace = _SITE.note``)
+    and ``.note`` on module-level RetraceSite instances — resolved
+    across modules through the import maps."""
+    site_names, note_names = set(), set()
+    for mod in ctx.modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                res = mod.resolve(v.func)
+                if res is not None and res.endswith("RetraceSite"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            site_names.add(mod.dotted + "." + t.id)
+            elif isinstance(v, ast.Attribute) and v.attr == "note":
+                base = mod.resolve(v.value)
+                if base is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            note_names.add(mod.dotted + "." + t.id)
+    return site_names, note_names
+
+
+def _body_notes(mod, func, site_names, note_names, local_note_aliases):
+    """Does the (to-be-)jitted function body call a registration?"""
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        res = mod.resolve(node.func)
+        if res is None:
+            continue
+        full = mod.dotted + "." + res
+        if res in note_names or full in note_names \
+                or res in local_note_aliases:
+            return True
+        if res.endswith(".note"):
+            base = res[:-5]
+            if base in site_names or mod.dotted + "." + base \
+                    in site_names:
+                return True
+    return False
+
+
+def _builder_params(func):
+    names = set()
+    a = func.args
+    for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _scope_names(func):
+    """All names bound anywhere inside ``func`` — its locals, plus the
+    parameters of nested defs/lambdas (those are never free)."""
+    names = _builder_params(func)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store,
+                                                      ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            if not isinstance(node, ast.Lambda) and node is not func:
+                names.add(node.name)
+            names.update(_builder_params(node))
+        elif isinstance(node, ast.ClassDef) and node is not func:
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                names.add((al.asname or al.name).split(".")[0])
+    return names
+
+
+def _param_derived(node, params, module_level, depth=0):
+    """Does this expression derive purely from ``params``, literals,
+    module-level names, and pure builtins thereof?"""
+    if depth > 12 or node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in params or node.id in module_level \
+            or node.id in PURE_BUILTINS
+    if isinstance(node, ast.Attribute):
+        return _param_derived(node.value, params, module_level,
+                              depth + 1)
+    if isinstance(node, ast.Subscript):
+        return _param_derived(node.value, params, module_level,
+                              depth + 1)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_param_derived(e, params, module_level, depth + 1)
+                   for e in node.elts)
+    if isinstance(node, ast.Call):
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in PURE_BUILTINS):
+            return False
+        return all(_param_derived(a, params, module_level, depth + 1)
+                   for a in node.args)
+    if isinstance(node, ast.BinOp):
+        return (_param_derived(node.left, params, module_level,
+                               depth + 1)
+                and _param_derived(node.right, params, module_level,
+                                   depth + 1))
+    if isinstance(node, ast.UnaryOp):
+        return _param_derived(node.operand, params, module_level,
+                              depth + 1)
+    if isinstance(node, ast.Compare):
+        return all(_param_derived(e, params, module_level, depth + 1)
+                   for e in [node.left] + list(node.comparators))
+    if isinstance(node, ast.IfExp):
+        return all(_param_derived(e, params, module_level, depth + 1)
+                   for e in (node.test, node.body, node.orelse))
+    return False
+
+
+class RetracePass(Pass):
+    name = "retrace"
+    doc = ("every jax.jit site registers with a RetraceSite; no "
+           "per-call jits; no environment-dependent closure captures")
+
+    def run(self, ctx):
+        site_names, note_names = _collect_note_names(ctx)
+        findings = []
+        for mod in ctx.modules:
+            findings.extend(self._scan_module(mod, site_names,
+                                              note_names))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _scan_module(self, mod, site_names, note_names):
+        out = []
+        module_level = set(mod.imports)
+        for node in mod.tree.body:
+            for t in ast.walk(node):
+                if isinstance(t, ast.Name) and isinstance(
+                        t.ctx, ast.Store) and isinstance(
+                        node, (ast.Assign, ast.AnnAssign,
+                               ast.AugAssign)):
+                    module_level.add(t.id)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                module_level.add(node.name)
+        # local aliases of note callables (rare; e.g. a module that
+        # does `note = SITE.note` at module level is caught above)
+        local_note_aliases = {n.rsplit(".", 1)[1] for n in note_names
+                              if n.startswith(mod.dotted + ".")}
+
+        jit_sites = []       # (call node, wrapped def or None)
+        decorated = set()
+        for func in (n for n in ast.walk(mod.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))):
+            for dec in func.decorator_list:
+                if (isinstance(dec, ast.Call)
+                        and _is_jit_call(mod, dec)) \
+                        or mod.resolve(dec) == "jax.jit":
+                    jit_sites.append((dec if isinstance(dec, ast.Call)
+                                      else func, func))
+                    decorated.add(id(dec))
+        for node in ast.walk(mod.tree):
+            if _is_jit_call(mod, node) and id(node) not in decorated:
+                encl = enclosing_function(node)
+                local_defs = {}
+                if encl is not None:
+                    for st in ast.walk(encl):
+                        if isinstance(st, ast.FunctionDef) \
+                                and st is not encl:
+                            local_defs[st.name] = st
+                jit_sites.append((node, _jitted_target(mod, node,
+                                                       local_defs)))
+
+        for call, target in jit_sites:
+            out.extend(self._check_site(mod, call, target, site_names,
+                                        note_names,
+                                        local_note_aliases,
+                                        module_level))
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_site(self, mod, call, target, site_names, note_names,
+                    local_note_aliases, module_level):
+        out = []
+        detail = target.name if target is not None else "<jit>"
+        # (a) registration inside the traced body
+        if target is None or not _body_notes(mod, target, site_names,
+                                             note_names,
+                                             local_note_aliases):
+            out.append(self.finding(
+                mod, call, "unregistered",
+                "jax.jit site does not register with a RetraceSite "
+                "(no _note_retrace()/<site>.note() in the traced "
+                "body) — its retraces are invisible to the "
+                "*_retraces witnesses and the program registry",
+                fix_hint="call a RetraceSite's .note() first thing "
+                         "inside the traced function (see "
+                         "executor.py), or waive with a reason",
+                detail=detail))
+        # (b) per-call construction
+        immediate = (isinstance(getattr(call, "_parent", None),
+                                ast.Call)
+                     and call._parent.func is call)
+        in_loop = any(isinstance(p, (ast.For, ast.While))
+                      for p in parents(call))
+        if immediate or in_loop:
+            out.append(self.finding(
+                mod, call, "per-call-jit",
+                "jax.jit constructed %s builds a fresh callable each "
+                "time — every call retraces (the jit cache is keyed "
+                "on the callable's identity)"
+                % ("and immediately invoked" if immediate
+                   else "inside a loop"),
+                fix_hint="hoist the jit to module level or a "
+                         "compile-once cache keyed by everything "
+                         "that changes the program",
+                detail=detail))
+        # (c) environment-dependent closure captures
+        if target is not None:
+            out.extend(self._check_captures(mod, call, target,
+                                            module_level))
+        return out
+
+    def _check_captures(self, mod, call, target, module_level):
+        encl = enclosing_function(target)
+        if encl is None:
+            return []
+        params = _builder_params(encl)
+        locals_of_target = _scope_names(target)
+        free = set()
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                n = node.id
+                if n not in locals_of_target and n not in module_level \
+                        and n not in PURE_BUILTINS and n != target.name:
+                    free.add(n)
+        if not free:
+            return []
+        # bindings of the free names in the enclosing scope
+        bindings = {}
+        for st in ast.walk(encl):
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) and nm.id in free:
+                            bindings.setdefault(nm.id, []).append(
+                                (t, st.value))
+            elif isinstance(st, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and st.name in free:
+                bindings.setdefault(st.name, []).append((st, None))
+        out = []
+        for name in sorted(free):
+            if name in params:
+                continue
+            ok = True
+            for tgt, value in bindings.get(name, [(None, None)]):
+                if isinstance(tgt, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    continue                      # nested def: fine
+                if isinstance(tgt, ast.Tuple) or isinstance(
+                        tgt, ast.Name):
+                    src = value
+                else:
+                    src = value
+                if not _param_derived(src, params, module_level):
+                    ok = False
+            if not ok:
+                out.append(self.finding(
+                    mod, target, "env-capture",
+                    "jitted body captures %r, bound from a call "
+                    "result that does not derive from the builder's "
+                    "parameters — invisible to any cache key, so a "
+                    "changed value keeps dispatching the stale "
+                    "program" % name,
+                    fix_hint="pass %r into the builder as a "
+                             "parameter and include it in the "
+                             "compile-cache key" % name,
+                    detail="%s:%s" % (target.name, name)))
+        return out

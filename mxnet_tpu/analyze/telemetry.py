@@ -1,0 +1,155 @@
+"""Pass 6 — telemetry consistency (the former tools/check_telemetry.py).
+
+Keeps ``telemetry.REGISTRY`` the single source of truth for
+operational witnesses; ``tools/check_telemetry.py`` is now a thin shim
+over this pass so existing tier-1 wiring and docs stay valid.  Four
+checks (history in docs/OBSERVABILITY.md):
+
+1. **No stray witness globals** — new module-level mutable ALL-CAPS
+   globals (``FOO = 0`` / ``[]`` / ``{}`` / ``set()``) in
+   ``mxnet_tpu/``; counters/state belong in the registry.  Genuine
+   constants go in ``ALLOWED_GLOBALS`` with a reason.
+2. **Glossary coverage** — every metric registered by literal must
+   appear in the docs/OBSERVABILITY.md glossary.
+3. **Reverse coverage** — every glossary row must still have a
+   registration site (``ALLOWED_DOC_ONLY`` for derived rows).
+4. **Label coverage** — every ``.labels(key=...)`` key must be
+   documented as a backticked ``\\`key\\``` in the glossary.
+
+These are text/regex checks (names cross module boundaries as
+strings), run over the shared module list so ``--changed`` and the
+waiver machinery apply uniformly.  Doc-side findings anchor at
+``docs/OBSERVABILITY.md`` and are not waivable in source — fix the
+docs or the allowlists.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from .core import Finding, Pass
+
+# (package-relative path, name): why this module-level global is OK
+ALLOWED_GLOBALS = {
+    ("contrib/text/embedding.py", "UNKNOWN_IDX"):
+        "vocabulary layout constant, not a mutable witness",
+}
+
+# glossary name: why it has no literal registration site in mxnet_tpu/
+ALLOWED_DOC_ONLY = {}
+
+_MUTABLE = re.compile(
+    r"^([A-Z][A-Z0-9_]*)\s*=\s*(?:0|0\.0|\[\]|\{\}|set\(\))\s*(?:#.*)?$")
+_REGISTER = re.compile(
+    r"""(?:\.|\b)(?:counter|gauge|histogram)\(\s*\n?\s*["']"""
+    r"""([A-Za-z0-9_.:]+)["']""")
+_PROF_COUNTER = re.compile(
+    r"""new_counter\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
+_LABEL_USE = re.compile(r"""\.labels\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*=""")
+_GLOSSARY_ROW = re.compile(r"^\|\s*`([A-Za-z0-9_:]+)`\s*\|")
+
+
+def sanitize(name):
+    out = []
+    for i, ch in enumerate(name):
+        ok = ("a" <= ch <= "z") or ("A" <= ch <= "Z") or ch in "_:" \
+            or ("0" <= ch <= "9")
+        if i == 0 and "0" <= ch <= "9":
+            out.append("_")
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+class TelemetryPass(Pass):
+    name = "telemetry"
+    doc = ("registry is the single source of truth: no stray witness "
+           "globals; glossary and label coverage in both directions")
+
+    GLOSSARY = "docs/OBSERVABILITY.md"
+
+    def __init__(self):
+        # scan results, exposed for the check_telemetry shim's summary
+        # line so its counts can never drift from what was checked
+        self.registered = {}     # sanitized name -> (path, line)
+        self.labels_used = {}    # label key -> (path, line)
+        self.glossary_names = set()
+
+    def run(self, ctx):
+        findings = []
+        registered = self.registered
+        labels_used = self.labels_used
+        for mod in ctx.modules:
+            if mod.path.startswith("mxnet_tpu/analyze/"):
+                continue     # the linter's sources quote the patterns
+            pkg_rel = mod.path.split("/", 1)[1] \
+                if "/" in mod.path else mod.path
+            for lineno, line in enumerate(mod.lines, 1):
+                m = _MUTABLE.match(line)
+                if m and (pkg_rel, m.group(1)) not in ALLOWED_GLOBALS:
+                    findings.append(self.finding(
+                        mod,
+                        _At(lineno), "mutable-global",
+                        "module-level mutable global %s — use a "
+                        "telemetry registry instrument"
+                        % m.group(1),
+                        fix_hint="move it into telemetry.REGISTRY or "
+                                 "allowlist it in analyze/telemetry."
+                                 "ALLOWED_GLOBALS with a reason",
+                        detail=m.group(1)))
+            for rx in (_REGISTER, _PROF_COUNTER):
+                for m in rx.finditer(mod.text):
+                    name = sanitize(m.group(1))
+                    line = mod.text.count("\n", 0, m.start()) + 1
+                    registered.setdefault(name, (mod.path, line))
+            for m in _LABEL_USE.finditer(mod.text):
+                line = mod.text.count("\n", 0, m.start()) + 1
+                labels_used.setdefault(m.group(1), (mod.path, line))
+
+        gpath = os.path.join(ctx.root, self.GLOSSARY)
+        if not os.path.exists(gpath):
+            findings.append(Finding(self.name, self.GLOSSARY, 1,
+                                    "glossary-missing",
+                                    "docs/OBSERVABILITY.md missing"))
+            return findings
+        with open(gpath) as f:
+            glossary_text = f.read()
+        known = self.glossary_names
+        for line in glossary_text.splitlines():
+            m = _GLOSSARY_ROW.match(line)
+            if m:
+                known.add(m.group(1))
+
+        for name in sorted(registered):
+            if name not in known:
+                path, line = registered[name]
+                findings.append(Finding(
+                    self.name, path, line, "undocumented-metric",
+                    "metric %r is missing from the "
+                    "docs/OBSERVABILITY.md glossary" % name,
+                    fix_hint="add a glossary row", detail=name))
+        for name in sorted(known):
+            if name not in registered and name not in ALLOWED_DOC_ONLY:
+                findings.append(Finding(
+                    self.name, self.GLOSSARY, 1, "stale-glossary-row",
+                    "glossary entry %r has no surviving registration "
+                    "site in mxnet_tpu/" % name,
+                    fix_hint="remove the row, restore the series, or "
+                             "allowlist in ALLOWED_DOC_ONLY with a "
+                             "reason", detail=name))
+        for key in sorted(labels_used):
+            if "`%s`" % key not in glossary_text:
+                path, line = labels_used[key]
+                findings.append(Finding(
+                    self.name, path, line, "undocumented-label",
+                    "label key %r is not documented in the glossary "
+                    "— its series' row must name it as a backticked "
+                    "`%s`" % (key, key), detail=key))
+        return findings
+
+
+class _At:
+    """Minimal node stand-in carrying a line number."""
+
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.end_lineno = lineno

@@ -1,0 +1,468 @@
+"""Pass 4 — thread-shared-state lint + lock-order table.
+
+The serving/decode/telemetry layers are multi-threaded: replica
+workers, the decode engine loop, HTTP handlers, checkpoint writers,
+watchdog/health threads, atexit/signal hooks.  Every
+shipped-then-fixed race in this repo (the PR 6 engine-loop deadlock,
+the racy ``_ttfts`` deque, the stats-vs-engine reads) was statically
+visible as *state written from more than one thread domain without a
+lock*.  Three checks:
+
+* ``unguarded-shared-write`` — within a class that owns thread entry
+  points (``threading.Thread(target=self.X)``, ``do_*`` HTTP handler
+  methods, atexit/signal registrations), an instance attribute
+  written (assignment, augmented assignment, subscript store, or a
+  mutating container call: append/add/pop/...) both from the
+  thread-reachable method set (transitive over ``self.`` calls) and
+  from externally-callable methods, where at least one write is not
+  under a ``with self.<lock>`` block (lock = attribute bound to
+  ``threading.Lock/RLock/Condition``, or name containing
+  ``lock``/``cv``).  One level of caller context counts: a method
+  whose every intra-class call site sits inside a lock's ``with``
+  inherits that guard.  ``__init__`` writes are pre-thread and
+  exempt.
+* ``unguarded-global-write`` — module-level mutable state written
+  from function bodies in the *threaded modules* list without a
+  module-level lock held.
+* ``lock-order`` — every *observed* nested lock acquisition
+  (syntactic ``with`` nesting, plus one level through intra-class
+  calls) must be consistent with the single global order declared in
+  ``LOCK_ORDER`` below; nesting locks the table doesn't know is a
+  finding too (add the pair to the table deliberately or restructure).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass, enclosing_function
+
+# modules whose module-level state is reachable from multiple threads
+THREADED_MODULES = (
+    "mxnet_tpu/serving/batcher.py",
+    "mxnet_tpu/serving/replica.py",
+    "mxnet_tpu/serving/server.py",
+    "mxnet_tpu/decode/engine.py",
+    "mxnet_tpu/decode/scheduler.py",
+    "mxnet_tpu/decode/cache.py",
+    "mxnet_tpu/telemetry/registry.py",
+    "mxnet_tpu/telemetry/tracing.py",
+    "mxnet_tpu/telemetry/flight.py",
+    "mxnet_tpu/telemetry/health.py",
+    "mxnet_tpu/telemetry/programs.py",
+    "mxnet_tpu/telemetry/export.py",
+    "mxnet_tpu/checkpoint/writer.py",
+    "mxnet_tpu/checkpoint/preemption.py",
+    "mxnet_tpu/kvstore_tpu/dist.py",
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/profiler.py",
+    "mxnet_tpu/io/io.py",
+    "mxnet_tpu/image/record_iter.py",
+)
+
+# The ONE global lock acquisition order (coarse -> fine).  A nested
+# acquisition must go left -> right; the telemetry metric/registry
+# locks are leaves (never held around foreign calls).  Identifiers are
+# "<ClassName>.<attr>" for instance locks, "<module>:<name>" for
+# module-level locks.
+LOCK_ORDER = (
+    "ModelServer._reload_lock",
+    "ServerStats.settled_cv",          # == ServerStats._lock
+    "ServerStats._lock",
+    "DecodeEngine._cv",                # == DecodeEngine._lock
+    "DecodeEngine._lock",
+    "DecodeEngine._step_lock",
+    "Replica._swap_lock",
+    "RequestQueue._nonempty",          # == RequestQueue._lock
+    "RequestQueue._lock",
+    "AsyncCheckpointWriter._lock",
+    "Watchdog._lock",
+    "mxnet_tpu/kvstore_tpu/dist.py:_lock",
+    "mxnet_tpu/telemetry/tracing.py:_ring_lock",
+    "mxnet_tpu/telemetry/tracing.py:_id_lock",
+    "mxnet_tpu/telemetry/programs.py:_lock",
+    "mxnet_tpu/telemetry/flight.py:_lock",
+    "mxnet_tpu/profiler.py:_lock",
+    "Registry._lock",
+    "_Metric._lock",
+)
+
+MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "clear",
+            "update", "extend", "remove", "discard", "insert",
+            "setdefault"}
+LOCKISH_TYPES = ("threading.Lock", "threading.RLock",
+                 "threading.Condition")
+HANDLER_BASES = ("BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                 "ThreadingHTTPServer", "StreamRequestHandler")
+
+
+def _self_attr(node):
+    """'x' for a ``self.x`` expression, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lockish_name(attr):
+    low = attr.lower()
+    return "lock" in low or low.endswith("_cv") or low.startswith("_cv") \
+        or "cond" in low
+
+
+class _ClassInfo:
+    def __init__(self, mod, node):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods = {n.name: n for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.locks = self._find_locks()
+        self.thread_roots = self._find_roots()
+        self.reachable = self._closure(self.thread_roots)
+        # externally-callable entry points: public methods and the
+        # dunder protocol (anything a caller on another thread can
+        # reach); a method reachable from BOTH sets is dual-domain
+        ext = {m for m in self.methods
+               if (not m.startswith("_")
+                   or m in ("__call__", "__enter__", "__exit__",
+                            "__iter__", "__next__", "__len__"))}
+        ext -= self.thread_roots
+        ext.discard("__init__")
+        self.ext_reachable = self._closure(ext)
+
+    def domains(self, mname):
+        out = set()
+        if mname in self.reachable:
+            out.add("thread")
+        if mname in self.ext_reachable:
+            out.add("external")
+        return out or {"external"}
+
+    def _find_locks(self):
+        locks = set()
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    res = self.mod.resolve(node.value.func)
+                    if res in LOCKISH_TYPES:
+                        for t in node.targets:
+                            a = _self_attr(t)
+                            if a:
+                                locks.add(a)
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a and _lockish_name(a):
+                            locks.add(a)
+        return locks
+
+    def _find_roots(self):
+        roots = set()
+        for mname, meth in self.methods.items():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                res = self.mod.resolve(node.func)
+                if res == "threading.Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            a = _self_attr(kw.value)
+                            if a and a in self.methods:
+                                roots.add(a)
+                elif res in ("atexit.register",):
+                    for arg in list(node.args) + [
+                            kw.value for kw in node.keywords]:
+                        a = _self_attr(arg)
+                        if a and a in self.methods:
+                            roots.add(a)
+                elif res == "signal.signal" and len(node.args) >= 2:
+                    a = _self_attr(node.args[1])
+                    if a and a in self.methods:
+                        roots.add(a)
+        # HTTP handler classes: every do_* method runs on a server
+        # thread (and only there — treat them as roots so writes they
+        # share with externally-called methods get flagged)
+        base_names = [self.mod.resolve(b) or "" for b in self.node.bases]
+        if any(any(h in b for h in HANDLER_BASES) for b in base_names):
+            roots.update(m for m in self.methods if m.startswith("do_"))
+        return roots
+
+    def _callees(self, mname):
+        out = set()
+        meth = self.methods.get(mname)
+        if meth is None:
+            return out
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Call):
+                a = _self_attr(node.func)
+                if a and a in self.methods:
+                    out.add(a)
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in self.methods:
+                    out.add(node.func.id)
+        return out
+
+    def _closure(self, seeds):
+        seen = set(seeds)
+        work = list(seeds)
+        while work:
+            m = work.pop()
+            for c in self._callees(m):
+                if c not in seen:
+                    seen.add(c)
+                    work.append(c)
+        return seen
+
+    # -- guards --------------------------------------------------------
+    def _with_locks(self, node):
+        """Lock attrs held at ``node`` via enclosing ``with`` blocks."""
+        held = set()
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    a = _self_attr(item.context_expr)
+                    if a and (a in self.locks or _lockish_name(a)):
+                        held.add(a)
+            cur = getattr(cur, "_parent", None)
+        return held
+
+    def _call_sites_guarded(self, mname):
+        """True when every intra-class call of ``mname`` is inside a
+        lock's with-block (one level of caller context)."""
+        sites = []
+        for other, meth in self.methods.items():
+            if other == mname:
+                continue
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Call):
+                    a = _self_attr(node.func)
+                    if a == mname:
+                        sites.append(node)
+        return bool(sites) and all(self._with_locks(s) for s in sites)
+
+
+class ThreadsPass(Pass):
+    name = "threads"
+    doc = ("state shared across thread entry points is lock-guarded; "
+           "nested lock acquisitions follow the declared order")
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.modules:
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = _ClassInfo(mod, node)
+                    if info.thread_roots:
+                        findings.extend(self._check_class(mod, info))
+                    findings.extend(self._check_lock_order(mod, info))
+            if mod.path in THREADED_MODULES:
+                findings.extend(self._check_globals(mod))
+        return findings
+
+    # -- shared instance attributes ------------------------------------
+    def _attr_writes(self, info, mname):
+        """[(attr, node, guarded)] for one method."""
+        meth = info.methods[mname]
+        caller_guard = info._call_sites_guarded(mname)
+        out = []
+        for node in ast.walk(meth):
+            attr, site = None, node
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    a = _self_attr(t)
+                    if a:
+                        attr = a
+                    elif isinstance(t, ast.Subscript):
+                        a = _self_attr(t.value)
+                        if a:
+                            attr = a
+                    if attr:
+                        guarded = bool(info._with_locks(node)) \
+                            or caller_guard
+                        out.append((attr, site, guarded))
+                        attr = None
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS:
+                a = _self_attr(node.func.value)
+                if a:
+                    guarded = bool(info._with_locks(node)) \
+                        or caller_guard
+                    out.append((a, site, guarded))
+        return out
+
+    def _check_class(self, mod, info):
+        writes = {}     # attr -> [(domain, node, guarded, method)]
+        for mname in info.methods:
+            if mname == "__init__":
+                continue
+            mdomains = info.domains(mname)
+            for attr, node, guarded in self._attr_writes(info, mname):
+                if attr in info.locks:
+                    continue
+                writes.setdefault(attr, []).append(
+                    (mdomains, node, guarded, mname))
+        out = []
+        for attr, ws in sorted(writes.items()):
+            domains = set()
+            for d, _, _, _ in ws:
+                domains |= d
+            if len(domains) < 2:
+                continue
+            unguarded = [(n, m) for d, n, g, m in ws if not g]
+            if not unguarded:
+                continue
+            node, mname = unguarded[0]
+            out.append(self.finding(
+                mod, node, "unguarded-shared-write",
+                "%s.%s is written from both a thread entry point and "
+                "externally-callable methods (%s), and this write in "
+                "%s() holds no lock" % (
+                    info.name, attr,
+                    ", ".join(sorted({m for _, _, _, m in ws})),
+                    mname),
+                fix_hint="guard every write with one of the class's "
+                         "locks (or a new leaf lock), or waive with "
+                         "the reason the race is benign",
+                detail="%s.%s" % (info.name, attr)))
+        return out
+
+    # -- module-level globals ------------------------------------------
+    def _check_globals(self, mod):
+        mutable = {}
+        module_locks = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        res = mod.resolve(v.func)
+                        if res in LOCKISH_TYPES:
+                            module_locks.add(t.id)
+                            continue
+                    if isinstance(v, (ast.Dict, ast.List, ast.Set)) \
+                            or (isinstance(v, ast.Call)
+                                and isinstance(v.func, ast.Name)
+                                and v.func.id in ("dict", "list",
+                                                  "set")):
+                        mutable[t.id] = node
+                    elif isinstance(v, ast.Call) \
+                            and isinstance(v.func, ast.Name) \
+                            and any(isinstance(c, ast.ClassDef)
+                                    and c.name == v.func.id
+                                    for c in mod.tree.body):
+                        # module-level instance of a local class: its
+                        # attribute writes are shared mutable state too
+                        mutable[t.id] = node
+        if not mutable:
+            return []
+        out = []
+        for node in ast.walk(mod.tree):
+            func = enclosing_function(node)
+            if func is None:
+                continue
+            name = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in mutable:
+                        name = t.value.id
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in mutable:
+                        name = t.value.id
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in mutable:
+                name = node.func.value.id
+            if name is None:
+                continue
+            held = self._module_locks_held(mod, node, module_locks)
+            if not held:
+                out.append(self.finding(
+                    mod, node, "unguarded-global-write",
+                    "module-level mutable %r is written in %s() "
+                    "without holding a module lock — this module "
+                    "runs on multiple threads" % (name, func.name),
+                    fix_hint="wrap the write in `with %s:` (or waive "
+                             "with the reason the race is benign)"
+                             % (sorted(module_locks)[0]
+                                if module_locks else "_lock"),
+                    detail="%s:%s" % (func.name, name)))
+        return out
+
+    @staticmethod
+    def _module_locks_held(mod, node, module_locks):
+        held = set()
+        cur = getattr(node, "_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Name) and e.id in module_locks:
+                        held.add(e.id)
+            cur = getattr(cur, "_parent", None)
+        return held
+
+    # -- lock order ----------------------------------------------------
+    def _check_lock_order(self, mod, info):
+        """Observed nested acquisitions must agree with LOCK_ORDER."""
+        out = []
+        order = {name: i for i, name in enumerate(LOCK_ORDER)}
+
+        def lock_id(attr):
+            return "%s.%s" % (info.name, attr)
+
+        for meth in info.methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.With):
+                    continue
+                inner = [a for item in node.items
+                         for a in [_self_attr(item.context_expr)]
+                         if a and (a in info.locks or _lockish_name(a))]
+                if not inner:
+                    continue
+                outer = info._with_locks(node)   # strictly enclosing
+                for i_attr in inner:
+                    for o_attr in outer:
+                        if o_attr == i_attr:
+                            continue
+                        oid, iid = lock_id(o_attr), lock_id(i_attr)
+                        if oid not in order or iid not in order:
+                            out.append(self.finding(
+                                mod, node, "undeclared-lock-nesting",
+                                "nested acquisition %s -> %s is not "
+                                "in the declared LOCK_ORDER table"
+                                % (oid, iid),
+                                fix_hint="add both locks to "
+                                         "analyze/threads.LOCK_ORDER "
+                                         "in their global order",
+                                detail="%s->%s" % (oid, iid)))
+                        elif order[oid] > order[iid]:
+                            out.append(self.finding(
+                                mod, node, "lock-order",
+                                "nested acquisition %s -> %s "
+                                "contradicts the declared global "
+                                "lock order (deadlock risk with any "
+                                "path acquiring them the other way)"
+                                % (oid, iid),
+                                fix_hint="restructure so locks are "
+                                         "taken coarse->fine per "
+                                         "LOCK_ORDER",
+                                detail="%s->%s" % (oid, iid)))
+        return out

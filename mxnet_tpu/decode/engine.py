@@ -173,6 +173,7 @@ class DecodeEngine:
                               and n not in self._cache_names]
         self._check_params(arg_params)
         self._exe.copy_params_from(
+            # analyze: ok(hostsync) checkpoint params are host-resident; one staging copy at engine construction, not on the step path
             {k: v if isinstance(v, NDArray) else NDArray(_np.asarray(v))
              for k, v in arg_params.items() if k in self._weight_names}, {},
             allow_extra_params=True)
@@ -243,14 +244,20 @@ class DecodeEngine:
                              % sorted(bad))
 
     def _prefill_exe(self, bucket):
-        exe = self._prefill_exes.get(bucket)
-        if exe is None:
-            psym = self._prefill_sym(bucket)
-            exe = psym.simple_bind(
-                ctx=self._ctx, grad_req="null", shared_exec=self._exe,
-                data=(1, bucket), prompt_len=(1,),
-                block_table=(1, self._table_width))
-            self._prefill_exes[bucket] = exe
+        # under _step_lock: warmup() (any thread) and the engine loop
+        # both bind lazily; an unguarded double-bind would waste a
+        # compile and tear the bucket->executor map (mx.analyze threads
+        # pass pins this)
+        with self._step_lock:
+            exe = self._prefill_exes.get(bucket)
+            if exe is None:
+                psym = self._prefill_sym(bucket)
+                exe = psym.simple_bind(
+                    ctx=self._ctx, grad_req="null",
+                    shared_exec=self._exe,
+                    data=(1, bucket), prompt_len=(1,),
+                    block_table=(1, self._table_width))
+                self._prefill_exes[bucket] = exe
         return exe
 
     def _bucket_for(self, n):
@@ -279,8 +286,12 @@ class DecodeEngine:
                     is_train=False, data=_np.zeros((1, b), _np.float32),
                     prompt_len=_np.zeros((1,), _np.float32),
                     block_table=zeros_tbl)
-                outs[1].asnumpy()
-            self._warm.add(("prefill", b))
+                # block until compiled+run; warmup exists to absorb
+                # this cost before serving
+                outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
+                # _warm is shared with the engine thread's _dispatch
+                # bookkeeping — every write holds _step_lock
+                self._warm.add(("prefill", b))
         with self._step_lock:
             outs = self._exe.forward(
                 is_train=False,
@@ -288,8 +299,8 @@ class DecodeEngine:
                 positions=_np.full((self.capacity, 1), -1.0, _np.float32),
                 block_table=_np.zeros((self.capacity, self._table_width),
                                       _np.float32))
-            outs[1].asnumpy()
-        self._warm.add("decode")
+            outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
+            self._warm.add("decode")
 
     # ------------------------------------------------------------------
     # client API
@@ -586,9 +597,11 @@ class DecodeEngine:
         # own row)
         logits_host = None
         if any(self._needs_logits(s) for _, s in active):
+            # analyze: ok(hostsync) the step's ONE logits readback, shared by every sampling/temperature slot (documented in the module doc)
             logits_host = outs[0].asnumpy()
         # likewise ONE readback of the greedy-token output for the
         # whole step, not one per active slot
+        # analyze: ok(hostsync) the greedy-token readback IS the streamed response — the documented one sync per decode iteration
         next_host = outs[1].asnumpy()
         for slot, seq in active:
             seq.pos += 1
@@ -620,9 +633,11 @@ class DecodeEngine:
         program fixed-shape."""
         if self._needs_logits(seq):
             if logits_host is None:
+                # analyze: ok(hostsync) prefill-path fallback readback of the first token's logits (once per admission, not per step)
                 logits_host = outs[0].asnumpy()
             logits = logits_host[row]
             if seq.handle.logits is not None:
+                # analyze: ok(hostsync) copies an already-host logits row into the user-visible handle
                 seq.handle.logits.append(_np.array(logits, copy=True))
             if seq.sampler is not None:
                 return int(seq.sampler(logits))
@@ -634,6 +649,7 @@ class DecodeEngine:
                 return int(seq.rng().choice(len(p), p=p))
             return int(logits.argmax())
         if next_host is None:
+            # analyze: ok(hostsync) prefill-path first-token readback; that token is the stream's first byte
             next_host = outs[1].asnumpy()
         return int(next_host[row])
 
@@ -711,6 +727,7 @@ class DecodeEngine:
             for name in self._weight_names:
                 v = arg_params[name]
                 if not isinstance(v, NDArray):
+                    # analyze: ok(hostsync) hot-reload weight staging crosses the host by contract; not on the per-iteration path
                     v = NDArray(_np.asarray(v))
                 dst = self._exe.arg_dict[name]
                 data = v._data

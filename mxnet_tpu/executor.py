@@ -180,11 +180,13 @@ def _compiled_cache(symbol):
         graph_fn = _build_graph_fn(symbol)
 
         @jax.jit
+        # analyze: ok(retrace) graph_fn is symbol-pure; the compiled cache lives on the Symbol itself (_exec_cache)
         def _fwd_train(args, auxs, seed):
             _note_retrace()
             return graph_fn(args, auxs, seed, True)
 
         @jax.jit
+        # analyze: ok(retrace) graph_fn is symbol-pure; the compiled cache lives on the Symbol itself (_exec_cache)
         def _fwd_eval(args, auxs, seed):
             _note_retrace()
             outs, _ = graph_fn(args, auxs, seed, False)
@@ -235,6 +237,7 @@ def _monitor_fn(symbol, is_train, monitor_all):
                                  monitor_all=monitor_all)
 
         @jax.jit
+        # analyze: ok(retrace) tapped graph is (symbol, is_train, monitor_all)-pure and cached under exactly that key
         def fn(args, auxs, seed):
             _note_retrace()
             return tapped(args, auxs, seed, is_train)
@@ -243,9 +246,12 @@ def _monitor_fn(symbol, is_train, monitor_all):
     return fn
 
 
-def _make_fwd_bwd(graph_fn, diff_names):
-    from . import config as _config
-    mirror = _config.backward_do_mirror()
+def _make_fwd_bwd(graph_fn, diff_names, mirror):
+    # `mirror` (MXNET_BACKWARD_DO_MIRROR) is an explicit builder param
+    # and part of every fwd_bwd cache key: a capture read from the
+    # environment here would be invisible to the cache, so flipping the
+    # knob between binds would silently reuse the wrong program
+    # (flagged by mx.analyze retrace/env-capture)
 
     @jax.jit
     def _fwd_bwd(args, auxs, seed, ograds):
@@ -316,14 +322,21 @@ class Executor:
         from . import random as _rand
         self._base_seed = _rand.next_seed()
 
+        from . import config as _config
+        # snapshot MXNET_BACKWARD_DO_MIRROR at BIND time: every fwd_bwd
+        # this executor selects (plain or stream-monitored) uses this
+        # one setting, and it is part of each cache key — a mid-life
+        # env flip affects only later binds, never an existing executor
+        self._mirror = mirror = _config.backward_do_mirror()
         if self._group_devices is None:
             cache = _compiled_cache(symbol)
             self._graph_fn = cache["graph_fn"]
             self._jit_fwd_train = cache["fwd_train"]
             self._jit_fwd_eval = cache["fwd_eval"]
-            key = tuple(sorted(self._diff_names))
+            key = (tuple(sorted(self._diff_names)), mirror)
             if key not in cache["fwd_bwd"]:
-                cache["fwd_bwd"][key] = _make_fwd_bwd(cache["graph_fn"], key)
+                cache["fwd_bwd"][key] = _make_fwd_bwd(
+                    cache["graph_fn"], key[0], mirror)
             self._jit_fwd_bwd = cache["fwd_bwd"][key]
         else:
             # model-parallel bind: the placed program is specific to this
@@ -340,11 +353,13 @@ class Executor:
                     symbol, group_devices=self._group_devices)
 
                 @jax.jit
+                # analyze: ok(retrace) placed graph_fn is (symbol, group->device map)-pure; cache keyed by that placement
                 def _fwd_train(args, auxs, seed):
                     _note_retrace()
                     return graph_fn(args, auxs, seed, True)
 
                 @jax.jit
+                # analyze: ok(retrace) placed graph_fn is (symbol, group->device map)-pure; cache keyed by that placement
                 def _fwd_eval(args, auxs, seed):
                     _note_retrace()
                     outs, _ = graph_fn(args, auxs, seed, False)
@@ -356,9 +371,10 @@ class Executor:
             self._graph_fn = entry["graph_fn"]
             self._jit_fwd_train = entry["fwd_train"]
             self._jit_fwd_eval = entry["fwd_eval"]
-            key = tuple(sorted(self._diff_names))
+            key = (tuple(sorted(self._diff_names)), mirror)
             if key not in entry["fwd_bwd"]:
-                entry["fwd_bwd"][key] = _make_fwd_bwd(entry["graph_fn"], key)
+                entry["fwd_bwd"][key] = _make_fwd_bwd(
+                    entry["graph_fn"], key[0], mirror)
             self._jit_fwd_bwd = entry["fwd_bwd"][key]
 
     # ------------------------------------------------------------------
@@ -435,10 +451,12 @@ class Executor:
                 tap_stat=self._monitor_stat)
 
             @jax.jit
+            # analyze: ok(retrace) stream-tap debug program: (symbol, monitor_all, stat)-pure, cached under that key; retraces intentionally uncounted on the monitored path
             def fwd_train(args, auxs, seed):
                 return tapped(args, auxs, seed, True)
 
             @jax.jit
+            # analyze: ok(retrace) stream-tap debug program: (symbol, monitor_all, stat)-pure, cached under that key; retraces intentionally uncounted on the monitored path
             def fwd_eval(args, auxs, seed):
                 outs, _ = tapped(args, auxs, seed, False)
                 return outs
@@ -450,11 +468,14 @@ class Executor:
                    "stat": self._monitor_stat}
             store[key] = fns
         # forward programs are diff-set independent; only the fused
-        # fwd+bwd needs a per-diff-set variant
-        diff_key = tuple(sorted(self._diff_names))
+        # fwd+bwd needs a per-(diff-set, mirror) variant — using the
+        # BIND-time mirror snapshot so a monitored backward can never
+        # run a different mirror setting than this executor's plain one
+        mirror = self._mirror
+        diff_key = (tuple(sorted(self._diff_names)), mirror)
         if diff_key not in fns["fwd_bwd"]:
-            fns["fwd_bwd"][diff_key] = _make_fwd_bwd(fns["graph_fn"],
-                                                     diff_key)
+            fns["fwd_bwd"][diff_key] = _make_fwd_bwd(
+                fns["graph_fn"], diff_key[0], mirror)
         return {"fwd_train": fns["fwd_train"], "fwd_eval": fns["fwd_eval"],
                 "fwd_bwd": fns["fwd_bwd"][diff_key]}
 
@@ -540,6 +561,7 @@ class Executor:
         monitored = self._monitor_active()
         stream = monitored and self._monitor_mode == "stream"
         if stream:
+            # analyze: ok(threads) documented debug-path limitation: the running executor is published globally for the duration of a monitored launch (_StreamTarget docstring)
             _STREAM_TARGET.exe = self
         try:
             if is_train:
@@ -570,6 +592,7 @@ class Executor:
                 jax.effects_barrier()   # flush in-flight tap callbacks
         finally:
             if stream:
+                # analyze: ok(threads) documented debug-path limitation (_StreamTarget docstring); cleared in the finally
                 _STREAM_TARGET.exe = None
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False
@@ -597,19 +620,19 @@ class Executor:
         self._train_seed = None
         self._train_auxs = None
         monitored = self._monitor_active() and self._pending_train_fwd
-        from . import config as _config
         # MXNET_BACKWARD_DO_MIRROR rematerializes the forward inside the
         # fused fwd+bwd (jax.checkpoint) — the re-run would fire every
         # stream tap twice, so monitored mirror steps use the tapped
-        # program instead
+        # program instead (bind-time snapshot, matching _stream_fns)
         stream = (monitored and self._monitor_mode == "stream"
-                  and not _config.backward_do_mirror())
+                  and not self._mirror)
         if monitored and not stream:
             # tapped mode: fire taps with the same seed/aux snapshot the
             # fused program will consume, so the monitored values match
             # what executes
             self._fire_monitor(True, seed, auxs)
         if stream:
+            # analyze: ok(threads) documented debug-path limitation: the running executor is published globally for the duration of a monitored launch (_StreamTarget docstring)
             _STREAM_TARGET.exe = self
         try:
             fwd_bwd = (self._stream_fns()["fwd_bwd"] if stream
@@ -622,6 +645,7 @@ class Executor:
                 jax.effects_barrier()   # flush in-flight tap callbacks
         finally:
             if stream:
+                # analyze: ok(threads) documented debug-path limitation (_StreamTarget docstring); cleared in the finally
                 _STREAM_TARGET.exe = None
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         self._pending_train_fwd = False

@@ -398,6 +398,7 @@ class HybridBlock(Block):
             new_aux = [aux_nd[n]._data for n in aux_names]
             return tuple(o._data for o in outs), new_aux
 
+        # analyze: ok(retrace) CachedGraph compiles once per hybridize cache entry; gluon's own tests pin cache hits
         return jax.jit(run)
 
     def _hybrid_call(self, in_nd, grad_nd, aux_nd):
@@ -570,12 +571,14 @@ class SymbolBlock(HybridBlock):
         if fn is None:
             graph_fn = _build_graph_fn(self._symbol)
 
+            # analyze: ok(retrace) graph_fn/is_train are part of the _graph_cache key computed two lines above; the capture cannot outlive its key
             def run(arg_vals, aux_vals, in_vals, seed):
                 all_args = dict(arg_vals)
                 all_args.update(dict(zip(self._input_names, in_vals)))
                 outs, _ = graph_fn(all_args, aux_vals, seed, is_train)
                 return tuple(outs)
 
+            # analyze: ok(retrace) HybridBlock forward compiles per (input signature, is_train) by the hybridize contract; witnessed by test_gluon
             fn = jax.jit(run)
             self._graph_cache[key] = fn
         aux_names = set(self._symbol.list_auxiliary_states())

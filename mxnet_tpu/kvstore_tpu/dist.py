@@ -46,14 +46,19 @@ _state = {"checked": False, "seq": {}}
 
 def _barrier_ms():
     """Lazy histogram handle (this module must stay importable before
-    telemetry — the package-import bootstrap runs first thing)."""
+    telemetry — the package-import bootstrap runs first thing).  The
+    handle cache is written under ``_lock``: barrier() is called from
+    fit loops, checkpoint commits, and the health monitor's exchange
+    concurrently (mx.analyze threads pass)."""
     h = _state.get("barrier_ms")
     if h is None:
         from .. import telemetry as _telemetry
-        h = _state["barrier_ms"] = _telemetry.REGISTRY.histogram(
+        hist = _telemetry.REGISTRY.histogram(
             "kvstore_tpu_barrier_ms",
             "wall time this rank waited at a coordination-service "
             "barrier (rank skew; the straggler signal)", unit="ms")
+        with _lock:
+            h = _state.setdefault("barrier_ms", hist)
     return h
 
 _DEFAULT_TIMEOUT_MS = int(os.environ.get("MXTPU_COLLECTIVE_TIMEOUT_MS",

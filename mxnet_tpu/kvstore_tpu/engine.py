@@ -342,6 +342,7 @@ class TPUBucketEngine(FusedBucketEngine):
         if keys_tuple is not None:
             self._flat_res[keys_tuple]["res"] = list(new_res)
 
+        # analyze: ok(hostsync) the host transport crosses the wire by design (CPU-backend multiprocess); priced in kvstore_tpu_allgather_ms
         payload = _np.ascontiguousarray(_np.asarray(flat_q))
         self._wire_bytes(payload.nbytes)
         t0 = time.perf_counter()

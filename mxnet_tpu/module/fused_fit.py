@@ -82,6 +82,7 @@ def _global_fit_mesh(kv, n_local):
         if len(mine) < n_local:
             return False        # a process with fewer devices: not fusable
         devs.extend(mine)
+    # analyze: ok(hostsync) mesh construction from host device handles, once per build, no device data
     return Mesh(_np.array(devs), ("dp",))
 
 # incremented inside the step function at trace time only; steady-state
@@ -340,13 +341,15 @@ class FusedFitStep:
             # each process contributes its LOCAL batch as its rows of
             # the global batch, sharded over the cross-host 'dp' mesh
             from jax.sharding import NamedSharding, PartitionSpec as P
+            # analyze: ok(hostsync) pod-path input staging: the process-local batch rows must cross the host to shard onto the global mesh
             host = value.asnumpy() if isinstance(value, NDArray) \
-                else _np.asarray(value)
+                else _np.asarray(value)  # analyze: ok(hostsync) iterator batches are host-resident; this is input staging, not a device readback
+            # analyze: ok(hostsync) contiguity fix-up on the already-host staging copy
             host = _np.ascontiguousarray(host, dtype=dst._data.dtype)
             return jax.make_array_from_process_local_data(
                 NamedSharding(self._pmesh, P("dp")), host)
         data = value._data if isinstance(value, NDArray) \
-            else jnp.asarray(_np.asarray(value))
+            else jnp.asarray(_np.asarray(value))  # analyze: ok(hostsync) iterator batches are host-resident; this is input staging, not a device readback
         if data.dtype != dst._data.dtype:
             data = data.astype(dst._data.dtype)
         if group._mesh is not None:

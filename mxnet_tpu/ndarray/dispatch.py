@@ -47,6 +47,7 @@ def _get_jitted(opdef, attrs, is_train, needs_rng, n_inputs):
             def run(*arrs):
                 with _reg._OpCtxScope(is_train, None):
                     return opdef.fn(*arrs, **attrs)
+        # analyze: ok(retrace) the eager op path compiles once per (op, attrs, shape) key by design; _JIT_CACHE is that registry
         fn = jax.jit(run)
         _JIT_CACHE[key] = fn
     return fn

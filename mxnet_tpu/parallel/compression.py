@@ -46,6 +46,7 @@ class TwoBitCompressor:
     def __hash__(self):
         return hash((type(self).__name__, self.threshold))
 
+    # analyze: ok(retrace) static_argnums quantize helper compiles once per compressor config; parity pinned by test_parallel
     @functools.partial(jax.jit, static_argnums=0)
     def compress_decompress(self, grad, residual):
         """Returns (quantized_grad, new_residual) — the fused local form
@@ -56,6 +57,7 @@ class TwoBitCompressor:
         q = jnp.where(acc > t, t, jnp.where(acc < -t, -t, jnp.zeros_like(acc)))
         return q, acc - q
 
+    # analyze: ok(retrace) static_argnums dequantize helper compiles once per compressor config; parity pinned by test_parallel
     @functools.partial(jax.jit, static_argnums=0)
     def compress(self, grad, residual):
         """Returns (packed_uint8, new_residual): 4 2-bit codes per byte —
@@ -77,6 +79,7 @@ class TwoBitCompressor:
     def decompress(self, packed, shape, dtype=jnp.float32):
         return self._decompress(packed, tuple(shape), dtype)
 
+    # analyze: ok(retrace) static_argnums error-feedback helper compiles once per compressor config; parity pinned by test_parallel
     @functools.partial(jax.jit, static_argnums=(0, 2, 3))
     def _decompress(self, packed, shape, dtype):
         TwoBitCompressor._traces += 1

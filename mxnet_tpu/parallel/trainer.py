@@ -26,8 +26,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ops import optimizer_ops as _oo
+from .. import telemetry as _telemetry
 
 __all__ = ["TrainStep", "default_tp_rule"]
+
+# trace-time retrace witness + program-registry registration, same
+# RetraceSite contract as executor/fused_fit/kvstore (the step body
+# calls _note_retrace(); step() dispatches through _SITE.timed)
+PARALLEL_RETRACES = _telemetry.REGISTRY.counter(
+    "parallel_step_retraces",
+    "parallel TrainStep program (re)traces (trace-time witness)",
+    vital=True)
+_SITE = _telemetry.RetraceSite(PARALLEL_RETRACES,
+                               _telemetry.JIT_COMPILE_MS,
+                               site="parallel_step")
+_note_retrace = _SITE.note
 
 
 def default_tp_rule(name, shape, mesh):
@@ -364,6 +377,8 @@ class TrainStep:
         idx = self._idx
 
         def step_fn(params, states, auxs, batch, lr, seed):
+            _note_retrace()   # trace-time host side effect only
+
             def f(p):
                 outs, new_auxs = graph_fn({**batch, **p}, auxs, seed, True)
                 return outs, new_auxs
@@ -440,8 +455,8 @@ class TrainStep:
         batch = {n: _place(n, v) for n, v in batch.items()}
         seed = _np.uint32((self._base_seed + self._nstep * 2654435761)
                           & 0x7FFFFFFF)
-        self.params, self.states, self.auxs, outs = self._step_fn(
-            self.params, self.states, self.auxs, batch,
+        self.params, self.states, self.auxs, outs = _SITE.timed(
+            self._step_fn, self.params, self.states, self.auxs, batch,
             jnp.float32(lr), seed)
         return outs
 

@@ -77,14 +77,18 @@ def set_config(**kwargs):
     stop/start cycle."""
     import logging
     global _device_trace_on
-    for k, v in kwargs.items():
-        if k not in _config:
-            # reference-valid options we don't distinguish (e.g.
-            # profile_process='worker'|'server') are accepted with a note
-            logging.warning("profiler.set_config: option '%s' is accepted "
-                            "but has no effect here", k)
-            continue
-        _config[k] = v
+    with _lock:
+        # _config is read by every profiled dispatch on other threads;
+        # writes hold the module lock (mx.analyze threads pass)
+        for k, v in kwargs.items():
+            if k not in _config:
+                # reference-valid options we don't distinguish (e.g.
+                # profile_process='worker'|'server') are accepted with
+                # a note
+                logging.warning("profiler.set_config: option '%s' is "
+                                "accepted but has no effect here", k)
+                continue
+            _config[k] = v
     _refresh_flags()
     if _state in ("run", "pause") and _config["trace_dir"]:
         if not _device_trace_on:

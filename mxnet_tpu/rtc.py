@@ -71,6 +71,7 @@ class PallasKernel:
                 out_shape=jax.ShapeDtypeStruct(self._out_shape,
                                                jnp.dtype(self._out_dtype)),
                 interpret=interpret, **kwargs)
+            # analyze: ok(retrace) user-authored RTC kernel compiles once per CudaKernel construction (the reference's nvrtc contract)
             self._compiled = jax.jit(call)
         return self._compiled
 

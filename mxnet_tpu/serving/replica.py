@@ -47,13 +47,19 @@ class Replica:
     def _pred_for(self, bucket):
         """Bucket-shaped Predictor, rebound lazily; parameters are shared
         device arrays (Predictor.reshape), so this costs one bind + (on
-        first forward) one XLA compile per bucket, ever."""
-        pred = self._preds.get(bucket)
-        if pred is None:
-            shapes = {name: (bucket,) + tuple(shape[1:])
-                      for name, shape in self._base.input_shapes.items()}
-            pred = self._base.reshape(shapes)
-            self._preds[bucket] = pred
+        first forward) one XLA compile per bucket, ever.  The rebind
+        map is shared between the worker loop and external callers
+        (warmup on a live replica), so get-or-bind holds the swap lock
+        — a racy double-rebind would waste a bind and drop one of the
+        two Predictors mid-bookkeeping (mx.analyze threads pass)."""
+        with self._swap_lock:
+            pred = self._preds.get(bucket)
+            if pred is None:
+                shapes = {name: (bucket,) + tuple(shape[1:])
+                          for name, shape
+                          in self._base.input_shapes.items()}
+                pred = self._base.reshape(shapes)
+                self._preds[bucket] = pred
         return pred
 
     def warmup(self):
@@ -112,7 +118,7 @@ class Replica:
                     if dst is None or name in self._base._input_shapes:
                         continue
                     data = v._data if hasattr(v, "_data") \
-                        else jnp.asarray(np.asarray(v))
+                        else jnp.asarray(np.asarray(v))  # analyze: ok(hostsync) hot-reload weight staging from host checkpoint values; serialized by the swap lock, not on the forward path
                     if data.dtype != dst._data.dtype:
                         data = data.astype(dst._data.dtype)
                     dst._set_data(jax.device_put(

@@ -1,0 +1,471 @@
+"""mx.analyze — the hot-path hazard analyzer (docs/ANALYZE.md).
+
+Each pass is proven against inline fixture snippets: a must-flag case
+(the seeded violation) and a must-pass case (the blessed idiom), plus
+the waiver machinery (honored, unused-fails, reason-required), the
+baseline round-trip, and the end-to-end "repo is clean" gates that put
+the analyzer inside tier-1.
+
+The fixtures build Modules directly from source strings — no files on
+disk, no jax import — so this file is fast and hermetic.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "mxnet_tpu"))
+
+import analyze                                          # noqa: E402
+from analyze import core                                # noqa: E402
+from analyze.hostsync import HostSyncPass               # noqa: E402
+from analyze.retrace import RetracePass                 # noqa: E402
+from analyze.donation import DonationPass               # noqa: E402
+from analyze.threads import ThreadsPass                 # noqa: E402
+from analyze.collective import CollectivePass           # noqa: E402
+
+
+def make_module(src, relpath="mxnet_tpu/module/fused_fit.py"):
+    """Build a Module from an inline snippet.  The default path is a
+    hot-path module so the hostsync pass applies."""
+    return core.Module(REPO, relpath, text=textwrap.dedent(src))
+
+
+def run_pass(p, *modules, waivers=True):
+    ctx = core.Context(REPO, list(modules))
+    findings = p.run(ctx)
+    if waivers:
+        findings = core.apply_waivers(ctx, findings)
+    return ctx, findings
+
+
+def slugs(findings, pass_name=None):
+    return sorted(f.slug for f in findings
+                  if pass_name is None or f.pass_name == pass_name)
+
+
+# ----------------------------------------------------------------------
+# pass 1: hostsync
+# ----------------------------------------------------------------------
+def test_hostsync_flags_item_asnumpy_and_tainted_scalarize():
+    m = make_module("""
+        def step(exe, args):
+            outs = exe.forward(True, **args)   # dispatch -> tainted
+            loss = float(outs[0])              # must-flag: scalarize
+            v = outs[1].asnumpy()              # must-flag: asnumpy
+            s = args["x"].item()               # must-flag: item
+            return loss, v, s
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    assert slugs(fs) == ["asnumpy", "item", "scalarize"]
+
+
+def test_hostsync_metadata_and_host_values_pass():
+    m = make_module("""
+        import numpy as _np
+        def step(exe, dst, args):
+            outs = exe.forward(True, **args)
+            if outs[0].dtype != dst._data.dtype:    # metadata: no sync
+                pass
+            host = outs[0].asnumpy()  # analyze: ok(hostsync) fixture
+            n = int(host.sum())                 # host value: fine
+            k = _np.asarray([1.0, 2.0])         # literal: fine
+            return n, k
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    assert not [f for f in fs if not f.waived], \
+        [f.format() for f in fs if not f.waived]
+
+
+def test_hostsync_implicit_bool():
+    m = make_module("""
+        def step(exe):
+            outs = exe.forward(False)
+            if outs[0]:                 # must-flag: implicit __bool__
+                return 1
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    assert slugs(fs) == ["implicit-bool"]
+
+
+def test_hostsync_only_hot_modules():
+    src = "def f(x):\n    return x.asnumpy()\n"
+    cold = core.Module(REPO, "mxnet_tpu/visualization.py", text=src)
+    _, fs = run_pass(HostSyncPass(), cold)
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# pass 2: retrace
+# ----------------------------------------------------------------------
+RETRACE_OK = """
+    import jax
+    from .. import telemetry as _telemetry
+    _SITE = _telemetry.RetraceSite(None, None, site="x")
+    _note_retrace = _SITE.note
+
+    def build(layout, threshold):
+        def step(residuals, grads):
+            _note_retrace()
+            return grads
+        return jax.jit(step, donate_argnums=(0,))
+"""
+
+
+def test_retrace_registered_site_passes():
+    m = make_module(RETRACE_OK, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(RetracePass(), m)
+    assert slugs(fs, "retrace") == []
+
+
+def test_retrace_unregistered_site_flags():
+    m = make_module("""
+        import jax
+        def build(layout):
+            def step(grads):
+                return grads
+            return jax.jit(step)
+    """, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(RetracePass(), m)
+    assert slugs(fs, "retrace") == ["unregistered"]
+
+
+def test_retrace_per_call_jit_flags():
+    m = make_module("""
+        import jax
+        def hot(xs):
+            out = []
+            for x in xs:
+                def step(v):
+                    return v
+                out.append(jax.jit(step)(x))   # jit-in-loop + immediate
+            return out
+    """, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(RetracePass(), m)
+    assert "per-call-jit" in slugs(fs, "retrace")
+
+
+def test_retrace_env_capture_flags_and_param_derived_passes():
+    m = make_module("""
+        import jax
+        from . import config as _config
+
+        def build(graph_fn, n_dev, mode):
+            kind, momentum = mode              # param-derived: fine
+            n = len(graph_fn)                  # builtin of param: fine
+            mirror = _config.backward_do_mirror()   # env read: BAD
+            def step(args):
+                if mirror:
+                    return graph_fn, momentum, n
+                return args
+            return jax.jit(step)
+    """, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(RetracePass(), m)
+    caps = [f for f in fs if f.slug == "env-capture"]
+    assert len(caps) == 1 and caps[0].detail.endswith(":mirror")
+
+
+# ----------------------------------------------------------------------
+# pass 3: donation
+# ----------------------------------------------------------------------
+DONATION_SRC = """
+    import jax
+
+    def _build(layout):
+        def step(weights, residuals, grads):
+            return weights, residuals
+        return jax.jit(step, donate_argnums=(1,))
+
+    def good(cache, sig, weights, residuals, grads):
+        fn = cache.get(sig)
+        if fn is None:
+            fn = cache[sig] = _build(sig)
+        new_w, new_res = fn(weights, residuals, grads)
+        return new_w, new_res, weights          # weights not donated
+
+    def bad(cache, sig, weights, residuals, grads):
+        fn = cache[sig] = _build(sig)
+        new_w, new_res = fn(weights, residuals, grads)
+        return residuals                        # read after donation!
+"""
+
+
+def test_donation_read_after_dispatch_flags_only_bad():
+    m = make_module(DONATION_SRC, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(DonationPass(), m)
+    assert slugs(fs, "donation") == ["donated-read"]
+    (f,) = [f for f in fs if f.pass_name == "donation"]
+    assert f.detail == "bad:residuals"
+
+
+def test_donation_rebind_by_result_passes():
+    m = make_module("""
+        import jax
+
+        def _build(layout):
+            def step(macc, grads):
+                return macc
+            return jax.jit(step, donate_argnums=(0,))
+
+        def ok(cache, sig, macc, grads):
+            fn = cache[sig] = _build(sig)
+            macc = fn(macc, grads)     # donated name rebound by result
+            return macc
+    """, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(DonationPass(), m)
+    assert slugs(fs, "donation") == []
+
+
+def test_donation_exclusive_branches_not_confused():
+    m = make_module("""
+        import jax
+
+        def _build(layout):
+            def step(residuals, grads):
+                return grads
+            return jax.jit(step, donate_argnums=(0,))
+
+        def dispatch(cache, sig, residuals, grads, mode):
+            if mode is None:
+                fn = cache[sig] = _build(sig)
+                out = fn(residuals, grads)
+            else:
+                out = (residuals, grads)   # OTHER branch: no dispatch
+            return out
+    """, "mxnet_tpu/kvstore_fused.py")
+    _, fs = run_pass(DonationPass(), m)
+    assert slugs(fs, "donation") == []
+
+
+# ----------------------------------------------------------------------
+# pass 4: threads
+# ----------------------------------------------------------------------
+THREADS_BAD = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._warm = set()
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self.thread = self._thread
+
+        def _loop(self):
+            self._warm.add("decode")       # thread-domain write
+
+        def warmup(self):
+            self._warm.add("prefill")      # external write, NO lock
+"""
+
+
+def test_threads_unguarded_shared_write_flags():
+    m = make_module(THREADS_BAD, "mxnet_tpu/decode/engine.py")
+    _, fs = run_pass(ThreadsPass(), m)
+    hits = [f for f in fs if f.slug == "unguarded-shared-write"]
+    assert len(hits) == 1 and hits[0].detail == "Engine._warm"
+
+
+def test_threads_guarded_writes_pass():
+    m = make_module(THREADS_BAD.replace(
+        'self._warm.add("prefill")      # external write, NO lock',
+        'with self._lock:\n'
+        '                self._warm.add("prefill")').replace(
+        'self._warm.add("decode")       # thread-domain write',
+        'with self._lock:\n'
+        '                self._warm.add("decode")'),
+        "mxnet_tpu/decode/engine.py")
+    _, fs = run_pass(ThreadsPass(), m)
+    assert [f for f in fs if f.slug == "unguarded-shared-write"] == []
+
+
+def test_threads_lock_order_contradiction_flags():
+    m = make_module("""
+        import threading
+
+        class DecodeEngine:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._step_lock = threading.Lock()
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._step_lock:       # fine -> leaf
+                    with self._cv:          # CONTRADICTS LOCK_ORDER
+                        pass
+    """, "mxnet_tpu/decode/engine.py")
+    _, fs = run_pass(ThreadsPass(), m)
+    assert "lock-order" in slugs(fs, "threads")
+
+
+def test_threads_module_global_unguarded_flags():
+    m = make_module("""
+        import threading
+        _lock = threading.Lock()
+        _state = {"seq": 0}
+
+        def good(tag):
+            with _lock:
+                _state[tag] = 1
+
+        def bad(tag):
+            _state[tag] = 2
+    """, "mxnet_tpu/kvstore_tpu/dist.py")
+    _, fs = run_pass(ThreadsPass(), m)
+    hits = [f for f in fs if f.slug == "unguarded-global-write"]
+    assert len(hits) == 1 and hits[0].detail == "bad:_state"
+
+
+# ----------------------------------------------------------------------
+# pass 5: collective
+# ----------------------------------------------------------------------
+def test_collective_rank_branch_and_tag_rules():
+    m = make_module("""
+        from ..kvstore_tpu import dist
+
+        def good(payload, rank):
+            if rank == 0:
+                payload = b"x"             # rank-conditional WORK: ok
+            out = dist.broadcast_bytes("mytag", payload)
+            dist.barrier("mydone")
+            return out
+
+        def bad_branch(payload, rank):
+            if rank == 0:
+                dist.barrier("oops")       # collective under rank!
+            return payload
+
+        def bad_dynamic(tag, payload):
+            return dist.allgather_bytes(tag, payload)
+
+        def bad_reuse(payload):
+            dist.barrier("mydone")         # tag already used in good()
+    """, "mxnet_tpu/checkpoint/multihost.py")
+    _, fs = run_pass(CollectivePass(), m)
+    assert slugs(fs, "collective") == ["dynamic-tag", "rank-branch",
+                                       "tag-reuse"]
+
+
+def test_collective_dist_module_itself_exempt():
+    src = ("def broadcast_bytes(tag, payload, root=0):\n"
+           "    import jax\n"
+           "    if jax.process_index() == root:\n"
+           "        barrier('x')\n")
+    m = core.Module(REPO, "mxnet_tpu/kvstore_tpu/dist.py", text=src)
+    _, fs = run_pass(CollectivePass(), m)
+    assert slugs(fs, "collective") == []
+
+
+# ----------------------------------------------------------------------
+# waivers + baseline
+# ----------------------------------------------------------------------
+def test_waiver_honored_and_reason_required():
+    m = make_module("""
+        def step(args):
+            # analyze: ok(hostsync) the readback is the contract here
+            a = args["x"].asnumpy()
+            b = args["y"].asnumpy()  # analyze: ok(hostsync)
+            return a, b
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    waived = [f for f in fs if f.waived]
+    assert len(waived) == 2            # both sites silenced...
+    missing = [f for f in fs if f.slug == "missing-reason"]
+    assert len(missing) == 1           # ...but the bare one is an error
+
+
+def test_unused_waiver_fails():
+    m = make_module("""
+        def fine(x):
+            # analyze: ok(hostsync) nothing here actually syncs
+            return x + 1
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    assert slugs(fs, "waiver") == ["unused"]
+
+
+def test_waiver_in_docstring_does_not_count():
+    m = make_module('''
+        def f(args):
+            """Docs may quote `# analyze: ok(hostsync) like this`."""
+            return args["x"].asnumpy()
+    ''')
+    _, fs = run_pass(HostSyncPass(), m)
+    assert [f.slug for f in fs if not f.waived] == ["asnumpy"]
+
+
+def test_baseline_round_trip(tmp_path):
+    m = make_module("""
+        def step(args):
+            # analyze: ok(hostsync) fixture reason
+            return args["x"].asnumpy()
+    """)
+    _, fs = run_pass(HostSyncPass(), m)
+    path = str(tmp_path / "baseline.json")
+    core.save_baseline(path, fs)
+    entries = core.load_baseline(path)
+    assert core.diff_baseline(fs, entries) == []
+    # a vanished waiver -> stale entry; a new waiver -> missing entry
+    assert core.diff_baseline([], entries) != []
+    assert core.diff_baseline(fs, []) != []
+    # a reason-less baseline entry is an error
+    doctored = json.loads(open(path).read())
+    doctored["waived"][0]["reason"] = ""
+    assert any("no reason" in e for e in
+               core.diff_baseline(fs, doctored["waived"]))
+
+
+# ----------------------------------------------------------------------
+# end-to-end: the repo is clean (this IS the tier-1 gate)
+# ----------------------------------------------------------------------
+def test_check_static_repo_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_static.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_check_static_changed_mode_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_static.py"),
+         "--changed"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_telemetry_shim_still_green():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_telemetry.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check_telemetry: OK" in proc.stdout
+
+
+def test_every_baseline_entry_has_reason():
+    path = os.path.join(REPO, "tools", "static_baseline.json")
+    entries = core.load_baseline(path)
+    assert entries, "baseline should record the repo's waived sites"
+    for e in entries:
+        assert e.get("reason", "").strip(), e
+
+
+def test_all_passes_registered():
+    names = [p.name for p in analyze.all_passes()]
+    assert names == ["hostsync", "retrace", "donation", "threads",
+                     "collective", "telemetry", "envknobs"]
+
+
+@pytest.mark.parametrize("knob", ["MXNET_KVSTORE_BIGARRAY_BOUND",
+                                  "MXNET_WATCHDOG_FACTOR",
+                                  "MXTPU_COORDINATOR"])
+def test_config_doc_covers_known_knobs(knob):
+    with open(os.path.join(REPO, "docs", "CONFIG.md")) as f:
+        assert "`%s`" % knob in f.read()

@@ -655,3 +655,46 @@ def test_decode_soak(model):
         assert st["dispatches_per_step"] == 1.0
     finally:
         eng.stop()
+
+
+# ----------------------------------------------------------------------
+# thread-safety pins (mx.analyze threads pass; docs/ANALYZE.md)
+# ----------------------------------------------------------------------
+def test_warmup_concurrent_with_traffic_is_safe(model):
+    """warmup() on a LIVE engine shares the _warm/_prefill_exes
+    bookkeeping with the engine thread; both are now guarded by
+    _step_lock (flagged by mx.analyze as unguarded-shared-write).
+    Concurrent warmup + traffic must finish every stream, warm every
+    bucket exactly once, and leave the zero-retrace witness at 0."""
+    import threading
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=36, max_prefill_len=8,
+                       prefill_buckets=[8], warmup=False)
+    try:
+        handles, errs = [], []
+
+        def traffic():
+            try:
+                handles.append(
+                    eng.submit([3, 1, 4], max_new_tokens=4))
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        warm = threading.Thread(target=eng.warmup)
+        cli = [threading.Thread(target=traffic) for _ in range(3)]
+        warm.start()
+        for t in cli:
+            t.start()
+        for t in cli + [warm]:
+            t.join(60)
+        assert not errs
+        for h in handles:
+            out = h.result(60)
+            assert len(out) == 4
+        st = eng.stats()
+        assert st["steady_state_retraces"] == 0
+        assert st["failed"] == 0
+        # every bucket warmed exactly once (set semantics intact)
+        assert ("prefill", 8) in eng._warm and "decode" in eng._warm
+    finally:
+        eng.stop()

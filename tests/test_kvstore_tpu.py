@@ -387,3 +387,32 @@ def test_two_process_smoke(tmp_path):
     sys.stderr.write(proc.stderr)
     assert proc.returncode == 0
     assert proc.stdout.count("all tpu kvstore checks passed") == 2
+
+
+# ----------------------------------------------------------------------
+# thread-safety pin (mx.analyze threads pass; docs/ANALYZE.md)
+# ----------------------------------------------------------------------
+def test_barrier_ms_handle_registration_race_safe():
+    """dist._barrier_ms lazily registers its histogram; the handle
+    cache write now holds the module lock (mx.analyze
+    unguarded-global-write pin), so concurrent first calls all get ONE
+    instrument and the registry sees exactly one series."""
+    import threading
+    from mxnet_tpu.kvstore_tpu import dist
+
+    dist._state.pop("barrier_ms", None)
+    barrier = threading.Barrier(6)
+    got = []
+
+    def race():
+        barrier.wait()
+        got.append(dist._barrier_ms())
+
+    threads = [threading.Thread(target=race) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(got) == 6
+    assert all(h is got[0] for h in got)
+    assert got[0] is telemetry.REGISTRY.get("kvstore_tpu_barrier_ms")

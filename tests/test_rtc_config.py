@@ -105,3 +105,50 @@ def test_backward_do_mirror_trains():
     w0 = float(base.stdout.split("W ")[1])
     w1 = float(mirrored.stdout.split("W ")[1])
     assert abs(w0 - w1) < 1e-4  # same math, different memory schedule
+
+
+def test_backward_do_mirror_is_a_fwd_bwd_cache_key():
+    """Two binds of the SAME symbol under flipped MXNET_BACKWARD_DO_MIRROR
+    must select DIFFERENT cached fwd_bwd programs (the flag is part of
+    the per-symbol cache key, and each executor snapshots it at bind
+    time) with matching gradients — before the mx.analyze retrace pass
+    flagged this (PR 9), the second bind silently reused the first
+    bind's program, so the knob appeared to work but did nothing."""
+    from mxnet_tpu import sym
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                           name="mirfc"), name="softmax")
+    xb = np.random.RandomState(3).rand(8, 6).astype(np.float32)
+    yb = np.zeros((8,), np.float32)
+
+    def bind_and_grad():
+        exe = net.simple_bind(ctx=mx.cpu(), grad_req="write",
+                              data=(8, 6), softmax_label=(8,))
+        return exe
+
+    prev = os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    try:
+        e_plain = bind_and_grad()
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+        e_mirror = bind_and_grad()
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+        else:
+            os.environ["MXNET_BACKWARD_DO_MIRROR"] = prev
+    assert e_plain._mirror is False and e_mirror._mirror is True
+    assert e_plain._jit_fwd_bwd is not e_mirror._jit_fwd_bwd, \
+        "mirror flip must select a different cached fwd_bwd program"
+    # the env flip after e_plain's bind must not retroactively change it
+    assert e_plain._mirror is False
+
+    def grads(exe):
+        for n, src in e_plain.arg_dict.items():
+            exe.arg_dict[n]._set_data(src._data)
+        exe.forward(is_train=True, data=xb, softmax_label=yb)
+        exe.backward()
+        return exe.grad_dict["mirfc_weight"].asnumpy().copy()
+
+    # remat reorders FMA contraction: rtol-level equality, not bitwise
+    np.testing.assert_allclose(grads(e_plain), grads(e_mirror),
+                               rtol=2e-6, atol=1e-8)

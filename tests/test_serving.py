@@ -468,3 +468,41 @@ def test_serving_soak_concurrent_stress(model):
             + st["requests"]["rejected_deadline"]
             + st["requests"]["failed"]
             + st["requests"]["cancelled"])
+
+
+# ----------------------------------------------------------------------
+# thread-safety pin (mx.analyze threads pass; docs/ANALYZE.md)
+# ----------------------------------------------------------------------
+def test_replica_pred_for_binds_once_under_race():
+    """Replica._pred_for's bucket->Predictor map is shared between the
+    worker loop and external callers (warmup on a live replica); the
+    get-or-bind now holds the swap lock, so a race binds exactly one
+    Predictor per bucket (mx.analyze unguarded-shared-write pin)."""
+    import threading
+    from mxnet_tpu.serving.replica import Replica
+
+    binds = []
+
+    class FakePred:
+        input_shapes = {"data": (4, FEAT)}
+
+        def reshape(self, shapes):
+            binds.append(shapes)
+            time.sleep(0.02)       # widen the race window
+            return FakePred()
+
+    rep = Replica(0, mx.cpu(), FakePred(), [4], batcher=None)
+    barrier = threading.Barrier(4)
+    got = []
+
+    def race():
+        barrier.wait()
+        got.append(rep._pred_for(2))
+
+    threads = [threading.Thread(target=race) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(binds) == 1, "racy double-bind: %d binds" % len(binds)
+    assert all(g is got[0] for g in got)

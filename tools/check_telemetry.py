@@ -2,148 +2,45 @@
 """Static telemetry-consistency check (runs inside tier-1 via
 tests/test_telemetry.py).
 
-Keeps ``telemetry.REGISTRY`` the single source of truth for
-operational witnesses:
-
-1. **No stray witness globals** — flags new module-level mutable
-   ALL-CAPS globals (``FOO = 0`` / ``= []`` / ``= {}`` / ``= set()``)
-   in ``mxnet_tpu/``; counters/state belong in the registry (the two
-   historical ``TRACE_COUNT`` ints are now registry-backed aliases).
-   Genuine constants go in the allowlist below with a reason.
-2. **Glossary coverage** — every metric name registered by literal in
-   ``mxnet_tpu/`` source (``REGISTRY.counter/gauge/histogram("name")``
-   and profiler ``new_counter("name")``) must appear in the
-   docs/OBSERVABILITY.md glossary, so the docs can never silently lag
-   the exported series.
-3. **Reverse coverage** — every glossary row must still have a
-   registration site in the source: a series whose instrumentation was
-   deleted or renamed must leave the glossary in the same commit
-   (stale docs are as misleading as missing ones).  Legitimately
-   derived/doc-only rows go in ``ALLOWED_DOC_ONLY`` with a reason.
-4. **Label coverage** — every label key used at a ``.labels(key=...)``
-   call site in ``mxnet_tpu/`` must be documented in the glossary as a
-   backticked ``\\`key\\``` (convention: the owning series' row says
-   "labeled by `key`"), so a dashboard reader can learn every label
-   dimension from the docs alone.
+Since the mx.analyze framework landed this is a thin shim: the four
+checks (no stray witness globals, glossary coverage both directions,
+label coverage — docstring history in ``mxnet_tpu/analyze/telemetry.py``)
+now run as the analyzer's ``telemetry`` pass, and the full tier-1 gate
+is ``tools/check_static.py`` (all seven passes + waiver baseline).
+This entry point stays so existing wiring, docs, and muscle memory
+(``python tools/check_telemetry.py``) keep working; it runs ONLY the
+telemetry pass and keeps the historical output shape.
 
 Stdlib-only, no package import: safe anywhere (including as a plain
 subprocess inside the test suite).
 """
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(ROOT, "mxnet_tpu")
-GLOSSARY = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+sys.path.insert(0, os.path.join(ROOT, "mxnet_tpu"))
 
-# (relative path, name): why this module-level global is legitimate
-ALLOWED_GLOBALS = {
-    ("contrib/text/embedding.py", "UNKNOWN_IDX"):
-        "vocabulary layout constant, not a mutable witness",
-}
-
-# glossary name: why it has no literal registration site in mxnet_tpu/
-ALLOWED_DOC_ONLY = {}
-
-_MUTABLE = re.compile(
-    r"^([A-Z][A-Z0-9_]*)\s*=\s*(?:0|0\.0|\[\]|\{\}|set\(\))\s*(?:#.*)?$")
-_REGISTER = re.compile(
-    r"""(?:\.|\b)(?:counter|gauge|histogram)\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
-_PROF_COUNTER = re.compile(
-    r"""new_counter\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
-_LABEL_USE = re.compile(r"""\.labels\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*=""")
-
-
-def sanitize(name):
-    out = []
-    for i, ch in enumerate(name):
-        ok = ("a" <= ch <= "z") or ("A" <= ch <= "Z") or ch in "_:" \
-            or ("0" <= ch <= "9")
-        if i == 0 and "0" <= ch <= "9":
-            out.append("_")
-        out.append(ch if ok else "_")
-    return "".join(out)
-
-
-def glossary_names():
-    names = set()
-    with open(GLOSSARY) as f:
-        for line in f:
-            m = re.match(r"^\|\s*`([A-Za-z0-9_:]+)`\s*\|", line)
-            if m:
-                names.add(m.group(1))
-    return names
-
-
-def scan():
-    bad_globals = []
-    registered = {}      # sanitized name -> first file:line
-    labels_used = {}     # label key -> first use site
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, PKG)
-            with open(path) as f:
-                text = f.read()
-            for lineno, line in enumerate(text.splitlines(), 1):
-                m = _MUTABLE.match(line)
-                if m and (rel, m.group(1)) not in ALLOWED_GLOBALS:
-                    bad_globals.append("%s:%d: module-level mutable "
-                                      "global %s — use a telemetry "
-                                      "registry instrument (or allowlist "
-                                      "it in tools/check_telemetry.py)"
-                                      % (rel, lineno, m.group(1)))
-            for rx in (_REGISTER, _PROF_COUNTER):
-                for m in rx.finditer(text):
-                    name = sanitize(m.group(1))
-                    registered.setdefault(
-                        name, "%s (near offset %d)" % (rel, m.start()))
-            for m in _LABEL_USE.finditer(text):
-                labels_used.setdefault(
-                    m.group(1), "%s (near offset %d)" % (rel, m.start()))
-    return bad_globals, registered, labels_used
+import analyze                                    # noqa: E402
+from analyze.telemetry import TelemetryPass       # noqa: E402
 
 
 def main():
-    errors, registered, labels_used = scan()
-    if not os.path.exists(GLOSSARY):
-        errors.append("docs/OBSERVABILITY.md missing")
-        known = set()
-        glossary_text = ""
-    else:
-        known = glossary_names()
-        with open(GLOSSARY) as f:
-            glossary_text = f.read()
-    for name in sorted(registered):
-        if name not in known:
-            errors.append(
-                "metric %r registered at %s is missing from the "
-                "docs/OBSERVABILITY.md glossary" % (name, registered[name]))
-    for name in sorted(known):
-        if name not in registered and name not in ALLOWED_DOC_ONLY:
-            errors.append(
-                "glossary entry %r has no surviving registration site in "
-                "mxnet_tpu/ — remove the row or restore the series (or "
-                "allowlist it in ALLOWED_DOC_ONLY with a reason)" % name)
-    for key in sorted(labels_used):
-        if "`%s`" % key not in glossary_text:
-            errors.append(
-                "label key %r (used at %s) is not documented in the "
-                "docs/OBSERVABILITY.md glossary — its series' row must "
-                "name it as a backticked `%s`"
-                % (key, labels_used[key], key))
+    tpass = TelemetryPass()
+    ctx, findings = analyze.run(ROOT, [tpass])
+    errors = [f for f in findings
+              if not f.waived and f.pass_name == "telemetry"]
     if errors:
         print("check_telemetry: %d problem(s)" % len(errors))
-        for e in errors:
-            print("  " + e)
+        for f in errors:
+            print("  %s:%d: %s" % (f.path, f.line, f.message))
         return 1
+    # historical summary shape, counts straight from the pass's own
+    # scan so they can never drift from what was actually checked
     print("check_telemetry: OK (%d series in glossary, %d registered "
-          "by literal, %d label keys documented)"
-          % (len(known), len(registered), len(labels_used)))
+          "by literal, %d label keys documented; full static gate: "
+          "tools/check_static.py)"
+          % (len(tpass.glossary_names), len(tpass.registered),
+             len(tpass.labels_used)))
     return 0
 
 
