@@ -948,6 +948,131 @@ def bench_kvstore_mh_worker(args):
         }))
 
 
+def bench_dlrm(args):
+    """Recommendation-scale training (mx.embedding, docs/EMBEDDING.md):
+    an embedding-dominated DLRM-style step — F categorical features
+    share one stacked (F*V, D) ``ShardedEmbedding`` table via
+    per-feature index offsets, indices drawn zipf(1.2) so traffic is
+    heavy-tailed (a few hot rows, a long cold tail, ragged unique-row
+    counts every step — the retrace stressor). Each step is ONE compiled
+    lookup dispatch (B*F is power-of-two by construction, so no unpad
+    slice) plus ONE compiled sparse-apply dispatch through ``kv.push``;
+    ``sparse_dispatches_per_step <= 2`` and zero steady-state retraces
+    across the ragged batches are asserted, not just reported. The
+    parity arm replays the identical gradient stream through the EAGER
+    row_sparse path (bucketing off) and compares final tables at
+    rtol 2e-5 — the compiled pipeline must train the same model."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.embedding import ShardedEmbedding
+    from mxnet_tpu.embedding.lookup import LOOKUPS, LOOKUP_RETRACES
+    from mxnet_tpu.embedding.engine import (SPARSE_DISPATCHES,
+                                            SPARSE_RETRACES)
+    from mxnet_tpu import telemetry, profiler
+
+    V, D, F, B = (args.dlrm_vocab, args.dlrm_dim,
+                  args.dlrm_features, args.dlrm_batch)
+    if (B * F) & (B * F - 1):
+        raise SystemExit("bench: --dlrm-batch * --dlrm-features must be "
+                         "a power of two (single-dispatch lookup)")
+    rng = np.random.RandomState(7)
+    steps = max(4, args.iters)
+    # per-step (B, F) zipf indices, offset feature f into its own V rows
+    offs = (np.arange(F) * V)[None, :]
+    batches = [np.minimum(rng.zipf(1.2, size=(B, F)) - 1, V - 1) + offs
+               for _ in range(args.warmup + steps)]
+    upstream = [rng.normal(0, 1, (B, F, D)).astype(np.float32)
+                for _ in range(args.warmup + steps)]
+    w0 = rng.normal(0, 0.05, (F * V, D)).astype(np.float32)
+
+    def run(bucketed):
+        blk = ShardedEmbedding(F * V, D)
+        blk.initialize()
+        kv = mx.kv.create("local")
+        kv.set_bucketing(bucketed)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                          lazy_update=True,
+                                          rescale_grad=1.0 / B))
+        blk.attach_to_kvstore(kv)
+        key = "embedding:%s" % blk.weight.name
+        # both arms start from the same table
+        kv._store[key]._set_data(jax.numpy.asarray(w0))
+
+        def step(i):
+            with autograd.record():
+                out = blk(nd.array(batches[i]))
+                # stand-in for the dense interaction tower: a weighted
+                # sum whose gradient w.r.t. the lookup is upstream[i]
+                loss = (out * nd.array(upstream[i])).sum()
+            loss.backward()
+            blk.sparse_push(kv, key=key)
+        return blk, kv, key, step
+
+    # -- compiled arm ---------------------------------------------------
+    blk, kv, key, step = run(bucketed=True)
+    t0 = time.perf_counter()
+    step(0)
+    jax.block_until_ready(kv._store[key]._data)
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    for i in range(1, args.warmup):
+        step(i)
+    jax.block_until_ready(kv._store[key]._data)
+    l0, s0 = LOOKUPS.value, SPARSE_DISPATCHES.value
+    lr0, sr0 = LOOKUP_RETRACES.value, SPARSE_RETRACES.value
+    hist = _step_hist()
+    t0 = time.perf_counter()
+    for i in range(steps):
+        t_s = time.perf_counter()
+        step(args.warmup + i)
+        hist.observe((time.perf_counter() - t_s) * 1e3)
+    jax.block_until_ready(kv._store[key]._data)
+    dt = time.perf_counter() - t0
+    retraces = (LOOKUP_RETRACES.value - lr0) + (SPARSE_RETRACES.value - sr0)
+    sparse_per_step = (SPARSE_DISPATCHES.value - s0) / steps
+    lookup_per_step = (LOOKUPS.value - l0) / steps
+    if retraces:
+        raise SystemExit("bench: %d embedding retraces across ragged "
+                         "measured steps — the runtime/static split "
+                         "leaked a shape into a trace" % retraces)
+    if sparse_per_step > 2:
+        raise SystemExit("bench: %.1f sparse dispatches/step > 2" %
+                         sparse_per_step)
+    compiled_w = np.asarray(kv._store[key]._data)
+
+    # -- parity arm: identical stream through the EAGER rsp path --------
+    _, kv_e, key_e, step_e = run(bucketed=False)
+    for i in range(args.warmup + steps):
+        step_e(i)
+    eager_w = np.asarray(kv_e._store[key_e]._data)
+    err = np.abs(compiled_w - eager_w).max() / max(
+        np.abs(eager_w).max(), 1e-12)
+    if err > 2e-5:
+        raise SystemExit("bench: compiled-vs-eager sparse training "
+                         "diverged (rel err %.2e > 2e-5)" % err)
+
+    hbm = telemetry.REGISTRY.get("embedding_hbm_bytes")
+    dev = jax.devices()[0]
+    return {
+        "metric": "dlrm_lookups_per_sec",
+        "value": round(B * F * steps / dt, 1),
+        "unit": "lookups/s",
+        "device_kind": dev.device_kind,
+        "dlrm_table_rows": F * V,
+        "dlrm_dim": D,
+        "dlrm_features": F,
+        "dlrm_batch": B,
+        "dlrm_steps": steps,
+        "dlrm_lookups_per_sec": round(B * F * steps / dt, 1),
+        "lookup_dispatches_per_step": round(lookup_per_step, 2),
+        "sparse_dispatches_per_step": round(sparse_per_step, 2),
+        "embedding_retraces": retraces,
+        "embedding_hbm_bytes": int(hbm.value),
+        "dlrm_parity_rel_err": float(err),
+        **_latency_fields(hist, compile_ms),
+    }
+
+
 def bench_fit(args):
     """Module-fit step witnesses: the single-launch fused fit step
     (module/fused_fit.py) vs the eager fwd_bwd + bucketed-kvstore pair
@@ -1390,7 +1515,7 @@ def main():
     ap.add_argument("--mode", type=str, default="train",
                     choices=["train", "inference", "serving", "checkpoint",
                              "kvstore", "kvstore-mh-worker",
-                             "fit", "decode"])
+                             "fit", "decode", "dlrm"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
     ap.add_argument("--layout", type=str, default="NHWC",
@@ -1464,6 +1589,15 @@ def main():
     ap.add_argument("--lm-d-model", type=int, default=2048)
     ap.add_argument("--lm-heads", type=int, default=16)
     ap.add_argument("--lm-vocab", type=int, default=16384)
+
+    ap.add_argument("--dlrm-vocab", type=int, default=4096,
+                    help="rows per categorical feature (the stacked "
+                         "table is dlrm-features * dlrm-vocab rows)")
+    ap.add_argument("--dlrm-dim", type=int, default=64)
+    ap.add_argument("--dlrm-features", type=int, default=8)
+    ap.add_argument("--dlrm-batch", type=int, default=128,
+                    help="batch * features must be a power of two "
+                         "(single-dispatch lookup)")
     args = ap.parse_args()
 
     if args.pipeline_scaling:
@@ -1477,6 +1611,9 @@ def main():
         return
     if args.mode == "kvstore-mh-worker":
         bench_kvstore_mh_worker(args)
+        return
+    if args.mode == "dlrm":
+        print(json.dumps(bench_dlrm(args)))
         return
     if args.mode == "fit":
         print(json.dumps(bench_fit(args)))

@@ -117,6 +117,7 @@ from . import decode
 from . import profiler
 from . import telemetry
 from . import checkpoint
+from . import embedding
 from . import kvstore_tpu
 from . import monitor
 from .monitor import Monitor

@@ -45,6 +45,8 @@ HOT_MODULES = (
     "mxnet_tpu/kvstore_tpu/engine.py",
     "mxnet_tpu/serving/replica.py",
     "mxnet_tpu/executor.py",
+    "mxnet_tpu/embedding/lookup.py",
+    "mxnet_tpu/embedding/engine.py",
 )
 
 # calls whose RESULT is a device value (basename match on methods,

@@ -1,0 +1,133 @@
+"""ShardedEmbedding: the gluon front end of the embedding subsystem.
+
+Differences from ``gluon.nn.Embedding`` (docs/EMBEDDING.md):
+
+* the table is looked up by the COMPILED lookup engine (lookup.py) —
+  one gather dispatch per forward, sharded over the local row mesh —
+  instead of riding the eager op tape;
+* the table is NOT differentiated through: each recorded forward marks
+  its output as an autograd leaf, so ``backward()`` deposits the dense
+  output gradient there and ``sparse_grad()`` reassembles it as a
+  row_sparse gradient (indices straight from the forward batch,
+  duplicates welcome — the kvstore engine coalesces in-program);
+* updates flow through ``kv.push`` (the compiled SparseApplyEngine when
+  the optimizer implements ``_fused_sparse_sig``), not the dense
+  Trainer. The weight Parameter is created with ``grad_req='null'`` so
+  a Trainer over ``collect_params()`` skips it; call ``sparse_push()``
+  after ``backward()`` instead.
+
+``attach_to_kvstore`` ALIASES the parameter storage to the kvstore's
+stored value: the engine updates the table in place (donated buffers),
+so the next forward reads fresh rows with zero pulls — the
+row_sparse_pull round trip is for explicit sharded-serving reads, not
+the training loop.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..gluon.block import Block
+from . import sharding as _sharding
+from . import lookup as _lookup
+
+__all__ = ["ShardedEmbedding"]
+
+
+class ShardedEmbedding(Block):
+    """Row-sharded embedding table with a compiled sparse grad path."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = int(input_dim)
+        self._output_dim = int(output_dim)
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(self._input_dim, self._output_dim),
+                dtype=dtype, init=weight_initializer,
+                grad_req="null", grad_stype="row_sparse")
+        self._tape = []        # (flat int32 indices, marked output)
+        self._kv = None
+        self._kv_key = None
+        self._placed = False
+
+    # -- forward --------------------------------------------------------
+    def forward(self, x):
+        from .. import autograd
+        w = self.weight.data()
+        if not self._placed:
+            w._set_data(_sharding.place_table(w._data))
+            self._placed = True
+        idx = _np.asarray(x._data if isinstance(x, NDArray) else x)
+        with autograd.pause():
+            out = NDArray(_lookup.lookup(w._data, idx), w.context)
+        if autograd.is_recording():
+            # leaf-mark the lookup output: backward stops here and the
+            # dense dy lands in out._grad, batch-sized — the huge table
+            # never joins the tape
+            out.attach_grad()
+            self._tape.append(
+                (idx.reshape(-1).astype(_np.int32), out))
+        return out
+
+    # -- sparse grad assembly -------------------------------------------
+    def sparse_grad(self):
+        """The row_sparse gradient of every recorded forward since the
+        last call (indices may repeat across and within batches — the
+        push path coalesces). None when nothing was recorded or no
+        backward has run."""
+        from ..ndarray.sparse import RowSparseNDArray
+        datas, idxs = [], []
+        for idx_flat, out in self._tape:
+            if out._grad is None:
+                continue
+            datas.append(out._grad._data.reshape(-1, self._output_dim))
+            idxs.append(idx_flat)
+        self._tape.clear()
+        if not datas:
+            return None
+        data = jnp.concatenate(datas) if len(datas) > 1 else datas[0]
+        idx = _np.concatenate(idxs) if len(idxs) > 1 else idxs[0]
+        w = self.weight.data()
+        return RowSparseNDArray(data, jnp.asarray(idx),
+                                (self._input_dim, self._output_dim),
+                                w.context)
+
+    # -- kvstore glue ----------------------------------------------------
+    def attach_to_kvstore(self, kv, key=None):
+        """Register the table with ``kv`` and alias the parameter to the
+        stored value so in-place engine updates are immediately visible
+        to the next forward."""
+        if self.weight._data is None:
+            raise MXNetError(
+                "initialize() the block before attach_to_kvstore")
+        key = key if key is not None else "embedding:%s" % self.weight.name
+        kv.init(key, self.weight.data())
+        stored = kv._store[key]
+        stored._set_data(_sharding.place_table(stored._data))
+        self.weight._data = stored
+        self._placed = True
+        self._kv, self._kv_key = kv, key
+        _sharding.account_bytes(key, stored._data.nbytes)
+        return key
+
+    def sparse_push(self, kv=None, key=None, priority=0):
+        """Push the recorded sparse gradient (compiled engine when the
+        optimizer is eligible; eager lazy update otherwise)."""
+        kv = kv if kv is not None else self._kv
+        key = key if key is not None else self._kv_key
+        if kv is None or key is None:
+            raise MXNetError(
+                "sparse_push needs attach_to_kvstore (or explicit "
+                "kv/key)")
+        grad = self.sparse_grad()
+        if grad is None:
+            return
+        kv.push(key, grad, priority=priority)
+
+    def __repr__(self):
+        return "ShardedEmbedding(%d -> %d)" % (self._input_dim,
+                                               self._output_dim)

@@ -1,0 +1,218 @@
+"""Sharded-table checkpointing: each rank persists its OWN row range.
+
+Rides the PR 7 multi-host protocol (checkpoint/manifest.py +
+checkpoint/multihost.py choreography), specialized for (vocab, dim)
+embedding tables whose full weight never fits one host at
+recommendation scale:
+
+1. every rank writes ``<prefix>-<tag>.embshard<r>`` — a crash-safe
+   (tmp + fsync + rename) pickle holding, for each table, THIS rank's
+   owned row range (sharding.row_range) of the weight, optimizer state,
+   and error-feedback residual, with explicit (lo, hi) bounds;
+2. ``dist.barrier`` — nobody publishes until every shard is durable;
+3. rank 0 publishes ``<prefix>-<tag>.emb.json`` listing every shard
+   file with its CRC — the single commit point.
+
+Because each shard records its absolute row bounds, ``load_tables``
+reassembles full tables under ANY world size — a W=8 checkpoint
+restores into a W=2 (or single-host) job, and any-host-can-die resume
+follows from the all-durable barrier. ``latest_tables`` walks tags
+newest-first and skips over checkpoints whose manifest or shard CRCs
+fail, the same corrupt-tag fallback the dense protocol gives
+(docs/CHECKPOINT.md). Dense parameters stay in the legacy single-file
+formats; only embedding tables go through this path.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..checkpoint import manifest as _manifest
+from . import sharding as _sharding
+
+__all__ = ["save_tables", "load_tables", "latest_tables", "list_table_tags"]
+
+_SHARD_FMT = "%s-%s.embshard%d"
+_MANIFEST_FMT = "%s-%s.emb.json"
+
+
+def _world():
+    from ..kvstore_tpu import dist
+    return dist.rank(), dist.world_size()
+
+
+def _as_np(arr):
+    if arr is None:
+        return None
+    if isinstance(arr, (tuple, list)):
+        return [_as_np(a) for a in arr]
+    return _np.asarray(arr._data if hasattr(arr, "_data") else arr)
+
+
+def save_tables(prefix, tag, tables, states=None, residuals=None):
+    """Checkpoint ``tables`` ({name: NDArray-or-jax (vocab, dim)}), with
+    optional parallel dicts of optimizer states and error-feedback
+    residuals. Collective in a multi-process world: every rank must
+    call with the same names and tag. Returns the manifest path (every
+    rank; only rank 0 wrote it)."""
+    rank, world = _world()
+    states = states or {}
+    residuals = residuals or {}
+    shard = {}
+    for name, table in tables.items():
+        host = _as_np(table)
+        rows, lo, hi = _sharding.owned_slice(host, rank, world)
+        st = _as_np(states.get(name))
+        res = _as_np(residuals.get(name))
+        shard[name] = {
+            "lo": lo, "hi": hi,
+            "shape": tuple(host.shape), "dtype": str(host.dtype),
+            "rows": _np.ascontiguousarray(rows),
+            "state": [ _np.ascontiguousarray(s[lo:hi]) for s in st ]
+                     if isinstance(st, list)
+                     else (_np.ascontiguousarray(st[lo:hi])
+                           if st is not None else None),
+            "residual": _np.ascontiguousarray(res[lo:hi])
+                        if res is not None else None,
+        }
+    shard_path = _SHARD_FMT % (prefix, tag, rank)
+    _manifest.atomic_write(shard_path, pickle.dumps(shard, protocol=4))
+
+    from ..kvstore_tpu import dist
+    if world > 1:
+        # all-durable barrier: the manifest below is the commit point,
+        # so it must not publish shards that are still in flight
+        dist.barrier("embckpt-shards")
+    manifest_path = _MANIFEST_FMT % (prefix, tag)
+    if rank == 0:
+        files = {}
+        for r in range(world):
+            p = _SHARD_FMT % (prefix, tag, r)
+            files[os.path.basename(p)] = {
+                "crc32": _manifest.crc32_file(p),
+                "bytes": os.path.getsize(p),
+            }
+        doc = {
+            "format": "mxnet_tpu-embedding-shards-v1",
+            "tag": str(tag),
+            "world": world,
+            "tables": {n: {"shape": list(s["shape"]),
+                           "dtype": s["dtype"]}
+                       for n, s in shard.items()},
+            "files": files,
+        }
+        _manifest.atomic_write(
+            manifest_path,
+            json.dumps(doc, indent=2, sort_keys=True).encode())
+    if world > 1:
+        dist.barrier("embckpt-commit")
+    return manifest_path
+
+
+def _validate(prefix, manifest_path):
+    try:
+        with open(manifest_path, "rb") as f:
+            doc = json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return None
+    if doc.get("format") != "mxnet_tpu-embedding-shards-v1":
+        return None
+    base = os.path.dirname(os.path.abspath(manifest_path))
+    for fname, meta in doc.get("files", {}).items():
+        path = os.path.join(base, fname)
+        try:
+            # crc32_file returns a (size, crc) tuple; JSON round-trips
+            # it as a list — normalize both sides before comparing
+            got = _manifest.crc32_file(path)
+            want = meta["crc32"]
+            got = list(got) if isinstance(got, (tuple, list)) else [got]
+            want = list(want) if isinstance(want, (tuple, list)) \
+                else [want]
+            if got != want:
+                return None
+        except OSError:
+            return None
+    return doc
+
+
+def list_table_tags(prefix):
+    """Tags with a published embedding manifest, oldest first (mtime
+    order, matching checkpoint/manifest.list_tags)."""
+    paths = glob.glob(_MANIFEST_FMT % (prefix, "*"))
+    paths.sort(key=lambda p: (os.path.getmtime(p), p))
+    tags = []
+    for p in paths:
+        suffix = p[len(prefix) + 1:]
+        tags.append(suffix[:-len(".emb.json")])
+    return tags
+
+
+def latest_tables(prefix):
+    """The newest tag whose manifest AND every shard validate, or None
+    — a torn/corrupt newest checkpoint falls back to the previous one
+    instead of failing resume."""
+    for tag in reversed(list_table_tags(prefix)):
+        if _validate(prefix, _MANIFEST_FMT % (prefix, tag)) is not None:
+            return tag
+    return None
+
+
+def load_tables(prefix, tag=None):
+    """Reassemble full tables from every shard of ``tag`` (default: the
+    newest valid tag). Returns {name: {"weight": np, "state":
+    np|list|None, "residual": np|None}}. World-size independent: row
+    bounds come from the shards, not from the current world."""
+    if tag is None:
+        tag = latest_tables(prefix)
+        if tag is None:
+            raise MXNetError(
+                "no valid embedding checkpoint under prefix %r" % prefix)
+    doc = _validate(prefix, _MANIFEST_FMT % (prefix, tag))
+    if doc is None:
+        raise MXNetError(
+            "embedding checkpoint %r tag %r is missing or corrupt"
+            % (prefix, tag))
+    out = {}
+    for name, meta in doc["tables"].items():
+        shape = tuple(meta["shape"])
+        out[name] = {
+            "weight": _np.zeros(shape, meta["dtype"]),
+            "state": None,
+            "residual": None,
+        }
+    for r in range(int(doc["world"])):
+        with open(_SHARD_FMT % (prefix, tag, r), "rb") as f:
+            shard = pickle.load(f)
+        for name, rec in shard.items():
+            dst = out[name]
+            lo, hi = rec["lo"], rec["hi"]
+            dst["weight"][lo:hi] = rec["rows"]
+            st = rec.get("state")
+            if st is not None:
+                if isinstance(st, list):
+                    if dst["state"] is None:
+                        dst["state"] = [
+                            _np.zeros((out[name]["weight"].shape[0],)
+                                      + s.shape[1:], s.dtype)
+                            for s in st]
+                    for d, s in zip(dst["state"], st):
+                        d[lo:hi] = s
+                else:
+                    if dst["state"] is None:
+                        dst["state"] = _np.zeros(
+                            (out[name]["weight"].shape[0],)
+                            + st.shape[1:], st.dtype)
+                    dst["state"][lo:hi] = st
+            res = rec.get("residual")
+            if res is not None:
+                if dst["residual"] is None:
+                    dst["residual"] = _np.zeros(
+                        (out[name]["weight"].shape[0],) + res.shape[1:],
+                        res.dtype)
+                dst["residual"][lo:hi] = res
+    return out

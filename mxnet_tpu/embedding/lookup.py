@@ -1,0 +1,116 @@
+"""Compiled embedding lookup: ONE gather program per step.
+
+The hot path (docs/EMBEDDING.md):
+
+* index batches arrive with any shape/values; the flattened indices pad
+  to the next power of two and ride as a RUNTIME argument, so ragged
+  batches re-use the cached program — zero steady-state retraces, the
+  same discipline as the serving bucket ladder (mx.decode);
+* the program is one ``jnp.take``; under the local row mesh
+  (sharding.py) the gather carries a sharding constraint and GSPMD
+  lowers it to gather -> all-to-all/psum over ICI. Padding slots use the
+  sentinel index ``vocab`` with ``mode='fill', fill_value=0`` — NOT
+  clip: a clipped sentinel would fetch (and on the grad path corrupt)
+  the last real row, the PR 6 paged-KV lesson;
+* cache key: (vocab, dim, dtype, padded length, mesh size). Index
+  VALUES never key anything.
+
+``lookup()`` is the single entry for the gluon block and the symbol op,
+so eager and compiled callers share one program cache.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import telemetry as _telemetry
+from . import sharding as _sharding
+
+__all__ = ["lookup", "pad_length", "LOOKUPS", "LOOKUP_RETRACES"]
+
+# one increment per compiled-lookup dispatch; with
+# embedding_sparse_dispatches this is the numerator of the bench's
+# sparse_dispatches_per_step witness (docs/OBSERVABILITY.md)
+LOOKUPS = _telemetry.REGISTRY.counter(
+    "embedding_lookups",
+    "compiled embedding lookup dispatches", vital=True)
+# trace-time-only witness: flat in the steady state across ragged
+# index batches (pinned by tests/test_embedding.py)
+LOOKUP_RETRACES = _telemetry.REGISTRY.counter(
+    "embedding_lookup_retraces",
+    "embedding lookup program (re)traces", vital=True)
+
+_SITE = _telemetry.RetraceSite(LOOKUP_RETRACES, _telemetry.JIT_COMPILE_MS,
+                               site="embedding_lookup")
+
+_LOCK = threading.Lock()
+_PROGRAMS = {}           # cache key -> jitted fn   (guarded by _LOCK)
+
+
+def pad_length(n):
+    """Next power of two >= n (>= 1): the ladder that keeps ragged
+    batches on cached programs."""
+    cap = 1
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _build(mesh):
+    @jax.jit
+    def _lookup(w, idx):
+        _SITE.note()
+        if mesh is not None:
+            w = jax.lax.with_sharding_constraint(
+                w, _sharding.table_sharding(mesh))
+        # sentinel=vocab padding drops to zeros via fill, never row V-1
+        return jnp.take(w, idx, axis=0, mode="fill", fill_value=0)
+
+    return _lookup
+
+
+def lookup(weight_jax, idx_host, out_shape=None):
+    """Gather rows ``idx_host`` (any-shape int array-like) from the
+    (vocab, dim) table ``weight_jax``. Returns a jax array shaped
+    ``idx.shape + (dim,)`` (or ``out_shape`` when given).
+
+    One compiled dispatch when the flat length is already a power of
+    two; otherwise the unpad slice adds a second (cheap, shape-keyed)
+    device op — size batches pow-2 to stay at one (docs/EMBEDDING.md).
+    """
+    vocab, _dim = weight_jax.shape
+    idx = _np.asarray(idx_host)  # analyze: ok(hostsync) indices arrive on host by contract (data pipeline output)
+    flat = idx.reshape(-1).astype(_np.int32)
+    n = flat.shape[0]
+    cap = pad_length(max(n, 1))
+    if cap != n:
+        flat = _np.concatenate(
+            [flat, _np.full(cap - n, vocab, _np.int32)])
+    mesh = _sharding.local_mesh()
+    if mesh is not None and (mesh.size <= 1 or vocab % mesh.size):
+        mesh = None
+    # mesh is part of the cache key (jax.sharding.Mesh hashes by
+    # devices+axis names), so a changed mesh never reuses a stale program
+    key = (int(vocab), int(_dim), str(weight_jax.dtype), cap, mesh)
+    with _LOCK:
+        fn = _PROGRAMS.get(key)
+        if fn is None:
+            fn = _PROGRAMS[key] = _build(mesh)
+    from ..executor import _count_dispatch
+    _count_dispatch()
+    LOOKUPS.inc()
+    out = _SITE.timed(fn, weight_jax, jnp.asarray(flat))
+    if mesh is not None:
+        # the (n, dim) result is small next to the table: land it on the
+        # default device so eager consumers (the dense tower, autograd)
+        # never mix an 8-device output with single-device arrays — the
+        # GSPMD win is the table-side gather, not the result placement
+        out = jax.device_put(out, jax.devices()[0])
+    if cap != n:
+        out = out[:n]
+    shape = tuple(idx.shape) + (weight_jax.shape[1],) \
+        if out_shape is None else tuple(out_shape)
+    return out.reshape(shape)

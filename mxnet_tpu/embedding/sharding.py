@@ -1,0 +1,111 @@
+"""Row-partition math and device placement for sharded embedding tables.
+
+One embedding table of ``vocab`` rows is row-partitioned two ways at
+once (docs/EMBEDDING.md):
+
+* **across devices** (layer 5): when the process sees more than one XLA
+  device, the table is laid out over a 1-D ``Mesh`` with axis ``"row"``
+  (``NamedSharding((row, None))``) so a compiled lookup lowers to
+  gather -> all-to-all/psum over ICI under GSPMD. With one device (the
+  CPU test container) placement is the identity and the same programs
+  run unsharded.
+* **across processes** (layer 6, the ``kvstore='tpu'`` world from
+  kvstore_tpu/dist.py): each rank OWNS the contiguous row range
+  ``row_range(vocab, rank, world)`` for checkpoint-writing purposes
+  (embedding/checkpoint.py: each rank persists its range; any host can
+  die). On the CPU multi-process worlds the table itself stays
+  replicated-deterministic — every rank applies the identical globally
+  coalesced update (engine.py), the same invariant the dense host
+  transport keeps for flat buckets.
+
+The split matters: device sharding is a *placement* concern the
+compiled programs see; process ownership is a *durability* concern only
+the checkpoint path sees. Neither leaks into the other's cache keys.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+from .. import telemetry as _telemetry
+
+__all__ = ["row_range", "owned_slice", "local_mesh", "table_sharding",
+           "place_table", "account_bytes", "EMBED_HBM"]
+
+# table + optimizer state + error-feedback residual bytes currently
+# resident for embedding tables, summed over registered keys
+# (docs/OBSERVABILITY.md); recsys capacity planning reads this gauge
+EMBED_HBM = _telemetry.REGISTRY.gauge(
+    "embedding_hbm_bytes",
+    "bytes resident for embedding tables (weights + optimizer state + "
+    "residuals), summed over tables", unit="bytes")
+
+_LOCK = threading.Lock()
+_MESH_CACHE = {}          # n_devices -> Mesh   (guarded by _LOCK)
+_HBM_BY_KEY = {}          # key -> bytes        (guarded by _LOCK)
+
+
+def row_range(vocab, rank, world):
+    """The contiguous row range rank ``rank`` owns: ceil-partitioned so
+    every rank owns rows (the final rank's range may be short or empty
+    when ``world`` does not divide ``vocab``)."""
+    if world <= 0:
+        raise ValueError("world must be positive")
+    per = -(-int(vocab) // int(world))      # ceil division
+    lo = min(int(vocab), int(rank) * per)
+    hi = min(int(vocab), lo + per)
+    return lo, hi
+
+
+def owned_slice(host_array, rank, world):
+    """``host_array[lo:hi]`` for this rank's owned row range."""
+    lo, hi = row_range(host_array.shape[0], rank, world)
+    return host_array[lo:hi], lo, hi
+
+
+def local_mesh():
+    """The process-local 1-D row mesh, or None when a single device (or
+    a multi-process world, where cross-device layout is the kvstore
+    transport's concern) makes sharding a no-op."""
+    from ..kvstore_tpu import dist
+    if dist.world_size() > 1:
+        return None
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    with _LOCK:
+        mesh = _MESH_CACHE.get(len(devs))
+        if mesh is None:
+            mesh = jax.sharding.Mesh(_np.asarray(devs), ("row",))
+            _MESH_CACHE[len(devs)] = mesh
+        return mesh
+
+
+def table_sharding(mesh):
+    """NamedSharding for a (vocab, dim) table: rows over the mesh."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("row", None))
+
+
+def place_table(arr):
+    """Lay a (vocab, dim) jax array out over the local row mesh (the
+    identity when there is no mesh or the vocab does not divide)."""
+    mesh = local_mesh()
+    if mesh is None or arr.ndim < 2:
+        return arr
+    if arr.shape[0] % mesh.size != 0:
+        return arr      # GSPMD wants even row tiles; lookup still works
+    return jax.device_put(arr, table_sharding(mesh))
+
+
+def account_bytes(key, nbytes):
+    """Record ``key``'s resident embedding bytes (replaces any previous
+    figure for the key) and refresh the ``embedding_hbm_bytes`` gauge."""
+    with _LOCK:
+        if nbytes:
+            _HBM_BY_KEY[key] = int(nbytes)
+        else:
+            _HBM_BY_KEY.pop(key, None)
+        EMBED_HBM.set(sum(_HBM_BY_KEY.values()))

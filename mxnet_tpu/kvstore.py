@@ -110,6 +110,10 @@ class KVStore:
         self._async_push = os.environ.get(
             "MXNET_KVSTORE_ASYNC_PUSH", "0") == "1"
         self._engine = None
+        # compiled row_sparse push path (embedding/engine.py,
+        # docs/EMBEDDING.md); shares the bucketing toggle — both are
+        # "the compiled hot path" from the operator's point of view
+        self._sparse_engine = None
 
     @property
     def type(self):
@@ -153,10 +157,27 @@ class KVStore:
             prios = list(priority)
         else:
             prios = [priority] * len(keys)
+        from .ndarray.sparse import RowSparseNDArray
         with _telemetry.tracing.span("kvstore.push", keys=len(keys)):
             eng = self._get_engine()
             mode = eng._updater_mode() if eng is not None else False
             for k, vlist, prio in zip(keys, values, prios):
+                if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                    # row_sparse gradients get their own compiled path
+                    # (one dedup->compress->reduce->apply program per
+                    # table); ineligible pushes fall back eager under a
+                    # NARROW reason slug — "unsupported optimizer" and
+                    # "ineligible dtype" warn separately
+                    seng = self._get_sparse_engine()
+                    sreason = seng.ineligible_reason(k, vlist) \
+                        if seng is not None else None
+                    if seng is not None and sreason is None:
+                        seng.push(k, vlist, prio)
+                    else:
+                        if seng is not None:
+                            _note_fallback(sreason, detail="key %r" % (k,))
+                        self._push_one(k, vlist)
+                    continue
                 reason = eng.ineligible_reason(k, vlist, mode) \
                     if eng is not None else None
                 if eng is not None and reason is None:
@@ -171,9 +192,22 @@ class KVStore:
     def _push_one(self, k, vlist):
         """Eager per-key push (the reference shape; also the fallback for
         sparse values, custom updaters, and non-fusable optimizers)."""
-        if self._compression is not None:
+        from .ndarray.sparse import RowSparseNDArray, _coalesce_rsp
+        all_rsp = all(isinstance(v, RowSparseNDArray) for v in vlist)
+        if self._compression is not None and not all_rsp:
             vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
         reduced = self._local_reduce(vlist)
+        if isinstance(reduced, RowSparseNDArray):
+            if len(vlist) == 1:
+                # single-stream pushes skip _local_reduce's coalesce;
+                # duplicate indices MUST merge before the lazy updates —
+                # their set-semantics row scatter would otherwise keep
+                # only the last duplicate's contribution
+                reduced = _coalesce_rsp(reduced._sp_data,
+                                        reduced._sp_indices,
+                                        reduced.shape, reduced.context)
+            if self._compression is not None:
+                reduced = self._compress_rsp(k, reduced)
         if self._updater is not None:
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
@@ -189,6 +223,20 @@ class KVStore:
             self._engine = FusedBucketEngine(self)
         return self._engine
 
+    def _get_sparse_engine(self):
+        if not self._bucketed:
+            return None
+        if self._sparse_engine is None:
+            from .embedding.engine import SparseApplyEngine
+            self._sparse_engine = SparseApplyEngine(
+                self, cross_host=self._sparse_cross_host())
+        return self._sparse_engine
+
+    def _sparse_cross_host(self):
+        """Whether the sparse engine must reduce across processes (the
+        collective store overrides to True)."""
+        return False
+
     def _flush_pending(self):
         if self._engine is not None:
             self._engine.flush()
@@ -198,10 +246,14 @@ class KVStore:
         error-feedback residuals back to the per-key dict. Every entry
         point that changes push routing (bucketing toggle, updater,
         compression config) must call this FIRST — in this order — or
-        the engine dispatches stale-mode buckets / strands residuals."""
+        the engine dispatches stale-mode buckets / strands residuals.
+        The sparse engine dispatches eagerly (nothing pending) but owns
+        per-table residuals the same way; spill those too."""
         self._flush_pending()
         if self._engine is not None:
             self._engine.spill_residuals()
+        if self._sparse_engine is not None:
+            self._sparse_engine.spill_residuals()
 
     def set_bucketing(self, enabled):
         """Toggle the compiled bucketed hot path (docs/KVSTORE.md);
@@ -264,9 +316,23 @@ class KVStore:
             for o in olist:
                 rids = rid_list[i]
                 i += 1
+                rid_host = rids.asnumpy().reshape(-1).astype(_np.int64)
+                if rid_host.size and (rid_host.min() < 0
+                                      or rid_host.max() >= src.shape[0]):
+                    # a silent device gather would CLAMP out-of-range ids
+                    # onto row 0 / row V-1 and hand back the wrong rows
+                    raise MXNetError(
+                        "row_sparse_pull: row_ids out of range [0, %d)"
+                        % src.shape[0])
                 if isinstance(o, RowSparseNDArray):
-                    rows = jnp.asarray(_np.unique(
-                        rids.asnumpy().astype(_np.int64)))
+                    if tuple(o.shape) != tuple(src.shape):
+                        raise MXNetError(
+                            "row_sparse_pull: out shape %s != stored %s"
+                            % (o.shape, src.shape))
+                    # duplicates dedup; int32 on device (sparse.py
+                    # contract); empty row_ids -> a valid empty rsp
+                    rows = jnp.asarray(
+                        _np.unique(rid_host).astype(_np.int32))
                     o._sp_data = src._data[rows]
                     o._sp_indices = rows
                     o._dense_cache = None
@@ -322,6 +388,26 @@ class KVStore:
             grad._data, residual._data)
         residual._set_data(new_residual)
         return NDArray(out, grad.context)
+
+    def _compress_rsp(self, key, grad):
+        """Row-wise 2-bit compression for a COALESCED row_sparse grad:
+        quantize only the touched rows against a table-shaped residual
+        keyed ``(key, 'rsp')`` — per process, not per device (the wire
+        the compression exists for is the cross-host hop). Same op
+        sequence as the compiled sparse program (embedding/engine.py),
+        which makes this the bit-for-bit parity oracle for it; untouched
+        rows' residuals are carried, not re-emitted — the documented
+        semantic difference from dense 2-bit (docs/EMBEDDING.md)."""
+        from .ndarray.sparse import RowSparseNDArray
+        from .kvstore_fused import two_bit_quantize
+        residual = self._get_residual((key, "rsp"), grad)
+        rows = grad._sp_indices
+        res_rows = residual._data[rows]
+        q, new_rows = two_bit_quantize(
+            res_rows, grad._sp_data.astype(jnp.float32),
+            self._compression.threshold)
+        residual._set_data(residual._data.at[rows].set(new_rows))
+        return RowSparseNDArray(q, rows, grad.shape, grad.context)
 
     def barrier(self):
         self._flush_pending()

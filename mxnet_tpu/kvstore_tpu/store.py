@@ -93,9 +93,22 @@ class KVStoreTPU(KVStore):
         ineligible keys keep dist_sync semantics."""
         if self._nproc == 1:
             return super()._push_one(k, vlist)
-        if self._compression is not None:
+        from .. import ndarray as _nd
+        all_rsp = all(isinstance(v, _nd.sparse.RowSparseNDArray)
+                      for v in vlist)
+        if self._compression is not None and not all_rsp:
             vlist = [self._compress(k, i, v) for i, v in enumerate(vlist)]
         reduced = self._local_reduce(vlist)
+        if isinstance(reduced, _nd.sparse.RowSparseNDArray):
+            if len(vlist) == 1:
+                reduced = _nd.sparse._coalesce_rsp(
+                    reduced._sp_data, reduced._sp_indices,
+                    reduced.shape, reduced.context)
+            if self._compression is not None:
+                reduced = self._compress_rsp(k, reduced)
+            # the rank-order wire below is dense; ineligible sparse keys
+            # (this fallback) pay densification, eligible ones never land
+            # here — SparseApplyEngine(cross_host=True) ships rows only
         from .engine import CROSSHOST_BYTES
         local = _np.ascontiguousarray(reduced.asnumpy())
         CROSSHOST_BYTES.inc(local.nbytes)
@@ -107,6 +120,11 @@ class KVStoreTPU(KVStore):
             self._updater(_updater_key(k), reduced, self._store[k])
         else:
             self._store[k] = reduced
+
+    def _sparse_cross_host(self):
+        # the compiled sparse pipeline must reduce across hosts before
+        # applying, not just across local devices
+        return self._nproc > 1
 
     def barrier(self):
         self._flush_pending()

@@ -137,7 +137,11 @@ class RowSparseNDArray(BaseSparseNDArray):
         dense = jnp.zeros(self._sp_shape, self._sp_data.dtype)
         if self._sp_data.shape[0] == 0:
             return dense
-        return dense.at[self._sp_indices].set(self._sp_data)
+        # additive scatter: identical to set-semantics for canonical
+        # (unique-index) arrays, and SUMS duplicate indices — matching
+        # how every reduce/coalesce path treats them (a `.set` here
+        # silently kept only the last duplicate's rows)
+        return dense.at[self._sp_indices].add(self._sp_data)
 
     def _set_from_dense(self, dense):
         if tuple(dense.shape) != self._sp_shape:
@@ -179,6 +183,10 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
+            if tuple(other._sp_shape) != tuple(self._sp_shape):
+                raise MXNetError(
+                    "add(rsp, rsp) shape mismatch %s vs %s"
+                    % (self._sp_shape, other._sp_shape))
             idx = jnp.concatenate([self._sp_indices, other._sp_indices])
             dat = jnp.concatenate([self._sp_data, other._sp_data])
             return _coalesce_rsp(dat, idx, self._sp_shape, self._ctx)
@@ -481,3 +489,38 @@ def sparse_adagrad_update(weight, grad, state, lr, epsilon=1e-7, wd=0.0,
     state._set_data(h.at[rows].set(new_hr.astype(h.dtype)))
     new_wr = wr - lr * (g / jnp.sqrt(new_hr + epsilon) + wd * wr)
     weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
+
+
+def sparse_group_adagrad_update(weight, grad, state, lr, epsilon=1e-5,
+                                rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-wise AdaGrad on the gradient's rows only (reference
+    contrib group_adagrad_op.cc GroupAdagradUpdateRspRspRspImpl): ONE
+    history cell per row — ``state`` is (vocab, 1) — and no weight
+    decay. The compiled sparse-apply program (embedding/engine.py)
+    replays this exact op sequence, so this function is its bit-for-bit
+    parity oracle."""
+    rows, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    wr = w[rows].astype(jnp.float32)
+    h = state._data
+    new_hr = h[rows] + jnp.mean(jnp.square(g), axis=1, keepdims=True)
+    state._set_data(h.at[rows].set(new_hr.astype(h.dtype)))
+    new_wr = wr - lr * g / jnp.sqrt(new_hr + epsilon)
+    weight._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
+
+
+def group_adagrad_update(weight, grad, state, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """Dense GroupAdaGrad (reference _contrib_group_adagrad_update):
+    the same row-wise history on every row. 2-D weights only — the
+    row-wise reduction is defined over the embedding dim."""
+    if len(weight.shape) != 2:
+        raise MXNetError("group_adagrad_update expects 2-D weights "
+                         "(got %s)" % (weight.shape,))
+    g = grad._data.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h = state._data + jnp.mean(jnp.square(g), axis=1, keepdims=True)
+    state._set_data(h)
+    w = weight._data.astype(jnp.float32) - lr * g / jnp.sqrt(h + epsilon)
+    weight._set_data(w.astype(weight.dtype))

@@ -1027,6 +1027,33 @@ def embedding(data, weight, *, input_dim, output_dim, dtype="float32",
     return jnp.take(weight, idx, axis=0, mode="clip")
 
 
+@register("_contrib_ShardedEmbedding")
+def sharded_embedding(data, weight, *, input_dim, output_dim,
+                      dtype="float32", sparse_grad=True):
+    """Symbol twin of embedding.ShardedEmbedding: the same gather, but
+    out-of-range ids yield zero rows via the sentinel fill instead of
+    Embedding's clamp — ids >= input_dim must not silently train row
+    input_dim-1. Row sharding follows the WEIGHT's placement: a
+    concrete table already placed on the local mesh (place_table) keeps
+    its row sharding re-asserted here; inside an executor trace the
+    graph's bind-device commitment governs (the executor is a
+    single-device program — forcing the mesh onto its dev0-committed
+    args would not compile), and GSPMD propagates any argument sharding
+    on mesh-compiled callers."""
+    from ..embedding import sharding as _esh
+    mesh = _esh.local_mesh()
+    if (mesh is not None and weight.shape[0] % mesh.devices.size == 0
+            and not isinstance(weight, jax.core.Tracer)
+            and isinstance(weight, jax.Array)
+            and len(weight.sharding.device_set) > 1):
+        weight = jax.lax.with_sharding_constraint(
+            weight, _esh.table_sharding(mesh))
+    idx = data.astype("int32")
+    oob = jnp.logical_or(idx < 0, idx >= int(input_dim))
+    idx = jnp.where(oob, int(input_dim), idx)
+    return jnp.take(weight, idx, axis=0, mode="fill", fill_value=0)
+
+
 @register("Correlation")
 def correlation(data1, data2, *, kernel_size=1, max_displacement=1, stride1=1,
                 stride2=1, pad_size=0, is_multiply=True):

@@ -144,6 +144,8 @@ _set("InstanceNorm", lambda known, attrs: (
 
 _set("Embedding", lambda known, attrs: {
     "weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))})
+_set("_contrib_ShardedEmbedding", lambda known, attrs: {
+    "weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))})
 
 
 def _leaky_shapes(known, attrs):

@@ -119,6 +119,16 @@ class Optimizer:
         argument in both, so ragged batches never retrace)."""
         return self._fused_bucket_sig()
 
+    def _fused_sparse_sig(self):
+        """Signature enabling the kvstore compiled row_sparse path
+        (embedding/engine.py, docs/EMBEDDING.md): a hashable
+        ``(kind, hyper, clip)`` tuple fully determining the pure lazy
+        per-row apply, or None to keep sparse pushes on the eager
+        per-key path. lr/wd/rescale_grad ride as runtime scalars (like
+        the dense bucket programs), so schedule steps never retrace;
+        the tuple keys the per-table program cache."""
+        return None
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
             inner_state, weight32 = state
@@ -212,6 +222,13 @@ class SGD(Optimizer):
         # rescale_grad is NOT part of the signature: gluon Trainer.step
         # rewrites it every call (scale/batch_size), so it rides along as
         # a runtime scalar — a ragged final batch must not retrace
+        return ("sgd", float(self.momentum),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_sparse_sig(self):
+        if self.multi_precision or not self.lazy_update:
+            return None     # mp tuples / dense semantics stay eager
         return ("sgd", float(self.momentum),
                 None if self.clip_gradient is None
                 else float(self.clip_gradient))
@@ -373,6 +390,13 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype="float32")
 
+    def _fused_sparse_sig(self):
+        if self.multi_precision:
+            return None
+        return ("adagrad", float(self.float_stable_eps),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -385,6 +409,48 @@ class AdaGrad(Optimizer):
             adagrad_update(weight, grad, state, out=weight, lr=lr, wd=wd,
                            epsilon=self.float_stable_eps,
                            **self._common_kwargs(index))
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Row-wise AdaGrad (reference contrib.GroupAdaGrad,
+    group_adagrad_op.cc): ONE adaptive-lr cell per table row —
+    ``history += mean(grad^2, axis=1)`` — so the state for a
+    (vocab, dim) embedding table is (vocab, 1), a dim-fold smaller than
+    AdaGrad's. The recsys default for sharded embedding tables
+    (docs/EMBEDDING.md). Like the reference, weight decay is not
+    supported (the row-wise denominator makes decoupled wd ill-posed);
+    a nonzero ``wd`` raises."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if self.wd != 0.0:
+            raise MXNetError("GroupAdaGrad does not support weight decay "
+                             "(reference contrib.GroupAdaGrad)")
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros((weight.shape[0], 1), weight.context, dtype="float32")
+
+    def _fused_sparse_sig(self):
+        if self.multi_precision:
+            return None
+        return ("group_adagrad", float(self.float_stable_eps),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        from .ndarray import sparse as _sp
+        if getattr(grad, "stype", "default") == "row_sparse":
+            _sp.sparse_group_adagrad_update(
+                weight, grad, state, lr, epsilon=self.float_stable_eps,
+                **self._common_kwargs(index))
+        else:
+            _sp.group_adagrad_update(
+                weight, grad, state, lr, epsilon=self.float_stable_eps,
+                **self._common_kwargs(index))
 
 
 @register

@@ -1,0 +1,346 @@
+"""mx.embedding: device-sharded tables + the compiled row_sparse
+gradient pipeline (docs/EMBEDDING.md).
+
+The load-bearing pins:
+
+* the compiled sparse push trains IDENTICALLY (rtol 2e-5, usually
+  ~1e-7) to the eager lazy updates in ndarray/sparse.py — for SGD,
+  SGD+momentum, AdaGrad and GroupAdaGrad, with and without 2-bit
+  compression (error-feedback residuals included);
+* ragged index batches and ragged gradient nnz counts hit CACHED
+  programs — the zero-steady-state-retrace witnesses;
+* ineligible pushes fall back eager under NARROW reason slugs;
+* sharded-table checkpoints round-trip and fall back past a corrupt
+  shard.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.embedding import (ShardedEmbedding, lookup_rows,
+                                 save_tables, load_tables, latest_tables)
+from mxnet_tpu.embedding.lookup import LOOKUP_RETRACES
+from mxnet_tpu.embedding.engine import SPARSE_RETRACES
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+V, D = 16, 4
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_programs():
+    """Drop this module's compiled executables (and jax's jit caches)
+    when the module finishes. The sparse/lookup program caches pin one
+    executable per (sig, caps, ...) combination; on the long single-
+    process tier-1 run that marginal code-memory, on top of everything
+    compiled before, pushes a later XLA CPU compile over a native
+    limit (deterministic segfault in backend_compile). Later tests
+    recompile what they need."""
+    yield
+    import jax
+    from mxnet_tpu.embedding import lookup as _lk
+    with _lk._LOCK:
+        _lk._PROGRAMS.clear()
+    # engine program caches are per-SparseApplyEngine instance and die
+    # with their test-local kvstores; the C++ executables live in jax's
+    # global caches until this drops them
+    jax.clear_caches()
+
+
+# ----------------------------------------------------------------------
+# lookup
+# ----------------------------------------------------------------------
+def test_lookup_matches_numpy_gather():
+    import jax.numpy as jnp
+    w = jnp.asarray(np.random.RandomState(0).randn(V, D).astype(np.float32))
+    idx = np.array([[0, 7, 15], [3, 3, 1]], np.int64)
+    out = np.asarray(lookup_rows(w, idx))
+    np.testing.assert_array_equal(out, np.asarray(w)[idx])
+
+
+def test_lookup_zero_retrace_across_ragged_batches():
+    """Ragged index batches (different lengths, shapes, values) must
+    reuse cached programs: values are runtime args, lengths pad to the
+    next power of two."""
+    import jax.numpy as jnp
+    w = jnp.asarray(np.random.RandomState(1).randn(V, D).astype(np.float32))
+    rng = np.random.RandomState(2)
+    # warm every capacity this test will touch (4, 8 and 16)
+    lookup_rows(w, rng.randint(0, V, size=3))
+    lookup_rows(w, rng.randint(0, V, size=5))
+    lookup_rows(w, rng.randint(0, V, size=12))
+    r0 = LOOKUP_RETRACES.value
+    for n in (5, 7, 8, 12, 16, 3):
+        idx = rng.randint(0, V, size=n)
+        np.testing.assert_array_equal(
+            np.asarray(lookup_rows(w, idx)), np.asarray(w)[idx])
+    idx = rng.randint(0, V, size=(2, 4))          # ragged SHAPE too
+    np.testing.assert_array_equal(
+        np.asarray(lookup_rows(w, idx)), np.asarray(w)[idx])
+    assert LOOKUP_RETRACES.value == r0, "ragged batch retraced"
+
+
+def test_sharded_lookup_on_virtual_mesh():
+    """vocab divisible by the 8 virtual CPU devices: the table places
+    over the row mesh and the gather still returns the right rows."""
+    from mxnet_tpu.embedding import place_table, local_mesh
+    import jax.numpy as jnp
+    vocab = 64                                     # 8 rows per device
+    w = place_table(jnp.asarray(
+        np.random.RandomState(3).randn(vocab, D).astype(np.float32)))
+    mesh = local_mesh()
+    if mesh is not None:
+        assert vocab % mesh.size == 0
+    idx = np.array([0, 8, 17, 63, 63], np.int64)
+    np.testing.assert_array_equal(
+        np.asarray(lookup_rows(w, idx)), np.asarray(w)[idx])
+
+
+# ----------------------------------------------------------------------
+# compiled vs eager parity
+# ----------------------------------------------------------------------
+def _grad_stream(rng, steps, streams=1):
+    """Ragged nnz, duplicate indices, occasional empty-ish batches."""
+    out = []
+    for s in range(steps):
+        vlist = []
+        for _ in range(streams):
+            n = int(rng.randint(1, 9))
+            rows = rng.randint(0, V, size=n).astype(np.int64)
+            data = rng.normal(0, 1, (n, D)).astype(np.float32)
+            vlist.append(nd.sparse.row_sparse_array(
+                (data, rows), shape=(V, D)))
+        out.append(vlist)
+    return out
+
+
+def _make_opt(name):
+    if name == "sgd":
+        return mx.optimizer.SGD(learning_rate=0.1, lazy_update=True,
+                                rescale_grad=0.5)
+    if name == "sgd_mom":
+        return mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                lazy_update=True, rescale_grad=0.5)
+    if name == "sgd_wd_clip":
+        return mx.optimizer.SGD(learning_rate=0.1, wd=0.01,
+                                clip_gradient=0.4, lazy_update=True,
+                                rescale_grad=0.5)
+    if name == "adagrad":
+        return mx.optimizer.AdaGrad(learning_rate=0.1, rescale_grad=0.5)
+    if name == "group_adagrad":
+        return mx.optimizer.GroupAdaGrad(learning_rate=0.1,
+                                         rescale_grad=0.5)
+    raise AssertionError(name)
+
+
+def _run_arm(opt_name, bucketed, compress, streams=1, steps=3):
+    kv = mx.kv.create("local")
+    kv.set_bucketing(bucketed)
+    if compress:
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.3})
+    kv.set_optimizer(_make_opt(opt_name))
+    w0 = np.random.RandomState(7).randn(V, D).astype(np.float32)
+    kv.init("t", nd.array(w0))
+    rng = np.random.RandomState(11)
+    for vlist in _grad_stream(rng, steps, streams):
+        kv.push("t", [vlist] if streams > 1 else vlist[0])
+    kv._sync_engine()
+    out = nd.zeros((V, D))
+    kv.pull("t", out=out)
+    from mxnet_tpu.kvstore import _updater_key
+    st = kv._updater.states.get(_updater_key("t"))
+    st = None if st is None else (
+        None if st is None else np.asarray(st._data)
+        if not isinstance(st, (tuple, list))
+        else [np.asarray(s._data) for s in st if s is not None])
+    res = kv._compression_residuals.get(("t", "rsp"))
+    return (out.asnumpy(), st,
+            None if res is None else np.asarray(res._data))
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "sgd_mom", "sgd_wd_clip",
+                                      "adagrad", "group_adagrad"])
+def test_compiled_push_matches_eager_sparse(opt_name):
+    w_c, st_c, _ = _run_arm(opt_name, bucketed=True, compress=False)
+    w_e, st_e, _ = _run_arm(opt_name, bucketed=False, compress=False)
+    np.testing.assert_allclose(w_c, w_e, rtol=2e-5, atol=1e-7)
+    if st_e is not None and not isinstance(st_e, list):
+        np.testing.assert_allclose(st_c, st_e, rtol=2e-5, atol=1e-7)
+
+
+def test_compiled_push_2bit_parity_and_residuals():
+    """2-bit compressed sparse training: same table AND same
+    error-feedback residual as the eager rsp compression path — the
+    residual is training state, divergence compounds."""
+    w_c, _, res_c = _run_arm("sgd", bucketed=True, compress=True)
+    w_e, _, res_e = _run_arm("sgd", bucketed=False, compress=True)
+    np.testing.assert_allclose(w_c, w_e, rtol=2e-5, atol=1e-7)
+    assert res_c is not None and res_e is not None
+    np.testing.assert_allclose(res_c, res_e, rtol=2e-5, atol=1e-7)
+
+
+def test_compiled_push_matches_eager_dense_on_densified_grads():
+    """With wd=0 and no momentum a dense update moves untouched rows by
+    exactly zero, so the compiled LAZY path must equal an eager DENSE
+    push of the densified gradients (the acceptance parity)."""
+    kv = mx.kv.create("local")
+    kv.set_bucketing(True)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                      rescale_grad=0.5))
+    kvd = mx.kv.create("local")
+    kvd.set_bucketing(False)
+    kvd.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, lazy_update=False,
+                                       rescale_grad=0.5))
+    w0 = np.random.RandomState(7).randn(V, D).astype(np.float32)
+    kv.init("t", nd.array(w0))
+    kvd.init("t", nd.array(w0))
+    rng = np.random.RandomState(13)
+    for vlist in _grad_stream(rng, 3):
+        kv.push("t", vlist[0])
+        kvd.push("t", nd.array(vlist[0].tostype("default").asnumpy()))
+    kv._sync_engine()
+    a, b = nd.zeros((V, D)), nd.zeros((V, D))
+    kv.pull("t", out=a)
+    kvd.pull("t", out=b)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                               rtol=2e-5, atol=1e-7)
+
+
+def test_sparse_zero_retrace_across_ragged_nnz():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(_make_opt("sgd"))
+    kv.init("t", nd.array(np.zeros((V, D), np.float32)))
+    rng = np.random.RandomState(17)
+
+    def push(n):
+        rows = rng.randint(0, V, size=n).astype(np.int64)
+        kv.push("t", nd.sparse.row_sparse_array(
+            (np.ones((n, D), np.float32), rows), shape=(V, D)))
+
+    push(5)                                        # warm cap 8
+    r0 = SPARSE_RETRACES.value
+    for n in (6, 8, 7, 5):                         # all pad to cap 8
+        push(n)
+    assert SPARSE_RETRACES.value == r0, "ragged nnz retraced"
+
+
+# ----------------------------------------------------------------------
+# fallback slugs
+# ----------------------------------------------------------------------
+def _fallback(reason):
+    return telemetry.REGISTRY.get("kvstore_fallbacks").labels(reason=reason)
+
+
+def test_unsupported_optimizer_slug_and_eager_fallback_still_trains():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+    w0 = np.zeros((V, D), np.float32)
+    kv.init("t", nd.array(w0))
+    c = _fallback("sparse_unsupported_optimizer:Adam")
+    before = c.value
+    kv.push("t", nd.sparse.row_sparse_array(
+        (np.ones((2, D), np.float32), np.array([1, 4])), shape=(V, D)))
+    assert c.value == before + 1
+    out = nd.zeros((V, D))
+    kv.pull("t", out=out)
+    assert np.abs(out.asnumpy()[[1, 4]]).sum() > 0    # trained eagerly
+    assert np.abs(out.asnumpy()[0]).sum() == 0        # and lazily
+
+
+def test_ineligible_dtype_slug():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(_make_opt("sgd"))
+    kv.init("t", nd.array(np.zeros((V, D), np.float16)))
+    c = _fallback("sparse_ineligible_dtype")
+    before = c.value
+    kv.push("t", nd.sparse.row_sparse_array(
+        (np.ones((1, D), np.float16), np.array([2])), shape=(V, D),
+        dtype="float16"))
+    assert c.value == before + 1
+
+
+# ----------------------------------------------------------------------
+# gluon block end to end
+# ----------------------------------------------------------------------
+def test_block_trains_touched_rows_only():
+    blk = ShardedEmbedding(V, D)
+    blk.initialize()
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                      lazy_update=True))
+    blk.attach_to_kvstore(kv)
+    key = "embedding:%s" % blk.weight.name
+    before = np.asarray(kv._store[key]._data).copy()
+    for _ in range(2):
+        with autograd.record():
+            out = blk(nd.array(np.array([[1, 4], [4, 9]], np.int64)))
+            loss = (out * out).sum()
+        loss.backward()
+        blk.sparse_push(kv)
+    after = np.asarray(kv._store[key]._data)
+    touched = [1, 4, 9]
+    untouched = [r for r in range(V) if r not in touched]
+    assert not np.allclose(after[touched], before[touched])
+    np.testing.assert_array_equal(after[untouched], before[untouched])
+    # the parameter aliases the store entry — no per-step pull
+    assert blk.weight._data is kv._store[key]
+
+
+# ----------------------------------------------------------------------
+# sharded checkpoints
+# ----------------------------------------------------------------------
+def test_checkpoint_roundtrip_and_corrupt_fallback(tmp_path):
+    prefix = str(tmp_path / "emb")
+    rng = np.random.RandomState(23)
+    t1 = {"tbl": rng.randn(V, D).astype(np.float32)}
+    s1 = {"tbl": rng.randn(V, 1).astype(np.float32)}
+    r1 = {"tbl": rng.randn(V, D).astype(np.float32)}
+    save_tables(prefix, "0001", t1, states=s1, residuals=r1)
+    t2 = {"tbl": rng.randn(V, D).astype(np.float32)}
+    save_tables(prefix, "0002", t2)
+
+    got = load_tables(prefix)                      # newest tag wins
+    np.testing.assert_array_equal(got["tbl"]["weight"], t2["tbl"])
+    assert got["tbl"]["state"] is None
+
+    # corrupt the newest shard: resume must fall back to tag 0001
+    with open("%s-0002.embshard0" % prefix, "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff\xff")
+    assert latest_tables(prefix) == "0001"
+    got = load_tables(prefix)
+    np.testing.assert_array_equal(got["tbl"]["weight"], t1["tbl"])
+    np.testing.assert_array_equal(got["tbl"]["state"], s1["tbl"])
+    np.testing.assert_array_equal(got["tbl"]["residual"], r1["tbl"])
+    with pytest.raises(MXNetError):
+        load_tables(prefix, tag="0002")
+
+
+# ----------------------------------------------------------------------
+# the real 2-process world
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_process_embedding_smoke(tmp_path):
+    """Spawn a real 2-process kvstore='tpu' world: sharded lookup,
+    cross-host sparse reduce through the compiled pipeline, and a
+    sharded-table checkpoint round-trip with corrupt-shard fallback
+    (tests/embedding_worker.py)."""
+    prefix = str(tmp_path / "mh" / "emb")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "run_multihost.py"),
+         "-n", "2", "--env", "MXTPU_EMB_PREFIX=%s" % prefix,
+         sys.executable, os.path.join(ROOT, "tests",
+                                      "embedding_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("all embedding checks passed") == 2

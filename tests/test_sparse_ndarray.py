@@ -1,0 +1,159 @@
+"""RowSparseNDArray edge-case pins (the mx.embedding bugfix audit):
+duplicate indices, empty row_ids, out-of-range rows, and ``out=``
+aliasing through ``kv.row_sparse_pull`` — each of these silently
+corrupted or crashed before the PR that added the compiled sparse
+pipeline, so they are pinned here independently of it."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+V, D = 10, 3
+
+
+def _rsp(rows, data=None, shape=(V, D)):
+    rows = np.asarray(rows, np.int64)
+    if data is None:
+        data = np.arange(rows.size * shape[1],
+                         dtype=np.float32).reshape(rows.size, shape[1]) + 1
+    return nd.sparse.row_sparse_array((np.asarray(data, np.float32), rows),
+                                      shape=shape)
+
+
+# ----------------------------------------------------------------------
+# duplicate indices
+# ----------------------------------------------------------------------
+def test_duplicate_indices_coalesce_on_eager_push():
+    """THE bug this audit found: a single-stream push of an rsp grad
+    with duplicate indices reached the lazy updater uncoalesced, and
+    the updater's set-semantics row scatter kept only the LAST
+    duplicate — silently dropping gradient. Pinned on the eager
+    (bucketing-off) path so it guards the fallback too."""
+    kv = mx.kv.create("local")
+    kv.set_bucketing(False)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, lazy_update=True))
+    w0 = np.zeros((V, D), np.float32)
+    kv.init("t", nd.array(w0))
+    g = _rsp([4, 4, 2], data=np.ones((3, D), np.float32))
+    kv.push("t", g)
+    out = nd.zeros((V, D))
+    kv.pull("t", out=out)
+    exp = np.zeros((V, D), np.float32)
+    exp[4] = -2.0          # both duplicate contributions survive
+    exp[2] = -1.0
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_to_dense_sums_duplicate_indices():
+    """Densification must agree with every reduce/coalesce path on
+    duplicates: a set-semantics scatter silently kept only the LAST
+    duplicate's rows when densifying an uncoalesced gradient."""
+    g = _rsp([4, 4], data=np.ones((2, D), np.float32))
+    dense = g.tostype("default").asnumpy()
+    exp = np.zeros((V, D), np.float32)
+    exp[4] = 2.0
+    np.testing.assert_array_equal(dense, exp)
+
+
+def test_coalesce_rsp_sums_sorts_and_int32():
+    from mxnet_tpu.ndarray.sparse import _coalesce_rsp
+    g = _rsp([7, 1, 7, 1], data=np.ones((4, D), np.float32))
+    c = _coalesce_rsp(g._sp_data, g._sp_indices, g.shape, g.context)
+    assert np.asarray(c._sp_indices).tolist() == [1, 7]
+    assert c._sp_indices.dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(c._sp_data),
+                                  np.full((2, D), 2.0, np.float32))
+
+
+def test_rsp_add_coalesces_and_shape_mismatch_raises():
+    a = _rsp([1, 3])
+    b = _rsp([3, 5])
+    s = (a + b).tostype("default").asnumpy()
+    exp = a.tostype("default").asnumpy() + b.tostype("default").asnumpy()
+    np.testing.assert_array_equal(s, exp)
+    with pytest.raises(MXNetError):
+        a + _rsp([0], shape=(V + 1, D))
+
+
+# ----------------------------------------------------------------------
+# retain
+# ----------------------------------------------------------------------
+def test_retain_empty_row_ids_gives_valid_empty_rsp():
+    r = _rsp([2, 5]).retain(np.array([], np.int64))
+    assert r._sp_data.shape[0] == 0
+    np.testing.assert_array_equal(r.tostype("default").asnumpy(),
+                                  np.zeros((V, D), np.float32))
+
+
+def test_retain_duplicate_and_absent_row_ids():
+    a = _rsp([2, 5])
+    r = a.retain(np.array([5, 5, 9], np.int64))   # 9 not present
+    assert np.asarray(r._sp_indices).tolist() == [5]
+    np.testing.assert_array_equal(
+        np.asarray(r._sp_data), np.asarray(a._sp_data)[1:])
+
+
+# ----------------------------------------------------------------------
+# row_sparse_pull
+# ----------------------------------------------------------------------
+def _store():
+    kv = mx.kv.create("local")
+    w = np.arange(V * D, dtype=np.float32).reshape(V, D)
+    kv.init("w", nd.array(w))
+    return kv, w
+
+
+def test_row_sparse_pull_dedups_and_empty_ok():
+    kv, w = _store()
+    out = nd.sparse.zeros("row_sparse", (V, D))
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=nd.array(np.array([5, 2, 5], np.int64)))
+    assert np.asarray(out._sp_indices).tolist() == [2, 5]
+    assert out._sp_indices.dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out._sp_data), w[[2, 5]])
+    # empty row_ids: a valid empty pull, not a crash
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=nd.array(np.array([], np.int64)))
+    assert out._sp_data.shape[0] == 0
+
+
+def test_row_sparse_pull_out_of_range_raises():
+    kv, _ = _store()
+    out = nd.sparse.zeros("row_sparse", (V, D))
+    for bad in ([V], [-1]):
+        with pytest.raises(MXNetError):
+            kv.row_sparse_pull("w", out=out,
+                               row_ids=nd.array(np.array(bad, np.int64)))
+
+
+def test_row_sparse_pull_shape_mismatch_raises():
+    kv, _ = _store()
+    out = nd.sparse.zeros("row_sparse", (V + 1, D))
+    with pytest.raises(MXNetError):
+        kv.row_sparse_pull("w", out=out,
+                           row_ids=nd.array(np.array([0], np.int64)))
+
+
+def test_row_sparse_pull_out_aliasing_is_safe():
+    """Re-pulling into the SAME out object (the steady-state training
+    shape: one preallocated holder per worker) must refresh all three
+    components coherently — stale _dense_cache was the aliasing bug."""
+    kv, w = _store()
+    out = nd.sparse.zeros("row_sparse", (V, D))
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=nd.array(np.array([1, 2], np.int64)))
+    first = out.tostype("default").asnumpy()
+    # no updater on this store, so the push ASSIGNS (replaces the value)
+    kv.push("w", _rsp([1], data=np.ones((1, D), np.float32)))
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=nd.array(np.array([3], np.int64)))
+    assert np.asarray(out._sp_indices).tolist() == [3]
+    assert out._sp_data.shape[0] == 1
+    refreshed = out.tostype("default").asnumpy()
+    assert not np.array_equal(first, refreshed)
+    exp = np.zeros((V, D), np.float32)
+    srcnow = np.asarray(kv._store["w"]._data)
+    exp[3] = srcnow[3]
+    np.testing.assert_array_equal(refreshed, exp)
