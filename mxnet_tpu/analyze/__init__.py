@@ -15,6 +15,9 @@ collective  dist collectives: distinct literal tags, never
             rank-branched
 telemetry   registry/glossary/label coverage (ex check_telemetry)
 envknobs    MXNET_*/MXTPU_* knob table coverage (docs/CONFIG.md)
+optfused    every registered optimizer implements the fused-update
+            protocol (``_fused_sig``) or carries a reasoned
+            FUSED_EAGER_WAIVERS entry; no stale waivers
 ========== ==========================================================
 
 Violations are waived per site with ``# analyze: ok(<pass>) <reason>``
@@ -32,16 +35,18 @@ from .threads import ThreadsPass
 from .collective import CollectivePass
 from .telemetry import TelemetryPass
 from .envknobs import EnvKnobsPass
+from .optfused import OptFusedPass
 
 __all__ = ["Context", "Finding", "Module", "Pass", "PASSES",
            "all_passes", "apply_waivers", "diff_baseline",
            "load_baseline", "load_package", "run", "save_baseline",
            "HostSyncPass", "RetracePass", "DonationPass",
            "ThreadsPass", "CollectivePass", "TelemetryPass",
-           "EnvKnobsPass"]
+           "EnvKnobsPass", "OptFusedPass"]
 
 PASS_CLASSES = (HostSyncPass, RetracePass, DonationPass, ThreadsPass,
-                CollectivePass, TelemetryPass, EnvKnobsPass)
+                CollectivePass, TelemetryPass, EnvKnobsPass,
+                OptFusedPass)
 
 
 def all_passes():

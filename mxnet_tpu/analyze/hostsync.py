@@ -47,6 +47,8 @@ HOT_MODULES = (
     "mxnet_tpu/executor.py",
     "mxnet_tpu/embedding/lookup.py",
     "mxnet_tpu/embedding/engine.py",
+    "mxnet_tpu/optimizer.py",
+    "mxnet_tpu/fused_update.py",
 )
 
 # calls whose RESULT is a device value (basename match on methods,

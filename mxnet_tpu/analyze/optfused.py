@@ -1,0 +1,141 @@
+"""Pass 8 — fused-update protocol coverage over the optimizer registry.
+
+Every ``@register``-ed optimizer either describes its update as a pure
+jittable program (``_fused_sig``, consumed by kvstore_fused.py /
+kvstore_tpu/engine.py / module/fused_fit.py through the shared
+fused_update builder) or sits in ``FUSED_EAGER_WAIVERS`` with a
+reason.  This is the contract that keeps "add an optimizer" from
+silently shipping the 25+ dispatch/step eager path: the dynamic suite
+only witnesses the configs it runs, while this pass fails tier-1 the
+moment a registered optimizer is neither fused nor waived.
+
+Rules, per ``optimizer.py`` module (main tree or fixture):
+
+* ``eager-only-optimizer`` — a registered class with no ``_fused_sig``
+  of its own or via an in-file ancestor chain (the root ``Optimizer``
+  doesn't count: its ``_fused_sig`` is the ``return None`` default),
+  and no waiver entry.
+* ``stale-waiver`` — a ``FUSED_EAGER_WAIVERS`` key naming a class that
+  is not registered in this module, or one that now implements the
+  protocol (the waiver outlived its reason).
+* ``empty-waiver-reason`` — a waiver whose value is not a non-empty
+  string literal: accepted eager-only optimizers must say why.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass
+
+ROOT_CLASS = "Optimizer"
+WAIVER_NAME = "FUSED_EAGER_WAIVERS"
+PROTOCOL_METHOD = "_fused_sig"
+
+
+def _is_register_decorator(node):
+    return (isinstance(node, ast.Name) and node.id == "register") or \
+        (isinstance(node, ast.Attribute) and node.attr == "register")
+
+
+def _class_defines(cls):
+    return any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n.name == PROTOCOL_METHOD for n in cls.body)
+
+
+def _literal_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # implicit concatenation of adjacent literals parses as a single
+    # Constant already; JoinedStr (f-string) is NOT a literal reason
+    return None
+
+
+def _collect(mod):
+    """(classes, registered, waivers) from one optimizer module.
+    ``classes``: name -> ClassDef; ``registered``: name -> ClassDef for
+    @register-ed ones; ``waivers``: name -> (reason-or-None, node)."""
+    classes, registered, waivers = {}, {}, {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            if any(_is_register_decorator(d) for d in node.decorator_list):
+                registered[node.name] = node
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == WAIVER_NAME \
+                        and isinstance(node.value, ast.Dict):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        key = _literal_str(k)
+                        if key is not None:
+                            waivers[key] = (_literal_str(v), k)
+    return classes, registered, waivers
+
+
+def _implements(name, classes, seen=None):
+    """Does class ``name`` define the protocol, itself or through an
+    in-file ancestor below the root ``Optimizer``?"""
+    if seen is None:
+        seen = set()
+    if name in seen or name == ROOT_CLASS or name not in classes:
+        return False
+    seen.add(name)
+    cls = classes[name]
+    if _class_defines(cls):
+        return True
+    return any(_implements(b.id, classes, seen)
+               for b in cls.bases if isinstance(b, ast.Name))
+
+
+class OptFusedPass(Pass):
+    name = "optfused"
+    doc = ("every @register-ed optimizer implements the fused-update "
+           "protocol (_fused_sig) or carries a FUSED_EAGER_WAIVERS "
+           "reason; no stale waivers")
+
+    def run(self, ctx):
+        out = []
+        for mod in ctx.modules:
+            if not mod.path.endswith("optimizer.py"):
+                continue
+            classes, registered, waivers = _collect(mod)
+            if not registered:
+                continue
+            for name, cls in sorted(registered.items()):
+                fused = _implements(name, classes)
+                waived = name in waivers
+                if fused and waived:
+                    out.append(self.finding(
+                        mod, waivers[name][1], "stale-waiver",
+                        "optimizer %r implements %s but still sits in "
+                        "%s — the waiver outlived its reason"
+                        % (name, PROTOCOL_METHOD, WAIVER_NAME),
+                        fix_hint="delete the %r entry" % name,
+                        detail=name))
+                elif not fused and not waived:
+                    out.append(self.finding(
+                        mod, cls, "eager-only-optimizer",
+                        "registered optimizer %r neither implements "
+                        "%s nor carries a %s entry — it would silently "
+                        "train on the eager per-key path"
+                        % (name, PROTOCOL_METHOD, WAIVER_NAME),
+                        fix_hint="implement %s (see fused_update.py "
+                                 "kinds) or add a reasoned waiver"
+                                 % PROTOCOL_METHOD,
+                        detail=name))
+            for name, (reason, node) in sorted(waivers.items()):
+                if name not in registered:
+                    out.append(self.finding(
+                        mod, node, "stale-waiver",
+                        "%s entry %r names no @register-ed optimizer "
+                        "in this module" % (WAIVER_NAME, name),
+                        fix_hint="delete the entry or fix the name",
+                        detail=name))
+                elif not (reason or "").strip():
+                    out.append(self.finding(
+                        mod, node, "empty-waiver-reason",
+                        "%s entry %r must carry a non-empty literal "
+                        "reason" % (WAIVER_NAME, name),
+                        fix_hint="say why this optimizer stays "
+                                 "eager-only",
+                        detail=name))
+        return out

@@ -185,6 +185,12 @@ def capture(module, epoch=None, step=None, include_optimizer=True):
     residuals = _capture_residuals(module)
     if residuals:
         extra["residuals"] = residuals
+    scaler = getattr(module, "_loss_scaler", None)
+    if scaler is not None:
+        # loss-scaler triple is training state: resuming a bf16 run at
+        # init scale would re-run the warmup backoffs (capture is a
+        # sync boundary, so state_dict's publish() readback is free)
+        extra["loss_scaler"] = scaler.state_dict()
     state["extra"] = extra
     return state
 
@@ -480,6 +486,17 @@ def restore(module, prefix, tag=None, load_optimizer=True, verify=True,
     residuals = extra.get("residuals")
     if residuals:
         _restore_residuals(module, residuals)
+
+    scaler_state = extra.get("loss_scaler")
+    if scaler_state:
+        from ..fused_update import DynamicLossScaler
+        scaler = getattr(module, "_loss_scaler", None)
+        if scaler is not None:
+            scaler.load_state_dict(scaler_state)
+        else:
+            # fused fit not built yet: park the restored scaler on the
+            # module; FusedFitStep.build picks it up before from_config
+            module._loss_scaler = DynamicLossScaler.from_state(scaler_state)
 
     rng = man.get("rng")
     if rng is not None:

@@ -1,0 +1,545 @@
+"""Shared fused optimizer-update builder (docs/TRAINING.md).
+
+An optimizer *describes* its update as a pure jittable program instead
+of opting in per engine: ``Optimizer._fused_sig()`` returns a hashable
+``(kind, *hypers)`` tuple that fully determines the per-key update
+math, and this module turns that tuple into the program pieces all
+three compiled consumers share — the flat-bucket kvstore step
+(kvstore_fused.py), the cross-host bucket step (kvstore_tpu/engine.py)
+and the per-tree single-launch fit step (module/fused_fit.py). Because
+there is ONE builder, an optimizer fused here is fused everywhere, and
+the eager ops in ops/optimizer_ops.py remain the parity oracle for all
+of them (tests/test_fused_optimizers.py pins the matrix).
+
+Contract for a kind's ``apply(w32, g, inner, lr, wd, rescale, extra,
+use_wd)``:
+
+* ``w32`` is the f32 view of the weight (the f32 master copy when the
+  key is multi-precision, else the weight cast to f32);
+* ``g`` is the raw f32 reduced gradient — each kind owns its full
+  gradient pipeline (rescale -> clip -> wd in whatever order its eager
+  op uses) so parity is exact, not approximate;
+* ``inner`` is the optimizer state in its natural nested structure
+  (None / array / tuple) and the same structure must come back;
+* ``lr``/``wd``/``rescale`` and the per-key ``extra`` scalars are
+  RUNTIME values (never trace keys): lr schedules, per-key bias
+  correction, ragged-batch rescale rewrites and loss-scale changes
+  never retrace;
+* ``use_wd`` is the one static flag (mirrors the eager ops' host-side
+  ``if wd:`` short-circuit).
+
+Multi-precision ``(inner_state, weight32)`` state tuples are handled
+by the shared wrapper (:func:`apply_one`): the master weight is peeled
+off the state, the update runs in f32, and the low-precision model
+weight is refreshed by a cast — all inside the same donated program.
+
+This module also owns :class:`DynamicLossScaler` — bf16/f16 training's
+loss-scale state (scale, good-step count, overflow skips) lives ON
+DEVICE and is donated through the fused fit program; overflow
+detection and the skip-update decision are a ``lax.cond`` inside the
+program, and telemetry (the ``loss_scale`` gauge and the
+``loss_scale_overflow_skips`` counter) is published lazily at sync
+boundaries, so a steady-state step still has zero host syncs.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+import jax.numpy as jnp
+
+from . import telemetry as _telemetry
+
+__all__ = ["build", "supported", "apply_one", "bulk_apply",
+           "flatten_state", "state_template", "unflatten",
+           "DynamicLossScaler", "scaler_config", "LOW_PRECISION"]
+
+# the low-precision dtypes that get f32 master weights under
+# multi_precision and are eligible for loss scaling
+LOW_PRECISION = (_np.dtype("float16"), _np.dtype("bfloat16"))
+
+
+def is_low_precision(dtype):
+    return _np.dtype(dtype) in LOW_PRECISION
+
+
+# ----------------------------------------------------------------------
+# state flattening: optimizer state -> ordered leaves + hashable template
+# ----------------------------------------------------------------------
+def flatten_state(state):
+    """Flatten a nested optimizer state (tuples / arrays / None) into
+    ``(leaves, template)``: ``leaves`` is the ordered list of array
+    leaves (NDArrays on the host side, jax arrays in-program) and
+    ``template`` is a hashable structure descriptor — ``None`` for an
+    absent leaf, ``"a"`` for an array, ``("t", ...)`` for a tuple.
+    The template is part of every engine's program cache key."""
+    if state is None:
+        return [], None
+    if isinstance(state, tuple):
+        leaves, tpls = [], []
+        for s in state:
+            sub, t = flatten_state(s)
+            leaves.extend(sub)
+            tpls.append(t)
+        return leaves, ("t",) + tuple(tpls)
+    return [state], "a"
+
+
+def state_template(state):
+    return flatten_state(state)[1]
+
+
+def unflatten(tpl, leaves):
+    """Inverse of :func:`flatten_state`: rebuild the nested structure
+    from the flat leaf sequence."""
+    it = iter(leaves)
+
+    def rec(t):
+        if t is None:
+            return None
+        if t == "a":
+            return next(it)
+        return tuple(rec(s) for s in t[1:])
+    return rec(tpl)
+
+
+def _leaf_values(state, out):
+    if state is None:
+        return out
+    if isinstance(state, tuple):
+        for s in state:
+            _leaf_values(s, out)
+        return out
+    out.append(state)
+    return out
+
+
+# ----------------------------------------------------------------------
+# the kind registry
+# ----------------------------------------------------------------------
+class _FusedUpdate:
+    """One kind's compiled-update descriptor: the pure per-key apply
+    plus the number of per-key extra runtime scalars the optimizer's
+    host hook (``Optimizer._fused_extra``) feeds it."""
+
+    __slots__ = ("kind", "apply", "n_extra")
+
+    def __init__(self, kind, apply, n_extra=0):
+        self.kind = kind
+        self.apply = apply
+        self.n_extra = n_extra
+
+
+_KINDS = {}
+_BUILT = {}
+
+
+def register_kind(kind):
+    def deco(builder):
+        _KINDS[kind] = builder
+        return builder
+    return deco
+
+
+def supported(sig):
+    """True when ``sig`` names a registered fused-update kind."""
+    return bool(sig) and sig[0] in _KINDS
+
+
+def build(sig):
+    """``sig`` (an ``Optimizer._fused_sig()`` tuple) -> cached
+    :class:`_FusedUpdate`. Raises KeyError for unknown kinds — engines
+    gate on :func:`supported` / a None sig first."""
+    upd = _BUILT.get(sig)
+    if upd is None:
+        upd = _BUILT[sig] = _KINDS[sig[0]](sig)
+    return upd
+
+
+def _clip(g, clip):
+    if clip is not None and clip >= 0:
+        return jnp.clip(g, -clip, clip)
+    return g
+
+
+def _common(g, w32, lr_unused, wd, rescale, clip, use_wd):
+    """ops/optimizer_ops.py ``_apply_common``: rescale -> clip -> wd."""
+    g = g * rescale
+    g = _clip(g, clip)
+    if use_wd:
+        g = g + wd * w32
+    return g
+
+
+@register_kind("sgd")
+def _sgd(sig):
+    _, momentum, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        g = _common(g, w32, lr, wd, rescale, clip, use_wd)
+        if inner is not None:
+            new_mom = momentum * inner.astype(jnp.float32) - lr * g
+            return w32 + new_mom, new_mom
+        return w32 - lr * g, None
+    return _FusedUpdate("sgd", apply)
+
+
+@register_kind("lbsgd")
+def _lbsgd(sig):
+    """LBSGD: SGD-momentum with a LARS layer-wise lr coefficient.
+    The eager path computes the norms on the host (two device syncs per
+    key); here they fold into the program — the fused path is where
+    LBSGD's host syncs go to die."""
+    _, momentum, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        # eager _get_lars uses the RAW (pre-rescale) gradient
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(w32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        lars = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            w_norm / (g_norm + wd * w_norm + 1e-9) * 0.001, 1.0)
+        lr = lr * lars
+        g = _common(g, w32, lr, wd, rescale, clip, use_wd)
+        if inner is not None:
+            new_mom = momentum * inner.astype(jnp.float32) - lr * g
+            return w32 + new_mom, new_mom
+        return w32 - lr * g, None
+    return _FusedUpdate("lbsgd", apply)
+
+
+@register_kind("adam")
+def _adam(sig):
+    # bias correction is folded into lr on the host (Adam._fused_lr),
+    # exactly like the eager update — lr stays a pure runtime scalar
+    _, beta1, beta2, epsilon, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        g = _common(g, w32, lr, wd, rescale, clip, use_wd)
+        mean, var = inner
+        new_mean = beta1 * mean + (1 - beta1) * g
+        new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+        new_w = w32 - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+        return new_w, (new_mean, new_var)
+    return _FusedUpdate("adam", apply)
+
+
+@register_kind("adagrad")
+def _adagrad(sig):
+    _, epsilon, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        # adagrad_update applies wd INSIDE the step term, not on g
+        g = _clip(g * rescale, clip)
+        new_h = inner + jnp.square(g)
+        new_w = w32 - lr * (g / jnp.sqrt(new_h + epsilon) + wd * w32)
+        return new_w, new_h
+    return _FusedUpdate("adagrad", apply)
+
+
+@register_kind("rmsprop")
+def _rmsprop(sig):
+    _, gamma1, epsilon, clip, clip_weights = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        g = _common(g, w32, lr, wd, rescale, clip, use_wd)
+        new_n = (1 - gamma1) * jnp.square(g) + gamma1 * inner
+        new_w = w32 - lr * g / jnp.sqrt(new_n + epsilon)
+        if clip_weights is not None and clip_weights > 0:
+            new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+        return new_w, new_n
+    return _FusedUpdate("rmsprop", apply)
+
+
+@register_kind("rmspropalex")
+def _rmspropalex(sig):
+    _, gamma1, gamma2, epsilon, clip, clip_weights = sig
+
+    def apply(w32, gr, inner, lr, wd, rescale, extra, use_wd):
+        gr = _common(gr, w32, lr, wd, rescale, clip, use_wd)
+        n, gacc, delta = inner
+        new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+        new_g = (1 - gamma1) * gr + gamma1 * gacc
+        new_delta = (gamma2 * delta - lr * gr
+                     / jnp.sqrt(new_n - jnp.square(new_g) + epsilon))
+        new_w = w32 + new_delta
+        if clip_weights is not None and clip_weights > 0:
+            new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+        return new_w, (new_n, new_g, new_delta)
+    return _FusedUpdate("rmspropalex", apply)
+
+
+@register_kind("adamax")
+def _adamax(sig):
+    # lr arrives pre-divided by (1 - beta1^t) (Adamax._fused_lr)
+    _, beta1, beta2, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        # eager Adamax: rescale -> +wd -> clip (wd applied
+        # unconditionally; wd == 0 adds an exact zero)
+        g = _clip(g * rescale + wd * w32, clip)
+        m, u = inner
+        new_m = beta1 * m + (1.0 - beta1) * g
+        new_u = jnp.maximum(beta2 * u, jnp.abs(g))
+        return w32 - lr * new_m / new_u, (new_m, new_u)
+    return _FusedUpdate("adamax", apply)
+
+
+@register_kind("nadam")
+def _nadam(sig):
+    # extra = (momentum_t, momentum_t_1, m_schedule, m_schedule_next,
+    # 1 - beta2^t): the schedule product mutates host state per key per
+    # step, so it is computed by Nadam._fused_extra in eager key order
+    # (schedule_decay, sig[4], only shapes those host-computed extras)
+    _, beta1, beta2, epsilon, _schedule_decay, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        momentum_t, momentum_t_1 = extra[0], extra[1]
+        m_schedule, m_schedule_next, bc2 = extra[2], extra[3], extra[4]
+        g = _clip(g * rescale + wd * w32, clip)
+        m, v = inner
+        new_m = beta1 * m + (1.0 - beta1) * g
+        new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        grad_prime = g / (1.0 - m_schedule)
+        m_prime = new_m / (1.0 - m_schedule_next)
+        v_prime = new_v / bc2
+        m_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_prime
+        new_w = w32 - lr * m_bar / (jnp.sqrt(v_prime) + epsilon)
+        return new_w, (new_m, new_v)
+    return _FusedUpdate("nadam", apply, n_extra=5)
+
+
+@register_kind("lamb")
+def _lamb(sig):
+    # extra = (1 - beta1^t, 1 - beta2^t) when bias_correction
+    _, beta1, beta2, epsilon, bias_correction, clip = sig
+
+    def apply(w32, g, inner, lr, wd, rescale, extra, use_wd):
+        g = _clip(g * rescale, clip)
+        m, v = inner
+        new_m = beta1 * m + (1.0 - beta1) * g
+        new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        if bias_correction:
+            m_hat = new_m / extra[0]
+            v_hat = new_v / extra[1]
+        else:
+            m_hat, v_hat = new_m, new_v
+        r = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(w32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        return w32 - lr * ratio * r, (new_m, new_v)
+    return _FusedUpdate("lamb", apply, n_extra=2)
+
+
+# ----------------------------------------------------------------------
+# the shared per-key wrapper (multi-precision aware)
+# ----------------------------------------------------------------------
+def apply_one(upd, w, g, state, mp, lr, wd, rescale, extra, use_wd):
+    """One key's fused update. ``state`` is the natural nested state
+    structure (jax-array leaves); ``mp`` is the STATIC multi-precision
+    flag (the state is ``(inner, weight32)`` and ``w`` is the
+    low-precision model weight). Returns ``(new_w, new_state)`` with
+    every leaf cast back to its input dtype, the model weight refreshed
+    from the f32 result."""
+    g32 = g.astype(jnp.float32)
+    if mp:
+        inner, w32 = state
+    else:
+        inner = state
+        w32 = w.astype(jnp.float32)
+    new_w32, new_inner = upd.apply(w32, g32, inner, lr, wd, rescale,
+                                   extra, use_wd)
+    old_leaves = _leaf_values(state, [])
+    new_state = (new_inner, new_w32) if mp else new_inner
+    new_leaves = _leaf_values(new_state, [])
+    cast = iter([nl.astype(ol.dtype)
+                 for nl, ol in zip(new_leaves, old_leaves)])
+
+    def rebuild(s):
+        if s is None:
+            return None
+        if isinstance(s, tuple):
+            return tuple(rebuild(x) for x in s)
+        return next(cast)
+    return new_w32.astype(w.dtype), rebuild(new_state)
+
+
+def bulk_apply(sig):
+    """The ``Optimizer._fused_update`` protocol body for ``sig``: a
+    pure function over aligned per-key sequences. ``runtime_scalars``
+    carries the runtime values (``lr``/``wd`` vectors, ``rescale``
+    scalar, ``extra`` (n_keys, n_extra) matrix) plus the static per-key
+    ``mp`` flags and the static ``use_wd`` short-circuit."""
+    upd = build(sig)
+
+    def fused_update(params, grads, states, runtime_scalars):
+        rt = runtime_scalars
+        lr, wd = rt["lr"], rt["wd"]
+        rescale = rt["rescale"]
+        extra = rt.get("extra")
+        mp = rt.get("mp") or (False,) * len(params)
+        use_wd = rt.get("use_wd", True)
+        new_ps, new_ss = [], []
+        for i, (w, g, st) in enumerate(zip(params, grads, states)):
+            e = extra[i] if upd.n_extra else ()
+            nw, ns = apply_one(upd, w, g, st, mp[i], lr[i], wd[i],
+                               rescale, e, use_wd)
+            new_ps.append(nw)
+            new_ss.append(ns)
+        return tuple(new_ps), tuple(new_ss)
+    return fused_update
+
+
+# ----------------------------------------------------------------------
+# dynamic loss scaling (bf16/f16 training)
+# ----------------------------------------------------------------------
+LOSS_SCALE = _telemetry.REGISTRY.gauge(
+    "loss_scale",
+    "current dynamic loss scale of the fused fit step (published at "
+    "sync boundaries — the live value rides on device)")
+OVERFLOW_SKIPS = _telemetry.REGISTRY.counter(
+    "loss_scale_overflow_skips",
+    "fused fit steps whose update was skipped because a non-finite "
+    "gradient was detected on device (the loss scale backs off)",
+    vital=True)
+
+
+def scaler_config():
+    """Loss-scaling knobs (docs/CONFIG.md). ``MXNET_LOSS_SCALE``:
+    ``dynamic`` (default), ``off``, or a float for a static scale (a
+    static scale still skips non-finite steps, it just never adjusts).
+    Returns None when scaling is disabled."""
+    mode = os.environ.get("MXNET_LOSS_SCALE", "dynamic").strip().lower()
+    if mode in ("off", "none", "0", ""):
+        return None
+    init = float(os.environ.get("MXNET_LOSS_SCALE_INIT", str(2.0 ** 15)))
+    interval = int(os.environ.get("MXNET_LOSS_SCALE_GROWTH_INTERVAL",
+                                  "2000"))
+    if mode == "dynamic":
+        return {"dynamic": True, "init": init, "interval": interval}
+    return {"dynamic": False, "init": float(mode), "interval": interval}
+
+
+class DynamicLossScaler:
+    """Device-resident loss-scale state for low-precision fused
+    training. The live ``(scale, good_steps, skips)`` triple is donated
+    through the fit program every step; the host copies are refreshed
+    only by :meth:`publish` (sync boundaries: ``Module._fit_sync``,
+    checkpoint capture, metric readback), so steady-state steps never
+    sync. Growth/backoff factors follow the standard 2x/0.5x schedule;
+    a non-dynamic scaler keeps the scale fixed but still skips
+    non-finite steps."""
+
+    GROWTH = 2.0
+    BACKOFF = 0.5
+    MAX_SCALE = 2.0 ** 24
+
+    def __init__(self, init_scale=None, growth_interval=None,
+                 dynamic=True):
+        cfg = scaler_config() or {"dynamic": True, "init": 2.0 ** 15,
+                                  "interval": 2000}
+        self.dynamic = bool(dynamic if dynamic is not None
+                            else cfg["dynamic"])
+        self._scale = float(init_scale if init_scale is not None
+                            else cfg["init"])
+        self.growth_interval = int(growth_interval
+                                   if growth_interval is not None
+                                   else cfg["interval"])
+        self._good = 0
+        self._skips = 0
+        self._published_skips = 0
+        self._dev = None       # live (scale, good, skips) jax arrays
+
+    @classmethod
+    def from_config(cls):
+        cfg = scaler_config()
+        if cfg is None:
+            return None
+        return cls(init_scale=cfg["init"],
+                   growth_interval=cfg["interval"],
+                   dynamic=cfg["dynamic"])
+
+    # -- trace-static identity (part of the fit-program cache key) ----
+    def trace_sig(self):
+        return ("lscale", self.dynamic, self.growth_interval,
+                self.GROWTH, self.BACKOFF, self.MAX_SCALE)
+
+    # -- device state -------------------------------------------------
+    def device_state(self):
+        if self._dev is None:
+            self._dev = (jnp.float32(self._scale),
+                         jnp.int32(self._good),
+                         jnp.int32(self._skips))
+        return self._dev
+
+    def set_device_state(self, triple):
+        self._dev = tuple(triple)
+
+    def step_fn(self, finite, state):
+        """In-program scale adjustment: pure, shapes fixed. Returns the
+        new (scale, good, skips) triple."""
+        scale, good, skips = state
+        new_skips = skips + jnp.where(finite, 0, 1).astype(skips.dtype)
+        if not self.dynamic:
+            return scale, good, new_skips
+        interval = self.growth_interval
+        new_good = jnp.where(finite, good + 1, 0).astype(good.dtype)
+        grown = jnp.minimum(scale * self.GROWTH, self.MAX_SCALE)
+        grow = new_good >= interval
+        new_scale = jnp.where(
+            finite, jnp.where(grow, grown, scale),
+            jnp.maximum(scale * self.BACKOFF, 1.0))
+        new_good = jnp.where(grow, 0, new_good).astype(good.dtype)
+        return new_scale, new_good, new_skips
+
+    # -- host-side sync boundaries ------------------------------------
+    def publish(self):
+        """Refresh host copies from the device triple and push
+        telemetry. This is a host sync by design — call it only at
+        existing sync boundaries, never per step."""
+        if self._dev is not None:
+            scale, good, skips = self._dev
+            # sync-boundary readback by contract (fit sync / checkpoint
+            # capture), never per-step
+            self._scale = float(scale)
+            self._good = int(good)
+            self._skips = int(skips)
+        LOSS_SCALE.set(self._scale)
+        delta = self._skips - self._published_skips
+        if delta > 0:
+            OVERFLOW_SKIPS.inc(delta)
+        self._published_skips = self._skips
+        return self._scale
+
+    @property
+    def scale(self):
+        return self._scale
+
+    @property
+    def skips(self):
+        return self._skips
+
+    # -- checkpoint (mx.checkpoint extra["loss_scaler"]) --------------
+    def state_dict(self):
+        self.publish()
+        return {"scale": self._scale, "good": self._good,
+                "skips": self._skips, "dynamic": self.dynamic,
+                "growth_interval": self.growth_interval}
+
+    def load_state_dict(self, d):
+        self._scale = float(d.get("scale", self._scale))
+        self._good = int(d.get("good", 0))
+        self._skips = int(d.get("skips", 0))
+        self.dynamic = bool(d.get("dynamic", self.dynamic))
+        self.growth_interval = int(d.get("growth_interval",
+                                         self.growth_interval))
+        self._published_skips = self._skips
+        self._dev = None
+
+    @classmethod
+    def from_state(cls, d):
+        s = cls()
+        s.load_state_dict(d)
+        return s

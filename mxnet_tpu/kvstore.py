@@ -376,16 +376,20 @@ class KVStore:
         return NDArray(acc, vlist[0].context)
 
     def _get_residual(self, res_key, like):
+        # error-feedback residuals always live in f32 — the
+        # master-gradient view — so 2-bit semantics are identical for
+        # f32 and low-precision (bf16/f16) gradients, and the eager
+        # path stays the bit-level parity oracle for the fused programs
         residual = self._compression_residuals.get(res_key)
         if residual is None:
-            residual = zeros(like.shape, like.context, str(like.dtype))
+            residual = zeros(like.shape, like.context, "float32")
             self._compression_residuals[res_key] = residual
         return residual
 
     def _compress(self, key, dev_idx, grad):
         residual = self._get_residual((key, dev_idx), grad)
         out, new_residual = self._compression.compress_decompress(
-            grad._data, residual._data)
+            grad._data.astype(jnp.float32), residual._data)
         residual._set_data(new_residual)
         return NDArray(out, grad.context)
 

@@ -23,9 +23,17 @@ and XLA's async dispatch overlaps the bucket computations with whatever
 host work (remaining backward) follows the push. ``pull``/``barrier``/
 state save are the sync points that flush pending work.
 
-Fallbacks stay eager per-key (and correct): row_sparse values, non-f32
-dtypes, custom updaters, and optimizers without a fused bucket signature
-(``Optimizer._fused_bucket_sig``).
+The optimizer apply is built from the SHARED fused-update builder
+(fused_update.py): any optimizer describing its update via
+``Optimizer._fused_sig`` — SGD, Adam, LAMB, RMSProp, ... including
+multi-precision ``(inner, weight32)`` state tuples and f16/bf16
+weights with f32 masters — runs inside the bucket program. 2-bit
+error-feedback residuals always live in f32 (the master-gradient
+view), so compression semantics are dtype-independent.
+
+Fallbacks stay eager per-key (and correct): row_sparse values,
+custom updaters, and optimizers without a fused signature (slug
+``unfused_optimizer:<Name>`` on the kvstore_fallbacks counter).
 """
 from __future__ import annotations
 
@@ -39,6 +47,7 @@ from jax import lax
 from .ndarray import NDArray
 from . import profiler
 from . import telemetry as _telemetry
+from . import fused_update as _fused
 
 __all__ = ["FusedBucketEngine", "bucket_byte_cap", "TRACE_COUNT",
            "two_bit_quantize", "fused_sgd_apply"]
@@ -139,7 +148,7 @@ def _on_device(x, dev):
     return jax.device_put(x, dev)
 
 
-def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
+def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
     """Compile-once bucket program: the whole bucket — 2-bit compress with
     error feedback, cross-device reduce, and the optimizer apply for every
     key — is ONE jitted computation.
@@ -151,18 +160,25 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
     per-key-in-one-program wins).
 
     With compression the bucket IS physically flat: each device's
-    gradients concatenate into one flat buffer, quantize against a single
-    DONATED flat error-feedback residual per device, reduce flat, and only
-    the optimizer apply slices back per key. That turns n_keys × n_dev
+    gradients concatenate into one flat f32 buffer (the master-gradient
+    view — low-precision gradients are cast first, so residual semantics
+    are dtype-independent), quantize against a single DONATED flat
+    error-feedback residual per device, reduce flat, and only the
+    optimizer apply slices back per key. That turns n_keys × n_dev
     tiny quantize kernels — plus as many residual output buffers and
     host-side writebacks — into n_dev of each.
 
     layout: tuple of (offset, size, shape) per key — the flat layout.
     mode: None for plain assign (no updater), or the optimizer's fused
-    bucket signature, e.g. ("sgd", momentum, clip) — rescale_grad is a
-    runtime argument, not a compile key, so per-batch rewrites (gluon
-    Trainer.step) never retrace.
-    state_mask: per-key bool — True where a momentum state exists.
+    signature, e.g. ("sgd", momentum, clip) — built into the per-key
+    apply via the SHARED fused-update builder (fused_update.py).
+    rescale_grad / lr / wd / per-key extra scalars are runtime
+    arguments, not compile keys, so per-batch rewrites (gluon
+    Trainer.step) and schedule steps never retrace.
+    tpls: per-key state template (fused_update.state_template) — states
+    cross the jit boundary as flat leaf tuples and are rebuilt inside.
+    mp_flags: per-key static multi-precision flag — True where the state
+    is an ``(inner, weight32)`` master-weight tuple.
     """
     n_keys = len(layout)
 
@@ -183,8 +199,9 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
             return reduced, ()
         dev_q, new_res = [], []
         for d in range(n_dev):
-            g = grads[d][0].reshape(-1) if n_keys == 1 else jnp.concatenate(
-                [grads[d][i].reshape(-1) for i in range(n_keys)])
+            parts = [grads[d][i].reshape(-1).astype(jnp.float32)
+                     for i in range(n_keys)]
+            g = parts[0] if n_keys == 1 else jnp.concatenate(parts)
             q, r = two_bit_quantize(residuals[d], g, threshold)
             new_res.append(r)
             dev_q.append(q)
@@ -202,19 +219,21 @@ def _build_step(layout, n_dev, threshold, mode, state_mask, use_wd):
             return tuple(reduced), new_res
         return jax.jit(step, donate_argnums=(0,))
 
-    kind, momentum, clip = mode
-    assert kind == "sgd"
+    upd = _fused.build(mode)
 
-    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale):
+    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale,
+             extra):
         _note_retrace()
         reduced, new_res = _reduce(residuals, grads)
         new_ws, new_ss = [], []
         for i in range(n_keys):
-            new_w, new_s = fused_sgd_apply(
-                weights[i], reduced[i], states[i] if state_mask[i] else None,
-                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            st = _fused.unflatten(tpls[i], states[i])
+            e = extra[i] if upd.n_extra else ()
+            new_w, new_s = _fused.apply_one(
+                upd, weights[i], reduced[i], st, mp_flags[i],
+                lr_vec[i], wd_vec[i], rescale, e, use_wd)
             new_ws.append(new_w)
-            new_ss.append(new_s)
+            new_ss.append(tuple(_fused.flatten_state(new_s)[0]))
         return tuple(new_ws), tuple(new_ss), new_res
     return jax.jit(step, donate_argnums=(1, 2))
 
@@ -287,7 +306,7 @@ class FusedBucketEngine:
             updater = self._kv._updater
             if not isinstance(updater, Updater):
                 return "custom_updater"
-            return ("optimizer_no_fused_sig:%s"
+            return ("unfused_optimizer:%s"
                     % type(updater.optimizer).__name__)
         for v in vlist:
             if not isinstance(v, NDArray):
@@ -295,21 +314,26 @@ class FusedBucketEngine:
             if getattr(v, "stype", "default") != "default":
                 return "sparse_value"
             if v.dtype != _np.float32:
-                return "non_f32_dtype"
+                # low-precision values fuse only through an optimizer
+                # apply (f32 master-gradient view); assign mode stays
+                # f32 so stored dtypes can't silently change
+                if mode is None or not _fused.is_low_precision(v.dtype):
+                    return "non_f32_dtype"
             if v.shape != vlist[0].shape:
                 return "mismatched_device_shapes"
         if mode is not None:
             stored = self._kv._store.get(key)
             if stored is None:
                 return "key_not_initialized"
-            if stored.dtype != _np.float32 \
+            if stored.dtype != vlist[0].dtype \
                     or stored.shape != vlist[0].shape:
                 return "stored_value_mismatch"
             from .kvstore import _updater_key
             st = self._kv._updater.states.get(_updater_key(key))
-            if st is not None and not isinstance(st, NDArray):
-                # e.g. multi-precision (state, weight32) tuple
-                return "non_fusable_optimizer_state"
+            if st is not None:
+                leaves, _ = _fused.flatten_state(st)
+                if not all(isinstance(l, NDArray) for l in leaves):
+                    return "non_fusable_optimizer_state"
         return None
 
     # -- queue ----------------------------------------------------------
@@ -339,13 +363,15 @@ class FusedBucketEngine:
     def _pack(self, items):
         """Greedy size-capped packing in (priority desc, arrival) order;
         a new bucket starts when the cap would overflow or the device
-        count changes; an oversized value gets its own bucket."""
+        count or dtype changes (a bucket's flat wire layout is
+        homogeneous); an oversized value gets its own bucket."""
         cap = bucket_byte_cap()
         buckets, cur, cur_bytes = [], [], 0
         for it in items:
             nbytes = it.size * it.itemsize
             if cur and (cur_bytes + nbytes > cap
-                        or it.n_dev != cur[0].n_dev):
+                        or it.n_dev != cur[0].n_dev
+                        or it.likes[0].dtype != cur[0].likes[0].dtype):
                 buckets.append(cur)
                 cur, cur_bytes = [], 0
             cur.append(it)
@@ -418,7 +444,7 @@ class FusedBucketEngine:
         updater = kv._updater
         opt = updater.optimizer
         ukeys = [_updater_key(it.key) for it in bucket]
-        weights_nd, states_nd = [], []
+        weights_nd, state_leaves, tpls, mp_flags = [], [], [], []
         for it, uk in zip(bucket, ukeys):
             w = kv._store[it.key]
             if uk not in updater.states:
@@ -426,16 +452,19 @@ class FusedBucketEngine:
                     uk, w)
                 updater.states_synced[uk] = True
             weights_nd.append(w)
-            states_nd.append(updater.states[uk])
-            opt._update_count(uk)
-        lr_vec = _np.asarray([opt._get_lr(uk) for uk in ukeys],
-                             _np.float32)
-        wd_vec = _np.asarray([opt._get_wd(uk) for uk in ukeys],
-                             _np.float32)
+            leaves, tpl = _fused.flatten_state(updater.states[uk])
+            state_leaves.append(leaves)
+            tpls.append(tpl)
+            # multi-precision is an EXPLICIT static flag (an Adam
+            # (mean, var) pair is structurally ambiguous with an
+            # (inner, weight32) master tuple)
+            mp_flags.append(bool(opt.multi_precision)
+                            and _fused.is_low_precision(w.dtype))
+        lr_vec, wd_vec, extra = opt._fused_runtime(ukeys)
         use_wd = bool(_np.any(wd_vec != 0.0))
-        state_mask = tuple(st is not None for st in states_nd)
-        return (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
-                state_mask, _np.float32(opt.rescale_grad))
+        return (weights_nd, state_leaves, tuple(tpls), tuple(mp_flags),
+                lr_vec, wd_vec, extra, use_wd,
+                _np.float32(opt.rescale_grad))
 
     def _dispatch_inner(self, bucket, mode):
         kv = self._kv
@@ -468,35 +497,39 @@ class FusedBucketEngine:
             fn = self._steps.get(sig)
             if fn is None:
                 fn = self._steps[sig] = _build_step(
-                    layout, n_dev, threshold, None, None, False)
+                    layout, n_dev, threshold, None, None, None, False)
                 _telemetry.programs.record("kvstore_bucket", fn,
                                            (residuals, grads))
             outs, new_res = fn(residuals, grads)
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(out, ctx0)
         else:
-            (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
-             state_mask, rescale) = self._updater_inputs(bucket)
-            sig = (mode, threshold, n_dev, layout, state_mask, use_wd)
+            (weights_nd, state_leaves, tpls, mp_flags, lr_vec, wd_vec,
+             extra, use_wd, rescale) = self._updater_inputs(bucket)
+            sig = (mode, threshold, n_dev, layout, tpls, mp_flags,
+                   use_wd)
             fn = self._steps.get(sig)
             fresh = fn is None
             if fresh:
                 fn = self._steps[sig] = _build_step(
-                    layout, n_dev, threshold, mode, state_mask, use_wd)
+                    layout, n_dev, threshold, mode, tpls, mp_flags,
+                    use_wd)
             weights = tuple(w._data for w in weights_nd)
-            states = tuple(st._data if st is not None else None
-                           for st in states_nd)
+            states = tuple(tuple(l._data for l in leaves)
+                           for leaves in state_leaves)
             if fresh:
                 _telemetry.programs.record(
                     "kvstore_bucket", fn,
                     (weights, states, residuals, grads, lr_vec, wd_vec,
-                     rescale))
+                     rescale, extra))
             new_ws, new_ss, new_res = fn(weights, states, residuals,
-                                         grads, lr_vec, wd_vec, rescale)
-            for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
+                                         grads, lr_vec, wd_vec, rescale,
+                                         extra)
+            for w, leaves, nw, ns in zip(weights_nd, state_leaves,
+                                         new_ws, new_ss):
                 w._set_data(nw)
-                if st is not None:
-                    st._set_data(ns)
+                for l, nl in zip(leaves, ns):
+                    l._set_data(nl)
         if keys_tuple is not None:
             self._flat_res[keys_tuple]["res"] = list(new_res)
 
@@ -524,9 +557,13 @@ class FusedBucketEngine:
             dev0 = _single_device(bucket[0].data[0])
             res = []
             for d in range(n_dev):
+                # residuals live in f32 (the master-gradient view)
+                # regardless of the gradient dtype; the cast is a no-op
+                # for f32 and defends against pre-f32 restored state
                 parts = [_on_device(
                     kv._get_residual((it.key, d), it.likes[d])._data,
-                    dev0).reshape(-1) for it in bucket]
+                    dev0).reshape(-1).astype(jnp.float32)
+                    for it in bucket]
                 res.append(parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))
                 for it in bucket:

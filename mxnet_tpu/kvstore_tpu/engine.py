@@ -45,8 +45,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
 from .. import telemetry as _telemetry
+from .. import fused_update as _fused
 from ..kvstore_fused import (FusedBucketEngine, two_bit_quantize,
-                             fused_sgd_apply, _note_retrace, _SITE,
+                             _note_retrace, _SITE,
                              DISPATCH_MS, _on_device)
 from . import dist
 
@@ -64,7 +65,7 @@ ALLGATHER_MS = _telemetry.REGISTRY.histogram(
     "backend transport; unused when reduction rides GSPMD)", unit="ms")
 
 
-def _build_tpu_step(layout, n_dev, nproc, threshold, mode, state_mask,
+def _build_tpu_step(layout, n_dev, nproc, threshold, mode, tpls, mp_flags,
                     use_wd):
     """ONE GSPMD program per bucket: compress -> cross-host all-reduce
     -> optimizer apply. Inputs arrive as global arrays over the process
@@ -91,10 +92,10 @@ def _build_tpu_step(layout, n_dev, nproc, threshold, mode, state_mask,
             return reduced, ()
         dev_q, new_res = [], []
         for d in range(n_dev):
-            g = grads[d][0].reshape(nproc, -1) if n_keys == 1 \
-                else jnp.concatenate(
-                    [grads[d][i].reshape(nproc, -1) for i in range(n_keys)],
-                    axis=1)
+            parts = [grads[d][i].reshape(nproc, -1).astype(jnp.float32)
+                     for i in range(n_keys)]
+            g = parts[0] if n_keys == 1 \
+                else jnp.concatenate(parts, axis=1)
             q, r = two_bit_quantize(residuals[d].reshape(nproc, -1), g,
                                     threshold)
             new_res.append(r.reshape(-1))
@@ -114,19 +115,21 @@ def _build_tpu_step(layout, n_dev, nproc, threshold, mode, state_mask,
             return tuple(reduced), new_res
         return jax.jit(step, donate_argnums=(0,))
 
-    kind, momentum, clip = mode
-    assert kind == "sgd"
+    upd = _fused.build(mode)
 
-    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale):
+    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale,
+             extra):
         _note_retrace()
         reduced, new_res = _reduce(residuals, grads)
         new_ws, new_ss = [], []
         for i in range(n_keys):
-            new_w, new_s = fused_sgd_apply(
-                weights[i], reduced[i], states[i] if state_mask[i] else None,
-                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            st = _fused.unflatten(tpls[i], states[i])
+            e = extra[i] if upd.n_extra else ()
+            new_w, new_s = _fused.apply_one(
+                upd, weights[i], reduced[i], st, mp_flags[i],
+                lr_vec[i], wd_vec[i], rescale, e, use_wd)
             new_ws.append(new_w)
-            new_ss.append(new_s)
+            new_ss.append(tuple(_fused.flatten_state(new_s)[0]))
         return tuple(new_ws), tuple(new_ss), new_res
     return jax.jit(step, donate_argnums=(1, 2))
 
@@ -153,8 +156,9 @@ def _build_local_reduce(layout, n_dev, threshold):
             return flat, ()
         dev_q, new_res = [], []
         for d in range(n_dev):
-            g = grads[d][0].reshape(-1) if n_keys == 1 else jnp.concatenate(
-                [grads[d][i].reshape(-1) for i in range(n_keys)])
+            parts = [grads[d][i].reshape(-1).astype(jnp.float32)
+                     for i in range(n_keys)]
+            g = parts[0] if n_keys == 1 else jnp.concatenate(parts)
             q, r = two_bit_quantize(residuals[d], g, threshold)
             new_res.append(r)
             dev_q.append(q)
@@ -165,22 +169,24 @@ def _build_local_reduce(layout, n_dev, threshold):
     return jax.jit(step, donate_argnums=(0,))
 
 
-def _build_local_apply(layout, state_mask, use_wd, mode):
+def _build_local_apply(layout, tpls, mp_flags, use_wd, mode):
     """Host-transport stage 2 (one LOCAL program): slice the globally
     reduced flat gradient per key and run the fused optimizer apply."""
-    kind, momentum, clip = mode
-    assert kind == "sgd"
+    upd = _fused.build(mode)
 
-    def step(weights, states, red_flat, lr_vec, wd_vec, rescale):
+    # analyze: ok(retrace) upd is a pure memoized function of `mode`, which is a builder parameter and part of every compile-cache key
+    def step(weights, states, red_flat, lr_vec, wd_vec, rescale, extra):
         _note_retrace()
         new_ws, new_ss = [], []
         for i, (off, size, shape) in enumerate(layout):
             g = lax.slice(red_flat, (off,), (off + size,)).reshape(shape)
-            new_w, new_s = fused_sgd_apply(
-                weights[i], g, states[i] if state_mask[i] else None,
-                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+            st = _fused.unflatten(tpls[i], states[i])
+            e = extra[i] if upd.n_extra else ()
+            new_w, new_s = _fused.apply_one(
+                upd, weights[i], g, st, mp_flags[i],
+                lr_vec[i], wd_vec[i], rescale, e, use_wd)
             new_ws.append(new_w)
-            new_ss.append(new_s)
+            new_ss.append(tuple(_fused.flatten_state(new_s)[0]))
         return tuple(new_ws), tuple(new_ss)
     return jax.jit(step, donate_argnums=(1,))
 
@@ -275,39 +281,42 @@ class TPUBucketEngine(FusedBucketEngine):
             if fn is None:
                 fn = self._steps[sig] = _build_tpu_step(
                     layout, n_dev, self._nproc, threshold, None, None,
-                    False)
+                    None, False)
                 _telemetry.programs.record("kvstore_tpu", fn,
                                            (residuals, grads))
             outs, new_res = fn(residuals, grads)
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(self._unlift(out), ctx0)
         else:
-            (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
-             state_mask, rescale) = self._updater_inputs(bucket)
-            sig = ("tpu", mode, threshold, n_dev, layout, state_mask,
-                   use_wd)
+            (weights_nd, state_leaves, tpls, mp_flags, lr_vec, wd_vec,
+             extra, use_wd, rescale) = self._updater_inputs(bucket)
+            sig = ("tpu", mode, threshold, n_dev, layout, tpls,
+                   mp_flags, use_wd)
             fn = self._steps.get(sig)
             fresh = fn is None
             if fresh:
                 fn = self._steps[sig] = _build_tpu_step(
                     layout, n_dev, self._nproc, threshold, mode,
-                    state_mask, use_wd)
+                    tpls, mp_flags, use_wd)
             weights = tuple(self._lift_repl(
                 _on_device(w._data, self._local_dev)) for w in weights_nd)
             states = tuple(
-                self._lift_repl(_on_device(st._data, self._local_dev))
-                if st is not None else None for st in states_nd)
+                tuple(self._lift_repl(_on_device(l._data,
+                                                 self._local_dev))
+                      for l in leaves) for leaves in state_leaves)
             if fresh:
                 _telemetry.programs.record(
                     "kvstore_tpu", fn,
                     (weights, states, residuals, grads, lr_vec, wd_vec,
-                     rescale))
+                     rescale, extra))
             new_ws, new_ss, new_res = fn(weights, states, residuals,
-                                         grads, lr_vec, wd_vec, rescale)
-            for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
+                                         grads, lr_vec, wd_vec, rescale,
+                                         extra)
+            for w, leaves, nw, ns in zip(weights_nd, state_leaves,
+                                         new_ws, new_ss):
                 w._set_data(self._unlift(nw))
-                if st is not None:
-                    st._set_data(self._unlift(ns))
+                for l, nl in zip(leaves, ns):
+                    l._set_data(self._unlift(nl))
         if keys_tuple is not None:
             self._flat_res[keys_tuple]["res"] = [self._unlift(r)
                                                  for r in new_res]
@@ -356,21 +365,22 @@ class TPUBucketEngine(FusedBucketEngine):
                     jnp.asarray(red_np[off:off + size].reshape(shape)),
                     ctx0)
             return
-        (weights_nd, states_nd, lr_vec, wd_vec, use_wd,
-         state_mask, rescale) = self._updater_inputs(bucket)
-        sig = ("tpu-host-apply", mode, layout, state_mask, use_wd)
+        (weights_nd, state_leaves, tpls, mp_flags, lr_vec, wd_vec,
+         extra, use_wd, rescale) = self._updater_inputs(bucket)
+        sig = ("tpu-host-apply", mode, layout, tpls, mp_flags, use_wd)
         fn = self._steps.get(sig)
         if fn is None:
-            fn = self._steps[sig] = _build_local_apply(layout, state_mask,
-                                                       use_wd, mode)
+            fn = self._steps[sig] = _build_local_apply(
+                layout, tpls, mp_flags, use_wd, mode)
         _count_dispatch()       # the apply is a second device launch
         weights = tuple(w._data for w in weights_nd)
-        states = tuple(st._data if st is not None else None
-                       for st in states_nd)
+        states = tuple(tuple(l._data for l in leaves)
+                       for leaves in state_leaves)
         new_ws, new_ss = _SITE.timed(
             fn, weights, states, jnp.asarray(red_np), lr_vec, wd_vec,
-            rescale, dispatch_hist=DISPATCH_MS)
-        for w, st, nw, ns in zip(weights_nd, states_nd, new_ws, new_ss):
+            rescale, extra, dispatch_hist=DISPATCH_MS)
+        for w, leaves, nw, ns in zip(weights_nd, state_leaves,
+                                     new_ws, new_ss):
             w._set_data(nw)
-            if st is not None:
-                st._set_data(ns)
+            for l, nl in zip(leaves, ns):
+                l._set_data(nl)

@@ -19,21 +19,33 @@ the same shape as parallel/trainer.py's TrainStep, brought to the
 Module/kvstore path that ``fit``, ``model.py``, and user scripts use.
 
 Eligibility (checked once per optimizer init, cheaply re-checked per
-batch): dense f32 params with grad_req='write', a fusable optimizer
-(``_fused_fit_sig`` non-None — SGD; LBSGD/multi-precision opt out), a
-local/device kvstore (or none) with or without 2-bit compression, no
-installed monitor, no inputs_need_grad. Everything else falls back to
-the eager fwd_bwd + bucketed-kvstore path unchanged; error-feedback
-residuals move between the two paths through the same spill/reseed
-mechanism the bucketed engine uses, so no accumulated residual is lost.
+batch): dense f32/f16/bf16 params with grad_req='write', an optimizer
+describing its update via the shared fused-update protocol
+(``_fused_fit_sig`` non-None — SGD, Adam, LAMB, RMSProp, AdaGrad,
+Adamax, Nadam, LBSGD, each with or without multi-precision
+``(inner, weight32)`` master-weight state), a local/device kvstore (or
+none) with or without 2-bit compression, no installed monitor, no
+inputs_need_grad. Everything else falls back to the eager fwd_bwd +
+bucketed-kvstore path unchanged; error-feedback residuals move between
+the two paths through the same spill/reseed mechanism the bucketed
+engine uses, so no accumulated residual is lost.
+
+Low-precision (bf16/f16) training is first-class: master weights and
+optimizer state stay f32 inside the same donated program, 2-bit
+residuals operate on the f32 master-gradient view, and a
+``DynamicLossScaler`` (fused_update.py) rides along — its scale is a
+runtime scalar, the inf/nan overflow check is folded into the program,
+and the skip-update decision is a ``lax.cond``, so overflow handling
+costs zero host syncs.
 
 The compiled step is cached per SYMBOL (sharing executables across
 rebinds like executor._compiled_cache) and keyed by everything that
 changes the program — param set, compression threshold, optimizer
-signature, state mask, metric signature. ``rescale_grad``, lr, and wd
-ride as runtime arguments, and jax's shape-keyed jit cache handles
-ragged final batches: each distinct batch shape traces once
-(``TRACE_COUNT``), steady state never retraces.
+signature, state templates, multi-precision flags, metric signature,
+loss-scaler config. ``rescale_grad``, lr, wd, per-key extra scalars,
+and the loss scale ride as runtime arguments, and jax's shape-keyed
+jit cache handles ragged final batches: each distinct batch shape
+traces once (``TRACE_COUNT``), steady state never retraces.
 """
 from __future__ import annotations
 
@@ -45,10 +57,11 @@ import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
+from .. import fused_update as _fused
 from .. import optimizer as opt_mod
 from .. import telemetry as _telemetry
 from ..kvstore import KVStore, _updater_key
-from ..kvstore_fused import two_bit_quantize, fused_sgd_apply
+from ..kvstore_fused import two_bit_quantize
 from ..executor import _compiled_cache, _count_dispatch
 from ..model import _local_updater_key
 
@@ -137,20 +150,33 @@ def _metric_closure(metric, label_names, output_names):
     return metric_fn, sig
 
 
-def _build_fit_program(graph_fn, param_order, threshold, mode, state_mask,
-                       use_wd, metric_fn, mirror):
+def _build_fit_program(graph_fn, param_order, threshold, mode, tpls,
+                       mp_flags, use_wd, metric_fn, mirror, scaler):
     """ONE jitted program: fwd+bwd+compress+reduce+update(+metric).
 
     The compress and optimizer math are the SAME functions the bucketed
-    kvstore step compiles (kvstore_fused.two_bit_quantize /
-    fused_sgd_apply, themselves mirroring ops/optimizer_ops.py), so
-    fused weights match the eager path within FMA-contraction ulps
-    (tests/test_fused_fit.py pins the tolerance)."""
-    kind, momentum, clip = mode
-    assert kind == "sgd"
+    kvstore step compiles (kvstore_fused.two_bit_quantize and the
+    fused_update builder, themselves mirroring ops/optimizer_ops.py),
+    so fused weights match the eager path within FMA-contraction ulps
+    (tests/test_fused_fit.py pins the tolerance).
 
-    def step(params, states, residuals, macc, inputs, auxs,
-             lr_vec, wd_vec, rescale, seed):
+    With a loss scaler, the entire compress+update block sits under a
+    ``lax.cond`` on a device-side finiteness check of the f32
+    master-gradient view — an overflow step updates neither weights,
+    nor optimizer state, nor error-feedback residuals — and the
+    scaler's (scale, good_steps, skips) triple is donated through the
+    program so skip bookkeeping never touches the host. The scale
+    itself stays a runtime scalar in that triple; MXNet loss heads
+    (SoftmaxOutput & co) generate their own gradient independent of
+    the output cotangent, so the backward chain is not cotangent-
+    scaled — see docs/TRAINING.md on why bf16's f32-matched exponent
+    range makes overflow DETECTION, not underflow scaling, the useful
+    half of the scaler here."""
+    upd = _fused.build(mode)
+
+    # analyze: ok(retrace) upd is a pure memoized function of `mode`, which is a builder parameter and part of the fit-program cache key
+    def step(params, states, residuals, macc, scaler_state, inputs, auxs,
+             lr_vec, wd_vec, rescale, extra, seed):
         _note_retrace()   # trace-time host side effect only
 
         def f(p):
@@ -165,30 +191,55 @@ def _build_fit_program(graph_fn, param_order, threshold, mode, state_mask,
         cts = [jnp.ones_like(o) for o in outs]
         (grads,) = vjp_fn(cts)
 
-        # 2-bit quantize with donated error-feedback residual; a mesh-
-        # sharded batch already yielded psum-reduced (replicated) grads
-        # from the vjp, so there is no separate reduce stage to launch
-        new_res, red = {}, {}
-        for name in param_order:
-            if threshold is not None:
-                red[name], new_res[name] = two_bit_quantize(
-                    residuals[name], grads[name], threshold)
-            else:
-                red[name] = grads[name]
+        # the f32 master-gradient view: error-feedback residuals and
+        # the optimizer math both run on it, so bf16 model grads are
+        # widened exactly once, before compression
+        g32 = {name: grads[name].astype(jnp.float32)
+               for name in param_order}
 
-        new_ps, new_ss = {}, {}
-        for i, name in enumerate(param_order):
-            new_ps[name], new_ss[name] = fused_sgd_apply(
-                params[name], red[name],
-                states[name] if state_mask[i] else None,
-                lr_vec[i], wd_vec[i], rescale, momentum, clip, use_wd)
+        def apply_updates(_):
+            # 2-bit quantize with donated error-feedback residual; a
+            # mesh-sharded batch already yielded psum-reduced
+            # (replicated) grads from the vjp, so there is no separate
+            # reduce stage to launch
+            new_res, red = {}, {}
+            for name in param_order:
+                if threshold is not None:
+                    red[name], new_res[name] = two_bit_quantize(
+                        residuals[name], g32[name], threshold)
+                else:
+                    red[name] = g32[name]
+            new_ps, new_ss = {}, {}
+            for i, name in enumerate(param_order):
+                st = _fused.unflatten(tpls[i], states[name])
+                e = extra[i] if upd.n_extra else ()
+                new_w, new_s = _fused.apply_one(
+                    upd, params[name], red[name], st, mp_flags[i],
+                    lr_vec[i], wd_vec[i], rescale, e, use_wd)
+                new_ps[name] = new_w
+                new_ss[name] = tuple(_fused.flatten_state(new_s)[0])
+            return (new_ps, new_ss,
+                    new_res if threshold is not None else residuals)
+
+        if scaler is not None:
+            finite = jnp.bool_(True)
+            for name in param_order:
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(g32[name])))
+            new_ps, new_ss, new_res = jax.lax.cond(
+                finite, apply_updates, lambda _: (params, states, residuals),
+                None)
+            new_scaler = scaler.step_fn(finite, scaler_state)
+        else:
+            new_ps, new_ss, new_res = apply_updates(None)
+            new_scaler = scaler_state
 
         if metric_fn is not None:
             bsum, bnum = metric_fn(inputs, outs)
             macc = (macc[0] + bsum, macc[1] + bnum)
-        return new_ps, new_ss, new_res, macc, new_auxs, outs
+        return new_ps, new_ss, new_res, macc, new_scaler, new_auxs, outs
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 5))
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 6))
 
 
 class FusedFitStep:
@@ -196,12 +247,14 @@ class FusedFitStep:
 
     _METRIC_UNSET = object()
 
-    def __init__(self, module, updater, kv, threshold, mode, pmesh=None):
+    def __init__(self, module, updater, kv, threshold, mode, pmesh=None,
+                 scaler=None):
         self._mod = module
         self._updater = updater
         self._kv = kv                 # None, plain local KVStore, or tpu
         self._threshold = threshold
         self._mode = mode             # optimizer._fused_fit_sig() at build
+        self._scaler = scaler         # DynamicLossScaler (low-prec params)
         # multi-process tpu kvstore on an accelerator backend: the fit
         # program runs over this global 'dp' mesh — the vjp's gradient
         # reduction becomes the cross-host psum, keeping one launch and
@@ -242,10 +295,13 @@ class FusedFitStep:
                     if kind == "residuals":
                         return list((s._residuals or {}).values())
                     if kind == "opt_states":
-                        states = (s._updater.states.get(uk)
-                                  for uk in (s._ukeys or ()))
-                        return [st._data for st in states
-                                if st is not None and hasattr(st, "_data")]
+                        out = []
+                        for uk in (s._ukeys or ()):
+                            leaves, _ = _fused.flatten_state(
+                                s._updater.states.get(uk))
+                            out.extend(l._data for l in leaves
+                                       if hasattr(l, "_data"))
+                        return out
                 except Exception:
                     return ()
                 return ()
@@ -280,7 +336,7 @@ class FusedFitStep:
         if sig is None:
             return no("optimizer %s has no fused signature"
                       % type(optimizer).__name__)
-        if sig[0] != "sgd":
+        if not _fused.supported(sig):
             return no("unsupported fused kind %r" % (sig[0],))
         kv = module._kvstore
         if module._update_on_kvstore:
@@ -305,6 +361,7 @@ class FusedFitStep:
             if thr is None:
                 return no("unsupported gradient compression")
             threshold = float(thr)
+        low_prec = False
         for name in group.param_names:
             arr = exe.arg_dict.get(name)
             if arr is None or exe._grad_req.get(name, "null") == "null":
@@ -312,10 +369,20 @@ class FusedFitStep:
             if exe._grad_req[name] != "write":
                 return no("grad_req %r on %s" % (exe._grad_req[name], name))
             if getattr(arr, "stype", "default") != "default" \
-                    or arr.dtype != _np.float32:
-                return no("non-dense-f32 param %s" % name)
+                    or (arr.dtype != _np.float32
+                        and not _fused.is_low_precision(arr.dtype)):
+                return no("non-dense-float param %s" % name)
+            low_prec = low_prec or _fused.is_low_precision(arr.dtype)
+        scaler = None
+        if low_prec:
+            # the scaler lives on the MODULE so it survives rebinds /
+            # init_optimizer and round-trips through checkpoints
+            scaler = getattr(module, "_loss_scaler", None)
+            if scaler is None:
+                scaler = _fused.DynamicLossScaler.from_config()
+                module._loss_scaler = scaler   # None when scaling is off
         step = FusedFitStep(module, updater, kv, threshold, sig,
-                            pmesh=pmesh)
+                            pmesh=pmesh, scaler=scaler)
         if not step._param_order():
             return no("no trainable parameters")
         return step
@@ -382,10 +449,14 @@ class FusedFitStep:
         for n in order:
             w = exe.arg_dict[n]
             if kv is not None:
-                res[n] = kv._get_residual((n, 0), w)._data
+                # residuals live on the f32 master-gradient view; the
+                # cast is a no-op for freshly seeded (already f32)
+                # residuals and widens any pre-upgrade checkpoint state
+                res[n] = kv._get_residual((n, 0), w)._data \
+                    .astype(jnp.float32)
                 kv._compression_residuals.pop((n, 0), None)
             else:
-                res[n] = jnp.zeros(w.shape, w._data.dtype)
+                res[n] = jnp.zeros(w.shape, jnp.float32)
         self._residuals = res
         return res
 
@@ -416,7 +487,7 @@ class FusedFitStep:
             self._release()
             return False
         mode = mod._optimizer._fused_fit_sig()
-        if mode is None or mode[0] != "sgd":
+        if mode is None or not _fused.supported(mode):
             self._release()
             return False
         group = mod._exec_group
@@ -466,24 +537,30 @@ class FusedFitStep:
         # must not have advanced update counts or created state entries
         for uk in ukeys:
             st = updater.states.get(uk)
-            if st is not None and not isinstance(st, NDArray):
-                self._release()
-                return False       # e.g. loaded multi-precision tuple
-        states_nd = []
+            if st is not None:
+                leaves, _ = _fused.flatten_state(st)
+                if not all(isinstance(l, NDArray) for l in leaves):
+                    self._release()
+                    return False   # e.g. a host-side custom state blob
+        states_nd, tpls, mp_flags = [], [], []
         for n, uk in zip(order, ukeys):
             if uk not in updater.states:
                 updater.states[uk] = optimizer.create_state_multi_precision(
                     uk, exe.arg_dict[n])
                 updater.states_synced[uk] = True
-            states_nd.append(updater.states[uk])
-            optimizer._update_count(uk)
-        lr_vec = _np.asarray([optimizer._get_lr(uk) for uk in ukeys],
-                             _np.float32)
-        wd_vec = _np.asarray([optimizer._get_wd(uk) for uk in ukeys],
-                             _np.float32)
+            st = updater.states[uk]
+            states_nd.append(st)
+            tpls.append(_fused.state_template(st))
+            # multi-precision is an EXPLICIT static flag (an Adam
+            # (mean, var) pair is structurally ambiguous with an
+            # (inner, weight32) master tuple)
+            mp_flags.append(bool(optimizer.multi_precision)
+                            and _fused.is_low_precision(
+                                exe.arg_dict[n].dtype))
+        lr_vec, wd_vec, extra = optimizer._fused_runtime(ukeys)
         use_wd = bool(_np.any(wd_vec != 0.0))
-        state_mask = tuple(st is not None for st in states_nd)
-        states = {n: (st._data if st is not None else None)
+        tpls, mp_flags = tuple(tpls), tuple(mp_flags)
+        states = {n: tuple(l._data for l in _fused.flatten_state(st)[0])
                   for n, st in zip(order, states_nd)}
         residuals = self._seed_residuals(order, exe) \
             if self._threshold is not None else {}
@@ -495,17 +572,25 @@ class FusedFitStep:
         metric_fn, msig = self._metric_fn, self._msig
         from .. import config as _config
         mirror = _config.backward_do_mirror()
+        scaler = self._scaler
+        if scaler is not None:
+            # a checkpoint restore may have swapped the module's scaler
+            # object; its step_fn is pure in trace_sig so cached
+            # programs built against the old object stay valid
+            scaler = getattr(mod, "_loss_scaler", None) or scaler
+            self._scaler = scaler
+        scaler_sig = scaler.trace_sig() if scaler is not None else None
         cache = _compiled_cache(mod._symbol).setdefault("fit_step", {})
         # `mode` re-read above: mutating optimizer hyperparams mid-
         # training switches programs (one retrace), like the eager path
-        key = (tuple(order), self._threshold, mode, state_mask,
-               use_wd, msig, mirror)
+        key = (tuple(order), self._threshold, mode, tpls, mp_flags,
+               use_wd, msig, mirror, scaler_sig)
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = _build_fit_program(
                 _compiled_cache(mod._symbol)["graph_fn"], tuple(order),
-                self._threshold, mode, state_mask, use_wd,
-                metric_fn, mirror)
+                self._threshold, mode, tpls, mp_flags, use_wd,
+                metric_fn, mirror, scaler)
 
         macc = ()
         if metric_fn is not None:
@@ -514,12 +599,14 @@ class FusedFitStep:
                     eval_metric._dev_num
                     if eval_metric._dev_num is not None else jnp.float32(0.0))
 
+        scaler_state = scaler.device_state() if scaler is not None else ()
         auxs = exe._auxs_values()
         if self._pmesh is not None:
             # lift every program input onto the cross-host mesh (no-op
             # for arrays the previous step already left there)
             params = {n: self._lift_repl(v) for n, v in params.items()}
-            states = {n: self._lift_repl(v) for n, v in states.items()}
+            states = {n: tuple(self._lift_repl(l) for l in v)
+                      for n, v in states.items()}
             residuals = {n: self._lift_repl(v)
                          for n, v in residuals.items()}
             auxs = {n: self._lift_repl(v) for n, v in auxs.items()}
@@ -528,6 +615,7 @@ class FusedFitStep:
                           else self._lift_repl(v))
                       for n, v in inputs.items()}
             macc = tuple(self._lift_repl(m) for m in macc)
+            scaler_state = tuple(self._lift_repl(s) for s in scaler_state)
 
         seed = exe._next_seed()
         rescale = _np.float32(optimizer.rescale_grad)
@@ -539,9 +627,10 @@ class FusedFitStep:
         try:
             with exe._prof_scope("Module::fused_fit_step"), \
                     _telemetry.tracing.span("fit.fused_dispatch"):
-                new_ps, new_ss, new_res, macc, new_auxs, outs = _SITE.timed(
-                    fn, params, states, residuals, macc, inputs,
-                    auxs, lr_vec, wd_vec, rescale, seed)
+                (new_ps, new_ss, new_res, macc, new_scaler, new_auxs,
+                 outs) = _SITE.timed(
+                    fn, params, states, residuals, macc, scaler_state,
+                    inputs, auxs, lr_vec, wd_vec, rescale, extra, seed)
         except Exception:
             # a runtime failure after donation consumes the donated
             # buffers — drop our residual refs so a later spill doesn't
@@ -559,10 +648,13 @@ class FusedFitStep:
             exe.arg_dict[n]._set_data(new_ps[n])
             if kv_store is not None and n in kv_store:
                 kv_store[n]._set_data(new_ps[n])
-            if st is not None:
-                st._set_data(new_ss[n])
+            for leaf, new_leaf in zip(_fused.flatten_state(st)[0],
+                                      new_ss[n]):
+                leaf._set_data(new_leaf)
         if self._threshold is not None:
             self._residuals = dict(new_res)
+        if scaler is not None:
+            scaler.set_device_state(new_scaler)
         exe._write_auxs(new_auxs)
         exe._outputs = [NDArray(o, exe._ctx) for o in outs]
         exe._pending_train_fwd = False

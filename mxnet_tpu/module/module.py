@@ -461,6 +461,11 @@ class Module(BaseModule):
             if arr is not None and exe._grad_req.get(name, "null") != "null":
                 jax.block_until_ready(arr._data)
                 break
+        scaler = getattr(self, "_loss_scaler", None)
+        if scaler is not None:
+            # already a sync boundary: refresh the loss_scale gauge and
+            # overflow-skip counter from the device triple
+            scaler.publish()
 
     def update(self):
         """Apply one optimizer step (kvstore push/pull or local updater)."""

@@ -22,10 +22,35 @@ from . import ndarray as nd
 
 __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "NAG", "SGLD", "Adam",
            "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
-           "FTML", "DCASGD", "LBSGD", "Test", "Updater", "get_updater",
-           "create", "register"]
+           "FTML", "DCASGD", "LBSGD", "LAMB", "Test", "Updater",
+           "get_updater", "create", "register", "FUSED_EAGER_WAIVERS"]
 
 _OPT_REGISTRY = {}
+
+# Optimizers that intentionally stay on the eager per-key path. The
+# analyze ``optfused`` pass (tools/check_static.py, tier-1) requires
+# every ``@register``-ed optimizer to either describe its update via
+# ``_fused_sig`` or sit here with a reason — new optimizers can't
+# silently ship eager-only.
+FUSED_EAGER_WAIVERS = {
+    "Signum": "sign-of-momentum update couples wd_lh into the weight "
+              "step; niche optimizer, fuse on demand",
+    "SignSGD": "inherits Signum's eager path",
+    "NAG": "nesterov look-ahead mutates the momentum mid-formula; "
+           "fuse together with Signum if demand appears",
+    "SGLD": "draws fresh host-side Langevin noise every update — not a "
+            "pure function of (weight, grad, state)",
+    "GroupAdaGrad": "embedding-table optimizer; rides the compiled "
+                    "row_sparse pipeline via _fused_sparse_sig instead",
+    "AdaDelta": "accumulator pair updated through aliased in-place "
+                "views; rarely used at scale",
+    "Ftrl": "piecewise-zero proximal update (sparse-regime optimizer)",
+    "FTML": "t-dependent denominator already runs as one fused XLA op "
+            "per key via nd.ftml_update",
+    "DCASGD": "delay compensation snapshots the full previous weight — "
+              "async-SGD only, never on the sync hot path",
+    "Test": "conformance-test fixture",
+}
 
 
 def register(klass):
@@ -89,10 +114,10 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        weight_master_copy = None
         if self.multi_precision and weight.dtype in (_np.float16, _np.dtype("bfloat16")):
-            weight_master_copy = array(weight.asnumpy().astype("float32"),
-                                       ctx=weight.context)
+            # master-copy creation stays on device (astype enqueues a
+            # cast; no asnumpy round-trip through the host)
+            weight_master_copy = weight.astype("float32")
             return (self.create_state(index, weight_master_copy),
                     weight_master_copy)
         return self.create_state(index, weight)
@@ -100,13 +125,75 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    # -- the fused-update protocol (docs/TRAINING.md) -------------------
+    # An optimizer *describes* its update as a pure jittable program:
+    # `_fused_sig()` names a kind registered in fused_update.py plus the
+    # trace-static hyperparameters; `_fused_update` is the resulting
+    # (params, grads, states, runtime_scalars) -> (params, states) pure
+    # function. Everything that changes per step — lr schedules, wd,
+    # rescale_grad (ragged batches!), loss scale, per-key bias
+    # correction (`_fused_extra`) — is a RUNTIME argument, so steady
+    # state never retraces. Multi-precision (inner, weight32) state
+    # tuples are first-class: the shared builder peels the master
+    # weight off the state and refreshes the low-precision model weight
+    # inside the same donated program.
+
+    def _fused_sig(self):
+        """Hashable ``(kind, *hypers)`` tuple fully determining the
+        pure per-key update (a fused_update.py kind), or None to stay
+        on the eager per-key path (then the class must be listed in
+        FUSED_EAGER_WAIVERS). The tuple keys every engine's program
+        cache, so mutating a hyperparameter in it retraces once."""
+        return None
+
+    def _fused_update(self, params, grads, states, runtime_scalars):
+        """The described update as a pure jittable program over aligned
+        per-key sequences: returns ``(new_params, new_states)``.
+        ``runtime_scalars`` carries the per-key ``lr``/``wd`` vectors,
+        the ``rescale`` scalar, the ``extra`` matrix from
+        `_fused_runtime`, the static per-key ``mp`` flags and the
+        static ``use_wd`` short-circuit."""
+        from . import fused_update
+        sig = self._fused_sig()
+        if sig is None:
+            raise MXNetError("%s does not describe a fused update"
+                             % type(self).__name__)
+        return fused_update.bulk_apply(sig)(params, grads, states,
+                                            runtime_scalars)
+
+    def _fused_lr(self, index):
+        """Per-key runtime lr as consumed by the fused program. Kinds
+        that fold time-dependent bias correction into the step size on
+        the host (Adam, Adamax) override this; `_update_count` must
+        already have run for the key."""
+        return self._get_lr(index)
+
+    def _fused_extra(self, ukeys):
+        """(n_keys, n_extra) float32 matrix of per-key runtime scalars
+        beyond lr/wd (e.g. Nadam's schedule products, LAMB's bias
+        corrections). Host-side schedule state is advanced HERE, in
+        ukeys order, mirroring the eager per-key sequence."""
+        return _np.zeros((len(ukeys), 0), dtype=_np.float32)
+
+    def _fused_runtime(self, ukeys):
+        """Advance update counts for ``ukeys`` and collect the runtime
+        vectors for one fused step: ``(lr_vec, wd_vec, extra)``."""
+        for uk in ukeys:
+            self._update_count(uk)
+        lr_vec = _np.asarray([self._fused_lr(uk) for uk in ukeys],
+                             dtype=_np.float32)
+        wd_vec = _np.asarray([self._get_wd(uk) for uk in ukeys],
+                             dtype=_np.float32)
+        return lr_vec, wd_vec, self._fused_extra(ukeys)
+
     def _fused_bucket_sig(self):
         """Signature enabling the kvstore compiled bucketed hot path
         (kvstore_fused.py): a hashable tuple fully determining the pure
         per-bucket update, or None to keep updates per-key eager. The
         tuple is part of the bucket-program cache key, so mutating any
-        hyperparameter in it retraces exactly once."""
-        return None
+        hyperparameter in it retraces exactly once. Defaults to the
+        shared fused-update signature."""
+        return self._fused_sig()
 
     def _fused_fit_sig(self):
         """Signature enabling the single-launch fit step
@@ -216,12 +303,12 @@ class SGD(Optimizer):
             return None
         return zeros(weight.shape, weight.context, dtype="float32")
 
-    def _fused_bucket_sig(self):
-        if self.multi_precision:
-            return None    # (state, weight32) tuples stay per-key eager
+    def _fused_sig(self):
         # rescale_grad is NOT part of the signature: gluon Trainer.step
         # rewrites it every call (scale/batch_size), so it rides along as
-        # a runtime scalar — a ragged final batch must not retrace
+        # a runtime scalar — a ragged final batch must not retrace.
+        # Multi-precision does NOT opt out: (inner, weight32) state
+        # tuples are handled by the shared builder.
         return ("sgd", float(self.momentum),
                 None if self.clip_gradient is None
                 else float(self.clip_gradient))
@@ -362,6 +449,19 @@ class Adam(Optimizer):
         return (zeros(weight.shape, weight.context, dtype="float32"),
                 zeros(weight.shape, weight.context, dtype="float32"))
 
+    def _fused_sig(self):
+        return ("adam", float(self.beta1), float(self.beta2),
+                float(self.epsilon),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_lr(self, index):
+        # bias correction folds into the step size on the host exactly
+        # like the eager update — lr stays a pure runtime scalar
+        t = self._index_update_count[index]
+        return self._get_lr(index) * (
+            math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t))
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -389,6 +489,11 @@ class AdaGrad(Optimizer):
 
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype="float32")
+
+    def _fused_sig(self):
+        return ("adagrad", float(self.float_stable_eps),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
 
     def _fused_sparse_sig(self):
         if self.multi_precision:
@@ -471,6 +576,17 @@ class RMSProp(Optimizer):
                     zeros(weight.shape, weight.context, dtype="float32"))
         return zeros(weight.shape, weight.context, dtype="float32")
 
+    def _fused_sig(self):
+        clip = (None if self.clip_gradient is None
+                else float(self.clip_gradient))
+        # mirrors the eager kwargs: clip_weights rides only when truthy
+        cw = float(self.clip_weights) if self.clip_weights else None
+        if self.centered:
+            return ("rmspropalex", float(self.gamma1), float(self.gamma2),
+                    float(self.epsilon), clip, cw)
+        return ("rmsprop", float(self.gamma1), float(self.epsilon),
+                clip, cw)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -546,6 +662,15 @@ class Adamax(Optimizer):
         return (zeros(weight.shape, weight.context),
                 zeros(weight.shape, weight.context))
 
+    def _fused_sig(self):
+        return ("adamax", float(self.beta1), float(self.beta2),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_lr(self, index):
+        t = self._index_update_count[index]
+        return self._get_lr(index) / (1.0 - self.beta1 ** t)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -575,6 +700,29 @@ class Nadam(Optimizer):
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context),
                 zeros(weight.shape, weight.context))
+
+    def _fused_sig(self):
+        return ("nadam", float(self.beta1), float(self.beta2),
+                float(self.epsilon), float(self.schedule_decay),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_extra(self, ukeys):
+        # the shared m_schedule product advances once per key per step;
+        # doing it here in ukeys order mirrors the eager sequence, so
+        # fused and eager see identical per-key schedule values
+        out = _np.zeros((len(ukeys), 5), dtype=_np.float32)
+        for i, uk in enumerate(ukeys):
+            t = self._index_update_count[uk]
+            momentum_t = self.beta1 * (
+                1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+            momentum_t_1 = self.beta1 * (
+                1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+            self.m_schedule = self.m_schedule * momentum_t
+            m_schedule_next = self.m_schedule * momentum_t_1
+            out[i] = (momentum_t, momentum_t_1, self.m_schedule,
+                      m_schedule_next, 1.0 - self.beta2 ** t)
+        return out
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -678,8 +826,15 @@ class LBSGD(SGD):
         self.batch_scale = batch_scale
         self.updates_per_epoch = updates_per_epoch
 
-    def _fused_bucket_sig(self):
-        return None    # per-key LARS norms don't fit the shared bucket fn
+    def _fused_sig(self):
+        # the per-key LARS norms fold into the fused program as device
+        # reductions — no host syncs, unlike the eager _get_lars path
+        return ("lbsgd", float(self.momentum),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_sparse_sig(self):
+        return None    # LARS over touched rows is ill-defined; stay eager
 
     def _get_lars(self, weight, g, wd):
         w_norm = float(nd.norm(weight).asscalar())
@@ -698,6 +853,82 @@ class LBSGD(SGD):
                            momentum=self.momentum, **kw)
         else:
             sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype in (
+            _np.float16, _np.dtype("bfloat16"))
+        if not use_mp:
+            return self.update(index, weight, grad, state)
+        # unlike the inherited SGD path, LARS must scale the step taken
+        # on the fp32 master (norms computed on master + f32 grad)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, weight32 = state
+        lr = lr * self._get_lars(weight32, grad.astype("float32"), wd)
+        kw = self._common_kwargs(index)
+        if mom is not None:
+            mp_sgd_mom_update(weight, grad, mom, weight32, out=weight,
+                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            mp_sgd_update(weight, grad, weight32, out=weight, lr=lr,
+                          wd=wd, **kw)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adaptive Moments for Batch training (You et al.,
+    arXiv:1904.00962): Adam moments with a per-layer trust ratio
+    ``||w|| / ||update||`` scaling the step, the large-batch
+    generalization of LARS to adaptive optimizers. The eager path
+    computes the two norms on the host (LBSGD idiom); the fused program
+    folds them in as device reductions."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype="float32"),
+                zeros(weight.shape, weight.context, dtype="float32"))
+
+    def _fused_sig(self):
+        return ("lamb", float(self.beta1), float(self.beta2),
+                float(self.epsilon), bool(self.bias_correction),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def _fused_extra(self, ukeys):
+        out = _np.zeros((len(ukeys), 2), dtype=_np.float32)
+        for i, uk in enumerate(ukeys):
+            t = self._index_update_count[uk]
+            out[i] = (1.0 - self.beta1 ** t, 1.0 - self.beta2 ** t)
+        return out
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad.astype("float32") * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        m, v = state
+        m[:] = self.beta1 * m + (1.0 - self.beta1) * grad
+        v[:] = self.beta2 * v + (1.0 - self.beta2) * grad * grad
+        if self.bias_correction:
+            m_hat = m / (1.0 - self.beta1 ** t)
+            v_hat = v / (1.0 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = m, v
+        r = m_hat / (nd.sqrt(v_hat) + self.epsilon) + wd * weight
+        w_norm = float(nd.norm(weight).asscalar())
+        r_norm = float(nd.norm(r).asscalar())
+        ratio = w_norm / r_norm if (w_norm > 0 and r_norm > 0) else 1.0
+        weight -= lr * ratio * r
 
 
 @register

@@ -457,10 +457,73 @@ def test_every_baseline_entry_has_reason():
         assert e.get("reason", "").strip(), e
 
 
+def test_optfused_flags_unwaived_and_stale():
+    from analyze.optfused import OptFusedPass
+    src = """
+        FUSED_EAGER_WAIVERS = {
+            "Waived": "niche optimizer, fuse on demand",
+            "Fused": "stale: class grew _fused_sig",
+            "Ghost": "names no registered class",
+            "Empty": "",
+        }
+
+        def register(klass):
+            return klass
+
+        class Optimizer:
+            def _fused_sig(self):
+                return None
+
+        @register
+        class Fused(Optimizer):
+            def _fused_sig(self):
+                return ("sgd", 0.0, None)
+
+        @register
+        class Inherits(Fused):
+            pass
+
+        @register
+        class Waived(Optimizer):
+            pass
+
+        @register
+        class Bare(Optimizer):
+            pass
+
+        @register
+        class Empty(Optimizer):
+            pass
+    """
+    m = make_module(src, relpath="mxnet_tpu/optimizer.py")
+    _, findings = run_pass(OptFusedPass(), m)
+    slugs = {(f.slug, f.detail) for f in findings}
+    # Bare: registered, no _fused_sig, no waiver
+    assert ("eager-only-optimizer", "Bare") in slugs
+    # the root Optimizer's default _fused_sig must NOT count as fused
+    assert not any(d == "Waived" and s == "stale-waiver"
+                   for s, d in slugs)
+    # Inherits gets the protocol through its in-file ancestor Fused
+    assert not any(d == "Inherits" for _, d in slugs)
+    # Fused implements the protocol but kept its waiver; Ghost names
+    # nothing registered; Empty has no reason
+    assert ("stale-waiver", "Fused") in slugs
+    assert ("stale-waiver", "Ghost") in slugs
+    assert ("empty-waiver-reason", "Empty") in slugs
+    assert len(findings) == 4
+
+
+def test_optfused_live_tree_clean():
+    from analyze.optfused import OptFusedPass
+    mod = core.Module(REPO, "mxnet_tpu/optimizer.py")
+    _, findings = run_pass(OptFusedPass(), mod)
+    assert findings == [], [(f.slug, f.detail) for f in findings]
+
+
 def test_all_passes_registered():
     names = [p.name for p in analyze.all_passes()]
     assert names == ["hostsync", "retrace", "donation", "threads",
-                     "collective", "telemetry", "envknobs"]
+                     "collective", "telemetry", "envknobs", "optfused"]
 
 
 @pytest.mark.parametrize("knob", ["MXNET_KVSTORE_BIGARRAY_BOUND",

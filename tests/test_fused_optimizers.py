@@ -1,0 +1,359 @@
+"""Fused optimizer update programs (mxnet_tpu/fused_update.py) and
+bf16 mixed-precision training end-to-end.
+
+Pins: the fused-vs-eager parity matrix (every fused optimizer kind x
+{f32, bf16 multi-precision} x {2-bit error feedback on/off}) at the
+kvstore level where both paths see IDENTICAL gradients, bit-level
+equality of the 2-bit error-feedback residuals on the f32
+master-gradient view, zero steady-state retraces while an lr schedule
+advances every step and batches go ragged, the dynamic loss scaler's
+overflow-skip semantics (weights/states frozen through a non-finite
+step, backoff, growth, static mode), checkpoint resume parity for a
+bf16+Adam multi-precision run (master weights + scaler state round
+trip), and the satellite-2 guarantee that a DEFAULT Adam config never
+falls back to the eager per-key path (no ``unfused_optimizer:`` slug).
+
+Tolerances: at the kvstore level the bucketed and eager paths consume
+the same pushed gradients, so f32 weights drift only by FMA
+contraction (~1 ulp per mul-add chain; docs/TRAINING.md Parity). The
+bf16 arm stores bf16 weights stepped from f32 masters on both paths;
+one bf16 ulp is ~0.8%, so the pin is 1e-2 (docs/TRAINING.md documents
+this bound). Residuals evolve through adds and exact-constant selects
+only — no contraction can perturb them — hence the atol=0 pin.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import fused_update
+from mxnet_tpu import telemetry
+from mxnet_tpu.module import fused_fit
+
+SHAPES = [(32, 16), (64,), (3, 3, 4, 4), (7,)]
+
+# f32: identical grads, same op sequence modulo program boundaries ->
+# ulp-scale drift only (sqrt/div chains in the adaptive optimizers are
+# a little wider than SGD's, hence 5e-6 over test_kvstore_fused's 5e-7)
+_F32_RTOL = _F32_ATOL = 5e-6
+# bf16: both paths step an f32 master and round to bf16 once; a master
+# drifting across a rounding boundary moves the stored value by one
+# bf16 ulp (~2**-8)
+_BF16_TOL = 1e-2
+
+_OPTIMIZERS = {
+    "sgd": lambda **kw: mx.optimizer.SGD(
+        learning_rate=0.05, momentum=0.9, wd=1e-4, **kw),
+    "adam": lambda **kw: mx.optimizer.Adam(
+        learning_rate=0.01, wd=1e-4, **kw),
+    "lamb": lambda **kw: mx.optimizer.LAMB(
+        learning_rate=0.01, wd=1e-2, **kw),
+    "rmsprop": lambda **kw: mx.optimizer.RMSProp(
+        learning_rate=0.01, centered=True, **kw),
+    "adagrad": lambda **kw: mx.optimizer.AdaGrad(
+        learning_rate=0.05, **kw),
+    "adamax": lambda **kw: mx.optimizer.Adamax(
+        learning_rate=0.01, **kw),
+    "nadam": lambda **kw: mx.optimizer.Nadam(
+        learning_rate=0.01, **kw),
+    "lbsgd": lambda **kw: mx.optimizer.LBSGD(
+        learning_rate=0.05, momentum=0.9, wd=1e-4, **kw),
+}
+
+
+def _make_kv(bucketed, opt_name, compress=None, multi_precision=False):
+    kv = mx.kv.create("device")
+    kv.set_bucketing(bucketed)
+    if compress is not None:
+        kv.set_gradient_compression({"type": "2bit",
+                                     "threshold": compress})
+    kw = {"multi_precision": True} if multi_precision else {}
+    kv.set_optimizer(_OPTIMIZERS[opt_name](rescale_grad=0.5, **kw))
+    return kv
+
+
+def _run_kv(kv, dtype="float32", n_steps=3, n_dev=2, seed=1):
+    """Init + push identical gradient streams; returns pulled weights
+    as f32 numpy. Both the bucketed-compiled and eager per-key paths
+    see the exact same inputs, so parity is on the optimizer math."""
+    keys = ["p%d" % i for i in range(len(SHAPES))]
+    rng = np.random.RandomState(0)
+    for k, s in zip(keys, SHAPES):
+        w = nd.array(rng.normal(0, 1, s).astype(np.float32))
+        kv.init(k, w if dtype == "float32" else w.astype(dtype))
+    r = np.random.RandomState(seed)
+    for _ in range(n_steps):
+        grads = []
+        for s in SHAPES:
+            vs = [nd.array(r.normal(0, 1, s).astype(np.float32))
+                  for _ in range(n_dev)]
+            if dtype != "float32":
+                vs = [v.astype(dtype) for v in vs]
+            grads.append(vs)
+        kv.push(keys, grads)
+    outs = [nd.zeros(s) if dtype == "float32"
+            else nd.zeros(s).astype(dtype) for s in SHAPES]
+    kv.pull(keys, out=outs)
+    return [o.astype("float32").asnumpy() for o in outs]
+
+
+# ----------------------------------------------------------------------
+# the parity matrix: optimizer x {f32, bf16+MP} x {2bit on/off}
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("compress", [None, 0.05],
+                         ids=["dense", "2bit"])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("opt_name", sorted(_OPTIMIZERS))
+def test_fused_matches_eager_matrix(opt_name, dtype, compress):
+    mp = dtype != "float32"
+    a = _run_kv(_make_kv(True, opt_name, compress, mp), dtype)
+    b = _run_kv(_make_kv(False, opt_name, compress, mp), dtype)
+    tol = {"rtol": _F32_RTOL, "atol": _F32_ATOL} if not mp else \
+          {"rtol": _BF16_TOL, "atol": _BF16_TOL}
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, err_msg=opt_name, **tol)
+
+
+def test_residuals_bit_identical_on_f32_master_view():
+    """2-bit error feedback under bf16 multi-precision Adam: the
+    residuals live on the f32 MASTER-gradient view (bf16 grads are
+    widened exactly once before compression) and evolve through adds
+    and exact-constant selects only, so the bucketed-compiled and
+    eager per-key residuals must agree BIT-FOR-BIT even though the
+    optimizer-applied weights drift by FMA ulps."""
+    kvs = {}
+    for bucketed in (True, False):
+        kv = _make_kv(bucketed, "adam", compress=0.05,
+                      multi_precision=True)
+        _run_kv(kv, "bfloat16")
+        kv._sync_engine()   # spill flat bucket residuals per (key, dev)
+        kvs[bucketed] = kv
+    res_f = kvs[True]._compression_residuals
+    res_e = kvs[False]._compression_residuals
+    assert res_f and sorted(res_f) == sorted(res_e)
+    for rk in res_f:
+        x = res_f[rk].asnumpy()
+        assert x.dtype == np.float32, (rk, x.dtype)
+        np.testing.assert_array_equal(x, res_e[rk].asnumpy(), err_msg=rk)
+    # and they are nonzero — real error feedback, not a dropped path
+    assert any(float(np.abs(v.asnumpy()).sum()) > 0
+               for v in res_f.values())
+
+
+# ----------------------------------------------------------------------
+# satellite 2: default Adam NEVER falls back
+# ----------------------------------------------------------------------
+def test_default_adam_takes_fused_path_no_fallback():
+    """An out-of-the-box Adam config must ride the compiled bucketed
+    path: the ``kvstore_fallbacks`` counter gains no
+    ``unfused_optimizer:Adam`` count and the engine reports the config
+    eligible."""
+    c = telemetry.REGISTRY.get("kvstore_fallbacks").labels(
+        reason="unfused_optimizer:Adam")
+    before = c.value
+    kv = mx.kv.create("device")
+    kv.set_bucketing(True)
+    kv.set_optimizer(mx.optimizer.Adam())     # ALL defaults
+    _run_kv(kv)
+    assert c.value == before, "default Adam fell back to eager"
+    eng = kv._get_engine()
+    assert eng.ineligible_reason(
+        "p0", [kv._store["p0"]], eng._updater_mode()) is None
+
+
+def test_waived_eager_optimizer_counts_bounded_slug():
+    """Waiver-listed eager-only optimizers fall back with the bounded
+    ``unfused_optimizer:<Name>`` slug (docs/KVSTORE.md)."""
+    c = telemetry.REGISTRY.get("kvstore_fallbacks").labels(
+        reason="unfused_optimizer:Ftrl")
+    before = c.value
+    kv = mx.kv.create("device")
+    kv.set_bucketing(True)
+    kv.set_optimizer(mx.optimizer.Ftrl())
+    kv.init("w", nd.array(np.ones((8,), np.float32)))
+    kv.push("w", nd.array(np.full((8,), 0.1, np.float32)))
+    assert c.value > before
+
+
+# ----------------------------------------------------------------------
+# zero steady-state retraces: lr schedule + ragged batches
+# ----------------------------------------------------------------------
+def _mlp(low_precision=False):
+    data = sym.Variable("data")
+    if low_precision:
+        data = sym.Cast(data, dtype="bfloat16")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    if low_precision:
+        net = sym.Cast(net, dtype="float32")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _make_mod(optimizer="adam", opt_params=None, low_precision=False,
+              batch=16):
+    mod = mx.Module(_mlp(low_precision), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 6))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer=optimizer,
+                       optimizer_params=opt_params
+                       or {"learning_rate": 0.05})
+    return mod
+
+
+def _batch(n=16, seed=0, bad=False):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 6).astype(np.float32)
+    if bad:
+        X[0, 0] = np.inf       # forward -> inf logits -> nan grads
+    y = rng.randint(0, 4, n).astype(np.float32)
+    return mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+
+
+def test_zero_retraces_while_lr_schedule_advances():
+    """The lr schedule changes the learning rate EVERY step; lr is a
+    runtime argument of the fused program, so the trace counter must
+    not move in steady state — across ragged final batches too."""
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.9)
+    mod = _make_mod(opt_params={"learning_rate": 0.1,
+                                "lr_scheduler": sched})
+    assert mod.fit_step(_batch(16))
+    assert mod.fit_step(_batch(7))      # ragged shape: one new trace
+    traced = fused_fit.TRACE_COUNT
+    lr0 = mod._optimizer._get_lr(0)
+    for i, n in enumerate((16, 7, 16, 16, 7)):
+        assert mod.fit_step(_batch(n, seed=i))
+    assert fused_fit.TRACE_COUNT == traced, \
+        "lr schedule stepping retraced the fit program"
+    # the schedule really advanced (decayed lr), without a retrace
+    assert mod._optimizer._get_lr(0) < lr0
+
+
+def test_bf16_multi_precision_single_launch_no_retrace():
+    """bf16 + Adam multi-precision: fused single-launch steps, zero
+    steady-state retraces, and the update state is ((mean, var), w32)
+    with an f32 master."""
+    mod = _make_mod(opt_params={"learning_rate": 0.05,
+                                "multi_precision": True},
+                    low_precision=True)
+    for i in range(3):
+        assert mod.fit_step(_batch(seed=i))
+    traced = fused_fit.TRACE_COUNT
+    for i in range(3):
+        assert mod.fit_step(_batch(seed=i))
+    assert fused_fit.TRACE_COUNT == traced
+    assert mod._fused_fit is not None and mod._fused_fit.launches == 6
+    st = next(iter(mod._updater.states.values()))
+    inner, w32 = st
+    assert str(w32.dtype).startswith("float32")
+    assert len(inner) == 2      # (mean, var)
+
+
+# ----------------------------------------------------------------------
+# loss scaler: overflow-skip semantics
+# ----------------------------------------------------------------------
+def test_loss_scaler_overflow_skips_update_and_backs_off():
+    """A non-finite gradient must skip the weight/state update entirely
+    (bit-identical params through the bad step), bump the skip counter,
+    and halve the dynamic scale — all detected on device, no per-step
+    host sync."""
+    mod = _make_mod(opt_params={"learning_rate": 0.05,
+                                "multi_precision": True},
+                    low_precision=True)
+    for i in range(2):
+        assert mod.fit_step(_batch(seed=i))
+    scaler = mod._loss_scaler
+    assert scaler is not None
+    init_scale = scaler.publish()
+    before = {k: v.asnumpy().copy()
+              for k, v in mod.get_params()[0].items()}
+
+    assert mod.fit_step(_batch(bad=True))      # nan grads: skipped
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    scaler.publish()
+    assert scaler.skips == 1
+    assert scaler.scale == init_scale * fused_update.DynamicLossScaler.BACKOFF
+
+    assert mod.fit_step(_batch(seed=5))        # finite again: applied
+    moved = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    assert any(not np.array_equal(before[k], moved[k]) for k in before)
+    scaler.publish()
+    assert scaler.skips == 1                   # no new skips
+
+
+def test_loss_scaler_step_fn_growth_backoff_and_static():
+    """Pure in-program bookkeeping: growth after ``growth_interval``
+    consecutive finite steps (capped at MAX_SCALE), backoff + good
+    reset on overflow, and a static scaler that skips but never
+    adjusts."""
+    s = fused_update.DynamicLossScaler(init_scale=4.0, growth_interval=2)
+    st = s.device_state()
+    st = s.step_fn(True, st)
+    assert float(st[0]) == 4.0 and int(st[1]) == 1
+    st = s.step_fn(True, st)                   # hits the interval
+    assert float(st[0]) == 8.0 and int(st[1]) == 0
+    st = s.step_fn(False, st)                  # overflow
+    assert float(st[0]) == 4.0
+    assert int(st[1]) == 0 and int(st[2]) == 1
+    # cap
+    s2 = fused_update.DynamicLossScaler(
+        init_scale=fused_update.DynamicLossScaler.MAX_SCALE,
+        growth_interval=1)
+    st2 = s2.step_fn(True, s2.device_state())
+    assert float(st2[0]) == fused_update.DynamicLossScaler.MAX_SCALE
+    # static: fixed scale, still counts skips
+    s3 = fused_update.DynamicLossScaler(init_scale=128.0, dynamic=False)
+    st3 = s3.step_fn(False, s3.device_state())
+    assert float(st3[0]) == 128.0 and int(st3[2]) == 1
+    st3 = s3.step_fn(True, st3)
+    assert float(st3[0]) == 128.0 and int(st3[2]) == 1
+
+
+# ----------------------------------------------------------------------
+# checkpoint resume parity: bf16 + Adam multi-precision
+# ----------------------------------------------------------------------
+def test_bf16_adam_checkpoint_resume_parity(tmp_path):
+    """Checkpoint a bf16+MP Adam run mid-training and resume: the
+    continued run is BIT-IDENTICAL to the uninterrupted one (the f32
+    masters live in the optimizer states file) and the loss-scaler
+    triple rides along in extra['loss_scaler']."""
+    from mxnet_tpu import checkpoint
+    prefix = str(tmp_path / "ck")
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = _make_mod(opt_params={"learning_rate": 0.05,
+                                "multi_precision": True},
+                    low_precision=True)
+    for i in range(3):
+        mod.fit_step(_batch(seed=i))
+    mgr = checkpoint.CheckpointManager(prefix, module=mod,
+                                       install_preemption=False)
+    man = mgr.save(epoch=0, step=3, block=True)
+    mgr.close()
+    assert "loss_scaler" in checkpoint.snapshot._load_extra(prefix, man)
+    for i in range(3, 6):
+        mod.fit_step(_batch(seed=i))
+    ref = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    mod._loss_scaler.publish()
+
+    mx.random.seed(99)
+    res = _make_mod(opt_params={"learning_rate": 0.05,
+                                "multi_precision": True},
+                    low_precision=True)
+    man2 = checkpoint.restore(res, prefix)
+    assert man2["step"] == 3
+    for i in range(3, 6):
+        res.fit_step(_batch(seed=i))
+    got = {k: v.asnumpy() for k, v in res.get_params()[0].items()}
+    assert sorted(got) == sorted(ref)
+    for k in ref:
+        assert ref[k].dtype == got[k].dtype
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+    # scaler state continued identically (same finite-step history)
+    res._loss_scaler.publish()
+    assert res._loss_scaler.scale == mod._loss_scaler.scale
+    assert res._loss_scaler.skips == mod._loss_scaler.skips

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mx.analyze CLI — static hot-path hazard analysis (docs/ANALYZE.md).
 
-Runs the seven analysis passes over ``mxnet_tpu/`` and fails on:
+Runs the eight analysis passes over ``mxnet_tpu/`` and fails on:
 
 * any unwaived finding;
 * any waiver without a reason, or matching no finding (unused);
