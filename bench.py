@@ -1475,7 +1475,11 @@ def bench_decode(args):
     ragged prompt/output lengths) and ``decode_steps_ratio_vs_static``
     (static steps / continuous steps — the dispatch-bound speedup; on
     the 1-core CPU container read the ratios, not wall times, per the
-    CHANGES.md convention)."""
+    CHANGES.md convention).  A reduced pallas-vs-xla A/B arm
+    (MXNET_PAGED_ATTN_IMPL forced per run, docs/KERNELS.md) gates on
+    the kernel arm keeping the same dispatch contract."""
+    import os
+
     import jax
     from mxnet_tpu import profiler, telemetry
     from mxnet_tpu.decode import DecodeEngine
@@ -1503,38 +1507,87 @@ def bench_decode(args):
 
     step_hist = telemetry.REGISTRY.get("decode_step_ms")
 
-    def run(admission):
-        t_c = time.perf_counter()
-        eng = DecodeEngine(params, cfg, capacity=args.decode_capacity,
-                           block_size=args.decode_block_size,
-                           num_blocks=args.decode_blocks,
-                           max_waiting=n_req + 1, admission=admission,
-                           warmup=True)
-        compile_ms = (time.perf_counter() - t_c) * 1e3
+    def run(admission, impl=None, n=None, gen_cap=None):
+        """One engine lifetime.  ``impl`` forces MXNET_PAGED_ATTN_IMPL
+        for the whole run (the dispatch decision is baked in at trace
+        time, so the env must cover engine construction + warmup);
+        ``n``/``gen_cap`` shrink the workload for the interpret-mode
+        pallas A/B arm, which is orders of magnitude slower off-TPU."""
+        ps = prompts if n is None else prompts[:n]
+        nt = (new_tokens if n is None
+              else [min(m, gen_cap) for m in new_tokens[:n]])
+        prev = os.environ.get("MXNET_PAGED_ATTN_IMPL")
+        if impl is not None:
+            os.environ["MXNET_PAGED_ATTN_IMPL"] = impl
         try:
-            snap0 = step_hist.snapshot() if step_hist is not None else None
-            d0 = profiler.DEVICE_DISPATCHES.value
-            t0 = time.perf_counter()
-            handles = [eng.submit(p, max_new_tokens=m)
-                       for p, m in zip(prompts, new_tokens)]
-            toks = sum(len(h.result(timeout=600)) for h in handles)
-            dt = time.perf_counter() - t0
-            st = eng.stats()
-            st["_tokens"] = toks
-            st["_dt"] = dt
-            st["_dispatches"] = profiler.DEVICE_DISPATCHES.value - d0
-            st["_compile_ms"] = compile_ms
-            if step_hist is not None and snap0 is not None:
-                st["_p50"] = telemetry.hist_quantile(
-                    step_hist.snapshot(), 0.5, since=snap0)
-                st["_p99"] = telemetry.hist_quantile(
-                    step_hist.snapshot(), 0.99, since=snap0)
+            t_c = time.perf_counter()
+            eng = DecodeEngine(params, cfg, capacity=args.decode_capacity,
+                               block_size=args.decode_block_size,
+                               num_blocks=args.decode_blocks,
+                               max_waiting=n_req + 1, admission=admission,
+                               warmup=True)
+            compile_ms = (time.perf_counter() - t_c) * 1e3
+            try:
+                snap0 = (step_hist.snapshot()
+                         if step_hist is not None else None)
+                d0 = profiler.DEVICE_DISPATCHES.value
+                t0 = time.perf_counter()
+                handles = [eng.submit(p, max_new_tokens=m)
+                           for p, m in zip(ps, nt)]
+                streams = [h.result(timeout=600) for h in handles]
+                toks = sum(len(s) for s in streams)
+                dt = time.perf_counter() - t0
+                st = eng.stats()
+                st["_tokens"] = toks
+                st["_streams"] = streams
+                st["_dt"] = dt
+                st["_dispatches"] = profiler.DEVICE_DISPATCHES.value - d0
+                st["_compile_ms"] = compile_ms
+                if step_hist is not None and snap0 is not None:
+                    st["_p50"] = telemetry.hist_quantile(
+                        step_hist.snapshot(), 0.5, since=snap0)
+                    st["_p99"] = telemetry.hist_quantile(
+                        step_hist.snapshot(), 0.99, since=snap0)
+            finally:
+                eng.stop()
+            return st
         finally:
-            eng.stop()
-        return st
+            if impl is not None:
+                if prev is None:
+                    os.environ.pop("MXNET_PAGED_ATTN_IMPL", None)
+                else:
+                    os.environ["MXNET_PAGED_ATTN_IMPL"] = prev
 
     cont = run("continuous")
     static = run("static")
+    # pallas-vs-xla A/B arm on a reduced workload (same engine
+    # geometry).  Forcing impl=pallas off-TPU is legal because the
+    # kernels run interpret=True anywhere; wall-clock is meaningless
+    # there, so the gate is structural: the kernel arm must keep the
+    # one-launch-per-step contract and stay retrace-free.
+    n_ab = min(6, n_req)
+    ab_xla = run("continuous", impl="xla", n=n_ab, gen_cap=6)
+    ab_pallas = run("continuous", impl="pallas", n=n_ab, gen_cap=6)
+    if (ab_pallas["dispatches_per_step"] != 1.0
+            or ab_pallas["steady_state_retraces"] != 0):
+        raise SystemExit(
+            "decode pallas arm broke the dispatch contract: "
+            "dispatches_per_step=%r (want 1.0), "
+            "steady_state_retraces=%r (want 0)"
+            % (ab_pallas["dispatches_per_step"],
+               ab_pallas["steady_state_retraces"]))
+    # the decode-step compiled program (batch dim == capacity on the
+    # (C, 1) token input distinguishes it from the prefill ladder);
+    # bytes_accessed is the donation acceptance witness — the donated
+    # step no longer pays the whole-cache in+out copy
+    fn_want = ("_fwd_eval_donated" if cont.get("cache_donation")
+               else "_fwd_eval")
+    step_rows = [p for p in telemetry.programs(site="executor")
+                 if p["fn_name"] == fn_want
+                 and any(s.endswith("[%d, 1]" % args.decode_capacity)
+                         for s in p["arg_shapes"])]
+    decode_bytes = max((p["bytes_accessed"] for p in step_rows
+                        if p["bytes_accessed"] is not None), default=None)
     dev = jax.devices()[0]
     out = {
         "metric": "decode_tokens_per_sec",
@@ -1560,6 +1613,15 @@ def bench_decode(args):
         "decode_retraces_steady_state": cont["steady_state_retraces"],
         "decode_preemptions": cont["preemptions"],
         "decode_steps": cont["steps"],
+        "decode_attn_impl": cont.get("attn_impl"),
+        "decode_cache_donation": cont.get("cache_donation"),
+        "decode_bytes_accessed": decode_bytes,
+        "decode_pallas_dispatches_per_step": _round_opt(
+            ab_pallas["dispatches_per_step"]),
+        "decode_pallas_retraces_steady_state":
+            ab_pallas["steady_state_retraces"],
+        "decode_ab_tokens_equal":
+            ab_pallas["_streams"] == ab_xla["_streams"],
         "static_tokens_per_sec": round(
             static["_tokens"] / static["_dt"], 1),
         "static_steps": static["steps"],
@@ -1744,6 +1806,8 @@ def main():
     out["decode_dispatches_per_step"] = dc["decode_dispatches_per_step"]
     out["decode_speedup_vs_static"] = dc["decode_speedup_vs_static"]
     out["decode_steps_ratio_vs_static"] = dc["decode_steps_ratio_vs_static"]
+    out["decode_attn_impl"] = dc["decode_attn_impl"]
+    out["decode_bytes_accessed"] = dc["decode_bytes_accessed"]
     print(json.dumps(out))
 
 

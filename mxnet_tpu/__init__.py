@@ -116,6 +116,7 @@ from . import serving
 from . import decode
 from . import profiler
 from . import telemetry
+from . import pallas
 from . import checkpoint
 from . import embedding
 from . import kvstore_tpu

@@ -49,6 +49,8 @@ HOT_MODULES = (
     "mxnet_tpu/embedding/engine.py",
     "mxnet_tpu/optimizer.py",
     "mxnet_tpu/fused_update.py",
+    "mxnet_tpu/pallas/attention.py",
+    "mxnet_tpu/pallas/quant.py",
 )
 
 # calls whose RESULT is a device value (basename match on methods,

@@ -16,6 +16,12 @@ site in the package:
   immediately invoked (``jax.jit(f)(x)``), constructs a fresh
   callable per call and defeats jax's jit cache entirely: every call
   retraces.
+* ``unregistered-kernel`` — every ``pl.pallas_call`` site (the
+  in-repo kernel library, mxnet_tpu/pallas/) must sit in a host
+  wrapper that threads a RetraceSite registration, directly or via a
+  module-level helper whose body notes (``_count_launch``): kernel
+  (re)builds are device-program constructions exactly like jit
+  retraces and must land in the same witnesses.
 * ``env-capture`` — the jitted body closes over a name bound from a
   *call result that does not derive from the builder's parameters*
   (e.g. a config/env read).  Such captures are invisible to any
@@ -190,11 +196,23 @@ def _param_derived(node, params, module_level, depth=0):
 
 class RetracePass(Pass):
     name = "retrace"
-    doc = ("every jax.jit site registers with a RetraceSite; no "
-           "per-call jits; no environment-dependent closure captures")
+    doc = ("every jax.jit and pl.pallas_call site registers with a "
+           "RetraceSite; no per-call jits; no environment-dependent "
+           "closure captures")
 
     def run(self, ctx):
         site_names, note_names = _collect_note_names(ctx)
+        # note-threading helpers: module-level defs whose own body
+        # calls a registration count as one (the pallas wrappers share
+        # a single ``_count_launch`` helper; callers resolve to its
+        # dotted name through the import maps)
+        for mod in ctx.modules:
+            aliases = {n.rsplit(".", 1)[1] for n in note_names
+                       if n.startswith(mod.dotted + ".")}
+            for node in mod.tree.body:
+                if isinstance(node, ast.FunctionDef) and _body_notes(
+                        mod, node, site_names, note_names, aliases):
+                    note_names.add(mod.dotted + "." + node.name)
         findings = []
         for mod in ctx.modules:
             findings.extend(self._scan_module(mod, site_names,
@@ -249,6 +267,31 @@ class RetracePass(Pass):
                                         note_names,
                                         local_note_aliases,
                                         module_level))
+
+        # pallas kernel constructions: same registration contract as
+        # jit sites, checked on the enclosing host wrapper
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            res = mod.resolve(node.func)
+            if res is None or not (res == "pallas_call"
+                                   or res.endswith(".pallas_call")):
+                continue
+            encl = enclosing_function(node)
+            if encl is None or not _body_notes(mod, encl, site_names,
+                                               note_names,
+                                               local_note_aliases):
+                out.append(self.finding(
+                    mod, node, "unregistered-kernel",
+                    "pl.pallas_call site's host wrapper does not "
+                    "thread a RetraceSite registration — kernel "
+                    "(re)builds are invisible to the *_retraces "
+                    "witnesses and the program registry",
+                    fix_hint="call _count_launch(<kernel name>) (or "
+                             "a RetraceSite's .note()) in the "
+                             "wrapper before pl.pallas_call, as "
+                             "pallas/attention.py does",
+                    detail=encl.name if encl is not None else "<module>"))
         return out
 
     # ------------------------------------------------------------------

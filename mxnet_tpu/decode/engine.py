@@ -34,6 +34,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError
+from ..pallas.dispatch import paged_attn_impl as _paged_attn_impl
 from ..serving.batcher import (DeadlineExceededError, QueueFullError,
                                ServerClosedError, percentile as _percentile)
 from ..telemetry import REGISTRY, tracing as _tracing
@@ -167,6 +168,18 @@ class DecodeEngine:
                                   "layer%d_v_cache" % i]
         self._cache_arrs = [self._exe.arg_dict[n] for n in self._cache_names]
         self.cache.attach_arrays(self._cache_arrs)
+        # donated caches (MXNET_DECODE_DONATE, default on): the compiled
+        # step takes the k/v cache buffers by donation and every dispatch
+        # re-points the cache NDArrays at the step's outputs
+        # (_commit_caches), so XLA updates the caches where they live —
+        # no whole-cache copy in and out per token (docs/DECODE.md).
+        # Block tables/positions are NOT donated: they are rebuilt
+        # host-side and fed by copy each iteration.
+        from .. import config as _config
+        self._donate = _config.env_bool("MXNET_DECODE_DONATE",
+                                        default=True)
+        if self._donate:
+            self._exe.donate_args(self._cache_names)
         self._inputs = ("data", "positions", "block_table", "prompt_len")
         self._weight_names = [n for n in self._exe.arg_dict
                               if n not in self._inputs
@@ -257,6 +270,11 @@ class DecodeEngine:
                     shared_exec=self._exe,
                     data=(1, bucket), prompt_len=(1,),
                     block_table=(1, self._table_width))
+                if self._donate:
+                    # shares the decode step's cache NDArrays
+                    # (shared_exec), so prefill dispatches donate the
+                    # same buffers and _commit_caches re-points them
+                    exe.donate_args(self._cache_names)
                 self._prefill_exes[bucket] = exe
         return exe
 
@@ -289,6 +307,9 @@ class DecodeEngine:
                 # block until compiled+run; warmup exists to absorb
                 # this cost before serving
                 outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
+                # donated caches: the dummy dispatch consumed the cache
+                # buffers — re-point them at the outputs like any step
+                self._commit_caches(outs, base=2)
                 # _warm is shared with the engine thread's _dispatch
                 # bookkeeping — every write holds _step_lock
                 self._warm.add(("prefill", b))
@@ -300,6 +321,7 @@ class DecodeEngine:
                 block_table=_np.zeros((self.capacity, self._table_width),
                                       _np.float32))
             outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
+            self._commit_caches(outs, base=2)
             self._warm.add("decode")
 
     # ------------------------------------------------------------------
@@ -819,6 +841,8 @@ class DecodeEngine:
             "prefill_dispatches": self._n_prefill_dispatches,
             "ttft_p99_ms": p99,
             "model_version": self._model_version,
+            "attn_impl": _paged_attn_impl(),
+            "cache_donation": self._donate,
             "cache": {
                 "num_blocks": self.cache.num_blocks,
                 "block_size": self.cache.block_size,

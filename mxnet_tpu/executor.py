@@ -193,9 +193,28 @@ def _compiled_cache(symbol):
             return outs
 
         cache = {"graph_fn": graph_fn, "fwd_train": _fwd_train,
-                 "fwd_eval": _fwd_eval, "fwd_bwd": {}, "fwd_monitor": {}}
+                 "fwd_eval": _fwd_eval, "fwd_eval_donated": None,
+                 "fwd_bwd": {}, "fwd_monitor": {}}
         symbol._exec_cache = cache
     return cache
+
+
+def _make_fwd_eval_donated(graph_fn):
+    """Inference-forward program whose FIRST argument pytree (a dict of
+    donated inputs) hands its buffers to XLA for in-place reuse.  The
+    decode engine routes the paged k/v caches here (donate_args), so
+    each compiled step updates the caches where they live instead of
+    copying the whole cache in and out every launch — the O(cache)
+    per-token traffic docs/DECODE.md used to book as an accepted cost.
+    ONE callable serves any donated/retained name split: jit keys on
+    the pytree structure of both dicts, and the distinct ``fn_name``
+    lets telemetry.programs() tell donated programs from copy-based
+    ones."""
+    def _fwd_eval_donated(donated, args, auxs, seed):
+        _note_retrace()
+        outs, _ = graph_fn(dict(args, **donated), auxs, seed, False)
+        return outs
+    return jax.jit(_fwd_eval_donated, donate_argnums=0)
 
 
 class _StreamTarget:
@@ -314,6 +333,8 @@ class Executor:
         self._monitor_all = False
         self._monitor_mode = "stream"
         self._monitor_stat = None
+        self._donated_names = ()
+        self._jit_fwd_eval_donated = None
         self._outputs = None
         self._pending_train_fwd = False
         self._train_seed = None
@@ -525,6 +546,37 @@ class Executor:
         import jax as _jax
         return _jax.device_put(data, dev)
 
+    def donate_args(self, names):
+        """Route the named arguments through the donated inference
+        forward: their device buffers are handed to XLA each eval
+        dispatch (donate_argnums), so programs that thread state through
+        outputs (the decode engine's k/v caches) update it in place
+        instead of copying it in and out every launch.
+
+        CONTRACT: after every dispatch the donated NDArrays hold
+        DELETED buffers — the caller must re-point them at the
+        corresponding outputs (engine._commit_caches) before anything
+        reads them.  Stream-monitored debug forwards fall back to the
+        copy-based program.  Pass an empty sequence to turn donation
+        back off."""
+        names = tuple(names)
+        for n in names:
+            if n not in self.arg_dict:
+                raise MXNetError("donate_args: unknown argument '%s'" % n)
+        if not names:
+            self._donated_names = ()
+            self._jit_fwd_eval_donated = None
+            return
+        if self._group_devices is not None:
+            raise MXNetError("donate_args: model-parallel (group2ctx) "
+                             "binds are not supported")
+        cache = _compiled_cache(self._symbol)
+        if cache["fwd_eval_donated"] is None:
+            cache["fwd_eval_donated"] = _make_fwd_eval_donated(
+                cache["graph_fn"])
+        self._donated_names = names
+        self._jit_fwd_eval_donated = cache["fwd_eval_donated"]
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
@@ -582,12 +634,23 @@ class Executor:
                 seed = self._next_seed()
                 if monitored and not stream:
                     self._fire_monitor(False, seed, self._auxs_values())
+                donated_fn = (self._jit_fwd_eval_donated
+                              if not stream else None)
                 fwd = (self._stream_fns()["fwd_eval"] if stream
                        else self._jit_fwd_eval)
                 with self._prof_scope("Executor::forward"):
                     _count_dispatch()
-                    outs = _timed_dispatch(
-                        fwd, self._args_values(), self._auxs_values(), seed)
+                    if donated_fn is not None:
+                        vals = self._args_values()
+                        donated = {n: vals.pop(n)
+                                   for n in self._donated_names}
+                        outs = _timed_dispatch(
+                            donated_fn, donated, vals,
+                            self._auxs_values(), seed)
+                    else:
+                        outs = _timed_dispatch(
+                            fwd, self._args_values(), self._auxs_values(),
+                            seed)
             if stream:
                 jax.effects_barrier()   # flush in-flight tap callbacks
         finally:

@@ -59,7 +59,12 @@ def two_bit_quantize(residual, grad, threshold):
     subtract) matches TwoBitCompressor.compress_decompress bit-for-bit;
     it is SHARED by the bucketed kvstore step and the fused fit step
     (module/fused_fit.py) so cross-path parity is structural, not
-    maintained by hand in two places."""
+    maintained by hand in two places.  ``MXNET_Q2BIT_IMPL`` selects the
+    fused Pallas kernel (pallas/quant.py — same op sequence, so still
+    bit-exact) instead of this elementwise XLA chain."""
+    from .pallas import two_bit_quantize_fused, use_q2bit_pallas
+    if use_q2bit_pallas():
+        return two_bit_quantize_fused(residual, grad, threshold)
     t = jnp.asarray(threshold, dtype=grad.dtype)
     acc = residual + grad
     q = jnp.where(acc > t, t, jnp.where(acc < -t, -t, jnp.zeros_like(acc)))

@@ -93,6 +93,8 @@ def _pallas_fwd(x2d, scale, shift, w2d, res):
     tiles, weights resident in VMEM across the grid."""
     from jax.experimental import pallas as pl
 
+    from ..pallas.attention import _count_launch
+
     m, k = x2d.shape
     n = w2d.shape[1]
     tm = _pick_tile_m(m)
@@ -114,6 +116,7 @@ def _pallas_fwd(x2d, scale, shift, w2d, res):
         args.append(res)
     else:
         kern = partial(_matmul_kernel, relu=True, out_dtype=x2d.dtype)
+    _count_launch("fused_scale_relu_matmul")
     return pl.pallas_call(
         kern,
         grid=grid,

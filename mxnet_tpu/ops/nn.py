@@ -700,23 +700,21 @@ def _use_flash_attention(seq_len, head_dim, dtype):
     ``auto`` (default) = flash when the backend/geometry supports it,
     ``xla`` = force the materialized-softmax path (A/B runs),
     ``flash`` = require the kernel — raise instead of silently measuring
-    the wrong path when it cannot run."""
+    the wrong path when it cannot run.  The selection semantics live in
+    ``pallas.dispatch.choose_impl``, shared with the paged-attention
+    and quantize knobs so the three contracts cannot drift."""
     import os
-    impl = os.environ.get("MXNET_ATTN_IMPL", "auto")
-    if impl == "xla":
-        return False
-    if impl not in ("auto", "flash"):
-        raise ValueError(f"MXNET_ATTN_IMPL={impl}; use auto|flash|xla")
+    from ..pallas.dispatch import choose_impl
     supported = (jax.default_backend() == "tpu" and head_dim % 128 == 0
                  and seq_len % 512 == 0
                  and dtype in (jnp.bfloat16, jnp.float32))
-    if impl == "flash" and not supported:
-        raise ValueError(
-            f"MXNET_ATTN_IMPL=flash but the kernel cannot run here "
-            f"(backend={jax.default_backend()}, head_dim={head_dim}, "
-            f"seq={seq_len}, dtype={dtype}); need TPU, head_dim%128==0, "
-            f"seq%512==0, bf16/f32")
-    return supported
+    return choose_impl(
+        "MXNET_ATTN_IMPL", os.environ.get("MXNET_ATTN_IMPL", "auto"),
+        "flash", supported,
+        why=f"backend={jax.default_backend()}, head_dim={head_dim}, "
+            f"seq={seq_len}, dtype={dtype}; need TPU, head_dim%128==0, "
+            f"seq%512==0, bf16/f32",
+        fallback_reason="flash-geometry")
 
 
 def _flash_attention(q, k, v, sm_scale):
@@ -891,18 +889,31 @@ def paged_decode_attention(data, qkv_weight, qkv_bias, proj_weight,
     kf = kf.at[widx].set(k.astype(kf.dtype), mode="drop")
     vf = vf.at[widx].set(v.astype(vf.dtype), mode="drop")
 
-    # gather the whole addressable context per slot and mask causally;
-    # padded table entries read block 0 but sit behind the mask
-    ctx = M * bs
-    j = jnp.arange(ctx)
-    ridx = table[:, j // bs] * bs + (j % bs)           # (C, ctx)
-    kctx = jnp.take(kf, ridx, axis=0, mode="clip")     # (C, ctx, H, D)
-    vctx = jnp.take(vf, ridx, axis=0, mode="clip")
-    s = jnp.einsum("che,cjhe->chj", q, kctx) * sc
-    mask = j[None, None, :] <= jnp.maximum(pos, 0)[:, None, None]
-    s = jnp.where(mask, s.astype(jnp.float32), -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    o = jnp.einsum("chj,cjhe->che", p, vctx)
+    from ..pallas import paged_decode_attend, use_paged_pallas
+    if use_paged_pallas():
+        # Pallas kernel (docs/KERNELS.md): walks the block table inside
+        # the kernel — one (bs, H, D) K/V block in VMEM at a time with
+        # an online softmax, so the (C, M*bs, H, D) gathered-context
+        # temp of the XLA path below never exists.  Inactive slots
+        # (pos < 0) come back as exact zeros instead of the XLA path's
+        # masked garbage; the engine masks both.
+        o = paged_decode_attend(q, kf.reshape(k_cache.shape),
+                                vf.reshape(v_cache.shape), table, pos,
+                                scale=sc)
+    else:
+        # gather the whole addressable context per slot and mask
+        # causally; padded table entries read block 0 but sit behind
+        # the mask
+        ctx = M * bs
+        j = jnp.arange(ctx)
+        ridx = table[:, j // bs] * bs + (j % bs)       # (C, ctx)
+        kctx = jnp.take(kf, ridx, axis=0, mode="clip")  # (C, ctx, H, D)
+        vctx = jnp.take(vf, ridx, axis=0, mode="clip")
+        s = jnp.einsum("che,cjhe->chj", q, kctx) * sc
+        mask = j[None, None, :] <= jnp.maximum(pos, 0)[:, None, None]
+        s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum("chj,cjhe->che", p, vctx)
     out = jnp.einsum("che,dhe->cd", o,
                      proj_weight.reshape(d, H, D)) + proj_bias
     return (out.reshape(C, 1, d), kf.reshape(k_cache.shape),
@@ -930,6 +941,25 @@ def paged_prefill_attention(data, qkv_weight, qkv_bias, proj_weight,
         raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
     D = d // H
     sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+
+    from ..pallas import paged_prefill_attend, use_paged_pallas
+    if use_paged_pallas():
+        # Pallas kernel (docs/KERNELS.md): causal attention per query
+        # block with the cache scatter FUSED into the same kernel —
+        # K/V rows land in their table-addressed cache blocks as they
+        # are produced, so the separate (B*S)-row XLA scatter below
+        # (and its index math) never runs.  Projections emit/consume
+        # the kernel's seq-major (B, S, H, D) layout directly.
+        Wqkv, bqkv = _paged_qkv_weights(qkv_weight, qkv_bias, d, H, D)
+        q = jnp.einsum("bsd,hed->bshe", data, Wqkv[0]) + bqkv[0]
+        k = jnp.einsum("bsd,hed->bshe", data, Wqkv[1]) + bqkv[1]
+        v = jnp.einsum("bsd,hed->bshe", data, Wqkv[2]) + bqkv[2]
+        o, kc, vc = paged_prefill_attend(
+            q, k, v, k_cache, v_cache, block_table.astype(jnp.int32),
+            lengths.reshape(B).astype(jnp.int32), scale=sc)
+        out = jnp.einsum("bshe,dhe->bsd", o,
+                         proj_weight.reshape(d, H, D)) + proj_bias
+        return out, kc, vc
 
     Wqkv = qkv_weight.reshape(3, H, D, d)
     bqkv = qkv_bias.reshape(3, H, 1, D)

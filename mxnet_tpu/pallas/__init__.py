@@ -1,0 +1,39 @@
+"""mx.pallas — in-repo Pallas kernel library (docs/KERNELS.md).
+
+The paper names "NN ops lowering to XLA/Pallas" as a first-class goal;
+this package holds the custom TPU kernels behind the framework's
+`*_IMPL` knobs:
+
+* :mod:`attention` — paged-KV-cache decode attention (walks the block
+  table inside the kernel, online softmax, no materialized context
+  tensor) and the prefill variant with the cache scatter fused into
+  the same kernel.
+* :mod:`quant` — fused 2-bit quantize (error-feedback residual) for
+  the kvstore bucket path.
+* :mod:`dispatch` — the one ``auto|<kernel>|xla`` selection contract
+  shared by every kernel knob (``MXNET_ATTN_IMPL``,
+  ``MXNET_PAGED_ATTN_IMPL``, ``MXNET_Q2BIT_IMPL``), plus the
+  ``pallas_kernel_launches`` / ``pallas_fallbacks`` witnesses.
+
+Every kernel runs under ``interpret=True`` off-TPU, so the CPU
+container and tier-1 exercise the exact kernel code paths against the
+XLA reference paths (the interpret-mode testing convention,
+docs/KERNELS.md).  jax is imported lazily inside the kernel modules'
+functions where possible; importing this package does not require a
+TPU.
+"""
+from . import dispatch
+from .dispatch import (PALLAS_FALLBACKS, PALLAS_LAUNCHES, choose_impl,
+                       paged_attn_impl, use_paged_pallas,
+                       use_q2bit_pallas)
+from . import attention
+from .attention import paged_decode_attend, paged_prefill_attend
+from . import quant
+from .quant import two_bit_quantize_fused
+
+__all__ = [
+    "attention", "dispatch", "quant",
+    "choose_impl", "paged_attn_impl", "use_paged_pallas",
+    "use_q2bit_pallas", "paged_decode_attend", "paged_prefill_attend",
+    "two_bit_quantize_fused", "PALLAS_FALLBACKS", "PALLAS_LAUNCHES",
+]

@@ -1,0 +1,272 @@
+"""Paged-KV-cache attention kernels (docs/KERNELS.md).
+
+The XLA reference path in ops/nn.py gathers the **entire addressable
+context** per slot per decode step (``jnp.take`` over the block table
+-> a ``(C, M*bs, H, D)`` temp) — O(cache) HBM traffic per token.  The
+kernels here walk the block table INSIDE the kernel via Pallas scalar
+prefetch: each grid step's ``BlockSpec`` index map reads the prefetched
+table to pull exactly one cache block into VMEM, an online softmax
+accumulates across blocks, and no gathered context tensor ever exists.
+
+* :func:`paged_decode_attend` — one token per slot against its cache
+  rows ``[0, pos]``; inactive slots (``pos < 0``) emit zeros (the XLA
+  path emits don't-care values there; the engine masks both).
+* :func:`paged_prefill_attend` — causal MHA over the padded prompt
+  batch with the K/V cache scatter FUSED into the same kernel: per
+  (row, cache-block) grid step the kernel writes the block's new rows
+  (masked to ``< length``) through an input/output-aliased cache.
+  Grid steps past a row's last real block are clamped onto that block
+  (an idempotent duplicate write), so padded table entries are never
+  dereferenced — the in-kernel equivalent of the XLA path's ``nb*bs``
+  OOB-drop sentinel.
+
+Off-TPU the wrappers run ``interpret=True`` so CPU tier-1 executes the
+exact kernel logic against the XLA reference (parity pinned at
+rtol<=2e-5 f32 in tests/test_pallas.py).  Block-size tuning notes live
+in docs/KERNELS.md.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:               # pragma: no cover — the pinned
+    pl = pltpu = None           # toolchain always ships pallas
+
+from .. import telemetry as _telemetry
+from ..telemetry.registry import RETRACE_SUPPRESS
+from .dispatch import PALLAS_LAUNCHES, PALLAS_RETRACES
+
+# kernel (re)builds land in a vital counter like every other trace
+# site; wrappers note() at build time (trace time of the enclosing
+# program), so a kernel being reconstructed per call is visible
+_SITE = _telemetry.RetraceSite(PALLAS_RETRACES, _telemetry.JIT_COMPILE_MS,
+                               site="pallas")
+_note_kernel_build = _SITE.note
+
+
+def _interpret_default(interpret):
+    if interpret is not None:
+        return bool(interpret)
+    return jax.default_backend() != "tpu"
+
+
+def _count_launch(kernel):
+    _note_kernel_build()
+    if not RETRACE_SUPPRESS.on:   # skip program-registry re-lowers
+        PALLAS_LAUNCHES.labels(kernel=kernel).inc()
+
+
+# ----------------------------------------------------------------------
+# decode: one token per slot, online softmax over the slot's blocks
+# ----------------------------------------------------------------------
+def _paged_decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, bs, scale):
+    c = pl.program_id(0)
+    m = pl.program_id(1)
+    pos = pos_ref[c]
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # blocks past the slot's position are never loaded into the
+    # softmax — the online-softmax state simply skips them (and an
+    # inactive slot, pos < 0, skips every block)
+    @pl.when(jnp.logical_and(pos >= 0, m * bs <= pos))
+    def _block():
+        q = q_ref[0].astype(jnp.float32)              # (H, D)
+        k = k_ref[0].astype(jnp.float32)              # (bs, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale   # (H, bs)
+        j = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + m * bs
+        s = jnp.where(j <= pos, s, -jnp.inf)
+        m_prev = m_ref[...]                           # (H, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # (H, bs)
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)       # (H, D)
+        m_ref[...] = m_new
+
+    @pl.when(m == pl.num_programs(1) - 1)
+    def _emit():
+        l = l_ref[...]
+        o = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def paged_decode_attend(q, k_cache, v_cache, block_table, positions, *,
+                        scale, interpret=None):
+    """Paged decode attention: ``q (C, H, D)`` against cache rows
+    ``[0, positions[c]]`` addressed through ``block_table (C, M)``;
+    ``k_cache/v_cache (num_blocks, block_size, H, D)`` already hold
+    the current token's K/V (the scatter is XLA-side in ops/nn.py,
+    shared with the reference path).  Returns ``(C, H, D)``; inactive
+    slots (``positions < 0``) return zeros.  The grid is (slot, table
+    block); each step's index map reads the scalar-prefetched table so
+    exactly one cache block streams through VMEM per step."""
+    C, H, D = q.shape
+    bs = k_cache.shape[1]
+    M = block_table.shape[1]
+    _count_launch("paged_decode_attend")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(C, M),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda c, m, t, p: (c, 0, 0)),
+            pl.BlockSpec((1, bs, H, D),
+                         lambda c, m, t, p: (t[c, m], 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, D),
+                         lambda c, m, t, p: (t[c, m], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda c, m, t, p: (c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),   # online-softmax acc
+            pltpu.VMEM((H, 1), jnp.float32),   # running max
+            pltpu.VMEM((H, 1), jnp.float32),   # running denom
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, bs=bs,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((C, H, D), q.dtype),
+        interpret=_interpret_default(interpret),
+    )
+    return fn(block_table.astype(jnp.int32),
+              positions.astype(jnp.int32), q, k_cache, v_cache)
+
+
+# ----------------------------------------------------------------------
+# prefill: causal MHA + the cache scatter fused into one kernel
+# ----------------------------------------------------------------------
+def _paged_prefill_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
+                          kc_ref, vc_ref, o_ref, ko_ref, vo_ref, *,
+                          bs, scale):
+    b = pl.program_id(0)
+    m = pl.program_id(1)
+    L = len_ref[b]
+
+    # causal attention for query rows [m*bs, (m+1)*bs) against the
+    # row's full K/V (VMEM-resident: prefill buckets are short)
+    q = q_ref[0].astype(jnp.float32)                  # (bs, H, D)
+    k = k_ref[0].astype(jnp.float32)                  # (S, H, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((1,), (1,))),
+        preferred_element_type=jnp.float32) * scale   # (H, bs, S)
+    jq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + m * bs
+    jk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(jq >= jk, s, -jnp.inf)
+    mx = jnp.max(s, axis=2, keepdims=True)
+    p = jnp.exp(s - mx)
+    p = p / jnp.sum(p, axis=2, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((2,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)           # (H, bs, D)
+    o_ref[0] = o.transpose(1, 0, 2).astype(o_ref.dtype)
+
+    # fused scatter: this block's K/V rows into cache block
+    # table[b, m], masked to rows < L.  Grid steps PAST the row's last
+    # real block (m*bs >= L, where the table holds padding/garbage) are
+    # CLAMPED — index maps and this slice both redirect to the last
+    # real block, so the step re-emits that block's exact bytes: a
+    # duplicate idempotent write instead of a write through an
+    # untrusted table entry (the in-kernel analog of the XLA path's
+    # nb*bs OOB-drop sentinel, which likewise never dereferences
+    # padded entries).
+    m_eff = jnp.minimum(m, jnp.maximum(-(-L // bs), 1) - 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (bs, 1, 1), 0) + m_eff * bs
+    keep = row < L
+    ko_ref[0] = jnp.where(
+        keep,
+        jax.lax.dynamic_slice_in_dim(k_ref[0], m_eff * bs, bs, 0)
+        .astype(ko_ref.dtype), kc_ref[0])
+    vo_ref[0] = jnp.where(
+        keep,
+        jax.lax.dynamic_slice_in_dim(v_ref[0], m_eff * bs, bs, 0)
+        .astype(vo_ref.dtype), vc_ref[0])
+
+
+def paged_prefill_attend(q, k, v, k_cache, v_cache, block_table,
+                         lengths, *, scale, interpret=None):
+    """Causal MHA over ``q/k/v (B, S, H, D)`` with the scatter of each
+    row's first ``lengths[b]`` K/V rows into the paged cache fused into
+    the same kernel (the caches are input/output aliased — in-place
+    block writes, no whole-cache copy).  Returns
+    ``(out (B, S, H, D), new_k_cache, new_v_cache)``.  ``S`` is padded
+    up to a block-size multiple internally, so any prefill bucket
+    geometry works."""
+    B, S, H, D = q.shape
+    bs = k_cache.shape[1]
+    pad = (-S) % bs
+    if pad:
+        # padded keys sit at jk >= S: the causal mask keeps them out of
+        # every real query row, and `keep` (row >= L) keeps them out of
+        # the cache
+        zeros = jnp.zeros((B, pad, H, D), q.dtype)
+        q = jnp.concatenate([q, zeros], axis=1)
+        k = jnp.concatenate([k, zeros.astype(k.dtype)], axis=1)
+        v = jnp.concatenate([v, zeros.astype(v.dtype)], axis=1)
+    Sp = S + pad
+    Mq = Sp // bs
+    if block_table.shape[1] < Mq:
+        raise ValueError(
+            "paged_prefill_attend: block_table width %d < %d blocks "
+            "needed for a %d-token prompt at block_size %d"
+            % (block_table.shape[1], Mq, S, bs))
+    _count_launch("paged_prefill_attend")
+
+    def cache_block(b, m, t, l):
+        # clamp to the row's LAST REAL block once m runs past the
+        # length: table entries there are padding (the engine leaves
+        # zeros) and must never be dereferenced — the kernel re-emits
+        # the last real block instead (idempotent duplicate write)
+        last = jnp.maximum(-(-l[b] // bs), 1) - 1
+        return (t[b, jnp.minimum(m, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Mq),
+        in_specs=[
+            pl.BlockSpec((1, bs, H, D), lambda b, m, t, l: (b, m, 0, 0)),
+            pl.BlockSpec((1, Sp, H, D), lambda b, m, t, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Sp, H, D), lambda b, m, t, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, H, D), lambda b, m, t, l: (b, m, 0, 0)),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+        ],
+        scratch_shapes=[],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_paged_prefill_kernel, bs=bs,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Sp, H, D), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # cache in -> cache out: in-place block writes, no cache copy
+        input_output_aliases={5: 1, 6: 2},
+        interpret=_interpret_default(interpret),
+    )
+    out, ko, vo = fn(block_table.astype(jnp.int32),
+                     lengths.astype(jnp.int32), q, k, v,
+                     k_cache, v_cache)
+    return out[:, :S], ko, vo

@@ -1,0 +1,117 @@
+"""Kernel-impl selection — one ``auto|<kernel>|xla`` contract.
+
+``MXNET_ATTN_IMPL`` (flash), ``MXNET_PAGED_ATTN_IMPL`` (paged
+decode/prefill) and ``MXNET_Q2BIT_IMPL`` (kvstore 2-bit quantize) all
+route through :func:`choose_impl`, so the three knobs cannot drift:
+
+* ``auto`` (default) — the kernel when the backend/geometry supports
+  it profitably, the XLA reference path otherwise (the fallback bumps
+  ``pallas_fallbacks{reason}``);
+* ``xla`` — force the reference path (A/B runs);
+* ``<kernel>`` — require the kernel; raise instead of silently
+  measuring the wrong path when it cannot run.  The paged/quant
+  kernels are *forceable anywhere* because ``interpret=True`` executes
+  them on any backend — that is the tier-1/CI testing convention
+  (docs/KERNELS.md).
+
+The decisions here run at TRACE time (inside the enclosing jitted
+program's Python), so they are per-program-construction, not
+per-launch — same contract as ``_use_flash_attention`` always had.
+"""
+import os
+
+from .. import telemetry as _telemetry
+from ..telemetry.registry import RETRACE_SUPPRESS
+
+# trace-time witnesses (docs/OBSERVABILITY.md glossary).  "Launches"
+# counts kernel instantiations built into traced programs: steady-state
+# dispatches ride the enclosing compiled program (decode_dispatches /
+# dispatches_per_step witness those), so a warm serving loop adds zero.
+PALLAS_LAUNCHES = _telemetry.REGISTRY.counter(
+    "pallas_kernel_launches",
+    "pallas kernel instantiations built into traced programs, "
+    "labeled by `kernel`", vital=True)
+PALLAS_FALLBACKS = _telemetry.REGISTRY.counter(
+    "pallas_fallbacks",
+    "auto-mode kernel selections that fell back to the XLA reference "
+    "path, labeled by `reason`")
+PALLAS_RETRACES = _telemetry.REGISTRY.counter(
+    "pallas_kernel_retraces",
+    "pallas kernel (re)builds — nonzero growth after warmup means a "
+    "kernel is being reconstructed per call", vital=True)
+
+
+def choose_impl(env_var, impl, kernel, supported, why, *,
+                force_supported=None, fallback_reason="unsupported",
+                count=True):
+    """Shared ``auto|<kernel>|xla`` selection for a kernel knob.
+
+    ``impl`` is the knob's raw value — the CALLER reads it with a
+    literal env-var name (``os.environ.get("MXNET_X_IMPL", "auto")``)
+    so the envknobs analyze pass can see the read site; ``env_var`` is
+    only for error messages.  Returns True when the custom kernel
+    should be used.  Raises ``ValueError`` for an unknown value, and
+    when the kernel is forced (``<env_var>=<kernel>``) but cannot run —
+    never silently measure the wrong path.  ``supported`` gates the
+    ``auto`` choice; ``force_supported`` (default: same as
+    ``supported``) gates the forced one — interpret-mode kernels pass
+    ``force_supported=True`` since they run on any backend when
+    explicitly requested.  ``count=False`` suppresses the fallback
+    counter for observer-only calls (stats/bench polling must not
+    inflate the per-trace witness).
+    """
+    if impl == "xla":
+        return False
+    if impl not in ("auto", kernel):
+        raise ValueError("%s=%s; use auto|%s|xla" % (env_var, impl, kernel))
+    if impl == kernel:
+        ok = supported if force_supported is None else force_supported
+        if not ok:
+            raise ValueError("%s=%s but the kernel cannot run here (%s)"
+                             % (env_var, impl, why))
+        return True
+    if not supported:
+        if count and not RETRACE_SUPPRESS.on:   # not a registry re-lower
+            PALLAS_FALLBACKS.labels(reason=fallback_reason).inc()
+        return False
+    return True
+
+
+def use_paged_pallas(count=True):
+    """Trace-time paged-attention impl decision shared by the decode
+    and prefill ops (ops/nn.py) and the engine's stats/bench reporting.
+    ``auto`` prefers the Pallas kernels on a TPU backend (where decode
+    is bandwidth-bound on exactly the gather traffic they remove) and
+    the XLA gather path elsewhere; ``MXNET_PAGED_ATTN_IMPL=pallas``
+    forces the kernels anywhere via interpret mode.  ``count=False``
+    suppresses the fallback counter for observer-only calls (stats)."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    return choose_impl(
+        "MXNET_PAGED_ATTN_IMPL",
+        os.environ.get("MXNET_PAGED_ATTN_IMPL", "auto"), "pallas", on_tpu,
+        why="backend=%s; auto uses the compiled kernels only on TPU — "
+            "force 'pallas' to run them in interpret mode anywhere"
+            % jax.default_backend(),
+        force_supported=True, fallback_reason="backend", count=count)
+
+
+def paged_attn_impl():
+    """The active paged-attention implementation name ('pallas' or
+    'xla') for stats()/bench JSON — no counter side effects."""
+    return "pallas" if use_paged_pallas(count=False) else "xla"
+
+
+def use_q2bit_pallas():
+    """Impl decision for the fused 2-bit quantize kernel on the
+    kvstore bucket path (``MXNET_Q2BIT_IMPL``): same semantics as the
+    paged knob — auto = kernel on TPU, forceable anywhere (interpret)."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    return choose_impl(
+        "MXNET_Q2BIT_IMPL",
+        os.environ.get("MXNET_Q2BIT_IMPL", "auto"), "pallas", on_tpu,
+        why="backend=%s; auto uses the compiled kernel only on TPU — "
+            "force 'pallas' to run it in interpret mode anywhere"
+            % jax.default_backend(),
+        force_supported=True, fallback_reason="backend")

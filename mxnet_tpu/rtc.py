@@ -66,6 +66,7 @@ class PallasKernel:
                 kwargs["in_specs"] = self._in_specs
             if self._out_specs is not None:
                 kwargs["out_specs"] = self._out_specs
+            # analyze: ok(retrace) user-authored RTC kernel — built once per CudaKernel and counted by the jit site below
             call = pl.pallas_call(
                 self._body,
                 out_shape=jax.ShapeDtypeStruct(self._out_shape,

@@ -4,6 +4,8 @@
 Runs the eight analysis passes over ``mxnet_tpu/`` and fails on:
 
 * any unwaived finding;
+* any mxnet_tpu/pallas/ kernel wrapper with no interpret-mode parity
+  test named in ``tests/`` (``check_kernel_parity``);
 * any waiver without a reason, or matching no finding (unused);
 * drift between the live waiver set and the committed baseline
   (``tools/static_baseline.json``).
@@ -23,6 +25,8 @@ runtime is ever imported — safe and <15 s as a tier-1 subprocess on a
 1-core container.
 """
 import argparse
+import ast
+import glob
 import os
 import subprocess
 import sys
@@ -102,6 +106,43 @@ def update_config_doc(ctx):
     return len(reads)
 
 
+def check_kernel_parity(ctx):
+    """Every host wrapper in mxnet_tpu/pallas/ that constructs a
+    ``pl.pallas_call`` must be exercised by name somewhere under
+    ``tests/test_*.py`` — the interpret=True parity convention
+    (docs/KERNELS.md): kernels run on CPU in interpret mode against
+    the XLA reference in tier-1.  Deliberately grep-level: it guards
+    against landing a kernel with NO test at all, not against weak
+    tests."""
+    test_text = ""
+    for p in sorted(glob.glob(os.path.join(ROOT, "tests",
+                                           "test_*.py"))):
+        with open(p) as f:
+            test_text += f.read()
+    errors = []
+    for mod in ctx.modules:
+        if not mod.path.startswith("mxnet_tpu/pallas/"):
+            continue
+        for node in mod.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            has_kernel = False
+            for c in ast.walk(node):
+                if isinstance(c, ast.Call):
+                    r = mod.resolve(c.func)
+                    if r is not None and (r == "pallas_call"
+                                          or r.endswith(".pallas_call")):
+                        has_kernel = True
+                        break
+            if has_kernel and node.name not in test_text:
+                errors.append(
+                    "%s:%d: [kernel-parity/untested-kernel] pallas "
+                    "kernel wrapper %r has no interpret-mode parity "
+                    "test (its name appears in no tests/test_*.py)"
+                    % (mod.path, node.lineno, node.name))
+    return errors
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--changed", action="store_true",
@@ -154,16 +195,20 @@ def main(argv=None):
         return 0
 
     errors = [f for f in findings if not f.waived]
+    kernel_errors = check_kernel_parity(ctx)
     baseline_errors = []
     if not args.changed:
         baseline_errors = analyze.diff_baseline(
             findings, analyze.load_baseline(BASELINE))
 
-    if errors or baseline_errors:
+    if errors or kernel_errors or baseline_errors:
         print("check_static: %d problem(s)"
-              % (len(errors) + len(baseline_errors)))
+              % (len(errors) + len(kernel_errors)
+                 + len(baseline_errors)))
         for f in errors:
             print("  " + f.format())
+        for e in kernel_errors:
+            print("  " + e)
         for e in baseline_errors:
             print("  " + e)
         return 1
