@@ -515,6 +515,147 @@ def bench_transformer(args):
     }
 
 
+def bench_transformer_mp(args):
+    """Tensor-parallel transformer fit on the 2-D dp×mp GSPMD mesh
+    (mx.sharding, docs/SHARDING.md): the model-parallelism acceptance
+    arm. Two arms of the SAME fused Module fit step on the SAME
+    TP-annotated symbol — ``replicated`` (mesh cleared, so the
+    ``__sharding__`` annotations stay latent and the step runs
+    dp-only) and ``mp`` (dp×mp=2 mesh: Megatron column/row-parallel
+    FFN + head-sharded attention partitioned INSIDE the one donated
+    program). Hard gates (SystemExit): the mp arm must stay
+    single-launch (``train_dispatches_per_step == 1.0``), retrace-free
+    in steady state, and its per-device param bytes must be ≤ 60% of
+    the replicated arm's — the matmul shards must actually halve, not
+    silently replicate."""
+    import os
+    import sys
+    if "jax" not in sys.modules \
+            and os.environ.get("JAX_PLATFORMS") == "cpu":
+        # standalone --mode transformer on the CPU container: force 8
+        # virtual devices so the dp4×mp2 mesh exists (same knob
+        # tests/conftest.py pins for tier-1)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import executor as _executor
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu import profiler, telemetry
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.module import fused_fit as _ff
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        return {"metric": "transformer_mp_dispatches_per_step",
+                "value": None, "unit": "launches/step",
+                "note": "%d visible device(s): the dp×mp=2 mesh needs "
+                        "an even count >= 2" % n_dev}
+    mp = 2
+    dp = n_dev // mp
+    B, S, V = 2 * dp, 32, 256
+    steps = max(4, args.fit_steps)
+    rng = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rng.randint(0, V, (B, S)).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, V, (B * S,))
+                           .astype(np.float32))])
+        for _ in range(steps + 2)]
+
+    def run_arm(mesh_axes):
+        mx.sharding.set_mesh(mesh_axes)
+        try:
+            sym = transformer.get_symbol(
+                num_classes=V, num_layers=2, d_model=64, num_heads=4,
+                seq_len=S, tensor_parallel="mp")
+            mod = mx.Module(sym, context=[mx.tpu(i)
+                                          for i in range(n_dev)])
+            mod.bind(data_shapes=[("data", (B, S))],
+                     label_shapes=[("softmax_label", (B * S,))])
+            mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                           factor_type="in",
+                                           magnitude=2))
+            mod.init_optimizer(
+                kvstore=mx.kv.create("device"), optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9})
+            m = metric_mod.create("ce")
+            t_c = time.perf_counter()
+            mod.fit_step(batches[0], m)
+            mod._fit_sync()
+            compile_ms = (time.perf_counter() - t_c) * 1e3
+            mod.fit_step(batches[1], m)     # steady-state entry
+            mod._fit_sync()
+            d0 = profiler.DEVICE_DISPATCHES.value
+            h0 = metric_mod.HOST_SYNCS.value
+            r0 = (_ff.FIT_RETRACES.value
+                  + _executor.EXECUTOR_RETRACES.value)
+            t0 = time.perf_counter()
+            for b in batches[2:2 + steps]:
+                mod.fit_step(b, m)
+            mod._fit_sync()
+            dt = time.perf_counter() - t0
+            exe = mod._exec_group._exec
+            params = [exe.arg_dict[n]
+                      for n in mod._exec_group.param_names
+                      if n in exe.arg_dict]
+            snap = telemetry.memory_snapshot()
+            return {
+                "dispatches_per_step": round(
+                    (profiler.DEVICE_DISPATCHES.value - d0) / steps, 2),
+                "host_syncs_per_step": round(
+                    (metric_mod.HOST_SYNCS.value - h0) / steps, 2),
+                "steady_retraces": int(
+                    _ff.FIT_RETRACES.value
+                    + _executor.EXECUTOR_RETRACES.value - r0),
+                "step_ms": round(dt / steps * 1000, 1),
+                "compile_ms": _round_opt(compile_ms, 1),
+                "param_bytes_per_device":
+                    mx.sharding.per_device_param_bytes(params),
+                "census_param_bytes_per_device":
+                    snap["param_bytes_per_device"],
+            }
+        finally:
+            mx.sharding.set_mesh(None)
+
+    rep = run_arm(None)
+    sharded = run_arm({"dp": dp, "mp": mp})
+    sites = int(mx.sharding.CONSTRAINT_SITES.value)
+    if sharded["dispatches_per_step"] != 1.0:
+        raise SystemExit(
+            "bench: transformer mp arm train_dispatches_per_step = %s "
+            "(want 1.0) — model parallelism must stay inside the ONE "
+            "donated program" % sharded["dispatches_per_step"])
+    if sharded["steady_retraces"]:
+        raise SystemExit(
+            "bench: transformer mp arm retraced %d time(s) in steady "
+            "state — mesh-fingerprint compile-cache regression"
+            % sharded["steady_retraces"])
+    ratio = sharded["param_bytes_per_device"] / max(
+        1, rep["param_bytes_per_device"])
+    if ratio > 0.60:
+        raise SystemExit(
+            "bench: mp arm per-device param bytes %d = %.0f%% of "
+            "replicated %d (want <= 60%%) — the mp shards silently "
+            "replicated" % (sharded["param_bytes_per_device"],
+                            100 * ratio, rep["param_bytes_per_device"]))
+    dev = jax.devices()[0]
+    return {
+        "metric": "transformer_mp_dispatches_per_step",
+        "value": sharded["dispatches_per_step"],
+        "unit": "launches/step",
+        "device_kind": dev.device_kind,
+        "config": "L2 d64 h4 S%d B%d vocab%d mesh=dp%dxmp%d" % (
+            S, B, V, dp, mp),
+        "transformer_mp": {"replicated": rep, "mp": sharded},
+        "param_bytes_per_device": sharded["param_bytes_per_device"],
+        "param_bytes_ratio_vs_replicated": round(ratio, 3),
+        "sharding_constraint_sites": sites,
+    }
+
+
 def bench_quantized_inference(args):
     """Calibrated 8-bit ResNet-50 inference (VERDICT r3 item 5): the
     conv/FC stack runs int8(/uint8)×int8 with int32 accumulation
@@ -1648,7 +1789,7 @@ def main():
     ap.add_argument("--mode", type=str, default="train",
                     choices=["train", "inference", "serving", "checkpoint",
                              "kvstore", "kvstore-mh-worker",
-                             "fit", "decode", "dlrm"])
+                             "fit", "decode", "dlrm", "transformer"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
     ap.add_argument("--layout", type=str, default="NHWC",
@@ -1751,6 +1892,9 @@ def main():
     if args.mode == "fit":
         print(json.dumps(bench_fit(args)))
         return
+    if args.mode == "transformer":
+        print(json.dumps(bench_transformer_mp(args)))
+        return
     if args.mode == "decode":
         print(json.dumps(bench_decode(args)))
         return
@@ -1795,6 +1939,10 @@ def main():
     out["train_dispatches_per_step"] = fit["train_dispatches_per_step"]
     out["host_syncs_per_step"] = fit["host_syncs_per_step"]
     out["fit_step_ms"] = fit["fit_step_ms"]
+    tmp = bench_transformer_mp(args)
+    out["transformer_mp"] = tmp.get("transformer_mp")
+    out["param_bytes_per_device"] = tmp.get("param_bytes_per_device")
+    out["sharding_constraint_sites"] = tmp.get("sharding_constraint_sites")
     cp = bench_checkpoint(args)
     out["checkpoint_block_ms"] = cp["value"]
     out["checkpoint_save_ms"] = cp["checkpoint_save_ms"]

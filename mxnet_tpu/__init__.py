@@ -100,6 +100,7 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import parallel
+from . import sharding
 from . import models
 from . import gluon
 from . import recordio

@@ -18,6 +18,8 @@ envknobs    MXNET_*/MXTPU_* knob table coverage (docs/CONFIG.md)
 optfused    every registered optimizer implements the fused-update
             protocol (``_fused_sig``) or carries a reasoned
             FUSED_EAGER_WAIVERS entry; no stale waivers
+sharding    axis literals at PartitionSpec/spec/constrain sites are
+            known mesh axes; no mesh construction in jitted bodies
 ========== ==========================================================
 
 Violations are waived per site with ``# analyze: ok(<pass>) <reason>``
@@ -36,17 +38,18 @@ from .collective import CollectivePass
 from .telemetry import TelemetryPass
 from .envknobs import EnvKnobsPass
 from .optfused import OptFusedPass
+from .sharding import ShardingPass
 
 __all__ = ["Context", "Finding", "Module", "Pass", "PASSES",
            "all_passes", "apply_waivers", "diff_baseline",
            "load_baseline", "load_package", "run", "save_baseline",
            "HostSyncPass", "RetracePass", "DonationPass",
            "ThreadsPass", "CollectivePass", "TelemetryPass",
-           "EnvKnobsPass", "OptFusedPass"]
+           "EnvKnobsPass", "OptFusedPass", "ShardingPass"]
 
 PASS_CLASSES = (HostSyncPass, RetracePass, DonationPass, ThreadsPass,
                 CollectivePass, TelemetryPass, EnvKnobsPass,
-                OptFusedPass)
+                OptFusedPass, ShardingPass)
 
 
 def all_passes():

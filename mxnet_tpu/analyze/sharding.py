@@ -1,0 +1,150 @@
+"""Pass 9 — GSPMD sharding-site lint (mx.sharding, docs/SHARDING.md).
+
+Sharding bugs are silent: an axis name that no mesh carries simply
+never partitions anything (the program runs replicated and the HBM win
+quietly evaporates), and a Mesh built inside a traced body bakes a
+device list into one trace.  This pass checks the static half of the
+contract:
+
+* ``unknown-axis`` — every axis-name LITERAL at a sharding site
+  (``PartitionSpec(...)``, ``mx.sharding.spec(...)`` /
+  ``.constrain(...)`` / ``.annotate(...)``) must be one of the
+  framework's named mesh axes (``sharding.KNOWN_AXES``: dp, mp, tp,
+  pp, sp, ep).  Computed axis names pass through — they resolve at
+  runtime against a live mesh.
+* ``mesh-in-jit`` — no mesh construction (``jax.sharding.Mesh``,
+  ``make_mesh``, ``data_parallel_mesh``) inside a jitted body: the
+  device list would be captured by the trace, every mesh change
+  retraces, and jax forbids some of it outright.
+
+The dynamic half — an axis size that cannot divide the annotated
+dimension — is enforced at BIND time by ``sharding.check_divisible``
+(called from ``sharding.resolve`` and the executor's constraint
+insertion), where real shapes exist; a static pass cannot see them.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Pass
+from .retrace import _is_jit_call, _jitted_target
+
+# mirror of mxnet_tpu.sharding.KNOWN_AXES (the analyzer is stdlib-only
+# and must not import the package under analysis)
+KNOWN_AXES = ("dp", "mp", "tp", "pp", "sp", "ep")
+
+# call targets whose string-literal arguments name mesh axes
+_SPEC_SUFFIXES = ("PartitionSpec", "sharding.spec", "sharding.constrain",
+                  "sharding.annotate", "batch_sharding")
+# call targets that construct a device mesh
+_MESH_SUFFIXES = ("jax.sharding.Mesh", "make_mesh", "data_parallel_mesh")
+
+
+def _axis_literals(call):
+    """String literals among a spec-site call's args (tuples included)."""
+    out = []
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            out.append((a, a.value))
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            for e in a.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append((e, e.value))
+    return out
+
+
+def _is_spec_site(res):
+    if res is None:
+        return False
+    return res.endswith(_SPEC_SUFFIXES) or res == "P" or res.endswith(".P")
+
+
+def _is_mesh_ctor(res):
+    if res is None:
+        return False
+    if res.endswith(_MESH_SUFFIXES):
+        return True
+    # `from jax.sharding import Mesh` resolves to jax.sharding.Mesh;
+    # a bare local class named Mesh does not resolve and stays None
+    return False
+
+
+class ShardingPass(Pass):
+    name = "sharding"
+    doc = ("axis-name literals at PartitionSpec/spec/constrain sites "
+           "must be known mesh axes; no mesh construction inside "
+           "jitted bodies (divisibility is enforced at bind time by "
+           "sharding.check_divisible)")
+
+    def run(self, ctx):
+        findings = []
+        for mod in ctx.modules:
+            findings.extend(self._scan_module(mod))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _scan_module(self, mod, _known=frozenset(KNOWN_AXES)):
+        out = []
+        # (a) unknown axis-name literals at sharding sites
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            res = mod.resolve(node.func)
+            if not _is_spec_site(res):
+                continue
+            for lit, value in _axis_literals(node):
+                if value not in _known:
+                    out.append(self.finding(
+                        mod, lit, "unknown-axis",
+                        "sharding site names axis %r, which is not a "
+                        "framework mesh axis %s — no mesh ever carries "
+                        "it, so the annotation silently partitions "
+                        "nothing" % (value, list(KNOWN_AXES)),
+                        fix_hint="use one of sharding.KNOWN_AXES, or "
+                                 "extend KNOWN_AXES (both the package "
+                                 "and this pass) for a new axis role",
+                        detail="%s:%s" % (res, value)))
+
+        # (b) mesh construction inside jitted bodies
+        jitted = []
+        for func in (n for n in ast.walk(mod.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))):
+            for dec in func.decorator_list:
+                if (isinstance(dec, ast.Call) and _is_jit_call(mod, dec)) \
+                        or mod.resolve(dec) == "jax.jit":
+                    jitted.append(func)
+        for node in ast.walk(mod.tree):
+            if not (_is_jit_call(mod, node)
+                    and isinstance(node, ast.Call)):
+                continue
+            local_defs = {}
+            for st in ast.walk(mod.tree):
+                if isinstance(st, ast.FunctionDef):
+                    local_defs[st.name] = st
+            target = _jitted_target(mod, node, local_defs)
+            if target is not None:
+                jitted.append(target)
+        seen = set()
+        for func in jitted:
+            if id(func) in seen:
+                continue
+            seen.add(id(func))
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                res = mod.resolve(node.func)
+                if _is_mesh_ctor(res):
+                    out.append(self.finding(
+                        mod, node, "mesh-in-jit",
+                        "mesh constructed inside a jitted body — the "
+                        "device list bakes into this one trace, every "
+                        "mesh change retraces, and the constructor "
+                        "itself may not be traceable",
+                        fix_hint="build the mesh once outside the jit "
+                                 "(mx.sharding.set_mesh) and close "
+                                 "over it; cache programs per mesh "
+                                 "fingerprint as executor._compiled_"
+                                 "cache does",
+                        detail="%s in %s" % (res, func.name)))
+        return out

@@ -45,6 +45,7 @@ import time
 from . import manifest
 from . import snapshot
 from . import multihost
+from . import sharded
 from . import writer as writer_mod
 from . import preemption
 from .manifest import latest
@@ -52,12 +53,14 @@ from .snapshot import capture, capture_params, load, restore, \
     write_checkpoint
 from .writer import AsyncCheckpointWriter, write_with_retry
 from .preemption import PreemptionHandler
+from .sharded import save_sharded, load_sharded, latest_sharded
 
 __all__ = ["CheckpointManager", "AsyncCheckpointWriter",
            "PreemptionHandler", "latest", "load", "resolve_params",
            "restore", "save",
            "capture", "capture_params", "manifest", "snapshot",
-           "multihost", "preemption"]
+           "multihost", "preemption", "sharded",
+           "save_sharded", "load_sharded", "latest_sharded"]
 
 
 def resolve_params(prefix, tag=None, epoch=None, what="reload"):

@@ -1,0 +1,158 @@
+"""GSPMD sharded checkpoints: shard-local slices with ABSOLUTE bounds.
+
+``checkpoint/multihost.py`` shards the training state by *rank* — each
+host writes whatever tensors it owns whole, and reload assumes the same
+world shape.  GSPMD-sharded tensors (mx.sharding) need the orthogonal
+protocol: a parameter partitioned over an ``mp`` axis exists as N
+device-local slices, and a checkpoint taken at dp=4 x mp=2 must restore
+into dp=8 x mp=1, a single device, or any future mesh.
+
+So every saved slice records its ABSOLUTE index bounds ``(lo, hi)`` per
+dimension (the same trick embedding/checkpoint.py uses for row-sharded
+tables).  Reload assembles the full logical tensor from whatever slices
+exist — the saving mesh never constrains the loading mesh — and the
+caller (or ``Executor._install_param_shardings`` at the next bind)
+re-places it under the current mesh.  Files ride the PR 7 manifest
+protocol (atomic publish, file+tensor CRC32s, newest-intact fallback).
+
+Layout for tag T:
+  ``<prefix>-<T>.sharded.npz``   — one npz of raw slices, keys ``s<i>``
+  ``<prefix>-<T>.ckpt.json``     — manifest; each tensor record carries
+                                   shape/dtype and its slice list
+                                   ``[{"key", "lo", "hi"}, ...]``
+"""
+from __future__ import annotations
+
+import os
+import zlib
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from . import manifest as _mf
+
+__all__ = ["save_sharded", "load_sharded", "latest_sharded"]
+
+_DATA_SUFFIX = ".sharded.npz"
+
+
+def _data_path(prefix, tag):
+    return "%s-%s%s" % (prefix, _mf.tag_str(tag), _DATA_SUFFIX)
+
+
+def _unique_slices(data):
+    """[(bounds, numpy slice)] covering ``data`` exactly once: walk the
+    addressable shards, normalize each shard.index to absolute (lo, hi)
+    bounds, and drop replicas (same bounds on another device)."""
+    shards = getattr(data, "addressable_shards", None)
+    if not shards:
+        arr = _np.asarray(data)
+        return [(tuple((0, s) for s in arr.shape), arr)]
+    out, seen = [], set()
+    shape = tuple(data.shape)
+    for sh in shards:
+        idx = sh.index if isinstance(sh.index, tuple) else (sh.index,)
+        bounds = []
+        for dim, sl in enumerate(idx):
+            lo = 0 if sl.start is None else int(sl.start)
+            hi = shape[dim] if sl.stop is None else int(sl.stop)
+            bounds.append((lo, hi))
+        # rank-0 (scalar) shards have an empty index: one replica total
+        bounds = tuple(bounds)
+        if bounds in seen:
+            continue
+        seen.add(bounds)
+        out.append((bounds, _np.asarray(sh.data)))
+    return out
+
+
+def save_sharded(prefix, tag, tensors, meta=None):
+    """Checkpoint a {key: NDArray | jax.Array | numpy} dict, writing
+    only the unique device-local slices of each tensor.  Returns the
+    manifest.  ``tensors`` keys are free-form — the fused-fit
+    convention is ``param:<name>``, ``state:<name>:<leaf>``,
+    ``residual:<name>`` (docs/SHARDING.md)."""
+    slices = {}          # npz key -> numpy slice
+    index = {}           # tensor key -> manifest record
+    n = 0
+    for key in sorted(tensors):
+        data = tensors[key]
+        data = data._data if isinstance(data, NDArray) else data
+        recs = []
+        for bounds, arr in _unique_slices(data):
+            skey = "s%d" % n
+            n += 1
+            slices[skey] = arr
+            recs.append({"key": skey,
+                         "lo": [int(b[0]) for b in bounds],
+                         "hi": [int(b[1]) for b in bounds]})
+        index[key] = {
+            "shape": [int(s) for s in getattr(data, "shape", ())],
+            "dtype": str(_np.dtype(getattr(data, "dtype", "float32"))),
+            "slices": recs,
+            "crc32": _tensor_crc(recs, slices),
+        }
+    path = _data_path(prefix, tag)
+
+    def _writer(tmp):
+        with open(tmp, "wb") as f:
+            _np.savez(f, **slices)
+
+    nbytes, crc = _mf.atomic_write(path, writer=_writer)
+    files = {"sharded": {"file": os.path.basename(path),
+                         "bytes": nbytes, "crc32": crc}}
+    return _mf.write_manifest(prefix, tag, files, index,
+                              meta=dict(meta or {}, kind="sharded"))
+
+
+def _tensor_crc(recs, slices):
+    crc = 0
+    for r in recs:
+        crc = zlib.crc32(_np.ascontiguousarray(slices[r["key"]]).tobytes(),
+                         crc)
+    return crc & 0xFFFFFFFF
+
+
+def load_sharded(prefix, tag=None, manifest=None):
+    """Assemble {key: numpy array} from a sharded checkpoint, whatever
+    mesh (or no mesh) wrote it.  With ``tag=None`` resumes from the
+    newest intact manifest.  Every tensor re-verifies its slice CRC."""
+    if manifest is None:
+        manifest = latest_sharded(prefix) if tag is None \
+            else _mf.read_manifest(prefix, tag)
+    if manifest is None:
+        raise MXNetError("no sharded checkpoint found at prefix %r"
+                         % (prefix,))
+    path = _data_path(prefix, manifest["tag"])
+    out = {}
+    try:
+        with _np.load(path) as npz:
+            slices = {k: npz[k] for k in npz.files}
+    except Exception as e:      # truncated/corrupt zip, missing file
+        raise MXNetError("sharded checkpoint %s unreadable: %s"
+                         % (path, e))
+    for key, rec in manifest["tensors"].items():
+        if _tensor_crc(rec["slices"], slices) != rec["crc32"]:
+            raise MXNetError("sharded checkpoint %s: tensor %r failed "
+                             "CRC validation" % (path, key))
+        shape = tuple(rec["shape"])
+        dst = _np.empty(shape, dtype=rec["dtype"])
+        for s in rec["slices"]:
+            window = tuple(slice(lo, hi)
+                           for lo, hi in zip(s["lo"], s["hi"]))
+            dst[window] = slices[s["key"]]
+        out[key] = dst
+    return out
+
+
+def latest_sharded(prefix):
+    """Newest intact manifest under ``prefix`` that is a sharded
+    checkpoint (kind == 'sharded')."""
+    for tag in reversed(_mf.list_tags(prefix)):
+        man = _mf.read_manifest(prefix, tag)
+        if man is None or man.get("kind") != "sharded":
+            continue
+        if _mf.validate(prefix, man):
+            return man
+    return None

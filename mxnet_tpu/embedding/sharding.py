@@ -86,7 +86,7 @@ def local_mesh():
 def table_sharding(mesh):
     """NamedSharding for a (vocab, dim) table: rows over the mesh."""
     return jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec("row", None))
+        mesh, jax.sharding.PartitionSpec("row", None))  # analyze: ok(sharding) embedding tables ride a dedicated single-axis 'row' mesh (local_mesh above), not the training mesh's named axes
 
 
 def place_table(arr):

@@ -101,6 +101,33 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
     entries = list(symbol._entries)
     aux_names = set(symbol.list_auxiliary_states())
 
+    # activation sharding constraints: __sharding__ attrs on op outputs
+    # become jax.lax.with_sharding_constraint inside the ONE program.
+    # The mesh is captured at build time — safe because _compiled_cache
+    # keys program caches on sharding.active_fingerprint(symbol).
+    from . import sharding as _sharding
+    _smesh = _sharding.get_mesh()
+    _constraints = {}
+    if _smesh is not None:
+        for _node in topo:
+            if _node.is_var:
+                continue
+            _s = _node.str_attrs.get(_sharding.SHARDING_ATTR)
+            if _s:
+                _constraints[id(_node)] = _sharding.parse_spec(_s)
+        _sharding.CONSTRAINT_SITES.set(len(_constraints))
+
+    def _constrain(node, v):
+        entries_ = _constraints.get(id(node))
+        if entries_ is None:
+            return v
+        # divisibility surfaces at trace time, when shapes are known
+        _sharding.check_divisible(entries_, v.shape, _smesh,
+                                  what="output of %r" % node.name)
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(_smesh, PartitionSpec(*entries_)))
+
     def _place(node, v):
         if not group_devices:
             return v
@@ -146,6 +173,9 @@ def _build_graph_fn(symbol, collect_taps=False, monitor_all=False,
                            if isinstance(raw, (tuple, list))
                            else _place(node, raw))
                 outs = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+                if _constraints:
+                    # the annotation names the node's primary output
+                    outs[0] = _constrain(node, outs[0])
                 n_vis = _tap_count(node)
                 for i, v in enumerate(outs):
                     env[(id(node), i)] = v
@@ -174,8 +204,20 @@ def _compiled_cache(symbol):
     """Per-symbol compiled-callable cache: executors bound to the same
     Symbol (rebinds, numeric-grad perturbations, BucketingModule buckets)
     share XLA executables — the analog of the reference's shared memory
-    pool across executors (graph_executor.cc InitDataEntryMemory)."""
-    cache = getattr(symbol, "_exec_cache", None)
+    pool across executors (graph_executor.cc InitDataEntryMemory).
+
+    The store is keyed by ``sharding.active_fingerprint(symbol)``: None
+    for mesh-independent symbols (the common case — one entry, exactly
+    the old behavior), or the selected mesh's fingerprint when the
+    symbol carries ``__sharding__`` annotations, whose graph_fn closes
+    over the mesh.  A mesh change then builds fresh programs instead of
+    silently reusing executables with stale shardings."""
+    from . import sharding as _sharding
+    store = getattr(symbol, "_exec_cache", None)
+    if store is None:
+        store = symbol._exec_cache = {}
+    fp = _sharding.active_fingerprint(symbol)
+    cache = store.get(fp)
     if cache is None:
         graph_fn = _build_graph_fn(symbol)
 
@@ -195,7 +237,7 @@ def _compiled_cache(symbol):
         cache = {"graph_fn": graph_fn, "fwd_train": _fwd_train,
                  "fwd_eval": _fwd_eval, "fwd_eval_donated": None,
                  "fwd_bwd": {}, "fwd_monitor": {}}
-        symbol._exec_cache = cache
+        store[fp] = cache
     return cache
 
 
@@ -826,8 +868,36 @@ class Executor:
                 aux_dict[name] = shared
             else:
                 aux_dict[name] = nd_zeros(shp, ctx, dt)
+        Executor._install_param_shardings(symbol, arg_dict, grad_dict,
+                                          aux_dict)
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict,
                         grad_req_dict, group2ctx)
+
+    @staticmethod
+    def _install_param_shardings(symbol, arg_dict, grad_dict, aux_dict):
+        """Bind-time GSPMD placement: resolve ``__sharding__`` var attrs
+        against the selected mesh (mx.sharding.set_mesh / MXTPU_MESH)
+        and device_put each annotated parameter — and its grad buffer —
+        with the resulting NamedSharding, so per-device param bytes
+        shrink the moment the executor exists (the HBM census reads
+        this).  No mesh selected, or no annotations: no-op."""
+        from . import sharding as _sharding
+        mesh = _sharding.get_mesh()
+        if mesh is None:
+            return
+        specs = _sharding.collect_var_specs(symbol)
+        if not specs:
+            return
+        for name, s in specs.items():
+            for store in (arg_dict, aux_dict):
+                arr = store.get(name)
+                if arr is None:
+                    continue
+                ns = _sharding.resolve(s, arr.shape, mesh, what=name)
+                arr._set_data(jax.device_put(arr._data, ns))
+                g = grad_dict.get(name) if store is arg_dict else None
+                if g is not None:
+                    g._set_data(jax.device_put(g._data, ns))
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states, group2ctx,

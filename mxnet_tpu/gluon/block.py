@@ -531,15 +531,27 @@ class SymbolBlock(HybridBlock):
         arg_names = outputs.list_arguments()
         aux_names = set(outputs.list_auxiliary_states())
         existing = dict(params.items()) if params is not None else {}
+        # the graph's per-variable user attrs: lr/wd mults map onto the
+        # typed Parameter fields; everything else (e.g. __sharding__)
+        # is carried verbatim so re-export round-trips (test_attr_parity)
+        var_attrs = outputs.attr_dict()
+        _consumed = ("__shape__", "__dtype__", "__init__",
+                     "__storage_type__", "__lr_mult__", "__wd_mult__",
+                     "lr_mult", "wd_mult")
         for name in arg_names + list(aux_names):
             if name in self._input_names:
                 continue
             if name in existing:
                 self._params._params[name] = existing[name]
             else:
+                a = var_attrs.get(name, {})
                 self._params._params[name] = Parameter(
                     name, allow_deferred_init=True,
-                    grad_req="null" if name in aux_names else "write")
+                    grad_req="null" if name in aux_names else "write",
+                    lr_mult=float(a.get("__lr_mult__", 1.0)),
+                    wd_mult=float(a.get("__wd_mult__", 1.0)),
+                    attrs={k: v for k, v in a.items()
+                           if k not in _consumed})
         self._graph_cache = {}
 
     @staticmethod

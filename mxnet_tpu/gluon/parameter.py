@@ -26,7 +26,8 @@ class DeferredInitializationError(MXNetError):
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype="float32",
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype="default", grad_stype="default"):
+                 differentiable=True, stype="default", grad_stype="default",
+                 attrs=None):
         self.name = name
         self._grad_req = None
         if isinstance(shape, int):
@@ -35,6 +36,10 @@ class Parameter:
         self.dtype = dtype
         self.lr_mult = lr_mult
         self.wd_mult = wd_mult
+        # free-form user attrs (e.g. __sharding__) that var() re-emits so
+        # a Block -> tojson -> SymbolBlock round trip keeps them — the
+        # same contract lr_mult rides through its typed field
+        self.attrs = dict(attrs) if attrs else {}
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
@@ -194,10 +199,13 @@ class Parameter:
                 self._init_grad()
 
     def var(self):
-        """Symbol variable for this parameter (used by export/SymbolBlock)."""
+        """Symbol variable for this parameter (used by export/SymbolBlock).
+        Free-form user attrs (``self.attrs``, e.g. ``__sharding__``)
+        ride along so export/tojson preserves them."""
         from .. import symbol as sym
-        return sym.var(self.name, shape=self.shape, dtype=self.dtype,
-                       lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+        return sym.var(self.name, attr=self.attrs or None, shape=self.shape,
+                       dtype=self.dtype, lr_mult=self.lr_mult,
+                       wd_mult=self.wd_mult)
 
 
 class Constant(Parameter):

@@ -21,19 +21,43 @@ from .. import symbol as sym
 
 def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
                ffn_dim=None, seq_len=1024, dtype="float32", dropout=0.0,
-               moe_experts=0, moe_every=2, moe_aux_coeff=0.01, **kwargs):
+               moe_experts=0, moe_every=2, moe_aux_coeff=0.01,
+               tensor_parallel=None, **kwargs):
     """``num_classes`` is the vocabulary size (factory-signature parity
     with the CNN zoo's get_symbol). With ``moe_experts`` > 0 every
     ``moe_every``-th layer's FFN becomes a Switch-MoE
     (sym.contrib.SwitchMoE, num_experts experts, top-1 routing) and the
     load-balancing aux losses join the heads through MakeLoss scaled by
     ``moe_aux_coeff`` — a sparse-expert LM end-to-end in the symbolic
-    API."""
+    API.
+
+    ``tensor_parallel`` (docs/SHARDING.md): a mesh-axis name (True means
+    "mp") that Megatron-splits every dense layer — attention heads and
+    the packed qkv projection partition over the axis (column-parallel),
+    the output/ffn_down projections are row-parallel with the psum at
+    their replicated outputs, so each transformer block costs exactly
+    two all-reduces in forward.  The annotations are plain
+    ``__sharding__`` attrs: without a selected mesh the symbol trains
+    replicated, unchanged."""
     vocab = int(num_classes)
     d = int(d_model)
     ffn = int(ffn_dim) if ffn_dim else 4 * d
     lp = float(dropout)
     aux_losses = []
+
+    tp = "mp" if tensor_parallel is True else tensor_parallel
+    if tp:
+        from .. import sharding as _sharding
+        if int(num_heads) < 2:
+            raise ValueError("tensor_parallel needs num_heads >= 2")
+        _col_w = {_sharding.SHARDING_ATTR: _sharding.spec(tp, None)}
+        _col_b = {_sharding.SHARDING_ATTR: _sharding.spec(tp)}
+        _row_w = {_sharding.SHARDING_ATTR: _sharding.spec(None, tp)}
+        _replicate = lambda s: _sharding.constrain(s)
+        _keep_split = lambda s: _sharding.constrain(s, None, None, tp)
+    else:
+        _col_w = _col_b = _row_w = {}
+        _replicate = _keep_split = lambda s: s
 
     data = sym.Variable("data")                      # (B, S) token ids
     tok = sym.Embedding(data, input_dim=vocab, output_dim=d,
@@ -51,13 +75,16 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
         # one fused sublayer op: qkv proj + causal MHA + out proj with
         # head-major internal layout (no transposes); weight names keep
         # the unfused FullyConnected convention so checkpoints interop
+        attn_kw = {"head_axis": tp} if tp else {}
         proj = sym.contrib.FusedCausalSelfAttention(
             ln1,
-            sym.Variable(pre + "qkv_weight"),
-            sym.Variable(pre + "qkv_bias", init=_init.Zero()),
-            sym.Variable(pre + "proj_weight"),
+            sym.Variable(pre + "qkv_weight", **_col_w),
+            sym.Variable(pre + "qkv_bias", init=_init.Zero(), **_col_b),
+            sym.Variable(pre + "proj_weight", **_row_w),
             sym.Variable(pre + "proj_bias", init=_init.Zero()),
-            num_heads=int(num_heads), name=pre + "attn")
+            num_heads=int(num_heads), name=pre + "attn", **attn_kw)
+        if tp:
+            proj = _replicate(proj)   # the block's first psum site
         if lp > 0:
             proj = sym.Dropout(data=proj, p=lp, name=pre + "drop1")
         x = x + proj
@@ -77,12 +104,25 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
             h = moe[0]
             aux_losses.append(moe[1])
         else:
-            h = sym.FullyConnected(data=ln2, num_hidden=ffn,
-                                   flatten=False, name=pre + "ffn_up")
+            # Megatron FFN: column-parallel up (weight (ffn, d) split on
+            # its output rows), gelu on the still-split activation,
+            # row-parallel down with the psum at its replicated output
+            h = sym.FullyConnected(
+                data=ln2,
+                weight=sym.Variable(pre + "ffn_up_weight", **_col_w),
+                bias=sym.Variable(pre + "ffn_up_bias", init=_init.Zero(),
+                                  **_col_b),
+                num_hidden=ffn, flatten=False, name=pre + "ffn_up")
+            h = _keep_split(h)
             h = sym.LeakyReLU(data=h, act_type="gelu_tanh",
                               name=pre + "gelu")
-            h = sym.FullyConnected(data=h, num_hidden=d, flatten=False,
-                                   name=pre + "ffn_down")
+            h = sym.FullyConnected(
+                data=h,
+                weight=sym.Variable(pre + "ffn_down_weight", **_row_w),
+                bias=sym.Variable(pre + "ffn_down_bias",
+                                  init=_init.Zero()),
+                num_hidden=d, flatten=False, name=pre + "ffn_down")
+            h = _replicate(h)
         if lp > 0:
             h = sym.Dropout(data=h, p=lp, name=pre + "drop2")
         x = x + h

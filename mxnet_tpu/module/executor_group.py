@@ -46,7 +46,18 @@ class DataParallelExecutorGroup:
         self._grad_req_arg = grad_req
 
         self._n_dev = len(contexts)
-        self._mesh = data_parallel_mesh(contexts) if self._n_dev > 1 else None
+        # an explicitly selected mesh (mx.sharding.set_mesh / MXTPU_MESH)
+        # takes over when it spans exactly this group's devices: the
+        # batch shards over its 'dp' axis while annotated params
+        # partition over 'mp' — else the implicit 1-D dp mesh as before
+        from .. import sharding as _sharding
+        smesh = _sharding.get_mesh()
+        if smesh is not None and "dp" in smesh.axis_names \
+                and smesh.devices.size == self._n_dev > 1:
+            self._mesh = smesh
+        else:
+            self._mesh = data_parallel_mesh(contexts) \
+                if self._n_dev > 1 else None
 
         req = {}
         for name in self.arg_names:
@@ -109,18 +120,49 @@ class DataParallelExecutorGroup:
     def _repl_sharding(self):
         return NamedSharding(self._mesh, P())
 
+    def _param_shardings(self):
+        """{param name: NamedSharding} for __sharding__-annotated vars,
+        resolved against THIS group's mesh (which may be the implicit
+        1-D dp mesh, where specs naming only 'mp' would fail loudly)."""
+        from .. import sharding as _sharding
+        axes = set(self._mesh.axis_names)
+        out = {}
+        for name, s in _sharding.collect_var_specs(self.symbol).items():
+            arr = self._exec.arg_dict.get(name) \
+                if name in self._exec.arg_dict \
+                else self._exec.aux_dict.get(name)
+            if arr is None:
+                continue
+            entries = _sharding.parse_spec(s)
+            named = {a for e in entries if e is not None
+                     for a in (e if isinstance(e, tuple) else (e,))}
+            if not named <= axes:
+                # annotations for axes this mesh doesn't carry are
+                # latent (TP symbol bound on the implicit dp mesh runs
+                # replicated); an explicitly selected mesh already
+                # failed loudly in Executor._install_param_shardings
+                continue
+            out[name] = _sharding.resolve(s, arr.shape, self._mesh,
+                                          what=name)
+        return out
+
     def _install_shardings(self):
         repl = self._repl_sharding()
         bsh = self._batch_sharding()
+        psh = self._param_shardings()
         for name, arr in self._exec.arg_dict.items():
-            sh = bsh if (name in self.data_names or name in self.label_names) \
-                else repl
+            if name in self.data_names or name in self.label_names:
+                sh = bsh
+            else:
+                sh = psh.get(name, repl)
             arr._set_data(jax.device_put(arr._data, sh))
-        for arr in self._exec.aux_dict.values():
-            arr._set_data(jax.device_put(arr._data, repl))
-        for arr in self._exec.grad_dict.values():
+        for name, arr in self._exec.aux_dict.items():
+            arr._set_data(jax.device_put(arr._data, psh.get(name, repl)))
+        for name, arr in self._exec.grad_dict.items():
             if arr is not None:
-                arr._set_data(jax.device_put(arr._data, repl))
+                # grads inherit their param's sharding (GSPMD's vjp of an
+                # mp-sharded matmul yields mp-sharded weight grads)
+                arr._set_data(jax.device_put(arr._data, psh.get(name, repl)))
 
     def _place_input(self, name, value):
         data = value._data if isinstance(value, NDArray) else \

@@ -445,6 +445,7 @@ class FusedFitStep:
             # flush pending buckets and spill their flat residuals back
             # to the per-(key,dev) dict before we take ownership
             kv._sync_engine()
+        from .. import sharding as _sharding
         res = {}
         for n in order:
             w = exe.arg_dict[n]
@@ -457,6 +458,10 @@ class FusedFitStep:
                 kv._compression_residuals.pop((n, 0), None)
             else:
                 res[n] = jnp.zeros(w.shape, jnp.float32)
+            # f32 residuals ride their param's sharding (mp-sharded
+            # params keep shard-local error feedback; device_put is an
+            # identity when the placement already matches)
+            res[n] = _sharding.match_param(res[n], w._data)
         self._residuals = res
         return res
 
@@ -560,6 +565,15 @@ class FusedFitStep:
         lr_vec, wd_vec, extra = optimizer._fused_runtime(ukeys)
         use_wd = bool(_np.any(wd_vec != 0.0))
         tpls, mp_flags = tuple(tpls), tuple(mp_flags)
+        if group._mesh is not None:
+            # optimizer-state leaves inherit each param's sharding, so
+            # mp-sharded params carry mp-sharded moments/masters inside
+            # the donated program (no resharding at the jit boundary)
+            from .. import sharding as _sharding
+            for n, st in zip(order, states_nd):
+                w = exe.arg_dict[n]._data
+                for l in _fused.flatten_state(st)[0]:
+                    l._set_data(_sharding.match_param(l._data, w))
         states = {n: tuple(l._data for l in _fused.flatten_state(st)[0])
                   for n, st in zip(order, states_nd)}
         residuals = self._seed_residuals(order, exe) \

@@ -365,6 +365,12 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._exec_group.param_names,
                                 update_on_kvstore=update_on_kvstore)
+            if self._exec_group._mesh is not None:
+                # the kvstore init/pull round-trip re-wrote the param
+                # arrays with single-device copies; restore the bind-time
+                # GSPMD placement (mp-sharded params must START sharded,
+                # not converge to it after the first donated step)
+                self._exec_group._install_shardings()
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:

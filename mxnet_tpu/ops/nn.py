@@ -782,7 +782,8 @@ def causal_self_attention(qkv, *, num_heads, scale=None):
 @register("_contrib_FusedCausalSelfAttention",
           aliases=("FusedCausalSelfAttention",))
 def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
-                                proj_bias, *, num_heads, scale=None):
+                                proj_bias, *, num_heads, scale=None,
+                                head_axis=None):
     """Whole attention sublayer in one op: QKV projection -> causal MHA ->
     output projection, (B, S, d) -> (B, S, d).
 
@@ -794,6 +795,13 @@ def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
     layouts match the reference FullyConnected convention ((3d, d) /
     (d, d) row-major), so checkpoints from the unfused pair load
     unchanged.
+
+    ``head_axis`` (docs/SHARDING.md): a mesh-axis name partitioning the
+    HEAD dim for tensor parallelism — q/k/v/o get GSPMD sharding
+    constraints over (None, head_axis) so each mp shard computes its own
+    heads locally (the Megatron split).  Inert when no mesh is selected
+    or the selected mesh lacks the axis; programs are cached per mesh
+    fingerprint so the trace-time mesh read cannot go stale.
     """
     B, S, d = data.shape
     H = int(num_heads)
@@ -802,11 +810,20 @@ def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
     D = d // H
     sc = (1.0 / D ** 0.5) if scale is None else float(scale)
 
+    _shard_heads = lambda t: t
+    if head_axis is not None:
+        from .. import sharding as _sharding
+        _mesh = _sharding.get_mesh()
+        if _mesh is not None and str(head_axis) in _mesh.axis_names:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            _ns = NamedSharding(_mesh, P(None, str(head_axis)))
+            _shard_heads = lambda t: jax.lax.with_sharding_constraint(t, _ns)
+
     Wqkv = qkv_weight.reshape(3, H, D, d)
     bqkv = qkv_bias.reshape(3, H, 1, D)
-    q = jnp.einsum("bsd,hed->bhse", data, Wqkv[0]) + bqkv[0]
-    k = jnp.einsum("bsd,hed->bhse", data, Wqkv[1]) + bqkv[1]
-    v = jnp.einsum("bsd,hed->bhse", data, Wqkv[2]) + bqkv[2]
+    q = _shard_heads(jnp.einsum("bsd,hed->bhse", data, Wqkv[0]) + bqkv[0])
+    k = _shard_heads(jnp.einsum("bsd,hed->bhse", data, Wqkv[1]) + bqkv[1])
+    v = _shard_heads(jnp.einsum("bsd,hed->bhse", data, Wqkv[2]) + bqkv[2])
 
     if _use_flash_attention(S, D, data.dtype):
         o = _flash_attention(q, k, v, sc)
@@ -820,6 +837,7 @@ def fused_causal_self_attention(data, qkv_weight, qkv_bias, proj_weight,
             return jnp.einsum("bhqk,bhke->bhqe", p, v)
         o = attn(q, k, v)
 
+    o = _shard_heads(o)
     return jnp.einsum("bhse,dhe->bsd", o,
                       proj_weight.reshape(d, H, D)) + proj_bias
 

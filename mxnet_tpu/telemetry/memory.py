@@ -59,6 +59,12 @@ PEAK_BYTES = REGISTRY.gauge(
 STEP_PEAK_DELTA = REGISTRY.gauge(
     "hbm_step_peak_delta_bytes",
     "peak-memory delta across the last tracked step", unit="bytes")
+PARAM_BYTES_PER_DEVICE = REGISTRY.gauge(
+    "param_bytes_per_device",
+    "bytes the tracked params group occupies on ONE device: replicated "
+    "params count full size, GSPMD-sharded ones their shard only "
+    "(mx.sharding — the number that shrinks when mp partitions params)",
+    unit="bytes")
 
 _GROUP_GAUGES = {"params": PARAMS_BYTES, "opt_states": OPT_STATES_BYTES,
                  "residuals": RESIDUALS_BYTES, "auxs": AUXS_BYTES}
@@ -122,6 +128,7 @@ def memory_snapshot():
             continue
 
     group_bytes = {}
+    params_dev_bytes = 0
     claimed = set()
     for name, provider in list(_groups.items()):
         nbytes = 0
@@ -142,6 +149,8 @@ def memory_snapshot():
                 nbytes += int(a.nbytes)
             except Exception:
                 continue
+            if name == "params":
+                params_dev_bytes += _one_device_bytes(a)
         group_bytes[name] = nbytes
 
     other = max(0, total - sum(group_bytes.values()))
@@ -151,6 +160,7 @@ def memory_snapshot():
     LIVE_ARRAYS.set(len(live))
     for name, gauge in _GROUP_GAUGES.items():
         gauge.set(group_bytes.get(name, 0))
+    PARAM_BYTES_PER_DEVICE.set(params_dev_bytes)
     OTHER_BYTES.set(other)
     BYTES_IN_USE.set(in_use or 0)
     PEAK_BYTES.set(peak or 0)
@@ -162,10 +172,27 @@ def memory_snapshot():
                     **{g: b for g, b in group_bytes.items()
                        if g not in _GROUP_GAUGES},
                     "other": other},
+        "param_bytes_per_device": params_dev_bytes,
         "bytes_in_use": in_use,
         "peak_bytes_in_use": peak,
         "devices": per_dev,
     }
+
+
+def _one_device_bytes(a):
+    """Bytes array ``a`` occupies on its first shard's device —
+    shard-local size for GSPMD-sharded arrays, full size otherwise."""
+    try:
+        shards = a.addressable_shards
+    except Exception:
+        shards = None
+    if not shards:
+        try:
+            return int(a.nbytes)
+        except Exception:
+            return 0
+    dev = shards[0].device
+    return sum(int(s.data.nbytes) for s in shards if s.device == dev)
 
 
 def _peak_or_live():

@@ -523,7 +523,8 @@ def test_optfused_live_tree_clean():
 def test_all_passes_registered():
     names = [p.name for p in analyze.all_passes()]
     assert names == ["hostsync", "retrace", "donation", "threads",
-                     "collective", "telemetry", "envknobs", "optfused"]
+                     "collective", "telemetry", "envknobs", "optfused",
+                     "sharding"]
 
 
 @pytest.mark.parametrize("knob", ["MXNET_KVSTORE_BIGARRAY_BOUND",

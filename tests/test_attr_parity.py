@@ -98,6 +98,31 @@ def test_attr_op_kwarg_lr_mult_reaches_optimizer():
     assert opt.lr_mult.get("fc_weight") == 2.0
 
 
+def test_sharding_attr_roundtrips_symbol_and_gluon():
+    """``__sharding__`` is a plain user attr: it must survive the JSON
+    wire format (pickle rides tojson) and the gluon SymbolBlock import,
+    whose Parameters carry non-consumed attrs verbatim and re-emit them
+    from ``var()`` — so a re-exported graph keeps its placement."""
+    from mxnet_tpu import sharding
+    w = mx.sym.Variable("w", attr={sharding.SHARDING_ATTR:
+                                   sharding.spec("mp", None)})
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), weight=w,
+                              num_hidden=4, name="fc"),
+        name="softmax")
+    back = pkl.loads(pkl.dumps(net))
+    assert back.attr_dict()["w"][sharding.SHARDING_ATTR] == "('mp', None)"
+    from mxnet_tpu.gluon import SymbolBlock
+    blk = SymbolBlock(back, [mx.sym.Variable("data"),
+                             mx.sym.Variable("softmax_label")])
+    p = blk.params._params["w"]
+    assert p.attrs[sharding.SHARDING_ATTR] == "('mp', None)"
+    assert p.var().attr(sharding.SHARDING_ATTR) == "('mp', None)"
+    # a consumed attr (lr_mult) still maps onto the typed field, and
+    # does NOT leak into the verbatim attrs dict
+    assert "__lr_mult__" not in p.attrs and "lr_mult" not in p.attrs
+
+
 def test_variable_rejects_non_dunder_kwargs():
     with pytest.raises(ValueError):
         mx.sym.Variable("x", not_dunder=1)

@@ -1,0 +1,354 @@
+"""mx.sharding — GSPMD model parallelism (mxnet_tpu/sharding/).
+
+Pins the PR's acceptance criteria on the 8-virtual-device CPU mesh
+(conftest forces --xla_force_host_platform_device_count=8):
+
+* spec/attr contract: canonical tuple-repr serialization, axis-name
+  validation, MXTPU_MESH parsing, bind-time divisibility errors;
+* dp=4 x mp=2 tensor-parallel transformer fused fit: ONE launch per
+  step, zero per-batch host syncs, zero steady-state retraces across
+  ragged batches, loss/weight parity vs the replicated arm (same
+  symbol, mesh cleared), and per-device param bytes genuinely halved
+  for the mp-sharded matmuls (HBM census agrees);
+* mesh-fingerprint-keyed compiled caches: changing the mesh compiles
+  new programs instead of silently reusing ones built against stale
+  shardings — and the old entries survive for a mesh switch-back;
+* sharded checkpoints (checkpoint/sharded.py): shard-local slices with
+  absolute bounds reassemble bit-for-bit into ANY world — dp8 x mp1
+  and single-device reload of a dp4 x mp2 save, optimizer state and
+  2-bit f32 residuals included.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, nd, sharding
+from mxnet_tpu import metric as metric_mod
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import transformer
+from mxnet_tpu.module import fused_fit
+from mxnet_tpu import fused_update
+
+
+@pytest.fixture(autouse=True)
+def _mesh_cleanup():
+    yield
+    sharding.set_mesh(None)
+
+
+# ----------------------------------------------------------------------
+# spec / mesh contract
+# ----------------------------------------------------------------------
+def test_spec_roundtrip_and_validation():
+    assert sharding.spec("mp", None) == "('mp', None)"
+    assert sharding.spec() == "()"
+    assert sharding.spec(("dp", "mp"), None) == "(('dp', 'mp'), None)"
+    assert sharding.parse_spec("('mp', None)") == ("mp", None)
+    assert sharding.partition_spec("('mp',)") == jax.sharding.PartitionSpec("mp")
+    with pytest.raises(MXNetError):
+        sharding.spec("bogus")
+    with pytest.raises(MXNetError):
+        sharding.parse_spec("('bogus',)")
+    with pytest.raises(MXNetError):
+        sharding.parse_spec("not a tuple at all ((")
+
+
+def test_set_mesh_and_env_parse(monkeypatch):
+    mesh = sharding.set_mesh({"dp": 4, "mp": 2})
+    assert tuple(mesh.axis_names) == ("dp", "mp")
+    assert tuple(mesh.devices.shape) == (4, 2)
+    assert sharding.get_mesh() is mesh
+    fp = sharding.mesh_fingerprint(mesh)
+    assert fp[0] == ("dp", "mp") and fp[1] == (4, 2)
+    sharding.set_mesh(None)
+    assert sharding.get_mesh() is None
+    # lazy env parse: first get_mesh() after a reset reads MXTPU_MESH
+    monkeypatch.setenv("MXTPU_MESH", "dp=2,mp=4")
+    sharding._STATE["env_checked"] = False
+    env_mesh = sharding.get_mesh()
+    assert tuple(env_mesh.devices.shape) == (2, 4)
+    monkeypatch.setenv("MXTPU_MESH", "dp4")      # malformed: no '='
+    sharding._STATE.update(mesh=None, env_checked=False)
+    with pytest.raises(MXNetError):
+        sharding.get_mesh()
+    sharding._STATE["env_checked"] = True
+
+
+def test_resolve_and_divisibility_errors():
+    mesh = sharding.set_mesh({"dp": 4, "mp": 2})
+    ns = sharding.resolve("('mp', None)", (8, 6), mesh, what="w")
+    assert isinstance(ns, jax.sharding.NamedSharding)
+    assert ns.spec == jax.sharding.PartitionSpec("mp", None)
+    # mp=2 cannot divide 7
+    with pytest.raises(MXNetError):
+        sharding.resolve("('mp', None)", (7, 6), mesh, what="w")
+    # rank overflow
+    with pytest.raises(MXNetError):
+        sharding.resolve("('mp', None, None)", (8, 6), mesh)
+    # axis absent from the mesh
+    with pytest.raises(MXNetError):
+        sharding.resolve("('pp',)", (8,), mesh)
+
+
+def test_annotate_collect_and_fingerprint():
+    w = mx.sym.Variable("w")
+    sharding.annotate(w, "mp", None)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), weight=w,
+                                num_hidden=8, name="fc")
+    assert sharding.collect_var_specs(net)["w"] == "('mp', None)"
+    assert sharding.symbol_has_sharding(net)
+    plain = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                  name="fc2")
+    assert not sharding.symbol_has_sharding(plain)
+    # fingerprint: None without a mesh, None for unannotated symbols
+    sharding.set_mesh(None)
+    assert sharding.active_fingerprint(net) is None
+    mesh = sharding.set_mesh({"dp": 4, "mp": 2})
+    assert sharding.active_fingerprint(net) == sharding.mesh_fingerprint(mesh)
+    assert sharding.active_fingerprint(plain) is None
+
+
+def test_parallel_fc_builders_attach_megatron_specs():
+    d = mx.sym.Variable("data")
+    col = sharding.column_parallel_fc(d, 16, "up", act_spec=(None, "mp"))
+    specs = sharding.collect_var_specs(col)
+    assert specs["up_weight"] == "('mp', None)"
+    assert specs["up_bias"] == "('mp',)"
+    assert specs["up"] == "(None, 'mp')"         # activation keeps the split
+    row = sharding.row_parallel_fc(col, 8, "down")
+    specs = sharding.collect_var_specs(row)
+    assert specs["down_weight"] == "(None, 'mp')"
+    assert specs["down"] == "()"                 # psum site: replicated
+
+
+# ----------------------------------------------------------------------
+# TP transformer training
+# ----------------------------------------------------------------------
+_V, _S, _B = 64, 16, 16        # vocab / seq / batch (divisible by dp=4)
+
+
+def _tp_module(n_dev=8, compress=None, arg_params=None):
+    """Bind + init a TP transformer Module.  ``arg_params`` restores
+    the given weights BEFORE init_optimizer (the checkpoint-restore
+    ordering: the kvstore adopts the restored values at init)."""
+    sym = transformer.get_symbol(num_classes=_V, num_layers=2, d_model=32,
+                                 num_heads=4, seq_len=_S,
+                                 tensor_parallel="mp")
+    kv = mx.kv.create("device")
+    if compress is not None:
+        kv.set_gradient_compression({"type": "2bit",
+                                     "threshold": compress})
+    mod = mx.Module(sym, context=[mx.cpu(i) for i in range(n_dev)])
+    mod.bind(data_shapes=[("data", (_B, _S))],
+             label_shapes=[("softmax_label", (_B * _S,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    if arg_params is not None:
+        mod.set_params(arg_params, {}, allow_missing=True)
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    return mod
+
+
+def _batch(rng, n=_B):
+    return mx.io.DataBatch(
+        data=[nd.array(rng.randint(0, _V, (n, _S)).astype(np.float32))],
+        label=[nd.array(rng.randint(0, _V, (n * _S,)).astype(np.float32))])
+
+
+def test_tp_fused_fit_single_launch_and_param_bytes():
+    """dp4 x mp2: one launch/step, no host syncs, no steady-state
+    retraces across ragged batches, per-device param bytes ~halved."""
+    sharding.set_mesh({"dp": 4, "mp": 2})
+    mod = _tp_module()
+    m = metric_mod.create("ce")
+    rng = np.random.RandomState(0)
+    assert mod.fit_step(_batch(rng), m)          # trace @ full batch
+    assert mod.fit_step(_batch(rng, 8), m)       # trace @ ragged batch
+    mod._fit_sync()
+    d0 = profiler.DEVICE_DISPATCHES.value
+    h0 = metric_mod.HOST_SYNCS.value
+    traced = fused_fit.TRACE_COUNT
+    r0 = int(mx.executor.EXECUTOR_RETRACES.value)
+    for n in (_B, 8, _B, 8, _B, _B):
+        assert mod.fit_step(_batch(rng, n), m)
+    mod._fit_sync()
+    assert (profiler.DEVICE_DISPATCHES.value - d0) == 6     # ONE per step
+    assert metric_mod.HOST_SYNCS.value - h0 == 0
+    assert fused_fit.TRACE_COUNT == traced, \
+        "TP fit program retraced in steady state across ragged batches"
+    assert int(mx.executor.EXECUTOR_RETRACES.value) == r0
+
+    # the mp-sharded matmuls genuinely halve; embeddings/lm_head stay
+    # replicated, so the whole-model ratio sits between 0.5 and 0.6
+    exe = mod._exec_group._exec
+    params = [exe.arg_dict[n] for n in mod._exec_group.param_names
+              if n in exe.arg_dict]
+    per_dev = sharding.per_device_param_bytes(params)
+    total = sum(int(p._data.nbytes) for p in params)
+    assert 0.45 <= per_dev / total <= 0.60
+    w = exe.arg_dict["layer0_ffn_up_weight"]._data
+    assert isinstance(w.sharding, jax.sharding.NamedSharding)
+    # (NamedSharding canonicalizes away trailing Nones)
+    assert tuple(w.sharding.spec) in (("mp",), ("mp", None))
+    # census gauge agrees with the direct accounting
+    snap = mx.telemetry.memory_snapshot()
+    assert snap["param_bytes_per_device"] == per_dev
+    name, val = m.get()
+    assert np.isfinite(val)
+
+
+def test_tp_loss_parity_vs_replicated():
+    """Partitioning the math must not change it: same symbol, same
+    init, same batches — mp arm tracks the replicated arm to 2e-5."""
+    rng_data = np.random.RandomState(7)
+    batches = [_batch(rng_data) for _ in range(5)]
+
+    def run(mesh_axes, params_from=None):
+        sharding.set_mesh(mesh_axes)
+        mod = _tp_module(arg_params=params_from)
+        m = metric_mod.create("ce")
+        for b in batches:
+            assert mod.fit_step(b, m)
+        mod._fit_sync()
+        arg, aux = mod.get_params()
+        _, loss = m.get()
+        return mod, (arg, aux), loss
+
+    _, (arg0, _aux0), _ = run(None)              # replicated baseline init
+    seed = {k: v.copy() for k, v in arg0.items()}
+    # rebuild both arms from the SAME weights so the comparison is exact
+    _, (arg_r, _), loss_r = run(None, params_from=seed)
+    _, (arg_s, _), loss_s = run({"dp": 4, "mp": 2}, params_from=seed)
+    assert np.isclose(loss_s, loss_r, rtol=2e-5, atol=1e-6)
+    for k in arg_r:
+        np.testing.assert_allclose(arg_s[k].asnumpy(), arg_r[k].asnumpy(),
+                                   rtol=2e-5, atol=1e-6,
+                                   err_msg="weight %s diverged" % k)
+
+
+def test_mesh_fingerprint_keys_compiled_cache():
+    """A mesh change must compile fresh programs (stale shardings are
+    baked into the old ones); switching back reuses the old entries."""
+    from mxnet_tpu.executor import _compiled_cache
+    sym = transformer.get_symbol(num_classes=_V, num_layers=1, d_model=32,
+                                 num_heads=2, seq_len=_S,
+                                 tensor_parallel="mp")
+    mesh_a = sharding.set_mesh({"dp": 4, "mp": 2})
+    cache_a = _compiled_cache(sym)
+    assert set(sym._exec_cache) == {sharding.mesh_fingerprint(mesh_a)}
+    mesh_b = sharding.set_mesh({"dp": 2, "mp": 4})
+    cache_b = _compiled_cache(sym)
+    assert cache_b is not cache_a
+    assert set(sym._exec_cache) == {sharding.mesh_fingerprint(mesh_a),
+                                    sharding.mesh_fingerprint(mesh_b)}
+    sharding.set_mesh(None)                      # mesh-independent slot
+    cache_none = _compiled_cache(sym)
+    assert cache_none is not cache_a and cache_none is not cache_b
+    sharding.set_mesh(mesh_a)
+    assert _compiled_cache(sym) is cache_a       # switch-back: cache hit
+    # unannotated symbols never fork their cache on mesh changes
+    plain = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                  name="fc")
+    c0 = _compiled_cache(plain)
+    sharding.set_mesh({"dp": 8})
+    assert _compiled_cache(plain) is c0
+
+
+# ----------------------------------------------------------------------
+# sharded checkpoints: any-world restore
+# ----------------------------------------------------------------------
+def _training_state_tensors(mod):
+    """{key: array} for params + optimizer state + residuals, plus a
+    {key: numpy ground truth} snapshot, following the documented
+    ``param:`` / ``state:`` / ``residual:`` key convention."""
+    exe = mod._exec_group._exec
+    ff = mod._fused_fit
+    upd = mod._kvstore._updater if mod._update_on_kvstore else mod._updater
+    tensors, truth = {}, {}
+    for n in ff._order:
+        tensors["param:" + n] = exe.arg_dict[n]
+        truth["param:" + n] = exe.arg_dict[n].asnumpy()
+    for n, uk in zip(ff._order, ff._ukeys):
+        leaves, _ = fused_update.flatten_state(upd.states[uk])
+        for i, leaf in enumerate(leaves):
+            tensors["state:%s:%d" % (n, i)] = leaf
+            truth["state:%s:%d" % (n, i)] = leaf.asnumpy()
+    for n, r in (ff._residuals or {}).items():
+        tensors["residual:" + n] = r
+        truth["residual:" + n] = np.asarray(r)
+    return tensors, truth
+
+
+def test_sharded_checkpoint_restores_into_any_world(tmp_path):
+    """Save at dp4 x mp2; the absolute-bounds slices must reassemble
+    bit-for-bit and place into dp8 x mp1 and single-device modules —
+    optimizer state and f32 2-bit residuals included."""
+    prefix = str(tmp_path / "ckpt")
+    rng = np.random.RandomState(3)
+    batches = [_batch(rng) for _ in range(3)]
+
+    sharding.set_mesh({"dp": 4, "mp": 2})
+    mod = _tp_module(compress=0.005)             # 2-bit: residuals exist
+    m = metric_mod.create("ce")
+    for b in batches:
+        assert mod.fit_step(b, m)
+    mod._fit_sync()
+    tensors, truth = _training_state_tensors(mod)
+    assert any(k.startswith("state:") for k in truth)
+    assert any(k.startswith("residual:") for k in truth)
+    assert all(np.asarray(v).dtype == np.float32
+               for k, v in truth.items() if k.startswith("residual:"))
+    # the save sees GENUINELY sharded inputs (multi-shard param slices)
+    w = mod._exec_group._exec.arg_dict["layer0_ffn_up_weight"]._data
+    assert len({repr(s.index) for s in w.addressable_shards}) > 1
+    checkpoint.save_sharded(prefix, 3, tensors,
+                            meta={"mesh": "dp4xmp2"})
+
+    loaded = checkpoint.load_sharded(prefix, tag=3)
+    assert set(loaded) == set(truth)
+    for k in truth:
+        assert loaded[k].dtype == np.asarray(truth[k]).dtype
+        np.testing.assert_array_equal(loaded[k], truth[k],
+                                      err_msg="key %s" % k)
+
+    # restore the params into other worlds and train one step in each
+    arg_params = {k.split(":", 1)[1]: nd.array(v)
+                  for k, v in loaded.items() if k.startswith("param:")}
+    for axes, n_dev in (({"dp": 8, "mp": 1}, 8), (None, 1)):
+        sharding.set_mesh(axes)
+        mod2 = _tp_module(n_dev=n_dev, arg_params=arg_params)
+        arg2, _ = mod2.get_params()
+        for k, v in arg_params.items():
+            np.testing.assert_array_equal(arg2[k].asnumpy(), v.asnumpy())
+        assert mod2.fit_step(_batch(rng), metric_mod.create("ce"))
+        mod2._fit_sync()
+
+    assert checkpoint.latest_sharded(prefix) is not None
+
+
+def test_sharded_checkpoint_detects_corruption(tmp_path):
+    prefix = str(tmp_path / "ck")
+    sharding.set_mesh({"dp": 4, "mp": 2})
+    mesh = sharding.get_mesh()
+    a = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       jax.sharding.NamedSharding(
+                           mesh, jax.sharding.PartitionSpec("mp", None)))
+    checkpoint.save_sharded(prefix, 1, {"param:a": a})
+    back = checkpoint.load_sharded(prefix, tag=1)
+    np.testing.assert_array_equal(back["param:a"], np.asarray(a))
+    # flip bytes in the data file: the per-tensor CRC must catch it
+    data = [f for f in os.listdir(tmp_path) if f.endswith(".sharded.npz")]
+    assert data
+    path = str(tmp_path / data[0])
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(MXNetError):
+        checkpoint.load_sharded(prefix, tag=1)

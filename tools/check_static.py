@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """mx.analyze CLI — static hot-path hazard analysis (docs/ANALYZE.md).
 
-Runs the eight analysis passes over ``mxnet_tpu/`` and fails on:
+Runs the nine analysis passes over ``mxnet_tpu/`` and fails on:
 
 * any unwaived finding;
 * any mxnet_tpu/pallas/ kernel wrapper with no interpret-mode parity
