@@ -1618,7 +1618,22 @@ def bench_decode(args):
     the 1-core CPU container read the ratios, not wall times, per the
     CHANGES.md convention).  A reduced pallas-vs-xla A/B arm
     (MXNET_PAGED_ATTN_IMPL forced per run, docs/KERNELS.md) gates on
-    the kernel arm keeping the same dispatch contract."""
+    the kernel arm keeping the same dispatch contract.
+
+    A chunked-vs-unchunked A/B arm runs a long-prompt heavy-tailed
+    mix through the engine at ``--decode-chunk`` vs an unchunked
+    oracle compiled at ``--decode-seq`` (whole context in one chunk).
+    Every iteration runs the ONE mixed step compiled at the engine's
+    chunk width, so per-launch device work is ``capacity +
+    chunk_width`` token rows **whether or not a prompt is in
+    flight** — the unchunked oracle pays whole-context chunk compute
+    on every decode step forever.  TTFT is therefore compared in
+    launch-work units (``ttft_steps_p99 * (capacity + chunk_width)``)
+    — the dispatch-count-convention stand-in for wall-clock TTFT on
+    hardware, where raw iteration counts would reward fat launches
+    the container can't time honestly.  Both arms' raw step counts
+    are published next to the gate so nothing hides in the
+    normalization."""
     import os
 
     import jax
@@ -1648,15 +1663,20 @@ def bench_decode(args):
 
     step_hist = telemetry.REGISTRY.get("decode_step_ms")
 
-    def run(admission, impl=None, n=None, gen_cap=None):
+    def run(admission, impl=None, n=None, gen_cap=None, chunk=None,
+            workload=None):
         """One engine lifetime.  ``impl`` forces MXNET_PAGED_ATTN_IMPL
         for the whole run (the dispatch decision is baked in at trace
         time, so the env must cover engine construction + warmup);
         ``n``/``gen_cap`` shrink the workload for the interpret-mode
-        pallas A/B arm, which is orders of magnitude slower off-TPU."""
-        ps = prompts if n is None else prompts[:n]
-        nt = (new_tokens if n is None
-              else [min(m, gen_cap) for m in new_tokens[:n]])
+        pallas A/B arm, which is orders of magnitude slower off-TPU;
+        ``chunk`` overrides the prefill chunk budget (the
+        chunked-vs-unchunked arm) and ``workload`` swaps in a
+        different ``(prompts, new_tokens)`` mix."""
+        ps, nt = (prompts, new_tokens) if workload is None else workload
+        if n is not None:
+            ps = ps[:n]
+            nt = [min(m, gen_cap) for m in nt[:n]]
         prev = os.environ.get("MXNET_PAGED_ATTN_IMPL")
         if impl is not None:
             os.environ["MXNET_PAGED_ATTN_IMPL"] = impl
@@ -1666,6 +1686,8 @@ def bench_decode(args):
                                block_size=args.decode_block_size,
                                num_blocks=args.decode_blocks,
                                max_waiting=n_req + 1, admission=admission,
+                               chunk_tokens=(chunk if chunk is not None
+                                             else args.decode_chunk),
                                warmup=True)
             compile_ms = (time.perf_counter() - t_c) * 1e3
             try:
@@ -1717,15 +1739,67 @@ def bench_decode(args):
             "steady_state_retraces=%r (want 0)"
             % (ab_pallas["dispatches_per_step"],
                ab_pallas["steady_state_retraces"]))
-    # the decode-step compiled program (batch dim == capacity on the
-    # (C, 1) token input distinguishes it from the prefill ladder);
-    # bytes_accessed is the donation acceptance witness — the donated
-    # step no longer pays the whole-cache in+out copy
+    # chunked-vs-unchunked A/B arm (docstring): a long-prompt
+    # heavy-tailed mix — many short prompts, a heavy tail reaching
+    # most of the context window — served at the production chunk
+    # budget vs an unchunked oracle whose every launch carries a
+    # max_context-wide chunk stream
+    ab_rng = np.random.RandomState(7)
+    ck_prompts, ck_gens = [], []
+    long_lo = max(args.decode_seq // 2, 8)
+    long_hi = max(args.decode_seq - 12, long_lo + 1)
+    for _ in range(min(10, n_req)):
+        plen = (ab_rng.randint(long_lo, long_hi)
+                if ab_rng.uniform() < 0.4 else ab_rng.randint(4, 13))
+        ck_prompts.append(list(ab_rng.randint(0, args.decode_vocab,
+                                              plen)))
+        ck_gens.append(2 + int(ab_rng.randint(0, 5)))
+    ck_wl = (ck_prompts, ck_gens)
+    ab_chunked = run("continuous", workload=ck_wl)
+    ab_unchunked = run("continuous", chunk=args.decode_seq,
+                       workload=ck_wl)
+    if (ab_chunked["dispatches_per_step"] != 1.0
+            or ab_chunked["steady_state_retraces"] != 0):
+        raise SystemExit(
+            "decode chunked arm broke the dispatch contract: "
+            "dispatches_per_step=%r (want 1.0), "
+            "steady_state_retraces=%r (want 0)"
+            % (ab_chunked["dispatches_per_step"],
+               ab_chunked["steady_state_retraces"]))
+    if ab_chunked["_streams"] != ab_unchunked["_streams"]:
+        raise SystemExit("chunked arm diverged from the unchunked "
+                         "full-prefill oracle (greedy streams differ)")
+
+    def _ttft_work(st):
+        # per-launch token rows: C decode rows + the compiled chunk
+        # width every launch carries, prompt in flight or not
+        return st["ttft_steps_p99"] * (args.decode_capacity
+                                       + st["chunk_tokens"])
+
+    if not _ttft_work(ab_chunked) < _ttft_work(ab_unchunked):
+        raise SystemExit(
+            "chunked prefill did not improve launch-work TTFT p99 "
+            "under the long-prompt mix: chunked %r (steps %r x width "
+            "%r) vs unchunked %r (steps %r x width %r)"
+            % (_ttft_work(ab_chunked), ab_chunked["ttft_steps_p99"],
+               args.decode_capacity + ab_chunked["chunk_tokens"],
+               _ttft_work(ab_unchunked),
+               ab_unchunked["ttft_steps_p99"],
+               args.decode_capacity + ab_unchunked["chunk_tokens"]))
+    # the mixed-step compiled program, recognized by its block-table
+    # feed [capacity, table_width] (recorded arg_shapes truncate at 8
+    # entries and the donated order puts the cache arrays first, so
+    # the (C, 1) token input can fall outside the recorded prefix —
+    # the block table survives both argument orders); bytes_accessed
+    # is the donation acceptance witness — the donated step no longer
+    # pays the whole-cache in+out copy
     fn_want = ("_fwd_eval_donated" if cont.get("cache_donation")
                else "_fwd_eval")
+    table_w = -(-args.decode_seq // args.decode_block_size)
     step_rows = [p for p in telemetry.programs(site="executor")
                  if p["fn_name"] == fn_want
-                 and any(s.endswith("[%d, 1]" % args.decode_capacity)
+                 and any(s.endswith("[%d, %d]" % (args.decode_capacity,
+                                                  table_w))
                          for s in p["arg_shapes"])]
     decode_bytes = max((p["bytes_accessed"] for p in step_rows
                         if p["bytes_accessed"] is not None), default=None)
@@ -1754,6 +1828,15 @@ def bench_decode(args):
         "decode_retraces_steady_state": cont["steady_state_retraces"],
         "decode_preemptions": cont["preemptions"],
         "decode_steps": cont["steps"],
+        "decode_chunk_tokens": cont["chunk_tokens"],
+        "decode_prefill_chunks_per_iter": _round_opt(
+            cont["prefill_chunks_per_iter"]),
+        "decode_ttft_steps_p99": cont["ttft_steps_p99"],
+        "decode_chunked_ttft_steps_p99": ab_chunked["ttft_steps_p99"],
+        "decode_unchunked_ttft_steps_p99":
+            ab_unchunked["ttft_steps_p99"],
+        "decode_chunked_ttft_work_p99": _ttft_work(ab_chunked),
+        "decode_unchunked_ttft_work_p99": _ttft_work(ab_unchunked),
         "decode_attn_impl": cont.get("attn_impl"),
         "decode_cache_donation": cont.get("cache_donation"),
         "decode_bytes_accessed": decode_bytes,
@@ -1856,6 +1939,10 @@ def main():
                     help="max context (position-embedding range)")
     ap.add_argument("--decode-prompt-max", type=int, default=12)
     ap.add_argument("--decode-gen-max", type=int, default=40)
+    ap.add_argument("--decode-chunk", type=int, default=8,
+                    help="prefill chunk budget (tokens/iteration); the "
+                         "chunked-vs-unchunked A/B arm compares against "
+                         "an oracle compiled at --decode-seq")
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -1950,6 +2037,10 @@ def main():
     dc = bench_decode(args)
     out["decode_tokens_per_sec"] = dc["value"]
     out["decode_ttft_p99_ms"] = dc["decode_ttft_p99_ms"]
+    out["decode_chunk_tokens"] = dc["decode_chunk_tokens"]
+    out["decode_prefill_chunks_per_iter"] = \
+        dc["decode_prefill_chunks_per_iter"]
+    out["decode_ttft_steps_p99"] = dc["decode_ttft_steps_p99"]
     out["decode_cache_occupancy"] = dc["decode_cache_occupancy"]
     out["decode_dispatches_per_step"] = dc["decode_dispatches_per_step"]
     out["decode_speedup_vs_static"] = dc["decode_speedup_vs_static"]

@@ -95,6 +95,13 @@ class PagedKVCache:
         """Blocks needed to hold ``n_tokens`` cache rows."""
         return -(-max(int(n_tokens), 0) // self.block_size)
 
+    def blocks_missing(self, have, n_tokens):
+        """Blocks a sequence holding ``have`` blocks still needs to
+        reach ``n_tokens`` cache rows — the incremental allocation unit
+        of chunked prefill, where the table grows chunk by chunk
+        instead of whole-prompt at admission."""
+        return max(self.blocks_for(n_tokens) - int(have), 0)
+
     @property
     def free_count(self):
         return len(self._free)

@@ -1,29 +1,34 @@
-"""DecodeEngine: continuous-batching autoregressive generation.
+"""DecodeEngine: continuous-batching generation with chunked prefill.
 
 One engine owns (a) a paged KV cache (``cache.PagedKVCache`` + the
-per-layer device arrays), (b) ONE compiled decode step bound at a fixed
-slot capacity — ``models.transformer.get_decode_step_symbol`` — and
-(c) a power-of-two ladder of prefill executors
-(``get_prefill_symbol``), all sharing the training checkpoint's device
-parameters through ``simple_bind(shared_exec=...)`` (zero weight
-copies, zero conversions).
+per-layer device arrays) and (b) ONE compiled *mixed* step bound at a
+fixed slot capacity — ``models.transformer.get_mixed_step_symbol`` —
+that every iteration processes up to K prefill-chunk tokens of one
+admitted prompt AND one decode token for every active slot in the same
+donated launch (Sarathi-Serve-style stall-free scheduling: prompt
+processing piggybacks on the memory-bound decode iteration instead of
+monopolizing the device for a full-prompt prefill).  The pow2 prefill
+ladder this replaced cost one compiled program per bucket and stalled
+every in-flight stream for the length of the longest prompt.
 
 Execution discipline (the PR 2/3 invariant, extended to serving):
 
-* every decode iteration is exactly ONE device launch — the compiled
-  step runs all slots, padded slots ride along masked (position -1);
-* sequence raggedness (positions, lengths, block tables) enters as
-  runtime arrays, so steady state NEVER retraces — witnessed by
-  ``decode_retraces``, which counts only retraces after each program's
-  first (expected) compile;
+* every iteration is exactly ONE device launch — the compiled mixed
+  step runs all slots plus the current prompt chunk; padded slots ride
+  along masked (position -1), an empty chunk rides along with
+  ``chunk_len == 0``;
+* sequence raggedness (positions, chunk offsets/lengths, block tables)
+  enters as runtime arrays, so steady state NEVER retraces — witnessed
+  by ``decode_retraces``, which counts only retraces after each
+  program's first (expected) compile;
 * the only per-iteration host sync is reading the sampled token back
-  (that readback *is* the streamed response).
+  (that readback *is* the streamed response); a completed prefill adds
+  one first-token readback per ADMISSION, not per step.
 
 Scheduling policy lives in ``scheduler.py``; this module is the device
-half: prefill/step dispatch, cache threading (each step's new cache
-arrays replace the bound inputs via ``NDArray._set_data`` — shared by
-every executor, so prefill and decode always see one coherent cache),
-sampling, and telemetry.
+half: mixed-step dispatch, cache threading (each step's new cache
+arrays replace the bound inputs via ``NDArray._set_data``, so every
+iteration sees one coherent cache), sampling, and telemetry.
 """
 from __future__ import annotations
 
@@ -61,8 +66,15 @@ CANCELLED = REGISTRY.counter(
     "decode_cancelled", "sequences cancelled by the client "
     "(StreamHandle.cancel / dropped HTTP stream)")
 PREFILLS = REGISTRY.counter(
-    "decode_prefills", "prompt prefill dispatches (admissions + "
-    "preemption recomputes)")
+    "decode_prefills", "prompts admitted into chunked prefill "
+    "(admissions + preemption recomputes)")
+PREFILL_CHUNKS = REGISTRY.counter(
+    "decode_prefill_chunks", "prompt chunks processed by mixed decode "
+    "steps (chunked prefill — one per iteration with a prompt in "
+    "flight)")
+CHUNK_BUDGET = REGISTRY.gauge(
+    "decode_chunk_tokens", "per-iteration chunked-prefill token budget "
+    "(MXNET_DECODE_CHUNK, pow2-padded)", unit="tokens")
 PREEMPTIONS = REGISTRY.counter(
     "decode_preemptions", "sequences preempted-by-recompute on cache "
     "pressure")
@@ -84,18 +96,20 @@ RELOADS = REGISTRY.counter(
     "decode_reloads", "successful hot weight reloads into a live engine")
 
 
-def _prefill_ladder(buckets, max_len):
-    """Power-of-two padded-prompt ladder capped/completed at max_len."""
-    if buckets:
-        out = sorted({int(b) for b in buckets if 0 < int(b) <= max_len})
-    else:
-        out, b = [], 8
-        while b < max_len:
-            out.append(b)
-            b *= 2
-    if not out or out[-1] < max_len:
-        out.append(int(max_len))
-    return out
+def _chunk_budget(chunk_tokens, max_context):
+    """Resolve the per-iteration prefill-chunk token budget K:
+    explicit arg > ``MXNET_DECODE_CHUNK`` > 64, capped at the context
+    length and padded up to a power of two (one bind-time geometry —
+    every chunk rides the same compiled mixed step)."""
+    import os
+    ck = chunk_tokens
+    if ck is None:
+        ck = int(os.environ.get("MXNET_DECODE_CHUNK", "0") or 0)
+    ck = int(ck) if int(ck or 0) > 0 else 64
+    p = 1
+    while p < ck:
+        p *= 2
+    return min(p, int(max_context))
 
 
 class DecodeEngine:
@@ -112,18 +126,23 @@ class DecodeEngine:
     capacity : fixed decode batch slots (the compiled step's batch dim)
     block_size, num_blocks : KV-cache geometry (per layer, K and V each
         are ``(num_blocks, block_size, H, D)``)
-    max_prefill_len : longest admissible prompt (default: seq_len - 1)
-    prefill_buckets : padded-prompt ladder (default: 8, 16, ... pow2)
+    chunk_tokens : per-iteration prefill-chunk token budget K (default:
+        ``MXNET_DECODE_CHUNK`` or 64; pow2-padded, capped at seq_len).
+        Any prompt under ``seq_len`` is admissible — it prefills over
+        ``ceil(len/K)`` mixed iterations without stalling decode.
+    max_prefill_len, prefill_buckets : accepted-but-ignored (the pow2
+        prefill ladder these configured is retired; chunked prefill
+        serves every prompt length through the one mixed step)
     admission : 'continuous' (default) or 'static' (run-to-completion —
         the A/B baseline for bench --mode decode)
     eos_id : default end-of-sequence token id (None = length-stop only)
     """
 
     def __init__(self, arg_params, model_config, capacity=8, block_size=16,
-                 num_blocks=64, max_prefill_len=None, prefill_buckets=None,
-                 ctx=None, eos_id=None, max_waiting=256,
-                 admission="continuous", default_max_new_tokens=64,
-                 warmup=False, start=True):
+                 num_blocks=64, chunk_tokens=None, max_prefill_len=None,
+                 prefill_buckets=None, ctx=None, eos_id=None,
+                 max_waiting=256, admission="continuous",
+                 default_max_new_tokens=64, warmup=False, start=True):
         from ..context import current_context
         from ..models import transformer
         from ..ndarray.ndarray import NDArray
@@ -138,30 +157,26 @@ class DecodeEngine:
         self._num_layers = int(self._cfg.get("num_layers", 12))
         bs = int(block_size)
         self._table_width = -(-self._max_context // bs)
-        self._max_prefill = int(max_prefill_len or self._max_context - 1)
-        if self._max_prefill >= self._max_context:
-            raise MXNetError("max_prefill_len %d leaves no room to "
-                             "generate within seq_len=%d"
-                             % (self._max_prefill, self._max_context))
-        # max_prefill_len bounds USER prompts; the ladder itself runs to
-        # the FULL context limit: a live sequence holds pos+1 tokens, so
-        # one preempted at pos == seq_len-1 recomputes from a seq_len-
-        # token prompt (the top bucket compiles lazily, only if a
-        # preemption actually reaches it)
-        self._buckets = _prefill_ladder(prefill_buckets, self._max_context)
+        self._chunk_tokens = _chunk_budget(chunk_tokens,
+                                           self._max_context)
+        CHUNK_BUDGET.set(self._chunk_tokens)
 
         self.cache = PagedKVCache(num_blocks, bs)
         self._sched = Scheduler(self.capacity, self.cache,
                                 max_waiting=max_waiting,
                                 admission=admission)
 
-        # --- bind the decode step at fixed capacity ------------------
-        dsym = transformer.get_decode_step_symbol(
+        # --- bind the ONE mixed step at fixed capacity + chunk budget
+        msym = transformer.get_mixed_step_symbol(
             block_size=bs, num_blocks=int(num_blocks), **self._cfg)
-        self._exe = dsym.simple_bind(
+        self._exe = msym.simple_bind(
             ctx=self._ctx, grad_req="null", data=(self.capacity, 1),
             positions=(self.capacity, 1),
-            block_table=(self.capacity, self._table_width))
+            block_table=(self.capacity, self._table_width),
+            chunk_data=(1, self._chunk_tokens),
+            chunk_positions=(1, self._chunk_tokens),
+            chunk_start=(1,), chunk_len=(1,),
+            chunk_table=(1, self._table_width))
         self._cache_names = []
         for i in range(self._num_layers):
             self._cache_names += ["layer%d_k_cache" % i,
@@ -180,7 +195,9 @@ class DecodeEngine:
                                         default=True)
         if self._donate:
             self._exe.donate_args(self._cache_names)
-        self._inputs = ("data", "positions", "block_table", "prompt_len")
+        self._inputs = ("data", "positions", "block_table", "chunk_data",
+                        "chunk_positions", "chunk_start", "chunk_len",
+                        "chunk_table")
         self._weight_names = [n for n in self._exe.arg_dict
                               if n not in self._inputs
                               and n not in self._cache_names]
@@ -191,18 +208,12 @@ class DecodeEngine:
              for k, v in arg_params.items() if k in self._weight_names}, {},
             allow_extra_params=True)
 
-        # --- prefill ladder, params + caches shared ------------------
-        self._prefill_exes = {}
-        self._prefill_sym = lambda S: transformer.get_prefill_symbol(
-            prefill_len=S, block_size=bs, num_blocks=int(num_blocks),
-            **self._cfg)
-
         # accounting (instance state; registry series are process-wide)
         self._warm = set()
         self._n_steps = 0
         self._n_prefills = 0
+        self._n_prefill_chunks = 0
         self._n_step_dispatches = 0
-        self._n_prefill_dispatches = 0
         self._occ_sum = 0
         self._cache_occ_sum = 0.0
         self._steady_retraces = 0
@@ -216,6 +227,10 @@ class DecodeEngine:
         # last-4096 window only: stats() p99 never reads further back,
         # and a long-lived server must not accumulate one float/request
         self._ttfts = _collections.deque(maxlen=4096)
+        # steps-to-first-token (submit -> first emit, in mixed-step
+        # iterations): the CPU-container TTFT witness — wall-clock there
+        # is bandwidth noise, dispatch counts are exact
+        self._ttft_steps = _collections.deque(maxlen=4096)
         self._rid = 0
         self._model_version = None
 
@@ -256,34 +271,23 @@ class DecodeEngine:
                              "across same-architecture reloads)"
                              % sorted(bad))
 
-    def _prefill_exe(self, bucket):
-        # under _step_lock: warmup() (any thread) and the engine loop
-        # both bind lazily; an unguarded double-bind would waste a
-        # compile and tear the bucket->executor map (mx.analyze threads
-        # pass pins this)
-        with self._step_lock:
-            exe = self._prefill_exes.get(bucket)
-            if exe is None:
-                psym = self._prefill_sym(bucket)
-                exe = psym.simple_bind(
-                    ctx=self._ctx, grad_req="null",
-                    shared_exec=self._exe,
-                    data=(1, bucket), prompt_len=(1,),
-                    block_table=(1, self._table_width))
-                if self._donate:
-                    # shares the decode step's cache NDArrays
-                    # (shared_exec), so prefill dispatches donate the
-                    # same buffers and _commit_caches re-points them
-                    exe.donate_args(self._cache_names)
-                self._prefill_exes[bucket] = exe
-        return exe
-
-    def _bucket_for(self, n):
-        for b in self._buckets:
-            if b >= n:
-                return b
-        raise MXNetError("prompt of %d tokens exceeds max_prefill_len=%d"
-                         % (n, self._max_prefill))
+    def _idle_feeds(self):
+        """All-slots-inactive, empty-chunk input set for the mixed step
+        (warmup and tests): positions -1 mask every decode row, and
+        ``chunk_len == 0`` makes the chunk stream a no-op (its zero-row
+        writes re-emit existing cache bytes, so no allocator state is
+        touched)."""
+        K = self._chunk_tokens
+        M = self._table_width
+        return dict(
+            data=_np.zeros((self.capacity, 1), _np.float32),
+            positions=_np.full((self.capacity, 1), -1.0, _np.float32),
+            block_table=_np.zeros((self.capacity, M), _np.float32),
+            chunk_data=_np.zeros((1, K), _np.float32),
+            chunk_positions=_np.zeros((1, K), _np.float32),
+            chunk_start=_np.zeros((1,), _np.float32),
+            chunk_len=_np.zeros((1,), _np.float32),
+            chunk_table=_np.zeros((1, M), _np.float32))
 
     # ------------------------------------------------------------------
     def start(self):
@@ -293,36 +297,20 @@ class DecodeEngine:
             self._thread.start()
 
     def warmup(self):
-        """Compile the decode step and every prefill bucket up front
-        (no allocator state is touched: the dummy prefill writes zero
-        rows and the dummy step runs all-slots-inactive)."""
-        zeros_tbl = _np.zeros((1, self._table_width), _np.float32)
-        for b in self._buckets:
-            exe = self._prefill_exe(b)
-            with self._step_lock:
-                outs = exe.forward(
-                    is_train=False, data=_np.zeros((1, b), _np.float32),
-                    prompt_len=_np.zeros((1,), _np.float32),
-                    block_table=zeros_tbl)
-                # block until compiled+run; warmup exists to absorb
-                # this cost before serving
-                outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
-                # donated caches: the dummy dispatch consumed the cache
-                # buffers — re-point them at the outputs like any step
-                self._commit_caches(outs, base=2)
-                # _warm is shared with the engine thread's _dispatch
-                # bookkeeping — every write holds _step_lock
-                self._warm.add(("prefill", b))
+        """Compile the ONE mixed step up front (vs the retired pow2
+        ladder's one compile per bucket): a single all-slots-inactive,
+        empty-chunk dispatch."""
         with self._step_lock:
-            outs = self._exe.forward(
-                is_train=False,
-                data=_np.zeros((self.capacity, 1), _np.float32),
-                positions=_np.full((self.capacity, 1), -1.0, _np.float32),
-                block_table=_np.zeros((self.capacity, self._table_width),
-                                      _np.float32))
+            outs = self._exe.forward(is_train=False, **self._idle_feeds())
+            # block until compiled+run; warmup exists to absorb this
+            # cost before serving
             outs[1].asnumpy()  # analyze: ok(hostsync) warmup deliberately blocks until the compile+first run completes
-            self._commit_caches(outs, base=2)
-            self._warm.add("decode")
+            # donated caches: the dummy dispatch consumed the cache
+            # buffers — re-point them at the outputs like any step.
+            # _warm is shared with the engine thread's _dispatch
+            # bookkeeping — every write holds _step_lock
+            self._commit_caches(outs, base=4)
+            self._warm.add("mixed")
 
     # ------------------------------------------------------------------
     # client API
@@ -340,10 +328,13 @@ class DecodeEngine:
         if max_new_tokens is not None and int(max_new_tokens) < 1:
             raise MXNetError("decode: max_new_tokens must be >= 1 "
                              "(got %s)" % (max_new_tokens,))
-        if len(tokens) > self._max_prefill:
-            raise MXNetError("decode: prompt of %d tokens exceeds "
-                             "max_prefill_len=%d"
-                             % (len(tokens), self._max_prefill))
+        # chunked prefill retired the max_prefill_len submit rejection:
+        # ANY prompt that fits the context (with one slot to generate)
+        # and whose full footprint fits the cache is admissible
+        if len(tokens) >= self._max_context:
+            raise MXNetError("decode: prompt of %d tokens leaves no "
+                             "room to generate within seq_len=%d"
+                             % (len(tokens), self._max_context))
         if self.cache.blocks_for(len(tokens)) > self.cache.num_blocks:
             raise MXNetError("decode: prompt needs %d cache blocks, the "
                              "cache only has %d"
@@ -362,6 +353,7 @@ class DecodeEngine:
                 eos_id=self._eos if eos_id == "default" else eos_id,
                 deadline=deadline, temperature=temperature, seed=seed,
                 sampler=sampler, collect_logits=collect_logits)
+            seq.submit_step = self._n_steps   # steps-to-first-token base
             self._sched.enqueue(seq)          # may raise QueueFullError
             if _tracing.enabled():
                 # submit -> finish span, parented under the submitting
@@ -449,43 +441,62 @@ class DecodeEngine:
                 if not self._sched.may_admit(batch_open):
                     break
                 seq = self._sched.waiting[0]
-                need = self.cache.blocks_for(len(seq.tokens))
+                # admission gates on the FIRST chunk's footprint only —
+                # chunked prefill grows the table incrementally, and
+                # later chunks may preempt (youngest first) for blocks
+                need = self.cache.blocks_for(
+                    min(len(seq.tokens), self._chunk_tokens))
                 if need > self.cache.free_count:
                     break             # FIFO: wait for blocks, no bypass
                 self._sched.waiting.popleft()
                 # visible to drain(): the sequence is in neither waiting
-                # nor slots until place(), and a cold prefill bucket can
-                # compile for seconds in that window
+                # nor slots until place()
                 self._mid_admission += 1
                 QUEUE_DEPTH.set(len(self._sched.waiting))
             slot = self._sched.free_slot()
             try:
-                self._prefill(seq, slot)
+                self._admit(seq, slot)
                 progressed = True
             except Exception as exc:   # noqa: BLE001 — the sequence is
                 # already off the wait queue and may not be placed yet,
                 # so _fail_everything would never see it: ANY failure
-                # here (device/jax errors included) must settle its
-                # handle and return its blocks, not just MXNetError
+                # here must settle its handle, not just MXNetError
                 self._finish(seq, error=exc)
             finally:
                 with self._cv:
                     self._mid_admission -= 1
-        # grow every running sequence's block table BEFORE the step —
+        # grow every DECODING sequence's block table BEFORE the step —
         # the step writes cache position seq.pos, and a missing table
         # entry would default to block 0 and corrupt whoever owns it.
         # Growth may preempt (youngest first), so re-snapshot after.
         for _, seq in self._sched.active():
             if seq.slot is None:      # preempted by an earlier growth
                 continue
+            if seq.n_prefilled < seq.prefill_target:
+                continue              # prefilling: grown with its chunk
             try:
                 self._ensure_blocks(seq, seq.pos // self.cache.block_size)
             except CacheOOMError as exc:
                 self._finish(seq, error=exc)
+        # pick THIS iteration's prefill chunk (oldest prefilling
+        # sequence) and make sure the chunk's cache blocks exist
+        chunk_seq = self._sched.pick_prefilling()
+        chunk_len = 0
+        if chunk_seq is not None:
+            chunk_len = min(self._chunk_tokens,
+                            chunk_seq.prefill_target
+                            - chunk_seq.n_prefilled)
+            last_row = chunk_seq.n_prefilled + chunk_len - 1
+            try:
+                self._ensure_blocks(chunk_seq,
+                                    last_row // self.cache.block_size)
+            except CacheOOMError as exc:
+                self._finish(chunk_seq, error=exc)
+                chunk_seq, chunk_len = None, 0
         active = self._sched.active()
         ACTIVE_SEQS.set(len(active))
         if active:
-            self._step(active)
+            self._step(active, chunk_seq, chunk_len)
             progressed = True
         return progressed
 
@@ -506,6 +517,9 @@ class DecodeEngine:
         with self._cv:
             self._sched.preempt(victim)
             QUEUE_DEPTH.set(len(self._sched.waiting))
+        if victim.prefill_span is not None:   # preempted mid-prefill
+            victim.prefill_span.end(preempted=True)
+            victim.prefill_span = None
         self._n_preemptions += 1
         PREEMPTIONS.inc()
 
@@ -536,52 +550,32 @@ class DecodeEngine:
             self._warm.add(warm_key)
         return outs, dd
 
-    def _prefill(self, seq, slot):
+    def _admit(self, seq, slot):
+        """Place a waiting sequence into a slot for chunked prefill.
+
+        No dispatch happens here — the mixed step carries the prompt
+        into the cache one chunk per iteration, so admission is just
+        bookkeeping: open the prefill span, arm the chunk cursor, and
+        hand the sequence to the scheduler."""
         P = len(seq.tokens)
-        bucket = self._bucket_for(P)
         if seq.queue_span is not None:
             seq.queue_span.end()
             seq.queue_span = None
-        pf_span = _tracing.start_span(
-            "decode.prefill",
-            parent=getattr(seq.trace_span, "context", None),
-            bucket=bucket, prompt_len=P,
-            preemptions=seq.preemptions) if seq.trace_span is not None \
-            else None
-        if not seq.blocks:
-            seq.blocks = self.cache.alloc(self.cache.blocks_for(P))
-        data = _np.zeros((1, bucket), _np.float32)
-        data[0, :P] = seq.tokens
-        table = _np.zeros((1, self._table_width), _np.float32)
-        table[0, :len(seq.blocks)] = seq.blocks
-        exe = self._prefill_exe(bucket)
-        try:
-            with self._step_lock:
-                outs, dd = self._dispatch(
-                    exe, ("prefill", bucket), data=data,
-                    prompt_len=_np.asarray([float(P)], _np.float32),
-                    block_table=table)
-                self._commit_caches(outs, base=2)
-        finally:
-            if pf_span is not None:
-                pf_span.end()
-        self._n_prefill_dispatches += dd
+        if seq.trace_span is not None:
+            seq.prefill_span = _tracing.start_span(
+                "decode.prefill",
+                parent=getattr(seq.trace_span, "context", None),
+                chunk_tokens=self._chunk_tokens, prompt_len=P,
+                preemptions=seq.preemptions)
+        seq.prefill_target = P
+        seq.n_prefilled = 0
+        seq.pos = 0
         self._n_prefills += 1
         PREFILLS.inc()
-        seq.pos = P
         with self._cv:
             self._sched.place(seq, slot)
-        # per-sequence containment: a bad user sampler must fail ONLY
-        # its own stream, never the engine or its neighbors
-        try:
-            tok = self._pick_token(seq, outs, 0)
-        except Exception as exc:   # noqa: BLE001
-            self._finish(seq, error=exc)
-            return
-        self._emit(seq, tok)
-        self._maybe_finish(seq, tok)
 
-    def _step(self, active):
+    def _step(self, active, chunk_seq=None, chunk_len=0):
         t0 = time.perf_counter()
         if self._watchdog is not None:
             self._watchdog.begin()
@@ -597,35 +591,80 @@ class DecodeEngine:
                     parent=getattr(s.trace_span, "context", None),
                     step=self._n_steps, slot=slot, pos=s.pos)
                 for slot, s in active if s.trace_span is not None]
+        if chunk_seq is not None and chunk_seq.slot is None:
+            chunk_seq, chunk_len = None, 0   # preempted after selection
+        # decode rows feed only FULLY-prefilled sequences; a sequence
+        # mid-prefill rides the step at pos=-1 (inactive row) until its
+        # last chunk lands, when the chunk head emits its first token
+        decoding = [(slot, seq) for slot, seq in active
+                    if seq.n_prefilled >= seq.prefill_target]
         data = _np.zeros((self.capacity, 1), _np.float32)
         pos = _np.full((self.capacity, 1), -1.0, _np.float32)
         table = _np.zeros((self.capacity, self._table_width), _np.float32)
-        for slot, seq in active:
+        for slot, seq in decoding:
             data[slot, 0] = seq.last_token
             pos[slot, 0] = seq.pos
             table[slot, :len(seq.blocks)] = seq.blocks
+        K = self._chunk_tokens
+        cdata = _np.zeros((1, K), _np.float32)
+        cpos = _np.zeros((1, K), _np.float32)
+        cstart = _np.zeros((1,), _np.float32)
+        clen = _np.zeros((1,), _np.float32)
+        ctable = _np.zeros((1, self._table_width), _np.float32)
+        if chunk_seq is not None:
+            s0 = chunk_seq.n_prefilled
+            cdata[0, :chunk_len] = chunk_seq.tokens[s0:s0 + chunk_len]
+            cpos[0, :chunk_len] = _np.arange(s0, s0 + chunk_len)
+            cstart[0] = s0
+            clen[0] = chunk_len
+            ctable[0, :len(chunk_seq.blocks)] = chunk_seq.blocks
         with self._step_lock:
-            outs, dd = self._dispatch(self._exe, "decode", data=data,
-                                      positions=pos, block_table=table)
-            self._commit_caches(outs, base=2)
+            outs, dd = self._dispatch(
+                self._exe, "mixed", data=data, positions=pos,
+                block_table=table, chunk_data=cdata,
+                chunk_positions=cpos, chunk_start=cstart,
+                chunk_len=clen, chunk_table=ctable)
+            self._commit_caches(outs, base=4)
         self._n_steps += 1
         self._n_step_dispatches += dd
         self._occ_sum += len(active)
         self._cache_occ_sum += self.cache.occupancy
         STEPS.inc()
+        if chunk_seq is not None:
+            chunk_seq.n_prefilled += chunk_len
+            self._n_prefill_chunks += 1
+            PREFILL_CHUNKS.inc()
+            if chunk_seq.n_prefilled >= chunk_seq.prefill_target:
+                # last chunk landed: the chunk head's greedy token (or
+                # logits row) is this sequence's FIRST token
+                chunk_seq.pos = chunk_seq.prefill_target
+                if chunk_seq.prefill_span is not None:
+                    chunk_seq.prefill_span.end()
+                    chunk_seq.prefill_span = None
+                # per-sequence containment: a bad user sampler must
+                # fail ONLY its own stream, never the engine
+                try:
+                    tok = self._pick_token(chunk_seq, outs, 0, base=2)
+                except Exception as exc:   # noqa: BLE001
+                    self._finish(chunk_seq, error=exc)
+                else:
+                    self._emit(chunk_seq, tok)
+                    self._maybe_finish(chunk_seq, tok)
         # ONE host copy of the (capacity, vocab) logits per step, shared
         # by every sampling/temperature/collect_logits sequence (rows
         # are per-slot, so a misbehaving user sampler can only touch its
         # own row)
         logits_host = None
-        if any(self._needs_logits(s) for _, s in active):
+        if any(self._needs_logits(s) for _, s in decoding):
             # analyze: ok(hostsync) the step's ONE logits readback, shared by every sampling/temperature slot (documented in the module doc)
             logits_host = outs[0].asnumpy()
         # likewise ONE readback of the greedy-token output for the
         # whole step, not one per active slot
-        # analyze: ok(hostsync) the greedy-token readback IS the streamed response — the documented one sync per decode iteration
-        next_host = outs[1].asnumpy()
-        for slot, seq in active:
+        next_host = None
+        if decoding:
+            # analyze: ok(hostsync) the greedy-token readback IS the streamed response — the documented one sync per decode iteration
+            next_host = outs[1].asnumpy()
+        for slot, seq in decoding:
             seq.pos += 1
             try:
                 tok = self._pick_token(seq, outs, slot, logits_host,
@@ -648,15 +687,18 @@ class DecodeEngine:
         return (seq.sampler is not None or seq.temperature > 0
                 or seq.handle.logits is not None)
 
-    def _pick_token(self, seq, outs, row, logits_host=None, next_host=None):
+    def _pick_token(self, seq, outs, row, logits_host=None, next_host=None,
+                    base=0):
         """Greedy reads the on-device argmax output; samplers and
         temperature read the logits row.  Host-side on purpose: the
         readback is the stream, and numpy sampling keeps the device
-        program fixed-shape."""
+        program fixed-shape.  ``base`` selects the output pair — 0 for
+        the shared decode head, 2 for the chunk head that yields a
+        prompt's first token on its final prefill chunk."""
         if self._needs_logits(seq):
             if logits_host is None:
-                # analyze: ok(hostsync) prefill-path fallback readback of the first token's logits (once per admission, not per step)
-                logits_host = outs[0].asnumpy()
+                # analyze: ok(hostsync) chunk-completion readback of the first token's logits (once per admission, not per step)
+                logits_host = outs[base].asnumpy()
             logits = logits_host[row]
             if seq.handle.logits is not None:
                 # analyze: ok(hostsync) copies an already-host logits row into the user-visible handle
@@ -671,8 +713,8 @@ class DecodeEngine:
                 return int(seq.rng().choice(len(p), p=p))
             return int(logits.argmax())
         if next_host is None:
-            # analyze: ok(hostsync) prefill-path first-token readback; that token is the stream's first byte
-            next_host = outs[1].asnumpy()
+            # analyze: ok(hostsync) chunk-completion first-token readback; that token is the stream's first byte
+            next_host = outs[base + 1].asnumpy()
         return int(next_host[row])
 
     def _emit(self, seq, tok):
@@ -684,9 +726,12 @@ class DecodeEngine:
             ttft = (now - seq.t_submit) * 1e3
             seq.handle.ttft_ms = ttft
             TTFT_MS.observe(ttft)
-            # under _cv: stats() iterates this deque from other threads
+            # under _cv: stats() iterates these deques from other threads
             with self._cv:
                 self._ttfts.append(ttft)
+                if seq.submit_step is not None:
+                    self._ttft_steps.append(self._n_steps
+                                            - seq.submit_step)
         seq.handle._emit(tok)
         self._n_tokens += 1
         TOKENS.inc()
@@ -705,6 +750,9 @@ class DecodeEngine:
         if seq.queue_span is not None:       # finished while waiting
             seq.queue_span.end()
             seq.queue_span = None
+        if seq.prefill_span is not None:     # finished mid-prefill
+            seq.prefill_span.end()
+            seq.prefill_span = None
         if seq.trace_span is not None:
             seq.trace_span.end(
                 finish_reason=(reason if error is None else "error"),
@@ -816,7 +864,9 @@ class DecodeEngine:
             depth = len(self._sched.waiting)
             active = sum(1 for s in self._sched.slots if s is not None)
             ttfts = sorted(self._ttfts)
+            ttft_steps = sorted(self._ttft_steps)
         p99 = _percentile(ttfts, 0.99)
+        steps_p99 = _percentile(ttft_steps, 0.99)
         return {
             "capacity": self.capacity,
             "queue_depth": depth,
@@ -838,8 +888,13 @@ class DecodeEngine:
             "decode_step_dispatches": self._n_step_dispatches,
             "dispatches_per_step": (self._n_step_dispatches / self._n_steps
                                     if self._n_steps else None),
-            "prefill_dispatches": self._n_prefill_dispatches,
+            "prefill_chunks": self._n_prefill_chunks,
+            "prefill_chunks_per_iter": (self._n_prefill_chunks
+                                        / self._n_steps
+                                        if self._n_steps else None),
+            "chunk_tokens": self._chunk_tokens,
             "ttft_p99_ms": p99,
+            "ttft_steps_p99": steps_p99,
             "model_version": self._model_version,
             "attn_impl": _paged_attn_impl(),
             "cache_donation": self._donate,
@@ -850,5 +905,4 @@ class DecodeEngine:
                 "blocks_free": self.cache.free_count,
                 "occupancy": round(self.cache.occupancy, 4),
             },
-            "prefill_buckets": list(self._buckets),
         }

@@ -7,10 +7,12 @@ the batch to drain, a finished request never pads it.  The policies are
 deliberately simple and documented (docs/DECODE.md):
 
 * **Admission** — FIFO, no head-of-line bypass: the oldest waiting
-  sequence is admitted as soon as a slot AND its prompt's cache blocks
-  are free.  ``admission='static'`` degrades to run-to-completion
-  batching (admit only into an idle engine) — kept as the measured A/B
-  baseline for ``bench.py --mode decode``.
+  sequence is admitted as soon as a slot AND its FIRST prefill chunk's
+  cache blocks are free (chunked prefill grows the rest incrementally,
+  one chunk per decode iteration — Sarathi-style stall-free prefill).
+  ``admission='static'`` degrades to run-to-completion batching (admit
+  only into an idle engine) — kept as the measured A/B baseline for
+  ``bench.py --mode decode``.
 * **Preemption** — on cache pressure the YOUNGEST running sequence is
   preempted *by recompute*: its blocks are freed, its tokens so far
   fold into a new prompt, and it rejoins the FRONT of the wait queue,
@@ -131,13 +133,21 @@ class Sequence:
         self.blocks = []
         self.pos = 0              # next cache position to be written
         self.last_token = None    # token the next decode step consumes
+        # chunked-prefill cursor: prompt rows [0, n_prefilled) are in
+        # the KV cache; the sequence decodes once n_prefilled reaches
+        # prefill_target (set at admission to the full prompt length)
+        self.prefill_target = 0
+        self.n_prefilled = 0
         self.t_submit = time.monotonic()
         self.t_first = None
+        self.submit_step = None   # engine step count at submit (TTFT-steps)
         self.preemptions = 0
         # mx.trace spans (None when tracing is off): trace_span covers
-        # submit -> finish, queue_span covers submit -> first prefill
+        # submit -> finish, queue_span covers submit -> admission,
+        # prefill_span covers admission -> last chunk landed
         self.trace_span = None
         self.queue_span = None
+        self.prefill_span = None
 
     @property
     def n_generated(self):
@@ -231,6 +241,14 @@ class Scheduler:
             self.cache.free(seq.blocks)
             seq.blocks = []
 
+    def pick_prefilling(self):
+        """Chunk policy: the OLDEST placed sequence still mid-prefill
+        (smallest rid) feeds this iteration's chunk rows — FIFO TTFT
+        order, one chunk per iteration."""
+        cands = [s for _, s in self.active()
+                 if s.n_prefilled < s.prefill_target]
+        return min(cands, key=lambda s: s.rid) if cands else None
+
     def pick_victim(self, exclude=()):
         """Preemption policy: youngest running sequence (largest rid)
         not in ``exclude`` — it has the least recompute to lose and the
@@ -246,6 +264,10 @@ class Scheduler:
         self.release(seq)
         seq.pos = 0
         seq.last_token = None
+        # a partially-prefilled prompt folds whole: the next admission
+        # re-targets the full (prompt + generated) token list
+        seq.prefill_target = 0
+        seq.n_prefilled = 0
         seq.preemptions += 1
         seq.handle.preemptions = seq.preemptions
         self.waiting.appendleft(seq)

@@ -165,25 +165,80 @@ def _decode_trunk_vars(pre):
             sym.Variable(pre + "proj_bias", init=_init.Zero()))
 
 
-def _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, layer_idx):
+def _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, layer_idx):
+    """Explicit post-attention sublayer weight Variables (training-graph
+    names) so the mixed-step symbol's two streams — decode slots and the
+    prefill chunk — bind ONE copy of every parameter."""
+    use_moe = moe_experts and (layer_idx + 1) % max(int(moe_every), 1) == 0
+    shared = {
+        "ln2_gamma": sym.Variable(pre + "ln2_gamma"),
+        "ln2_beta": sym.Variable(pre + "ln2_beta", init=_init.Zero()),
+    }
+    if use_moe:
+        shared.update({
+            "router_weight": sym.Variable(pre + "moe_router_weight"),
+            "expert_up_weight": sym.Variable(
+                pre + "moe_expert_up_weight", init=_init.Normal(d ** -0.5)),
+            "expert_up_bias": sym.Variable(pre + "moe_expert_up_bias",
+                                           init=_init.Zero()),
+            "expert_down_weight": sym.Variable(
+                pre + "moe_expert_down_weight",
+                init=_init.Normal(ffn ** -0.5)),
+            "expert_down_bias": sym.Variable(pre + "moe_expert_down_bias",
+                                             init=_init.Zero()),
+        })
+    else:
+        shared.update({
+            "up_weight": sym.Variable(pre + "ffn_up_weight"),
+            "up_bias": sym.Variable(pre + "ffn_up_bias",
+                                    init=_init.Zero()),
+            "down_weight": sym.Variable(pre + "ffn_down_weight"),
+            "down_bias": sym.Variable(pre + "ffn_down_bias",
+                                      init=_init.Zero()),
+        })
+    return shared
+
+
+def _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, layer_idx,
+                shared=None, tag=""):
     """Post-attention FFN sublayer shared by the decode/prefill graphs
-    (inference form: MoE aux losses are dropped, dropout is off)."""
-    ln2 = sym.LayerNorm(data=x, name=pre + "ln2")
-    if moe_experts and (layer_idx + 1) % max(int(moe_every), 1) == 0:
-        w_up = sym.Variable(pre + "moe_expert_up_weight",
-                            init=_init.Normal(d ** -0.5))
-        w_down = sym.Variable(pre + "moe_expert_down_weight",
-                              init=_init.Normal(ffn ** -0.5))
+    (inference form: MoE aux losses are dropped, dropout is off).
+
+    ``shared`` (a `_ffn_shared_vars` dict) passes every weight as an
+    explicit Variable — the mixed-step symbol instantiates this sublayer
+    twice against ONE parameter set; ``tag`` keeps the second instance's
+    op names distinct (variable names are unchanged either way)."""
+    use_moe = moe_experts and (layer_idx + 1) % max(int(moe_every), 1) == 0
+    ln_kw = ({"gamma": shared["ln2_gamma"], "beta": shared["ln2_beta"]}
+             if shared else {})
+    ln2 = sym.LayerNorm(data=x, name=pre + tag + "ln2", **ln_kw)
+    if use_moe:
+        if shared:
+            w_up, w_down = (shared["expert_up_weight"],
+                            shared["expert_down_weight"])
+            moe_kw = {"router_weight": shared["router_weight"],
+                      "expert_up_bias": shared["expert_up_bias"],
+                      "expert_down_bias": shared["expert_down_bias"]}
+        else:
+            w_up = sym.Variable(pre + "moe_expert_up_weight",
+                                init=_init.Normal(d ** -0.5))
+            w_down = sym.Variable(pre + "moe_expert_down_weight",
+                                  init=_init.Normal(ffn ** -0.5))
+            moe_kw = {}
         moe = sym.contrib.SwitchMoE(
             ln2, expert_up_weight=w_up, expert_down_weight=w_down,
             num_experts=int(moe_experts), num_hidden=ffn,
-            k=1, name=pre + "moe")
+            k=1, name=pre + tag + "moe", **moe_kw)
         return moe[0]
-    h = sym.FullyConnected(data=ln2, num_hidden=ffn,
-                           flatten=False, name=pre + "ffn_up")
-    h = sym.LeakyReLU(data=h, act_type="gelu_tanh", name=pre + "gelu")
+    up_kw = ({"weight": shared["up_weight"], "bias": shared["up_bias"]}
+             if shared else {})
+    down_kw = ({"weight": shared["down_weight"],
+                "bias": shared["down_bias"]} if shared else {})
+    h = sym.FullyConnected(data=ln2, num_hidden=ffn, flatten=False,
+                           name=pre + tag + "ffn_up", **up_kw)
+    h = sym.LeakyReLU(data=h, act_type="gelu_tanh", name=pre + tag + "gelu")
     return sym.FullyConnected(data=h, num_hidden=d, flatten=False,
-                              name=pre + "ffn_down")
+                              name=pre + tag + "ffn_down", **down_kw)
 
 
 def get_decode_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
@@ -305,3 +360,127 @@ def get_prefill_symbol(num_classes=16384, num_layers=12, d_model=2048,
         logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
     nxt = sym.argmax(logits, axis=1, name="greedy_token")
     return sym.Group([logits, nxt] + new_kv)
+
+
+def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
+                          num_heads=16, ffn_dim=None, seq_len=1024,
+                          dtype="float32", block_size=16, num_blocks=64,
+                          moe_experts=0, moe_every=2, **kwargs):
+    """ONE decode iteration with chunked prefill fused in (stall-free
+    scheduling, docs/DECODE.md): up to K prefill-chunk tokens of one
+    admitted prompt AND one decode token for every active slot run in
+    the same compiled, donated launch.
+
+    Two streams share every parameter (each weight is created once as
+    an explicit Variable and bound by both op instances, so the graph
+    has ONE copy and checkpoints load unchanged):
+
+    * decode stream — identical to `get_decode_step_symbol`: ``data``
+      (C, 1), ``positions`` (C, 1) (< 0 = inactive), ``block_table``
+      (C, M), PagedDecodeAttention per layer;
+    * chunk stream — ``chunk_data`` (1, K) the current prompt chunk,
+      ``chunk_positions`` (1, K) its absolute positions (for the
+      position embedding), ``chunk_start`` (1,) / ``chunk_len`` (1,)
+      the chunk's absolute offset and real token count
+      (``chunk_len == 0`` disables the stream for the iteration), and
+      ``chunk_table`` (1, M) the prefilling sequence's blocks;
+      PagedChunkPrefillAttention attends the chunk causally against the
+      cache prefix written by earlier chunks.
+
+    Cache variables thread decode-write -> chunk-write per layer, so
+    one donated buffer chain carries both streams.  K and C are set at
+    bind time by the input shapes — the symbol itself is geometry-free.
+    Outputs: ``[decode logits (C, vocab), decode greedy token (C,),
+    chunk last-token logits (1, vocab), chunk greedy token (1,),
+    new caches...]`` — the chunk head's greedy token is the sequence's
+    FIRST generated token once its final chunk lands.
+    """
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    H = int(num_heads)
+    D = d // H
+
+    data = sym.Variable("data")                      # (C, 1) token ids
+    positions = sym.Variable("positions")            # (C, 1)
+    table = sym.Variable("block_table")              # (C, M)
+    cdata = sym.Variable("chunk_data")               # (1, K) chunk ids
+    cpos = sym.Variable("chunk_positions")           # (1, K) absolute
+    cstart = sym.Variable("chunk_start")             # (1,)
+    clen = sym.Variable("chunk_len")                 # (1,)
+    ctable = sym.Variable("chunk_table")             # (1, M)
+
+    tokw = sym.Variable("tok_embed_weight")
+    pos_w = sym.Variable("pos_embed_weight", shape=(1, int(seq_len), d))
+    pos_flat = sym.Reshape(pos_w, shape=(int(seq_len), d))
+
+    tok = sym.Embedding(data, tokw, input_dim=vocab, output_dim=d,
+                        name="tok_embed")
+    x = tok + sym.take(pos_flat, positions, name="pos_take")
+    ctok = sym.Embedding(cdata, tokw, input_dim=vocab, output_dim=d,
+                         name="c_tok_embed")
+    xc = ctok + sym.take(pos_flat, cpos, name="c_pos_take")
+    if dtype in ("float16", "bfloat16"):
+        x = sym.Cast(data=x, dtype=dtype, name="cast_embed")
+        xc = sym.Cast(data=xc, dtype=dtype, name="c_cast_embed")
+
+    new_kv = []
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        attn_vars = _decode_trunk_vars(pre)
+        ln1_g = sym.Variable(pre + "ln1_gamma")
+        ln1_b = sym.Variable(pre + "ln1_beta", init=_init.Zero())
+        kc = sym.Variable(pre + "k_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        vc = sym.Variable(pre + "v_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+
+        ln1 = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
+                            name=pre + "ln1")
+        att = sym.contrib.PagedDecodeAttention(
+            ln1, *attn_vars, kc, vc, table, positions,
+            num_heads=H, name=pre + "attn")
+        x = x + att[0]
+
+        # the chunk reads/writes the cache AFTER the decode scatter —
+        # one coherent donated buffer chain; block tables are disjoint
+        # (a sequence is either prefilling or decoding, never both in
+        # one launch), so the streams never alias a block
+        cln1 = sym.LayerNorm(data=xc, gamma=ln1_g, beta=ln1_b,
+                             name=pre + "c_ln1")
+        catt = sym.contrib.PagedChunkPrefillAttention(
+            cln1, *attn_vars, att[1], att[2], ctable, cstart, clen,
+            num_heads=H, name=pre + "c_attn")
+        xc = xc + catt[0]
+        new_kv += [catt[1], catt[2]]
+
+        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i)
+        x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i,
+                            shared=shared)
+        xc = xc + _decode_ffn(xc, pre, d, ffn, moe_experts, moe_every, i,
+                              shared=shared, tag="c_")
+
+    lnf_g = sym.Variable("ln_f_gamma")
+    lnf_b = sym.Variable("ln_f_beta", init=_init.Zero())
+    lmw = sym.Variable("lm_head_weight")
+    lmb = sym.Variable("lm_head_bias", init=_init.Zero())
+
+    x = sym.LayerNorm(data=x, gamma=lnf_g, beta=lnf_b, name="ln_f")
+    logits = sym.FullyConnected(data=x, weight=lmw, bias=lmb,
+                                num_hidden=vocab, flatten=False,
+                                name="lm_head")      # (C, 1, vocab)
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
+    flat = sym.Reshape(data=logits, shape=(-1, vocab), name="logits_2d")
+    nxt = sym.argmax(flat, axis=1, name="greedy_token")
+
+    xc = sym.LayerNorm(data=xc, gamma=lnf_g, beta=lnf_b, name="c_ln_f")
+    clast = sym.contrib.GatherTimestep(xc, clen - 1, name="c_last_token")
+    clogits = sym.FullyConnected(data=clast, weight=lmw, bias=lmb,
+                                 num_hidden=vocab, flatten=False,
+                                 name="c_lm_head")   # (1, vocab)
+    if dtype in ("float16", "bfloat16"):
+        clogits = sym.Cast(data=clogits, dtype="float32",
+                           name="c_cast_out")
+    cnxt = sym.argmax(clogits, axis=1, name="c_greedy_token")
+    return sym.Group([flat, nxt, clogits, cnxt] + new_kv)

@@ -1015,6 +1015,96 @@ def paged_prefill_attention(data, qkv_weight, qkv_bias, proj_weight,
     return out, kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
 
 
+@register("_contrib_PagedChunkPrefillAttention",
+          aliases=("PagedChunkPrefillAttention",), num_outputs=3)
+def paged_chunk_prefill_attention(data, qkv_weight, qkv_bias,
+                                  proj_weight, proj_bias, k_cache,
+                                  v_cache, block_table, start, lengths,
+                                  *, num_heads, scale=None):
+    """Chunked prompt-phase attention over an EXISTING cache prefix.
+
+    The chunked-prefill variant of PagedPrefillAttention (Sarathi-Serve
+    /Orca-style stall-free scheduling, docs/DECODE.md): data (B, K, d)
+    holds one K-token CHUNK per row whose tokens sit at absolute
+    positions ``[start[b], start[b] + lengths[b])`` of the sequence;
+    earlier chunks' K/V already live in the paged cache, addressed by
+    ``block_table (B, M)``.  The chunk's K/V rows are scattered first,
+    then every chunk query attends causally against the FULL context so
+    far (prior chunks fully visible, in-chunk keys causally).  Rows
+    past ``lengths[b]`` are padding (scatter dropped, output garbage
+    the engine masks); ``lengths[b] == 0`` makes row b a no-op.
+    Outputs (hidden (B, K, d), new_k_cache, new_v_cache).  Weight
+    names/layouts match FusedCausalSelfAttention, so the training
+    checkpoint serves chunked prefill with no conversion."""
+    B, K, d = data.shape
+    H = int(num_heads)
+    if d % H:
+        raise ValueError("d_model %d not divisible by num_heads %d" % (d, H))
+    D = d // H
+    sc = (1.0 / D ** 0.5) if scale is None else float(scale)
+    st = start.reshape(B).astype(jnp.int32)
+    L = lengths.reshape(B).astype(jnp.int32)
+    table = block_table.astype(jnp.int32)              # (B, M)
+    M = table.shape[1]
+    nb, bs = k_cache.shape[0], k_cache.shape[1]
+
+    from ..pallas import paged_chunk_prefill_attend, use_paged_pallas
+    if use_paged_pallas():
+        # Pallas kernel (docs/KERNELS.md): streams the context cache
+        # block by block with an online softmax, merging the chunk's
+        # own K/V into each block in-kernel and writing it back through
+        # the aliased caches — the (B, M*bs, H, D) gathered-context
+        # temp of the XLA path below never exists.
+        Wqkv, bqkv = _paged_qkv_weights(qkv_weight, qkv_bias, d, H, D)
+        q = jnp.einsum("bsd,hed->bshe", data, Wqkv[0]) + bqkv[0]
+        k = jnp.einsum("bsd,hed->bshe", data, Wqkv[1]) + bqkv[1]
+        v = jnp.einsum("bsd,hed->bshe", data, Wqkv[2]) + bqkv[2]
+        o, kc, vc = paged_chunk_prefill_attend(
+            q, k, v, k_cache, v_cache, table, st, L, scale=sc)
+        out = jnp.einsum("bshe,dhe->bsd", o,
+                         proj_weight.reshape(d, H, D)) + proj_bias
+        return out, kc, vc
+
+    Wqkv = qkv_weight.reshape(3, H, D, d)
+    bqkv = qkv_bias.reshape(3, H, 1, D)
+    q = jnp.einsum("bsd,hed->bhse", data, Wqkv[0]) + bqkv[0]
+    k = jnp.einsum("bsd,hed->bhse", data, Wqkv[1]) + bqkv[1]
+    v = jnp.einsum("bsd,hed->bhse", data, Wqkv[2]) + bqkv[2]
+
+    # scatter the chunk's rows at their ABSOLUTE positions first, so
+    # the gather below reads a cache that already contains them (the
+    # in-chunk causal mask does the rest)
+    kf = k_cache.reshape(nb * bs, H, D)
+    vf = v_cache.reshape(nb * bs, H, D)
+    j = jnp.arange(K)
+    apos = st[:, None] + j[None, :]                    # (B, K) absolute
+    blk = jnp.clip(apos // bs, 0, M - 1)
+    base = jnp.take_along_axis(table, blk, axis=1)
+    widx = jnp.where(j[None, :] < L[:, None],
+                     base * bs + apos % bs, nb * bs)   # OOB sentinel
+    kw = k.transpose(0, 2, 1, 3).reshape(B * K, H, D)
+    vw = v.transpose(0, 2, 1, 3).reshape(B * K, H, D)
+    kf = kf.at[widx.reshape(B * K)].set(kw.astype(kf.dtype), mode="drop")
+    vf = vf.at[widx.reshape(B * K)].set(vw.astype(vf.dtype), mode="drop")
+
+    # gather the whole addressable context per row and mask causally
+    # against absolute positions; padded table entries read block 0 but
+    # sit behind the mask
+    ctx = M * bs
+    jk = jnp.arange(ctx)
+    ridx = table[:, jk // bs] * bs + (jk % bs)         # (B, ctx)
+    kctx = jnp.take(kf, ridx, axis=0, mode="clip")     # (B, ctx, H, D)
+    vctx = jnp.take(vf, ridx, axis=0, mode="clip")
+    s = jnp.einsum("bhqe,bjhe->bhqj", q, kctx) * sc
+    mask = jk[None, :] <= apos[:, :, None]             # (B, K, ctx)
+    s = jnp.where(mask[:, None], s.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqj,bjhe->bhqe", p, vctx)
+    out = jnp.einsum("bhse,dhe->bsd", o,
+                     proj_weight.reshape(d, H, D)) + proj_bias
+    return out, kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
+
+
 @register("_contrib_GatherTimestep", aliases=("GatherTimestep",))
 def gather_timestep(data, index):
     """data (B, S, d), index (B,) or (B, 1) -> (B, d): data[b, index[b]]

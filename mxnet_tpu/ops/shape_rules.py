@@ -126,6 +126,7 @@ _set("_contrib_FusedCausalSelfAttention", _fused_attn_shapes)
 # explicit Variable shapes, never from inference
 _set("_contrib_PagedDecodeAttention", _fused_attn_shapes)
 _set("_contrib_PagedPrefillAttention", _fused_attn_shapes)
+_set("_contrib_PagedChunkPrefillAttention", _fused_attn_shapes)
 
 
 def _ln_shapes(known, attrs):

@@ -27,13 +27,15 @@ from .dispatch import (PALLAS_FALLBACKS, PALLAS_LAUNCHES, choose_impl,
                        paged_attn_impl, use_paged_pallas,
                        use_q2bit_pallas)
 from . import attention
-from .attention import paged_decode_attend, paged_prefill_attend
+from .attention import (paged_chunk_prefill_attend, paged_decode_attend,
+                        paged_prefill_attend)
 from . import quant
 from .quant import two_bit_quantize_fused
 
 __all__ = [
     "attention", "dispatch", "quant",
     "choose_impl", "paged_attn_impl", "use_paged_pallas",
-    "use_q2bit_pallas", "paged_decode_attend", "paged_prefill_attend",
+    "use_q2bit_pallas", "paged_chunk_prefill_attend",
+    "paged_decode_attend", "paged_prefill_attend",
     "two_bit_quantize_fused", "PALLAS_FALLBACKS", "PALLAS_LAUNCHES",
 ]

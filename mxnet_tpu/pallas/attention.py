@@ -20,6 +20,13 @@ accumulates across blocks, and no gathered context tensor ever exists.
   dereferenced — the in-kernel equivalent of the XLA path's ``nb*bs``
   OOB-drop sentinel.
 
+* :func:`paged_chunk_prefill_attend` — the chunked-prefill variant:
+  a K-token chunk of ONE prompt attends causally against the full
+  context so far (prior chunks read back from the paged cache, the
+  chunk's own rows merged in-kernel), with the chunk's K/V scatter
+  fused through the same clamp-onto-last-real-block discipline but
+  addressed at an absolute ``start`` offset into an EXISTING cache.
+
 Off-TPU the wrappers run ``interpret=True`` so CPU tier-1 executes the
 exact kernel logic against the XLA reference (parity pinned at
 rtol<=2e-5 f32 in tests/test_pallas.py).  Block-size tuning notes live
@@ -270,3 +277,156 @@ def paged_prefill_attend(q, k, v, k_cache, v_cache, block_table,
                      lengths.astype(jnp.int32), q, k, v,
                      k_cache, v_cache)
     return out[:, :S], ko, vo
+
+
+# ----------------------------------------------------------------------
+# chunked prefill: one prompt chunk against an EXISTING cache prefix
+# ----------------------------------------------------------------------
+def _paged_chunk_prefill_kernel(table_ref, start_ref, len_ref, q_ref,
+                                kpad_ref, vpad_ref, kc_ref, vc_ref,
+                                o_ref, ko_ref, vo_ref, acc_ref, m_ref,
+                                l_ref, *, bs, scale):
+    b = pl.program_id(0)
+    m = pl.program_id(1)
+    st = start_ref[b]
+    L = len_ref[b]
+    end = st + L
+    # blocks holding real context once this chunk lands: [0, nctx)
+    nctx = jnp.maximum(-(-end // bs), 1)
+    m_eff = jnp.minimum(m, nctx - 1)
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # merge the chunk's rows into this step's cache block: block m_eff
+    # holds absolute rows [m_eff*bs, m_eff*bs + bs); rows inside
+    # [start, end) come from the chunk (kpad carries bs zero rows on
+    # each side so the dynamic slice stays in-bounds even when the
+    # chunk straddles a block boundary), every other row keeps its
+    # existing cache bytes.  Clamped steps (m >= nctx) re-emit the last
+    # real block's exact bytes — the idempotent duplicate write that
+    # keeps padded table entries undereferenced.
+    row_abs = (jax.lax.broadcasted_iota(jnp.int32, (bs, 1, 1), 0)
+               + m_eff * bs)
+    in_chunk = jnp.logical_and(row_abs >= st, row_abs < end)
+    off = m_eff * bs - st           # chunk-local index of the block's
+    kslice = jax.lax.dynamic_slice_in_dim(   # first row (may be < 0)
+        kpad_ref[0], off + bs, bs, 0)
+    vslice = jax.lax.dynamic_slice_in_dim(vpad_ref[0], off + bs, bs, 0)
+    kblk = jnp.where(in_chunk, kslice.astype(kc_ref.dtype), kc_ref[0])
+    vblk = jnp.where(in_chunk, vslice.astype(vc_ref.dtype), vc_ref[0])
+    ko_ref[0] = kblk
+    vo_ref[0] = vblk
+
+    # online softmax over the merged context blocks; clamped steps are
+    # skipped so the duplicate write never double-counts a block
+    @pl.when(m < nctx)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)              # (K, H, D)
+        kk = kblk.astype(jnp.float32)                 # (bs, H, D)
+        vv = vblk.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kk, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * scale   # (H, K, bs)
+        jq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + st
+        jk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + m * bs
+        # causal over the FULL context: prior chunks fully visible,
+        # in-chunk keys causally.  Finite fill (not -inf): a later
+        # block can be entirely masked for early queries, and
+        # exp(m_prev - max(m_prev, -1e30)) must stay 0/1, not NaN.
+        s = jnp.where(jk <= jq, s, -1e30)
+        m_prev = m_ref[...]                           # (H, K, 1)
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # (H, K, bs)
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=2,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vv, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)       # (H, K, D)
+        m_ref[...] = m_new
+
+    @pl.when(m == pl.num_programs(1) - 1)
+    def _emit():
+        l = l_ref[...]
+        o = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = o.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_chunk_prefill_attend(q, k, v, k_cache, v_cache, block_table,
+                               start, lengths, *, scale,
+                               interpret=None):
+    """Chunked prefill attention over an EXISTING cache: the chunk rows
+    ``q/k/v (B, K, H, D)`` sit at absolute positions
+    ``[start[b], start[b] + lengths[b])`` of their sequences; each
+    chunk query attends causally against the full context so far —
+    earlier chunks' K/V are streamed back from the paged cache block by
+    block, the chunk's own K/V are merged in-kernel before the block is
+    both attended and written back through the input/output-aliased
+    caches.  Rows past ``lengths[b]`` are padding: never scattered,
+    outputs don't-care.  ``lengths[b] == 0`` makes row ``b`` a no-op
+    (block 0 is re-emitted byte-identically).  Returns
+    ``(out (B, K, H, D), new_k_cache, new_v_cache)``."""
+    B, K, H, D = q.shape
+    bs = k_cache.shape[1]
+    M = block_table.shape[1]
+    _count_launch("paged_chunk_prefill_attend")
+    zk = jnp.zeros((B, bs, H, D), k.dtype)
+    zv = jnp.zeros((B, bs, H, D), v.dtype)
+    kpad = jnp.concatenate([zk, k, zk], axis=1)   # (B, K + 2*bs, H, D)
+    vpad = jnp.concatenate([zv, v, zv], axis=1)
+    Kp = K + 2 * bs
+
+    def cache_block(b, m, t, st, l):
+        # same clamp as paged_prefill_attend, but the last real block
+        # is start+length blocks in — the chunk extends a live prefix
+        last = jnp.maximum(-(-(st[b] + l[b]) // bs), 1) - 1
+        return (t[b, jnp.minimum(m, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, M),
+        in_specs=[
+            pl.BlockSpec((1, K, H, D),
+                         lambda b, m, t, st, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Kp, H, D),
+                         lambda b, m, t, st, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Kp, H, D),
+                         lambda b, m, t, st, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, K, H, D),
+                         lambda b, m, t, st, l: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+            pl.BlockSpec((1, bs, H, D), cache_block),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, K, D), jnp.float32),   # online-softmax acc
+            pltpu.VMEM((H, K, 1), jnp.float32),   # running max
+            pltpu.VMEM((H, K, 1), jnp.float32),   # running denom
+        ],
+    )
+    fn = pl.pallas_call(
+        functools.partial(_paged_chunk_prefill_kernel, bs=bs,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, H, D), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # cache in -> cache out: in-place block writes, no cache copy
+        # (scalar-prefetch args count: table=0, start=1, len=2, q=3,
+        # kpad=4, vpad=5, k_cache=6, v_cache=7)
+        input_output_aliases={6: 1, 7: 2},
+        interpret=_interpret_default(interpret),
+    )
+    return fn(block_table.astype(jnp.int32), start.astype(jnp.int32),
+              lengths.astype(jnp.int32), q, kpad, vpad,
+              k_cache, v_cache)

@@ -650,7 +650,11 @@ class ModelServer:
                 except (MXNetError, TypeError, ValueError) as e:
                     # TypeError/ValueError: malformed field types
                     # (non-int tokens, non-numeric temperature) — a
-                    # client error, same as any other validation miss
+                    # client error, same as any other validation miss.
+                    # MXNetError here is only a prompt the cache can
+                    # NEVER hold (>= max_context, or more blocks than
+                    # exist): long-but-servable prompts are admitted
+                    # and prefilled in chunks (docs/DECODE.md)
                     self._reply(400, {"error": str(e), "type": "bad_request"})
                     return
                 if not doc.get("stream", True):

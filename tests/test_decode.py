@@ -59,8 +59,7 @@ def model():
 def engine(model):
     """Shared warm engine for the behavioral tests (capacity 3)."""
     eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
-                       num_blocks=36, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=True)
+                       num_blocks=36, chunk_tokens=8, warmup=True)
     yield eng
     eng.stop()
 
@@ -118,22 +117,26 @@ def test_cache_gauges_aggregate_across_instances():
     assert BLOCKS_FREE.value == free0 + 2
 
 
-def test_prefill_ladder_covers_preemption_recompute(model):
-    """A live sequence holds pos+1 tokens, so one preempted at
-    pos == seq_len-1 re-prefills from a seq_len-token prompt: the
-    bucket ladder must reach the FULL context length or the recompute
-    dies with a spurious too-long-prompt error."""
+def test_chunk_budget_resolution(model, monkeypatch):
+    """The pow2 prefill ladder is retired: ONE chunk budget K (pow2-
+    padded, capped at seq_len) sizes the single mixed step; the
+    ``MXNET_DECODE_CHUNK`` knob feeds the default and the retired
+    ladder kwargs are accepted-but-ignored (checkpoint configs keep
+    loading)."""
+    from mxnet_tpu.decode.engine import _chunk_budget
+    assert _chunk_budget(8, SEQ) == 8
+    assert _chunk_budget(9, SEQ) == 16          # pow2 padded
+    assert _chunk_budget(1024, SEQ) == SEQ      # capped at context
+    monkeypatch.setenv("MXNET_DECODE_CHUNK", "12")
+    assert _chunk_budget(None, SEQ) == 16
+    monkeypatch.delenv("MXNET_DECODE_CHUNK")
+    assert _chunk_budget(None, SEQ) == SEQ      # default 64 capped to 48
     eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
-                       num_blocks=24, warmup=False, start=False)
+                       num_blocks=24, chunk_tokens=6, max_prefill_len=8,
+                       prefill_buckets=[8], warmup=False, start=False)
     try:
-        assert eng._buckets[-1] == SEQ
-        assert eng._bucket_for(SEQ) == SEQ
-        # explicit small buckets get the same completion
-        eng2 = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
-                            num_blocks=24, prefill_buckets=[8],
-                            warmup=False, start=False)
-        assert eng2._buckets == [8, SEQ]
-        eng2.stop()
+        assert eng._chunk_tokens == 8           # pow2; ladder kwargs inert
+        assert not hasattr(eng, "_buckets")     # the ladder is GONE
     finally:
         eng.stop()
 
@@ -159,12 +162,25 @@ def test_scheduler_policies():
     assert s.pick_victim(exclude=(s2,)) is s1
     s2.blocks = cache.alloc(2)
     s2.pos = 5
+    # mid-prefill state folds whole on preemption: the re-admission
+    # re-targets the full token list through fresh chunks
+    s2.prefill_target, s2.n_prefilled = 7, 5
     s.preempt(s2)
     assert cache.used_count == 0              # blocks returned
     assert s.slots[1] is None and s.waiting[0] is s2
     assert s2.pos == 0 and s2.preemptions == 1
+    assert s2.prefill_target == 0 and s2.n_prefilled == 0
+    # chunk policy: the OLDEST placed sequence mid-prefill feeds chunks
+    s1.prefill_target, s1.n_prefilled = 2, 0
+    assert s.pick_prefilling() is s1
+    s1.n_prefilled = 2
+    assert s.pick_prefilling() is None        # everyone fully prefilled
     s.release(s1)
     assert not s.has_active()
+    # incremental chunk allocation helper
+    assert cache.blocks_missing(0, 5) == 2
+    assert cache.blocks_missing(2, 5) == 0
+    assert cache.blocks_missing(3, 5) == 0    # never negative
 
 
 # ----------------------------------------------------------------------
@@ -363,8 +379,7 @@ def test_zero_retraces_and_one_launch_per_step_ragged(engine):
 
 def test_deadline_expiry_waiting_and_queue_order(model):
     eng = DecodeEngine(model["params"], CFG, capacity=1, block_size=4,
-                       num_blocks=16, max_prefill_len=4,
-                       prefill_buckets=[4], warmup=False)
+                       num_blocks=16, chunk_tokens=4, warmup=False)
     try:
         blocker = eng.submit([1], max_new_tokens=25)
         doomed = eng.submit([2], max_new_tokens=5, timeout_ms=30)
@@ -381,8 +396,7 @@ def test_preemption_by_recompute_equivalence(model, engine):
     recomputed; greedy outputs are IDENTICAL to the uncontended run and
     all blocks come home."""
     eng = DecodeEngine(model["params"], CFG, capacity=4, block_size=4,
-                       num_blocks=7, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=False)
+                       num_blocks=7, chunk_tokens=8, warmup=False)
     try:
         prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
         hs = [eng.submit(p, max_new_tokens=10) for p in prompts]
@@ -398,19 +412,100 @@ def test_preemption_by_recompute_equivalence(model, engine):
         eng.stop()
 
 
+def test_chunked_prefill_long_prompt_parity(model, engine):
+    """The regression the chunked rework exists for: prompts LONGER
+    than the retired max_prefill_len=8 are admitted and their greedy
+    streams are bit-identical to a full-prefill oracle (chunk budget >=
+    prompt length == one chunk == the old whole-prompt prefill)."""
+    rng = np.random.RandomState(31)
+    prompts = [list(rng.randint(0, 50, n)) for n in (5, 19, 33)]
+    oracle = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                          num_blocks=36, chunk_tokens=SEQ, warmup=False)
+    try:
+        ref = [oracle.generate(p, max_new_tokens=8, timeout=120)
+               for p in prompts]
+        assert oracle.stats()["prefill_chunks"] == 3   # one chunk each
+    finally:
+        oracle.stop()
+    # the shared engine chunks at 8 tokens: 1, 3 and 5 chunks resp.
+    hs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+    assert [h.result(timeout=120) for h in hs] == ref
+
+
+def test_mixed_step_witnesses_with_chunks_in_flight(model):
+    """With multi-chunk prefills interleaving live decodes, every
+    iteration is STILL exactly one device launch and a warm engine
+    never retraces — the stall-free claim, pinned."""
+    rng = np.random.RandomState(13)
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True)
+    try:
+        hs = [eng.submit(list(rng.randint(0, 50, n)), max_new_tokens=6)
+              for n in (3, 21, 17, 30, 5, 26)]
+        for h in hs:
+            h.result(timeout=120)
+        st = eng.stats()
+        assert st["steady_state_retraces"] == 0
+        assert st["decode_step_dispatches"] == st["steps"] > 0
+        assert st["dispatches_per_step"] == 1.0
+        assert st["prefills"] == 6
+        assert st["prefill_chunks"] >= 1 + 3 + 3 + 4 + 1 + 4
+        assert st["ttft_steps_p99"] is not None
+        assert st["cache"]["blocks_free"] == st["cache"]["num_blocks"]
+    finally:
+        eng.stop()
+
+
+def test_preemption_mid_prefill_equivalence(model, engine):
+    """Preemption landing in the MIDDLE of a chunked prefill folds the
+    partial prefill whole (no cache rows survive) and the recompute
+    stream stays bit-identical to the uncontended run."""
+    rng = np.random.RandomState(17)
+    prompts = [list(rng.randint(0, 50, n)) for n in (18, 22, 20)]
+    # 7 blocks of 4 rows = 28 cache rows for ~60 prompt rows: chunk 8
+    # prefills MUST overlap and preempt each other mid-prompt
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=7, chunk_tokens=8, warmup=False)
+    try:
+        hs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        outs = [h.result(timeout=120) for h in hs]
+        st = eng.stats()
+        assert st["preemptions"] > 0
+        assert st["steady_state_retraces"] == 0
+        assert st["cache"]["blocks_free"] == st["cache"]["num_blocks"]
+        ref = [engine.generate(p, max_new_tokens=6, timeout=120)
+               for p in prompts]
+        assert outs == ref
+    finally:
+        eng.stop()
+
+
+def test_http_long_prompt_now_streams(served):
+    """Submit-time rejection of long prompts is GONE: a prompt past the
+    old max_prefill_len=8 ladder cap streams 200, not 400."""
+    host, port = served["host"], served["port"]
+    doc = {"tokens": list(range(1, 30)), "max_new_tokens": 4,
+           "stream": False}
+    out = json.loads(_post_json(host, port, "/generate", doc).read())
+    assert len(out["tokens"]) == 4 and out["finish_reason"] == "length"
+
+
 def test_cache_oom_fails_cleanly(model):
     """A sequence that cannot grow even after evicting everyone else
     fails with CacheOOMError; inadmissible prompts fail at submit."""
     eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
-                       num_blocks=2, max_prefill_len=4,
-                       prefill_buckets=[4], warmup=False)
+                       num_blocks=2, chunk_tokens=4, warmup=False)
     try:
         h = eng.submit([1, 2], max_new_tokens=30)   # needs > 8 cache rows
         with pytest.raises(CacheOOMError):
             h.result(timeout=120)
         assert eng.stats()["cache"]["blocks_free"] == 2
         with pytest.raises(mx.base.MXNetError):
-            eng.submit(list(range(9)), max_new_tokens=1)  # > max_prefill
+            # whole-prompt footprint exceeds the ENTIRE cache: still a
+            # submit-time rejection (chunking can't conjure blocks)
+            eng.submit(list(range(9)), max_new_tokens=1)
+        with pytest.raises(mx.base.MXNetError):
+            eng.submit(list(range(SEQ)), max_new_tokens=1)  # no room left
         with pytest.raises(mx.base.MXNetError):
             eng.submit([], max_new_tokens=1)
     finally:
@@ -419,8 +514,7 @@ def test_cache_oom_fails_cleanly(model):
 
 def test_engine_stop_rejects_new_work(model):
     eng = DecodeEngine(model["params"], CFG, capacity=1, block_size=4,
-                       num_blocks=8, max_prefill_len=4,
-                       prefill_buckets=[4], warmup=False)
+                       num_blocks=8, chunk_tokens=4, warmup=False)
     assert eng.generate([1], max_new_tokens=2, timeout=120)
     eng.stop()
     from mxnet_tpu.serving import ServerClosedError
@@ -428,18 +522,17 @@ def test_engine_stop_rejects_new_work(model):
         eng.submit([1])
 
 
-def test_prefill_failure_settles_stream_and_frees_blocks(model):
-    """A non-MXNetError escaping prefill (a device/jax failure) must
-    fail ONLY that stream and return its cache blocks: the sequence is
-    already off the wait queue and not yet placed, so the engine-loop
-    catch-all can never settle it."""
+def test_admission_failure_settles_stream_and_frees_blocks(model):
+    """A non-MXNetError escaping admission must fail ONLY that stream
+    and return its cache blocks: the sequence is already off the wait
+    queue and not yet placed, so the engine-loop catch-all can never
+    settle it."""
     eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
-                       num_blocks=12, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=False)
+                       num_blocks=12, chunk_tokens=8, warmup=False)
     try:
-        def boom(bucket):
-            raise RuntimeError("simulated device failure")
-        eng._prefill_exe = boom
+        def boom(seq, slot):
+            raise RuntimeError("simulated admission failure")
+        eng._admit = boom
         h = eng.submit([1, 2, 3], max_new_tokens=4)
         with pytest.raises(RuntimeError):
             h.result(timeout=30)
@@ -456,8 +549,7 @@ def test_prefill_failure_settles_stream_and_frees_blocks(model):
 def served(model, tmp_path_factory):
     from mxnet_tpu.serving import ModelServer
     eng = DecodeEngine(model["params"], CFG, capacity=4, block_size=4,
-                       num_blocks=40, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=True)
+                       num_blocks=40, chunk_tokens=8, warmup=True)
     srv = ModelServer(model["sym"], model["params"], {}, {"data": (SEQ,)},
                       num_replicas=1, max_batch_size=1, warmup=False,
                       decode_engine=eng)
@@ -557,8 +649,8 @@ def test_http_generate_errors(served, model):
     assert e.value.code == 400
     with pytest.raises(urllib.error.HTTPError) as e:
         _post_json(host, port, "/generate",
-                   {"tokens": list(range(99))})    # > max_prefill_len
-    assert e.value.code == 400
+                   {"tokens": list(range(99))})    # >= seq_len: no room
+    assert e.value.code == 400                     # to generate anything
     # malformed field TYPES are client errors too, not 500s
     with pytest.raises(urllib.error.HTTPError) as e:
         _post_json(host, port, "/generate", {"tokens": ["abc"]})
@@ -634,8 +726,8 @@ def test_decode_soak(model):
     per iteration throughout."""
     rng = np.random.RandomState(23)
     eng = DecodeEngine(model["params"], CFG, capacity=4, block_size=4,
-                       num_blocks=30, max_prefill_len=8,
-                       prefill_buckets=[8], max_waiting=512, warmup=True)
+                       num_blocks=30, chunk_tokens=8, max_waiting=512,
+                       warmup=True)
     try:
         hs = []
         for i in range(60):
@@ -661,15 +753,14 @@ def test_decode_soak(model):
 # thread-safety pins (mx.analyze threads pass; docs/ANALYZE.md)
 # ----------------------------------------------------------------------
 def test_warmup_concurrent_with_traffic_is_safe(model):
-    """warmup() on a LIVE engine shares the _warm/_prefill_exes
-    bookkeeping with the engine thread; both are now guarded by
-    _step_lock (flagged by mx.analyze as unguarded-shared-write).
-    Concurrent warmup + traffic must finish every stream, warm every
-    bucket exactly once, and leave the zero-retrace witness at 0."""
+    """warmup() on a LIVE engine shares the _warm bookkeeping with the
+    engine thread; both are guarded by _step_lock (flagged by
+    mx.analyze as unguarded-shared-write).  Concurrent warmup + traffic
+    must finish every stream, warm the mixed step exactly once, and
+    leave the zero-retrace witness at 0."""
     import threading
     eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
-                       num_blocks=36, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=False)
+                       num_blocks=36, chunk_tokens=8, warmup=False)
     try:
         handles, errs = [], []
 
@@ -694,7 +785,7 @@ def test_warmup_concurrent_with_traffic_is_safe(model):
         st = eng.stats()
         assert st["steady_state_retraces"] == 0
         assert st["failed"] == 0
-        # every bucket warmed exactly once (set semantics intact)
-        assert ("prefill", 8) in eng._warm and "decode" in eng._warm
+        # the ONE mixed program warmed exactly once (set semantics)
+        assert eng._warm == {"mixed"}
     finally:
         eng.stop()

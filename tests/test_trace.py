@@ -232,8 +232,7 @@ def test_tracing_overhead_guard_decode():
               if n not in ("data", "softmax_label")}
     tracing.enable()
     eng = DecodeEngine(params, cfg, capacity=2, block_size=4,
-                       num_blocks=16, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=True)
+                       num_blocks=16, chunk_tokens=8, warmup=True)
     try:
         handles = [eng.submit([1, 2, 3], max_new_tokens=6)
                    for _ in range(3)]
@@ -269,8 +268,7 @@ def test_generate_single_connected_trace(tmp_path):
     tracing.enable()
     tracing.clear()
     eng = DecodeEngine(params, cfg, capacity=2, block_size=4,
-                       num_blocks=16, max_prefill_len=8,
-                       prefill_buckets=[8], warmup=True)
+                       num_blocks=16, chunk_tokens=8, warmup=True)
     srv = ModelServer(tsym, params, {}, input_shapes={"data": (32,)},
                       num_replicas=1, warmup=False, decode_engine=eng)
     try:
