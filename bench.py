@@ -1652,8 +1652,15 @@ def bench_decode(args):
               for n, s in zip(tsym.list_arguments(), arg_shapes)
               if n not in ("data", "softmax_label")}
     n_req = args.decode_requests
-    prompts = [list(rng.randint(0, args.decode_vocab,
-                                rng.randint(4, args.decode_prompt_max + 1)))
+    # every request opens with the same system-prompt-style preamble
+    # (the production shape prefix sharing exists for: identical
+    # few-shot headers across the fleet) followed by a random tail
+    sys_prompt = list(rng.randint(0, args.decode_vocab,
+                                  args.decode_block_size + 1))
+    prompts = [sys_prompt
+               + list(rng.randint(0, args.decode_vocab,
+                                  rng.randint(4,
+                                              args.decode_prompt_max + 1)))
                for _ in range(n_req)]
     # heavy-tailed output lengths (many short, few near-max) — the
     # production shape continuous batching exists for; run-to-completion
@@ -1664,15 +1671,18 @@ def bench_decode(args):
     step_hist = telemetry.REGISTRY.get("decode_step_ms")
 
     def run(admission, impl=None, n=None, gen_cap=None, chunk=None,
-            workload=None):
+            workload=None, spec_k=None, prefix=False):
         """One engine lifetime.  ``impl`` forces MXNET_PAGED_ATTN_IMPL
         for the whole run (the dispatch decision is baked in at trace
         time, so the env must cover engine construction + warmup);
         ``n``/``gen_cap`` shrink the workload for the interpret-mode
         pallas A/B arm, which is orders of magnitude slower off-TPU;
         ``chunk`` overrides the prefill chunk budget (the
-        chunked-vs-unchunked arm) and ``workload`` swaps in a
-        different ``(prompts, new_tokens)`` mix."""
+        chunked-vs-unchunked arm), ``workload`` swaps in a different
+        ``(prompts, new_tokens)`` mix, and ``spec_k``/``prefix`` arm
+        draft-verify spans / COW prefix sharing (the speculative A/B
+        arm) — both pinned explicitly so a stray env knob can never
+        flip an arm's baseline."""
         ps, nt = (prompts, new_tokens) if workload is None else workload
         if n is not None:
             ps = ps[:n]
@@ -1688,7 +1698,8 @@ def bench_decode(args):
                                max_waiting=n_req + 1, admission=admission,
                                chunk_tokens=(chunk if chunk is not None
                                              else args.decode_chunk),
-                               warmup=True)
+                               spec_k=(spec_k if spec_k is not None else 0),
+                               prefix_cache=prefix, warmup=True)
             compile_ms = (time.perf_counter() - t_c) * 1e3
             try:
                 snap0 = (step_hist.snapshot()
@@ -1770,6 +1781,39 @@ def bench_decode(args):
         raise SystemExit("chunked arm diverged from the unchunked "
                          "full-prefill oracle (greedy streams differ)")
 
+    # speculative A/B arm (docs/DECODE.md): the SAME heavy-tailed mix
+    # with draft-verify spans on vs off (the `cont` arm IS the spec-off
+    # baseline — identical engine geometry and workload).  Greedy
+    # acceptance must keep the streams oracle-identical; the structural
+    # gates pin the one-launch / zero-retrace contract; and
+    # tokens_per_launch > 1 is the feature's existence proof — the
+    # n-gram drafter must land SOME accepted spans on this mix.
+    spec_on = run("continuous", spec_k=args.decode_spec_k, prefix=True)
+    if spec_on["_streams"] != cont["_streams"]:
+        raise SystemExit("speculative arm diverged from the "
+                         "non-speculative oracle (greedy streams differ)")
+    if (spec_on["dispatches_per_step"] != 1.0
+            or spec_on["steady_state_retraces"] != 0):
+        raise SystemExit(
+            "decode speculative arm broke the dispatch contract: "
+            "dispatches_per_step=%r (want 1.0), "
+            "steady_state_retraces=%r (want 0)"
+            % (spec_on["dispatches_per_step"],
+               spec_on["steady_state_retraces"]))
+    if not (spec_on["tokens_per_launch"] or 0) > 1.0:
+        raise SystemExit(
+            "decode speculative arm committed no extra tokens: "
+            "tokens_per_launch=%r (want > 1.0; accept_rate=%r, "
+            "proposed=%r)" % (spec_on["tokens_per_launch"],
+                              spec_on["accept_rate"],
+                              spec_on["spec_proposed"]))
+    if not spec_on["cache"]["prefix_hit_blocks"] > 0:
+        raise SystemExit(
+            "prefix sharing never hit: every request carries the same "
+            "system preamble, so later admissions must adopt trie "
+            "blocks (prefix_hit_blocks=%r)"
+            % spec_on["cache"]["prefix_hit_blocks"])
+
     def _ttft_work(st):
         # per-launch token rows: C decode rows + the compiled chunk
         # width every launch carries, prompt in flight or not
@@ -1846,6 +1890,17 @@ def bench_decode(args):
             ab_pallas["steady_state_retraces"],
         "decode_ab_tokens_equal":
             ab_pallas["_streams"] == ab_xla["_streams"],
+        # speculative arm: stream identity is gated above; steps ratio
+        # is the dispatch-bound speedup speculation buys on this mix
+        "decode_spec_k": args.decode_spec_k,
+        "decode_spec_impl": spec_on.get("spec_impl"),
+        "decode_accept_rate": _round_opt(spec_on["accept_rate"]),
+        "decode_tokens_per_launch": _round_opt(
+            spec_on["tokens_per_launch"]),
+        "decode_spec_steps_ratio": round(
+            cont["steps"] / max(spec_on["steps"], 1), 2),
+        "decode_prefix_hit_blocks":
+            spec_on["cache"]["prefix_hit_blocks"],
         "static_tokens_per_sec": round(
             static["_tokens"] / static["_dt"], 1),
         "static_steps": static["steps"],
@@ -1943,6 +1998,10 @@ def main():
                     help="prefill chunk budget (tokens/iteration); the "
                          "chunked-vs-unchunked A/B arm compares against "
                          "an oracle compiled at --decode-seq")
+    ap.add_argument("--decode-spec-k", type=int, default=4,
+                    help="draft tokens per slot for the speculative "
+                         "A/B arm (spec-on vs spec-off under the same "
+                         "heavy-tailed mix; stream-identity gated)")
     # transformer-LM config (sized for one v5e chip at bf16)
     ap.add_argument("--lm-batch", type=int, default=4)
     ap.add_argument("--lm-seq", type=int, default=1024)
@@ -2047,6 +2106,9 @@ def main():
     out["decode_steps_ratio_vs_static"] = dc["decode_steps_ratio_vs_static"]
     out["decode_attn_impl"] = dc["decode_attn_impl"]
     out["decode_bytes_accessed"] = dc["decode_bytes_accessed"]
+    out["decode_spec_k"] = dc["decode_spec_k"]
+    out["decode_accept_rate"] = dc["decode_accept_rate"]
+    out["decode_tokens_per_launch"] = dc["decode_tokens_per_launch"]
     print(json.dumps(out))
 
 

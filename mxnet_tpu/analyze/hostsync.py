@@ -42,6 +42,7 @@ HOT_MODULES = (
     "mxnet_tpu/module/fused_fit.py",
     "mxnet_tpu/decode/engine.py",
     "mxnet_tpu/decode/scheduler.py",
+    "mxnet_tpu/decode/spec.py",
     "mxnet_tpu/kvstore_fused.py",
     "mxnet_tpu/kvstore_tpu/engine.py",
     "mxnet_tpu/serving/replica.py",

@@ -44,6 +44,7 @@ THREADED_MODULES = (
     "mxnet_tpu/decode/engine.py",
     "mxnet_tpu/decode/scheduler.py",
     "mxnet_tpu/decode/cache.py",
+    "mxnet_tpu/decode/spec.py",
     "mxnet_tpu/telemetry/registry.py",
     "mxnet_tpu/telemetry/tracing.py",
     "mxnet_tpu/telemetry/flight.py",

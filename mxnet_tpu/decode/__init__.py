@@ -32,7 +32,10 @@ from .cache import CacheOOMError, PagedKVCache
 from .engine import DecodeEngine
 from .scheduler import (DeadlineExceededError, QueueFullError, Scheduler,
                         Sequence, StreamHandle)
+from .spec import (DraftModelDrafter, Drafter, NGramDrafter,
+                   choose_spec_impl)
 
 __all__ = ["DecodeEngine", "PagedKVCache", "CacheOOMError", "Scheduler",
            "Sequence", "StreamHandle", "DeadlineExceededError",
-           "QueueFullError"]
+           "QueueFullError", "Drafter", "NGramDrafter",
+           "DraftModelDrafter", "choose_spec_impl"]

@@ -45,6 +45,8 @@ from ..serving.batcher import (DeadlineExceededError, QueueFullError,
 from ..telemetry import REGISTRY, tracing as _tracing
 from .cache import CacheOOMError, PagedKVCache
 from .scheduler import Scheduler, Sequence
+from .spec import (ACCEPT_RATE, SPEC_ACCEPTED, SPEC_PROPOSED,
+                   TOKENS_PER_LAUNCH, choose_spec_impl, make_drafter)
 
 __all__ = ["DecodeEngine"]
 
@@ -142,7 +144,10 @@ class DecodeEngine:
                  num_blocks=64, chunk_tokens=None, max_prefill_len=None,
                  prefill_buckets=None, ctx=None, eos_id=None,
                  max_waiting=256, admission="continuous",
-                 default_max_new_tokens=64, warmup=False, start=True):
+                 default_max_new_tokens=64, warmup=False, start=True,
+                 spec_k=None, spec_impl=None, prefix_cache=None,
+                 draft_params=None, draft_config=None):
+        import os as _os
         from ..context import current_context
         from ..models import transformer
         from ..ndarray.ndarray import NDArray
@@ -161,22 +166,71 @@ class DecodeEngine:
                                            self._max_context)
         CHUNK_BUDGET.set(self._chunk_tokens)
 
-        self.cache = PagedKVCache(num_blocks, bs)
+        # --- speculative decoding + prefix sharing knobs (both default
+        # OFF: docs/DECODE.md).  spec_k > 0 binds the span-verify step
+        # (S = spec_k + 1 tokens per slot per launch) instead of the
+        # one-token mixed step; the drafter follows the auto/force/off
+        # contract of pallas.dispatch.choose_impl.
+        from .. import config as _config
+        if spec_k is None:
+            spec_k = int(_os.environ.get("MXNET_DECODE_SPEC_K", "0") or 0)
+        self._spec_k = max(int(spec_k), 0)
+        self._spec_impl = None
+        self._drafter = None
+        if self._spec_k > 0:
+            raw = (spec_impl if spec_impl is not None
+                   else _os.environ.get("MXNET_DECODE_SPEC_IMPL", "auto"))
+            self._spec_impl = choose_spec_impl(raw,
+                                               draft_params is not None)
+            if self._spec_impl is None:      # MXNET_DECODE_SPEC_IMPL=off
+                self._spec_k = 0
+            else:
+                self._drafter = make_drafter(
+                    self._spec_impl, draft_params, draft_config,
+                    ctx=self._ctx, forced=(raw == "draft"))
+                self._spec_impl = self._drafter.name
+        self._span = self._spec_k + 1
+        if prefix_cache is None:
+            prefix_cache = _config.env_bool("MXNET_DECODE_PREFIX_CACHE",
+                                            default=False)
+        self._prefix_cache = bool(prefix_cache)
+        self._prefix_flush = False    # set by swap_params, drained by _tick
+
+        self.cache = PagedKVCache(num_blocks, bs,
+                                  prefix_sharing=self._prefix_cache)
         self._sched = Scheduler(self.capacity, self.cache,
                                 max_waiting=max_waiting,
                                 admission=admission)
 
-        # --- bind the ONE mixed step at fixed capacity + chunk budget
-        msym = transformer.get_mixed_step_symbol(
-            block_size=bs, num_blocks=int(num_blocks), **self._cfg)
-        self._exe = msym.simple_bind(
-            ctx=self._ctx, grad_req="null", data=(self.capacity, 1),
-            positions=(self.capacity, 1),
-            block_table=(self.capacity, self._table_width),
-            chunk_data=(1, self._chunk_tokens),
-            chunk_positions=(1, self._chunk_tokens),
-            chunk_start=(1,), chunk_len=(1,),
-            chunk_table=(1, self._table_width))
+        # --- bind the ONE step at fixed capacity + chunk budget: the
+        # mixed step (one decode token per slot) or, with speculation
+        # on, the span-verify step (S tokens per slot through the same
+        # chunk-attention primitive — get_spec_step_symbol)
+        if self._spec_k > 0:
+            msym = transformer.get_spec_step_symbol(
+                block_size=bs, num_blocks=int(num_blocks), **self._cfg)
+            self._exe = msym.simple_bind(
+                ctx=self._ctx, grad_req="null",
+                data=(self.capacity, self._span),
+                positions=(self.capacity, self._span),
+                span_start=(self.capacity,),
+                span_len=(self.capacity,),
+                block_table=(self.capacity, self._table_width),
+                chunk_data=(1, self._chunk_tokens),
+                chunk_positions=(1, self._chunk_tokens),
+                chunk_start=(1,), chunk_len=(1,),
+                chunk_table=(1, self._table_width))
+        else:
+            msym = transformer.get_mixed_step_symbol(
+                block_size=bs, num_blocks=int(num_blocks), **self._cfg)
+            self._exe = msym.simple_bind(
+                ctx=self._ctx, grad_req="null", data=(self.capacity, 1),
+                positions=(self.capacity, 1),
+                block_table=(self.capacity, self._table_width),
+                chunk_data=(1, self._chunk_tokens),
+                chunk_positions=(1, self._chunk_tokens),
+                chunk_start=(1,), chunk_len=(1,),
+                chunk_table=(1, self._table_width))
         self._cache_names = []
         for i in range(self._num_layers):
             self._cache_names += ["layer%d_k_cache" % i,
@@ -190,14 +244,13 @@ class DecodeEngine:
         # no whole-cache copy in and out per token (docs/DECODE.md).
         # Block tables/positions are NOT donated: they are rebuilt
         # host-side and fed by copy each iteration.
-        from .. import config as _config
         self._donate = _config.env_bool("MXNET_DECODE_DONATE",
                                         default=True)
         if self._donate:
             self._exe.donate_args(self._cache_names)
         self._inputs = ("data", "positions", "block_table", "chunk_data",
                         "chunk_positions", "chunk_start", "chunk_len",
-                        "chunk_table")
+                        "chunk_table", "span_start", "span_len")
         self._weight_names = [n for n in self._exe.arg_dict
                               if n not in self._inputs
                               and n not in self._cache_names]
@@ -218,6 +271,13 @@ class DecodeEngine:
         self._cache_occ_sum = 0.0
         self._steady_retraces = 0
         self._n_tokens = 0
+        # speculative accounting: slot-iterations vs slot-tokens give
+        # tokens_per_launch (exactly 1.0 without speculation); proposed
+        # vs accepted give the draft acceptance rate
+        self._n_slot_iters = 0
+        self._n_slot_tokens = 0
+        self._n_spec_proposed = 0
+        self._n_spec_accepted = 0
         self._n_completed = 0
         self._n_failed = 0
         self._n_expired = 0
@@ -279,15 +339,28 @@ class DecodeEngine:
         touched)."""
         K = self._chunk_tokens
         M = self._table_width
-        return dict(
-            data=_np.zeros((self.capacity, 1), _np.float32),
-            positions=_np.full((self.capacity, 1), -1.0, _np.float32),
-            block_table=_np.zeros((self.capacity, M), _np.float32),
+        feeds = dict(
             chunk_data=_np.zeros((1, K), _np.float32),
             chunk_positions=_np.zeros((1, K), _np.float32),
             chunk_start=_np.zeros((1,), _np.float32),
             chunk_len=_np.zeros((1,), _np.float32),
             chunk_table=_np.zeros((1, M), _np.float32))
+        if self._spec_k > 0:
+            # span step: span_len == 0 masks a row (chunk-attention
+            # zero-length no-op), positions pad at 0 harmlessly
+            feeds.update(
+                data=_np.zeros((self.capacity, self._span), _np.float32),
+                positions=_np.zeros((self.capacity, self._span),
+                                    _np.float32),
+                span_start=_np.zeros((self.capacity,), _np.float32),
+                span_len=_np.zeros((self.capacity,), _np.float32),
+                block_table=_np.zeros((self.capacity, M), _np.float32))
+        else:
+            feeds.update(
+                data=_np.zeros((self.capacity, 1), _np.float32),
+                positions=_np.full((self.capacity, 1), -1.0, _np.float32),
+                block_table=_np.zeros((self.capacity, M), _np.float32))
+        return feeds
 
     # ------------------------------------------------------------------
     def start(self):
@@ -310,18 +383,20 @@ class DecodeEngine:
             # _warm is shared with the engine thread's _dispatch
             # bookkeeping — every write holds _step_lock
             self._commit_caches(outs, base=4)
-            self._warm.add("mixed")
+            self._warm.add("spec" if self._spec_k > 0 else "mixed")
 
     # ------------------------------------------------------------------
     # client API
     # ------------------------------------------------------------------
     def submit(self, tokens, max_new_tokens=None, eos_id="default",
                timeout_ms=None, temperature=0.0, seed=None, sampler=None,
-               collect_logits=False):
+               collect_logits=False, speculative=True):
         """Queue one generation; returns a :class:`StreamHandle`
         (iterate it for streamed tokens, or ``.result()`` for the full
         output).  Raises ``QueueFullError`` on backpressure and
-        ``MXNetError`` for an inadmissible prompt."""
+        ``MXNetError`` for an inadmissible prompt.  ``speculative=False``
+        opts this request out of draft-verify spans on a spec-enabled
+        engine (it decodes one verified token per iteration)."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise MXNetError("decode: empty prompt")
@@ -352,7 +427,8 @@ class DecodeEngine:
                 else self._default_max_new,
                 eos_id=self._eos if eos_id == "default" else eos_id,
                 deadline=deadline, temperature=temperature, seed=seed,
-                sampler=sampler, collect_logits=collect_logits)
+                sampler=sampler, collect_logits=collect_logits,
+                speculative=speculative)
             seq.submit_step = self._n_steps   # steps-to-first-token base
             self._sched.enqueue(seq)          # may raise QueueFullError
             if _tracing.enabled():
@@ -415,6 +491,16 @@ class DecodeEngine:
 
     def _tick(self):
         """One scheduler iteration; returns False when nothing ran."""
+        with self._step_lock:
+            flush, self._prefix_flush = self._prefix_flush, False
+        if flush:
+            # deferred from swap_params: the trie's cached rows were
+            # computed under the OLD weights.  Flushing here (engine
+            # thread, before this tick's admissions) keeps the cache
+            # single-owner; the transient is the same mixed-version
+            # window hot reload already accepts for mid-prefill
+            # sequences (swap_params docstring).
+            self.cache.flush_prefixes()
         now = time.monotonic()
         with self._cv:
             expired = self._sched.take_expired_waiting(now)
@@ -496,7 +582,10 @@ class DecodeEngine:
         active = self._sched.active()
         ACTIVE_SEQS.set(len(active))
         if active:
-            self._step(active, chunk_seq, chunk_len)
+            if self._spec_k > 0:
+                self._step_spec(active, chunk_seq, chunk_len)
+            else:
+                self._step(active, chunk_seq, chunk_len)
             progressed = True
         return progressed
 
@@ -570,6 +659,17 @@ class DecodeEngine:
         seq.prefill_target = P
         seq.n_prefilled = 0
         seq.pos = 0
+        # prefix-cache hit: adopt the trie's already-prefilled blocks
+        # (COW — acquire_prefix increfs them for this sequence) and
+        # start chunked prefill at the first unshared row.  At most
+        # (P-1)//block_size blocks can match, so at least one prompt
+        # token always prefills and the chunk head still emits the
+        # sequence's first token.
+        if self._prefix_cache and not seq.blocks:
+            shared, rows = self.cache.acquire_prefix(seq.tokens[:P])
+            if shared:
+                seq.blocks = list(shared)
+                seq.n_prefilled = rows
         self._n_prefills += 1
         PREFILLS.inc()
         with self._cv:
@@ -631,25 +731,7 @@ class DecodeEngine:
         self._cache_occ_sum += self.cache.occupancy
         STEPS.inc()
         if chunk_seq is not None:
-            chunk_seq.n_prefilled += chunk_len
-            self._n_prefill_chunks += 1
-            PREFILL_CHUNKS.inc()
-            if chunk_seq.n_prefilled >= chunk_seq.prefill_target:
-                # last chunk landed: the chunk head's greedy token (or
-                # logits row) is this sequence's FIRST token
-                chunk_seq.pos = chunk_seq.prefill_target
-                if chunk_seq.prefill_span is not None:
-                    chunk_seq.prefill_span.end()
-                    chunk_seq.prefill_span = None
-                # per-sequence containment: a bad user sampler must
-                # fail ONLY its own stream, never the engine
-                try:
-                    tok = self._pick_token(chunk_seq, outs, 0, base=2)
-                except Exception as exc:   # noqa: BLE001
-                    self._finish(chunk_seq, error=exc)
-                else:
-                    self._emit(chunk_seq, tok)
-                    self._maybe_finish(chunk_seq, tok)
+            self._advance_chunk(chunk_seq, chunk_len, outs)
         # ONE host copy of the (capacity, vocab) logits per step, shared
         # by every sampling/temperature/collect_logits sequence (rows
         # are per-slot, so a misbehaving user sampler can only touch its
@@ -666,14 +748,221 @@ class DecodeEngine:
             next_host = outs[1].asnumpy()
         for slot, seq in decoding:
             seq.pos += 1
+            self._n_slot_iters += 1
             try:
                 tok = self._pick_token(seq, outs, slot, logits_host,
                                        next_host)
             except Exception as exc:   # noqa: BLE001 — user sampler;
                 self._finish(seq, error=exc)   # contain to this stream
                 continue
+            self._n_slot_tokens += 1
             self._emit(seq, tok)
             self._maybe_finish(seq, tok)
+        if it_spans:
+            for sp in it_spans:
+                sp.end()
+        if self._watchdog is not None:
+            self._watchdog.end()
+        STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+
+    def _advance_chunk(self, chunk_seq, chunk_len, outs):
+        """Account this iteration's prefill chunk; on the LAST chunk,
+        publish sharable full blocks into the prefix trie and emit the
+        sequence's first token from the chunk head (outputs base 2)."""
+        chunk_seq.n_prefilled += chunk_len
+        self._n_prefill_chunks += 1
+        PREFILL_CHUNKS.inc()
+        if chunk_seq.n_prefilled < chunk_seq.prefill_target:
+            return
+        # last chunk landed: the chunk head's greedy token (or
+        # logits row) is this sequence's FIRST token
+        chunk_seq.pos = chunk_seq.prefill_target
+        if chunk_seq.prefill_span is not None:
+            chunk_seq.prefill_span.end()
+            chunk_seq.prefill_span = None
+        if self._prefix_cache:
+            # publish the finished prefill's FULL blocks for COW reuse
+            # (the trie takes its own reference on each; the partial
+            # tail block is never shared, so generation writes stay
+            # exclusive by construction)
+            self.cache.register_prefix(
+                chunk_seq.tokens[:chunk_seq.prefill_target],
+                chunk_seq.prefill_target, chunk_seq.blocks)
+        # per-sequence containment: a bad user sampler must
+        # fail ONLY its own stream, never the engine
+        try:
+            tok = self._pick_token(chunk_seq, outs, 0, base=2)
+        except Exception as exc:   # noqa: BLE001
+            self._finish(chunk_seq, error=exc)
+        else:
+            self._emit(chunk_seq, tok)
+            self._maybe_finish(chunk_seq, tok)
+
+    def _fork_block(self, seq, idx):
+        """COW safety valve: give ``seq`` a private copy of table entry
+        ``idx`` when that block is shared.  Device-side row copy (one
+        eager op per cache array, never on the steady-state step path:
+        full-blocks-only sharing means the engine's writes always land
+        past every shared row, so this triggers only through direct
+        cache manipulation)."""
+        old = seq.blocks[idx]
+        new = self.cache.fork_for_write(old)
+        if new is None:
+            return
+        for nd in self._cache_arrs:
+            nd._set_data(nd._data.at[new].set(nd._data[old]))
+        seq.blocks[idx] = new
+
+    def _step_spec(self, active, chunk_seq=None, chunk_len=0):
+        """One draft-verify iteration (docs/DECODE.md): propose up to
+        ``spec_k`` tokens per decoding slot, verify every span in ONE
+        compiled donated launch of the span step, and commit the
+        longest draft prefix that matches the target model's own greedy
+        tokens.  Greedy acceptance keeps the stream token-identical to
+        non-speculative decoding by construction — draft token j
+        commits only when it equals greedy output j-1, so every emitted
+        token is the argmax the one-token engine would have produced.
+        A rejected tail rolls back by CURSOR arithmetic alone: the next
+        span's scatter overwrites rows from the new ``pos`` before its
+        gather, and surviving stale rows sit at positions above every
+        query's causal mask (rollback math in docs/DECODE.md)."""
+        t0 = time.perf_counter()
+        if self._watchdog is not None:
+            self._watchdog.begin()
+        it_spans = None
+        if _tracing.enabled():
+            it_spans = [
+                _tracing.start_span(
+                    "decode.iteration",
+                    parent=getattr(s.trace_span, "context", None),
+                    step=self._n_steps, slot=slot, pos=s.pos)
+                for slot, s in active if s.trace_span is not None]
+        if chunk_seq is not None and chunk_seq.slot is None:
+            chunk_seq, chunk_len = None, 0   # preempted after selection
+        decoding = [(slot, seq) for slot, seq in active
+                    if seq.n_prefilled >= seq.prefill_target]
+        S = self._span
+        bs = self.cache.block_size
+        vocab = int(self._cfg.get("num_classes", 0))
+        data = _np.zeros((self.capacity, S), _np.float32)
+        pos = _np.zeros((self.capacity, S), _np.float32)
+        sstart = _np.zeros((self.capacity,), _np.float32)
+        slen = _np.zeros((self.capacity,), _np.float32)
+        table = _np.zeros((self.capacity, self._table_width), _np.float32)
+        drafts = {}
+        for slot, seq in decoding:
+            draft = []
+            # budget: the span's rows must fit the context, and tokens
+            # past this stream's length stop are wasted verification
+            budget = min(self._spec_k,
+                         self._max_context - seq.pos - 1,
+                         seq.max_new_tokens - seq.n_generated - 1)
+            if (budget > 0 and seq.speculative
+                    and not self._needs_logits(seq)):
+                try:
+                    draft = [int(t) for t in
+                             self._drafter.propose(seq.tokens, budget)]
+                except Exception:   # noqa: BLE001 — a drafter bug costs
+                    draft = []      # speedup, never a stream
+                draft = [t for t in draft[:budget] if 0 <= t < vocab]
+            # opportunistic span-block growth: row seq.pos is already
+            # guaranteed by _tick's _ensure_blocks; extra draft rows
+            # TRIM on pressure instead of preempting (the `active`
+            # snapshot must stay placed through this step)
+            L = 1 + len(draft)
+            while (seq.pos + L - 1) // bs >= len(seq.blocks):
+                try:
+                    seq.blocks += self.cache.alloc(1)
+                except CacheOOMError:
+                    L = min(1 + len(draft),
+                            max(1, len(seq.blocks) * bs - seq.pos))
+                    draft = draft[:L - 1]
+                    break
+            # COW guard: fork any shared block the span would write
+            for bi in range(seq.pos // bs, (seq.pos + L - 1) // bs + 1):
+                if self.cache.ref(seq.blocks[bi]) > 1:
+                    self._fork_block(seq, bi)
+            drafts[slot] = draft
+            data[slot, :L] = [seq.last_token] + draft
+            pos[slot, :L] = _np.arange(seq.pos, seq.pos + L)
+            sstart[slot] = seq.pos
+            slen[slot] = L
+            table[slot, :len(seq.blocks)] = seq.blocks
+            if draft:
+                self._n_spec_proposed += len(draft)
+                SPEC_PROPOSED.inc(len(draft))
+        K = self._chunk_tokens
+        cdata = _np.zeros((1, K), _np.float32)
+        cpos = _np.zeros((1, K), _np.float32)
+        cstart = _np.zeros((1,), _np.float32)
+        clen = _np.zeros((1,), _np.float32)
+        ctable = _np.zeros((1, self._table_width), _np.float32)
+        if chunk_seq is not None:
+            s0 = chunk_seq.n_prefilled
+            cdata[0, :chunk_len] = chunk_seq.tokens[s0:s0 + chunk_len]
+            cpos[0, :chunk_len] = _np.arange(s0, s0 + chunk_len)
+            cstart[0] = s0
+            clen[0] = chunk_len
+            ctable[0, :len(chunk_seq.blocks)] = chunk_seq.blocks
+        with self._step_lock:
+            outs, dd = self._dispatch(
+                self._exe, "spec", data=data, positions=pos,
+                span_start=sstart, span_len=slen, block_table=table,
+                chunk_data=cdata, chunk_positions=cpos,
+                chunk_start=cstart, chunk_len=clen, chunk_table=ctable)
+            self._commit_caches(outs, base=4)
+        self._n_steps += 1
+        self._n_step_dispatches += dd
+        self._occ_sum += len(active)
+        self._cache_occ_sum += self.cache.occupancy
+        STEPS.inc()
+        if chunk_seq is not None:
+            self._advance_chunk(chunk_seq, chunk_len, outs)
+        # same readback discipline as the mixed step: ONE logits copy
+        # shared by every sampling slot, ONE greedy-token copy for the
+        # whole step — span rows are (slot * S + j)
+        logits_host = None
+        if any(self._needs_logits(s) for _, s in decoding):
+            # analyze: ok(hostsync) the step's ONE logits readback, shared by every sampling/temperature slot (documented in the module doc)
+            logits_host = outs[0].asnumpy()
+        next_host = None
+        if decoding:
+            # analyze: ok(hostsync) the greedy-token readback IS the streamed response — the documented one sync per decode iteration
+            next_host = outs[1].asnumpy()
+        for slot, seq in decoding:
+            draft = drafts.get(slot, [])
+            L = 1 + len(draft)
+            self._n_slot_iters += 1
+            accepted = 0
+            for j in range(L):
+                # row j is the target's verdict GIVEN span tokens
+                # 0..j; it is reached only while every earlier draft
+                # token matched the target's greedy choice
+                seq.pos += 1
+                try:
+                    tok = self._pick_token(seq, outs, slot * S + j,
+                                           logits_host, next_host)
+                except Exception as exc:   # noqa: BLE001 — user
+                    self._finish(seq, error=exc)   # sampler: contain
+                    break
+                self._n_slot_tokens += 1
+                if j > 0:
+                    accepted += 1
+                self._emit(seq, tok)
+                self._maybe_finish(seq, tok)
+                if seq.slot is None:
+                    break                  # finished mid-span
+                if j < L - 1 and draft[j] != tok:
+                    break                  # tail rejected: cursor stays
+            if accepted:
+                self._n_spec_accepted += accepted
+                SPEC_ACCEPTED.inc(accepted)
+        if self._n_spec_proposed:
+            ACCEPT_RATE.set(self._n_spec_accepted
+                            / float(self._n_spec_proposed))
+        if self._n_slot_iters:
+            TOKENS_PER_LAUNCH.set(self._n_slot_tokens
+                                  / float(self._n_slot_iters))
         if it_spans:
             for sp in it_spans:
                 sp.end()
@@ -806,6 +1095,15 @@ class DecodeEngine:
                 dst._set_data(jax.device_put(data, self._ctx.jax_device))
             if version is not None:
                 self._model_version = version
+        if self._prefix_cache:
+            # the trie's cached rows were computed under the replaced
+            # weights; the engine thread flushes at its next tick (the
+            # cache stays single-owner).  An engine that never started
+            # has no owner thread — flush inline.
+            with self._step_lock:
+                self._prefix_flush = self._thread is not None
+            if self._thread is None:
+                self.cache.flush_prefixes()
         RELOADS.inc()
 
     def reload(self, prefix, tag=None, epoch=None):
@@ -898,11 +1196,26 @@ class DecodeEngine:
             "model_version": self._model_version,
             "attn_impl": _paged_attn_impl(),
             "cache_donation": self._donate,
+            "spec_k": self._spec_k,
+            "spec_impl": self._spec_impl,
+            "spec_proposed": self._n_spec_proposed,
+            "spec_accepted": self._n_spec_accepted,
+            "accept_rate": (self._n_spec_accepted
+                            / self._n_spec_proposed
+                            if self._n_spec_proposed else None),
+            "tokens_per_launch": (self._n_slot_tokens
+                                  / self._n_slot_iters
+                                  if self._n_slot_iters else None),
             "cache": {
                 "num_blocks": self.cache.num_blocks,
                 "block_size": self.cache.block_size,
                 "blocks_used": self.cache.used_count,
                 "blocks_free": self.cache.free_count,
                 "occupancy": round(self.cache.occupancy, 4),
+                "prefix_sharing": self._prefix_cache,
+                "prefix_hit_blocks":
+                    self.cache.prefix_stats["hit_blocks"],
+                "prefix_trie_blocks":
+                    self.cache.prefix_stats["trie_blocks"],
             },
         }

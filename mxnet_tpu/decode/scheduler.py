@@ -114,7 +114,7 @@ class Sequence:
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id=None,
                  deadline=None, temperature=0.0, sampler=None, seed=None,
-                 collect_logits=False):
+                 collect_logits=False, speculative=True):
         self.rid = rid
         self.tokens = list(int(t) for t in prompt)   # prompt + generated
         self.n_prompt = len(self.tokens)             # original prompt size
@@ -127,6 +127,12 @@ class Sequence:
         self.handle = StreamHandle(rid)
         if collect_logits:
             self.handle.logits = []
+        # per-request speculative opt-out (docs/DECODE.md): False pins
+        # this stream to one verified token per iteration even on a
+        # spec-enabled engine.  Sampling/temperature/collect_logits
+        # streams are excluded from drafting automatically either way —
+        # greedy acceptance is exact only for greedy streams.
+        self.speculative = bool(speculative)
         self._rng = None
         # engine-owned placement state
         self.slot = None

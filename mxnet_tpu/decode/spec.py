@@ -1,0 +1,224 @@
+"""mx.speculative — draft proposers for draft-verify decoding.
+
+Speculative decoding (Leviathan et al., 2023) splits each serving
+iteration into a cheap PROPOSE and an exact VERIFY: a drafter guesses
+the next K tokens of a stream, the target model scores all K+1
+positions in one launch, and the longest prefix of the draft that
+matches the target's own greedy choices is committed.  Under greedy
+acceptance every emitted token is *by construction* a token the target
+model would have produced one-at-a-time — speculation changes tokens
+per launch, never the stream (docs/DECODE.md, "Speculative decoding &
+prefix sharing").
+
+This module is the PROPOSE half.  The VERIFY half is the engine's
+spec step (``engine.DecodeEngine._step_spec``), which rides the same
+chunk-attention primitive as chunked prefill
+(``_contrib_PagedChunkPrefillAttention`` — a span of new tokens
+attending a live paged cache with per-row starts) batched across all
+slots, so verification costs ONE compiled donated launch per iteration
+exactly like plain decoding.
+
+Two drafters ship:
+
+* :class:`NGramDrafter` (default) — self-speculative prompt lookup
+  (Saxena, 2023): match the stream's trailing n-gram against its own
+  earlier tokens and propose the historical continuation.  Zero extra
+  launches, zero extra weights; shines exactly where serving is
+  repetitive (summarization, code edit, RAG quoting its context).
+  A miss proposes nothing and the iteration degrades to plain
+  one-token decoding — never worse than baseline launches.
+* :class:`DraftModelDrafter` — a small draft transformer loaded
+  through the ordinary checkpoint machinery (same weight-name
+  contract as the target).  Proposes with K sequential forwards of
+  the draft net, so it ADDS launches outside the engine's
+  one-launch-per-iteration witness — worth it only when the draft
+  model is much cheaper than the target and acceptance is high.
+  Tier-1 pins the mechanism, not the economics.
+
+Implementation selection follows the kernel-knob contract of
+``pallas.dispatch.choose_impl`` (``MXNET_DECODE_SPEC_IMPL`` =
+``auto|ngram|draft|off``): ``auto`` picks the draft model when a
+checkpoint was provided and n-gram otherwise; forcing ``draft``
+without a checkpoint raises instead of silently measuring the wrong
+path; a draft model that fails to load under ``auto`` falls back to
+n-gram, bumps ``decode_spec_fallbacks`` and leaves a flight-recorder
+note (``spec_drafter_fallback``).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..telemetry import REGISTRY
+from ..telemetry.flight import RECORDER
+
+__all__ = ["Drafter", "NGramDrafter", "DraftModelDrafter",
+           "choose_spec_impl", "make_drafter"]
+
+SPEC_PROPOSED = REGISTRY.counter(
+    "decode_spec_proposed", "draft tokens proposed for verification")
+SPEC_ACCEPTED = REGISTRY.counter(
+    "decode_spec_accepted", "draft tokens accepted by target-model "
+    "verification (committed to streams)")
+SPEC_FALLBACKS = REGISTRY.counter(
+    "decode_spec_fallbacks", "auto-mode draft-model selections that "
+    "fell back to the n-gram drafter, labeled by `reason`")
+ACCEPT_RATE = REGISTRY.gauge(
+    "decode_accept_rate", "accepted/proposed draft-token ratio over "
+    "the engine's lifetime", unit="ratio")
+TOKENS_PER_LAUNCH = REGISTRY.gauge(
+    "decode_tokens_per_launch", "tokens committed per compiled decode "
+    "launch (1.0 = non-speculative)", unit="tokens")
+
+
+def choose_spec_impl(impl, has_draft_model, *, env_var="MXNET_DECODE_SPEC_IMPL"):
+    """Resolve the drafter implementation knob.
+
+    ``impl`` is the raw knob value (the CALLER reads the env var with a
+    literal name so the envknobs analyze pass sees the site); returns
+    ``"ngram"``, ``"draft"`` or ``None`` (speculation off).  Mirrors
+    ``pallas.dispatch.choose_impl``: forcing ``draft`` without a draft
+    checkpoint raises — never silently measure the wrong path.
+    """
+    if impl == "off":
+        return None
+    if impl not in ("auto", "ngram", "draft"):
+        raise ValueError("%s=%s; use auto|ngram|draft|off"
+                         % (env_var, impl))
+    if impl == "draft":
+        if not has_draft_model:
+            raise ValueError(
+                "%s=draft but no draft checkpoint was provided "
+                "(DecodeEngine(draft_params=..., draft_config=...))"
+                % env_var)
+        return "draft"
+    if impl == "ngram":
+        return "ngram"
+    return "draft" if has_draft_model else "ngram"
+
+
+class Drafter:
+    """Proposer interface: ``propose(tokens, k)`` returns up to ``k``
+    guessed continuation ids for a stream whose full history (prompt +
+    generated) is ``tokens``.  Proposals are *hints* — the verify step
+    accepts only the prefix that matches the target model's own greedy
+    argmax, so a bad drafter costs speedup, never correctness."""
+
+    name = "null"
+
+    def propose(self, tokens, k):
+        return []
+
+
+class NGramDrafter(Drafter):
+    """Self-speculative prompt lookup: find the most recent earlier
+    occurrence of the stream's trailing n-gram (longest ``n`` in
+    ``[min_n, max_n]`` wins) and propose the tokens that followed it.
+
+    Pure host-side integer matching — no device work, no extra
+    weights, and no second tokenizer contract.  Window-bounded so a
+    very long stream costs O(window) per proposal, not O(history).
+    """
+
+    name = "ngram"
+
+    def __init__(self, max_n=3, min_n=1, window=1024):
+        if not (1 <= int(min_n) <= int(max_n)):
+            raise ValueError("NGramDrafter: need 1 <= min_n <= max_n")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+        self.window = int(window)
+
+    def propose(self, tokens, k):
+        k = int(k)
+        hist = [int(t) for t in tokens[-self.window:]]
+        n_hist = len(hist)
+        for n in range(min(self.max_n, n_hist - 1), self.min_n - 1, -1):
+            tail = hist[n_hist - n:]
+            # most recent earlier occurrence wins: recency beats length
+            # ties at a given n, and longer n is tried first
+            for i in range(n_hist - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    cont = hist[i + n:i + n + k]
+                    if cont:
+                        return cont
+        return []
+
+
+class DraftModelDrafter(Drafter):
+    """Draft-transformer proposer: a (small) checkpoint bound through
+    the ordinary training symbol, run autoregressively for ``k`` greedy
+    steps per proposal.
+
+    The forward reuses ``models.transformer.get_symbol`` at a fixed
+    ``(1, seq_len)`` geometry (one compile, zero steady-state
+    retraces); history is left-aligned and zero-padded, and causal
+    masking makes the padded tail invisible to the rows we read.  Each
+    ``propose`` costs ``k`` draft-net launches — honest accounting:
+    these are OUTSIDE the engine's one-launch-per-iteration witness,
+    which covers the target model's verify step only (module
+    docstring).
+    """
+
+    name = "draft"
+
+    def __init__(self, arg_params, model_config, ctx=None):
+        from ..context import current_context
+        from ..models import transformer
+        from ..ndarray.ndarray import NDArray
+
+        cfg = dict(model_config)
+        cfg.pop("dropout", None)
+        self._seq_len = int(cfg.get("seq_len", 1024))
+        sym = transformer.get_symbol(**cfg)
+        self._exe = sym.simple_bind(
+            ctx=ctx if ctx is not None else current_context(),
+            grad_req="null", data=(1, self._seq_len),
+            softmax_label=(self._seq_len,))
+        want = set(sym.list_arguments()) - {"data", "softmax_label"}
+        missing = [n for n in sorted(want) if n not in arg_params]
+        if missing:
+            raise ValueError("draft checkpoint missing params: %s"
+                             % ", ".join(missing[:4]))
+        self._exe.copy_params_from(
+            # analyze: ok(hostsync) draft checkpoint staged host->device once at drafter construction, not on the serving step path
+            {k: v if isinstance(v, NDArray) else NDArray(_np.asarray(v))
+             for k, v in arg_params.items() if k in want}, {},
+            allow_extra_params=True)
+
+    def propose(self, tokens, k):
+        hist = [int(t) for t in tokens]
+        out = []
+        for _ in range(int(k)):
+            ctx_toks = hist[-self._seq_len:]
+            n = len(ctx_toks)
+            if n == 0:
+                break
+            data = _np.zeros((1, self._seq_len), _np.float32)
+            data[0, :n] = ctx_toks
+            probs = self._exe.forward(is_train=False, data=data)[0]
+            # analyze: ok(hostsync) draft-net argmax readback is the drafter's output; it happens outside the target model's one-launch step
+            nxt = int(_np.argmax(probs.asnumpy()[n - 1]))
+            out.append(nxt)
+            hist.append(nxt)
+        return out
+
+
+def make_drafter(impl, draft_params=None, draft_config=None, ctx=None,
+                 forced=False):
+    """Instantiate the resolved drafter.  Under ``auto``
+    (``forced=False``) a draft checkpoint that fails to load degrades
+    to the n-gram drafter (counter + flight-recorder note) instead of
+    killing the engine; a FORCED draft model propagates the error —
+    the three-knob contract (never silently measure the wrong path)."""
+    if impl is None:
+        return None
+    if impl == "ngram":
+        return NGramDrafter()
+    try:
+        return DraftModelDrafter(draft_params, draft_config, ctx=ctx)
+    except Exception as exc:
+        if forced:
+            raise
+        SPEC_FALLBACKS.labels(reason="load_error").inc()
+        RECORDER.note("spec_drafter_fallback", error=str(exc)[:200])
+        return NGramDrafter()

@@ -484,3 +484,140 @@ def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
                            name="c_cast_out")
     cnxt = sym.argmax(clogits, axis=1, name="c_greedy_token")
     return sym.Group([flat, nxt, clogits, cnxt] + new_kv)
+
+
+def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
+                         num_heads=16, ffn_dim=None, seq_len=1024,
+                         dtype="float32", block_size=16, num_blocks=64,
+                         moe_experts=0, moe_every=2, **kwargs):
+    """The mixed step generalized to draft-verify spans (speculative
+    decoding, docs/DECODE.md): instead of ONE token per slot, every
+    iteration scores an S-token span per slot — the slot's last
+    committed token followed by up to S-1 draft tokens — so the engine
+    can accept several tokens from a single compiled, donated launch.
+
+    The decode stream of `get_mixed_step_symbol` is replaced by a SPAN
+    stream built on the same chunk-attention primitive the prompt
+    chunk uses (``PagedChunkPrefillAttention`` is B-row capable:
+    per-row start/length, zero-length rows are no-ops), batched across
+    all C slots:
+
+    * span stream — ``data`` (C, S) span token ids (row r holds the
+      slot's last token then its draft; tail padded), ``positions``
+      (C, S) absolute positions (pad rows 0 — harmless, masked by
+      length), ``span_start`` (C,) each row's absolute cache offset,
+      ``span_len`` (C,) real span tokens (0 = inactive slot),
+      ``block_table`` (C, M); per layer the span scatters its K/V at
+      positions ``span_start + j`` and attends causally against the
+      slot's whole cache prefix — exactly verification: row j's logits
+      condition on every committed token plus draft tokens < j;
+    * chunk stream — unchanged from the mixed step (chunked prefill
+      continues to ride along), reading the cache AFTER the span
+      scatter in the same donated buffer chain.
+
+    With S == 1 the span stream degenerates to exactly one token per
+    slot — plain decoding through the chunk-attention primitive.
+    Rejected draft rows leave K/V entries above the accepted prefix;
+    they are dead by construction: the next iteration's span starts at
+    the first rejected position and its scatter overwrites those rows
+    before any query can attend them (scatter-then-gather inside the
+    op, causal mask ``j <= pos``).
+
+    Outputs: ``[span logits (C*S, vocab), span greedy tokens (C*S,),
+    chunk last-token logits (1, vocab), chunk greedy token (1,),
+    new caches...]`` — same base layout as the mixed step, so the
+    engine's cache-commit and chunk-completion paths are shared.  Row
+    ``r*S + j`` is slot r, span offset j; greedy token at offset j is
+    the target model's choice for position ``span_start + j + 1``.
+    """
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    H = int(num_heads)
+    D = d // H
+
+    data = sym.Variable("data")                      # (C, S) span ids
+    positions = sym.Variable("positions")            # (C, S) absolute
+    sstart = sym.Variable("span_start")              # (C,)
+    slen = sym.Variable("span_len")                  # (C,) 0 = inactive
+    table = sym.Variable("block_table")              # (C, M)
+    cdata = sym.Variable("chunk_data")               # (1, K) chunk ids
+    cpos = sym.Variable("chunk_positions")           # (1, K) absolute
+    cstart = sym.Variable("chunk_start")             # (1,)
+    clen = sym.Variable("chunk_len")                 # (1,)
+    ctable = sym.Variable("chunk_table")             # (1, M)
+
+    tokw = sym.Variable("tok_embed_weight")
+    pos_w = sym.Variable("pos_embed_weight", shape=(1, int(seq_len), d))
+    pos_flat = sym.Reshape(pos_w, shape=(int(seq_len), d))
+
+    tok = sym.Embedding(data, tokw, input_dim=vocab, output_dim=d,
+                        name="tok_embed")
+    x = tok + sym.take(pos_flat, positions, name="pos_take")
+    ctok = sym.Embedding(cdata, tokw, input_dim=vocab, output_dim=d,
+                         name="c_tok_embed")
+    xc = ctok + sym.take(pos_flat, cpos, name="c_pos_take")
+    if dtype in ("float16", "bfloat16"):
+        x = sym.Cast(data=x, dtype=dtype, name="cast_embed")
+        xc = sym.Cast(data=xc, dtype=dtype, name="c_cast_embed")
+
+    new_kv = []
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        attn_vars = _decode_trunk_vars(pre)
+        ln1_g = sym.Variable(pre + "ln1_gamma")
+        ln1_b = sym.Variable(pre + "ln1_beta", init=_init.Zero())
+        kc = sym.Variable(pre + "k_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+        vc = sym.Variable(pre + "v_cache",
+                          shape=(int(num_blocks), int(block_size), H, D))
+
+        ln1 = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
+                            name=pre + "ln1")
+        att = sym.contrib.PagedChunkPrefillAttention(
+            ln1, *attn_vars, kc, vc, table, sstart, slen,
+            num_heads=H, name=pre + "attn")
+        x = x + att[0]
+
+        # chunk reads/writes the cache AFTER the span scatter — one
+        # coherent donated chain; a sequence is either prefilling or
+        # decoding, never both in one launch, so the streams never
+        # alias a block (prefix-shared blocks are read-only in both)
+        cln1 = sym.LayerNorm(data=xc, gamma=ln1_g, beta=ln1_b,
+                             name=pre + "c_ln1")
+        catt = sym.contrib.PagedChunkPrefillAttention(
+            cln1, *attn_vars, att[1], att[2], ctable, cstart, clen,
+            num_heads=H, name=pre + "c_attn")
+        xc = xc + catt[0]
+        new_kv += [catt[1], catt[2]]
+
+        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i)
+        x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i,
+                            shared=shared)
+        xc = xc + _decode_ffn(xc, pre, d, ffn, moe_experts, moe_every, i,
+                              shared=shared, tag="c_")
+
+    lnf_g = sym.Variable("ln_f_gamma")
+    lnf_b = sym.Variable("ln_f_beta", init=_init.Zero())
+    lmw = sym.Variable("lm_head_weight")
+    lmb = sym.Variable("lm_head_bias", init=_init.Zero())
+
+    x = sym.LayerNorm(data=x, gamma=lnf_g, beta=lnf_b, name="ln_f")
+    logits = sym.FullyConnected(data=x, weight=lmw, bias=lmb,
+                                num_hidden=vocab, flatten=False,
+                                name="lm_head")      # (C, S, vocab)
+    if dtype in ("float16", "bfloat16"):
+        logits = sym.Cast(data=logits, dtype="float32", name="cast_out")
+    flat = sym.Reshape(data=logits, shape=(-1, vocab), name="logits_2d")
+    nxt = sym.argmax(flat, axis=1, name="greedy_token")
+
+    xc = sym.LayerNorm(data=xc, gamma=lnf_g, beta=lnf_b, name="c_ln_f")
+    clast = sym.contrib.GatherTimestep(xc, clen - 1, name="c_last_token")
+    clogits = sym.FullyConnected(data=clast, weight=lmw, bias=lmb,
+                                 num_hidden=vocab, flatten=False,
+                                 name="c_lm_head")   # (1, vocab)
+    if dtype in ("float16", "bfloat16"):
+        clogits = sym.Cast(data=clogits, dtype="float32",
+                           name="c_cast_out")
+    cnxt = sym.argmax(clogits, axis=1, name="c_greedy_token")
+    return sym.Group([flat, nxt, clogits, cnxt] + new_kv)

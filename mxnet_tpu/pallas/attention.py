@@ -370,7 +370,15 @@ def paged_chunk_prefill_attend(q, k, v, k_cache, v_cache, block_table,
     caches.  Rows past ``lengths[b]`` are padding: never scattered,
     outputs don't-care.  ``lengths[b] == 0`` makes row ``b`` a no-op
     (block 0 is re-emitted byte-identically).  Returns
-    ``(out (B, K, H, D), new_k_cache, new_v_cache)``."""
+    ``(out (B, K, H, D), new_k_cache, new_v_cache)``.
+
+    The per-row start/length geometry makes this kernel double as the
+    VERIFY step of speculative decoding (docs/DECODE.md): the engine's
+    span step batches one draft span per slot — row ``b`` holds a
+    slot's last committed token plus its draft, ``start[b]`` its cache
+    cursor — so scoring K+1 positions for every slot costs the same
+    single launch as one prompt chunk.  Nothing here is spec-specific:
+    the span IS a chunk that happens to contain unverified tokens."""
     B, K, H, D = q.shape
     bs = k_cache.shape[1]
     M = block_table.shape[1]

@@ -614,7 +614,10 @@ class ModelServer:
                 """POST /generate — streamed autoregressive generation
                 through the attached mx.decode engine.  Body:
                 ``{"tokens": [...], "max_new_tokens": n, "stream": true,
-                "eos_id"/"temperature"/"timeout_ms"/"seed": optional}``.
+                "eos_id"/"temperature"/"timeout_ms"/"seed": optional,
+                "speculative": false}`` — the last opts one request out
+                of draft-verify spans on a spec-enabled engine
+                (docs/DECODE.md).
                 Streaming replies are chunked JSON-lines: one
                 ``{"index": i, "token": t}`` object per generated token
                 and a final ``{"done": true, ...}`` summary line (an
@@ -640,7 +643,9 @@ class ModelServer:
                         max_new_tokens=doc.get("max_new_tokens"),
                         timeout_ms=doc.get("timeout_ms"),
                         temperature=float(doc.get("temperature", 0.0)),
-                        seed=doc.get("seed"), **kwargs)
+                        seed=doc.get("seed"),
+                        speculative=bool(doc.get("speculative", True)),
+                        **kwargs)
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e), "type": "queue_full"})
                     return

@@ -1,0 +1,380 @@
+"""mx.speculative — draft-verify decoding + COW prefix cache sharing.
+
+Covers ISSUE 16:
+
+* refcounted paged allocator (incref/decref, double-free guard,
+  shared-block census counted once);
+* copy-on-write prefix sharing (trie acquire/register, fork-on-write
+  isolation, sharer-safe free, occupancy dedup, trie flush);
+* draft-verify decoding: greedy streams BIT-IDENTICAL to the
+  non-speculative engine (the acceptance rule only ever emits the
+  argmax the one-token engine would produce), tokens_per_launch > 1,
+  zero steady-state retraces at exactly one dispatch per iteration;
+* drafters: n-gram prompt-lookup unit behavior, draft-model
+  mechanism, the ``MXNET_DECODE_SPEC_IMPL`` selection contract;
+* semantics riders: sampling slots and ``speculative=False`` requests
+  ride span_len=1 (no proposals), EOS fires mid-span identically.
+
+Engines here are tiny (2 layers, d16) so CPU compiles stay cheap;
+stream-identity checks compare whole token lists, which pins the
+kernel-vs-decode numerics end to end.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.decode import (CacheOOMError, DecodeEngine, NGramDrafter,
+                              PagedKVCache, choose_spec_impl)
+from mxnet_tpu.models import transformer
+
+SEQ = 48
+CFG = dict(num_classes=50, num_layers=2, d_model=16, num_heads=2,
+           seq_len=SEQ)
+
+# prompts with repeated n-grams (drafter hits) and without (drafter
+# misses) — identity must hold either way
+PROMPTS = [[3, 7, 11, 3, 7, 11, 3, 7],
+           [1, 2, 3, 4, 5],
+           [9, 9, 9, 9],
+           [42, 17, 42, 17, 42]]
+
+
+@pytest.fixture(scope="module")
+def model():
+    tsym = transformer.get_symbol(**CFG)
+    arg_shapes, _, _ = tsym.infer_shape(data=(1, SEQ), softmax_label=(SEQ,))
+    rng = np.random.RandomState(7)
+    params = {n: rng.normal(0, 0.1, s).astype(np.float32)
+              for n, s in zip(tsym.list_arguments(), arg_shapes)
+              if n not in ("data", "softmax_label")}
+    return {"params": params}
+
+
+@pytest.fixture(scope="module")
+def baseline(model):
+    """Non-speculative oracle engine + its greedy streams."""
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True)
+    streams = [eng.generate(p, max_new_tokens=10, timeout=120)
+               for p in PROMPTS]
+    yield {"eng": eng, "streams": streams}
+    eng.stop()
+
+
+@pytest.fixture(scope="module")
+def spec_engine(model):
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True,
+                       spec_k=3, spec_impl="ngram", prefix_cache=True)
+    yield eng
+    eng.stop()
+
+
+# ----------------------------------------------------------------------
+# refcounted allocator
+# ----------------------------------------------------------------------
+def test_allocator_refcounts_and_guards():
+    c = PagedKVCache(num_blocks=8, block_size=4)
+    blocks = c.alloc(2)
+    b = blocks[0]
+    assert c.ref(b) == 1
+    c.incref(b)
+    assert c.ref(b) == 2
+    c.free([b])                               # decref: still allocated
+    assert c.ref(b) == 1 and c.used_count == 2
+    c.free([b])                               # hits zero: really freed
+    assert c.ref(b) == 0 and c.used_count == 1
+    with pytest.raises(mx.base.MXNetError):
+        c.free([b])                           # decref below zero
+    c.free(blocks[1:])
+    assert c.free_count == 8
+
+
+def test_allocator_shared_block_census_counts_once():
+    """A block with refcount 3 occupies ONE physical block — census
+    gauges and occupancy must reflect dedup, not logical refs."""
+    from mxnet_tpu.decode.cache import BLOCKS_USED
+    c = PagedKVCache(num_blocks=8, block_size=4)
+    b = c.alloc(1)[0]
+    c.incref(b)
+    c.incref(b)
+    assert c.used_count == 1 and c.free_count == 7
+    assert c.occupancy == pytest.approx(1 / 8)
+    # the process-wide gauge saw this instance add exactly one block
+    assert BLOCKS_USED.value >= 1
+    c.free([b]); c.free([b]); c.free([b])
+    assert c.used_count == 0
+
+
+def test_allocator_fork_for_write():
+    c = PagedKVCache(num_blocks=4, block_size=4)
+    b = c.alloc(1)[0]
+    assert c.fork_for_write(b) is None        # sole owner: write in place
+    c.incref(b)
+    nb = c.fork_for_write(b)                  # shared: peel off a copy
+    assert nb is not None and nb != b
+    assert c.ref(b) == 1 and c.ref(nb) == 1
+    assert c.used_count == 2
+
+
+# ----------------------------------------------------------------------
+# prefix trie (cache-level)
+# ----------------------------------------------------------------------
+def test_prefix_trie_acquire_register_flush():
+    c = PagedKVCache(num_blocks=8, block_size=4, prefix_sharing=True)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    blocks = c.alloc(3)
+    c.register_prefix(toks, 9, blocks)        # publishes 2 FULL blocks
+    assert c.prefix_stats["trie_blocks"] == 2
+    # a second identical prompt re-acquires those blocks: no new alloc
+    used0 = c.used_count
+    got, rows = c.acquire_prefix(toks)
+    assert rows == 8 and got == blocks[:2]
+    assert c.used_count == used0              # zero new physical blocks
+    assert c.ref(blocks[0]) == 3              # seq + trie + sharer
+    # sharing is capped below the full prompt: at least one token must
+    # go through prefill so the chunk head emits the first output
+    got2, rows2 = c.acquire_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    assert rows2 == 4 and got2 == blocks[:1]  # (8-1)//4 == 1 block
+    # different tokens never match (token tuples, not hashes)
+    assert c.acquire_prefix([1, 2, 3, 5]) == ([], 0)
+    for g in (got, got2):
+        c.free(g)
+    c.free(blocks)                            # the sequence releases
+    assert c.used_count == 2                  # trie still pins 2
+    c.flush_prefixes()
+    assert c.used_count == 0 and c.prefix_stats["trie_blocks"] == 0
+
+
+def test_prefix_trie_sharer_free_never_frees_other(model):
+    """Freeing one sharer's block list leaves the other sharer's (and
+    the trie's) references intact — the COW lifetime guarantee."""
+    c = PagedKVCache(num_blocks=8, block_size=4, prefix_sharing=True)
+    toks = list(range(8))
+    blocks = c.alloc(2)
+    c.register_prefix(toks, 8, blocks)
+    shared, _ = c.acquire_prefix(toks + [99])
+    assert shared == blocks                   # (9-1)//4 == both blocks
+    c.free(blocks)                            # first sharer preempted
+    assert c.ref(shared[0]) == 2              # second sharer + trie live
+    c.free(shared)
+    assert c.prefix_stats["trie_blocks"] == 2  # trie alone keeps them
+
+
+def test_prefix_trie_eviction_under_pressure():
+    """Trie-pinned blocks are reclaimable: when the free list runs dry
+    the allocator evicts leaf-first instead of raising OOM."""
+    c = PagedKVCache(num_blocks=4, block_size=4, prefix_sharing=True)
+    blocks = c.alloc(2)
+    c.register_prefix(list(range(8)), 8, blocks)
+    c.free(blocks)                            # only the trie holds them
+    got = c.alloc(4)                          # needs ALL blocks
+    assert len(got) == 4
+    assert c.prefix_stats["trie_blocks"] == 0
+    with pytest.raises(CacheOOMError):
+        c.alloc(1)                            # nothing left to evict
+
+
+# ----------------------------------------------------------------------
+# engine-level COW prefix sharing
+# ----------------------------------------------------------------------
+def test_prefix_sharing_hits_and_identical_streams(model, baseline):
+    eng = DecodeEngine(model["params"], CFG, capacity=3, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True,
+                       prefix_cache=True)
+    try:
+        p = ([3, 7, 11, 4] * 5)[:17]          # 17 tokens: 3 chunks cold
+        ref = baseline["eng"].generate(p, max_new_tokens=10, timeout=120)
+        first = eng.generate(p, max_new_tokens=10, timeout=120)
+        chunks0 = eng.stats()["prefill_chunks"]
+        second = eng.generate(p, max_new_tokens=10, timeout=120)
+        st = eng.stats()
+        assert first == ref and second == ref  # bit-identical outputs
+        assert st["cache"]["prefix_hit_blocks"] > 0
+        # the second admission shares (17-1)//4 == 4 full blocks, so it
+        # prefills 1 residual row == 1 chunk vs 3 chunks cold
+        assert st["prefill_chunks"] - chunks0 < chunks0
+        # occupancy dedup: after drain only the trie's single copy
+        # remains resident (sequence refs all released)
+        assert (st["cache"]["num_blocks"] - st["cache"]["blocks_free"]
+                == st["cache"]["prefix_trie_blocks"])
+    finally:
+        eng.stop()
+
+
+def test_fork_block_isolates_device_rows(model):
+    """_fork_block gives the writer a private copy of a shared block:
+    the copy carries the original rows, the original keeps its data and
+    drops to the remaining sharers."""
+    eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
+                       num_blocks=12, chunk_tokens=8, warmup=False,
+                       start=False, prefix_cache=True)
+    try:
+        b = eng.cache.alloc(1)[0]
+        eng.cache.incref(b)                   # simulate a second sharer
+        marker = np.full(eng._cache_arrs[0].shape[1:], 7.5, np.float32)
+        for nd in eng._cache_arrs:
+            nd._set_data(nd._data.at[b].set(marker))
+        import types
+        seq = types.SimpleNamespace(blocks=[b])
+        eng._fork_block(seq, 0)
+        nb = seq.blocks[0]
+        assert nb != b
+        assert eng.cache.ref(b) == 1 and eng.cache.ref(nb) == 1
+        for nd in eng._cache_arrs:
+            np.testing.assert_array_equal(np.asarray(nd._data[nb]), marker)
+            np.testing.assert_array_equal(np.asarray(nd._data[b]), marker)
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# draft-verify decoding
+# ----------------------------------------------------------------------
+def test_spec_greedy_streams_bit_identical(spec_engine, baseline):
+    outs = [spec_engine.generate(p, max_new_tokens=10, timeout=120)
+            for p in PROMPTS]
+    assert outs == baseline["streams"]
+    st = spec_engine.stats()
+    assert st["spec_k"] == 3 and st["spec_impl"] == "ngram"
+    assert st["spec_proposed"] > 0
+    assert st["steady_state_retraces"] == 0
+    assert st["dispatches_per_step"] == 1.0
+    # the whole point: strictly more than one token per verified launch
+    assert st["tokens_per_launch"] > 1.0
+    assert st["cache"]["blocks_free"] + st["cache"]["prefix_trie_blocks"] \
+        == st["cache"]["num_blocks"]          # no leaks past the trie
+
+
+def test_spec_concurrent_load_matches_sequential(spec_engine, baseline):
+    handles = [spec_engine.submit(p, max_new_tokens=10) for p in PROMPTS]
+    outs = [h.result(timeout=120) for h in handles]
+    assert outs == baseline["streams"]
+    assert spec_engine.stats()["steady_state_retraces"] == 0
+
+
+def test_spec_eos_mid_span(spec_engine, baseline):
+    """Declare the 3rd greedy token EOS: the speculative engine must
+    stop at exactly the same point even when that token lands in the
+    middle of an accepted span."""
+    ref = baseline["streams"][0]
+    eos = ref[2]
+    want = baseline["eng"].generate(PROMPTS[0], max_new_tokens=10,
+                                    eos_id=eos, timeout=120)
+    got = spec_engine.generate(PROMPTS[0], max_new_tokens=10, eos_id=eos,
+                               timeout=120)
+    assert got == want and got[-1] == eos and len(got) <= 3
+
+
+def test_spec_sampling_rides_span_one(spec_engine):
+    """Sampling slots are excluded from drafting (greedy acceptance is
+    only exact for greedy streams): seeded sampling reproduces and adds
+    zero proposals."""
+    before = spec_engine.stats()["spec_proposed"]
+    t1 = spec_engine.generate([1, 2], max_new_tokens=5, temperature=0.8,
+                              seed=3, timeout=120)
+    t2 = spec_engine.generate([1, 2], max_new_tokens=5, temperature=0.8,
+                              seed=3, timeout=120)
+    assert t1 == t2 and len(t1) == 5
+    assert spec_engine.stats()["spec_proposed"] == before
+
+
+def test_spec_per_request_opt_out(spec_engine, baseline):
+    before = spec_engine.stats()["spec_proposed"]
+    out = spec_engine.generate(PROMPTS[0], max_new_tokens=10,
+                               speculative=False, timeout=120)
+    assert out == baseline["streams"][0]
+    assert spec_engine.stats()["spec_proposed"] == before
+
+
+def test_spec_draft_model_drafter(model, baseline):
+    """Self-draft (draft == target) exercises the two-model path; the
+    drafter then agrees with the target and acceptance is high."""
+    eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True,
+                       spec_k=2, spec_impl="draft",
+                       draft_params=model["params"], draft_config=CFG)
+    try:
+        out = eng.generate(PROMPTS[1], max_new_tokens=8, timeout=120)
+        assert out == baseline["eng"].generate(PROMPTS[1],
+                                               max_new_tokens=8,
+                                               timeout=120)
+        st = eng.stats()
+        assert st["spec_impl"] == "draft"
+        assert st["spec_proposed"] > 0
+        assert st["spec_accepted"] > 0        # self-draft mostly agrees
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# drafters + impl selection
+# ----------------------------------------------------------------------
+def test_ngram_drafter_prompt_lookup():
+    d = NGramDrafter(max_n=3, min_n=1)
+    # trailing [3,7] seen earlier -> proposes the continuation [11, 3]
+    assert d.propose([3, 7, 11, 3, 7], 2) == [11, 3]
+    # longest match wins over shorter, most recent occurrence wins
+    assert d.propose([1, 2, 9, 1, 2, 5, 1, 2], 1) == [5]
+    # no earlier occurrence of any trailing n-gram: no proposal
+    assert d.propose([1, 2, 3, 4], 3) == []
+    assert d.propose([5], 3) == []
+    assert d.propose([2, 2, 2, 2], 0) == []   # k=0 never proposes
+
+
+def test_choose_spec_impl_contract(model):
+    assert choose_spec_impl("off", False) is None
+    assert choose_spec_impl("auto", False) == "ngram"
+    assert choose_spec_impl("auto", True) == "draft"
+    assert choose_spec_impl("ngram", True) == "ngram"
+    with pytest.raises(ValueError):
+        choose_spec_impl("draft", False)      # forced but no checkpoint
+    with pytest.raises(ValueError):
+        choose_spec_impl("medusa", True)      # unknown impl
+    # a forced-draft engine without draft weights fails LOUDLY at
+    # construction, not silently at serve time
+    with pytest.raises(ValueError):
+        DecodeEngine(model["params"], CFG, capacity=1, block_size=4,
+                     num_blocks=8, chunk_tokens=8, warmup=False,
+                     start=False, spec_k=2, spec_impl="draft")
+
+
+def test_spec_env_knobs(model, monkeypatch):
+    monkeypatch.setenv("MXNET_DECODE_SPEC_K", "2")
+    monkeypatch.setenv("MXNET_DECODE_SPEC_IMPL", "ngram")
+    monkeypatch.setenv("MXNET_DECODE_PREFIX_CACHE", "1")
+    eng = DecodeEngine(model["params"], CFG, capacity=1, block_size=4,
+                       num_blocks=8, chunk_tokens=8, warmup=False,
+                       start=False)
+    try:
+        assert eng._spec_k == 2 and eng._spec_impl == "ngram"
+        assert eng._prefix_cache is True
+    finally:
+        eng.stop()
+    monkeypatch.setenv("MXNET_DECODE_SPEC_IMPL", "off")
+    eng = DecodeEngine(model["params"], CFG, capacity=1, block_size=4,
+                       num_blocks=8, chunk_tokens=8, warmup=False,
+                       start=False)
+    try:
+        assert eng._spec_k == 0               # off zeroes the span
+    finally:
+        eng.stop()
+
+
+def test_swap_params_flushes_prefix_trie(model):
+    """Hot-reload must invalidate published prefixes — cached K/V from
+    the old weights would otherwise serve under the new version."""
+    eng = DecodeEngine(model["params"], CFG, capacity=2, block_size=4,
+                       num_blocks=36, chunk_tokens=8, warmup=True,
+                       prefix_cache=True)
+    try:
+        ref = eng.generate(PROMPTS[0], max_new_tokens=6, timeout=120)
+        assert eng.stats()["cache"]["prefix_trie_blocks"] > 0
+        eng.swap_params(model["params"])
+        # same weights swapped in: streams unchanged, trie rebuilt fresh
+        out = eng.generate(PROMPTS[0], max_new_tokens=6, timeout=120)
+        assert out == ref
+        st = eng.stats()["cache"]
+        assert st["prefix_trie_blocks"] > 0   # re-registered post-flush
+    finally:
+        eng.stop()
