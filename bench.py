@@ -1606,6 +1606,186 @@ def bench_serving(args):
     }
 
 
+def _coldstart_symbol():
+    """Tiny MLP for the coldstart arms — they measure COMPILE
+    accounting across process restarts, not model speed, so the
+    smallest symbol with a softmax head keeps the 4 subprocess arms
+    cheap."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+        act_type="relu")
+    return mx.sym.softmax(
+        mx.sym.FullyConnected(h, num_hidden=16, name="fc2"),
+        name="softmax")
+
+
+def bench_coldstart_worker(args):
+    """One process of ``--mode coldstart`` (spawned with the cache /
+    manifest wiring in env+argv; also runs standalone).  Arms:
+
+    * ``seed``  — warmed server; populates MXNET_COMPILE_CACHE_DIR and
+      captures the AOT manifest the restart arms consume.
+    * ``cold``  — ``warmup=False`` restart: the first request pays the
+      compile (the witness baseline).
+    * ``warm``  — manifest-warmed restart (no cache): warmup compiles
+      before traffic, the first request must not.
+    * ``cache`` — manifest + persistent cache: warmup disk-loads, the
+      first request must not compile and the cache must report hits.
+
+    ``coldstart_compiles`` is the executor+pallas retrace delta around
+    the FIRST request — the same dispatch-count witnesses every other
+    mode uses, exact on any backend.  Prints one JSON line."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import aot, serving, telemetry
+    from mxnet_tpu.executor import EXECUTOR_RETRACES
+    from mxnet_tpu.pallas.dispatch import PALLAS_RETRACES
+
+    sym = _coldstart_symbol()
+    rng = np.random.RandomState(0)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 32))
+    params = {n: rng.normal(0, 0.05, s).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n != "data"}
+    arm = args.coldstart_arm
+
+    def retraces():
+        return EXECUTOR_RETRACES.value + PALLAS_RETRACES.value
+
+    if arm == "seed":
+        srv = serving.ModelServer(sym, params, {}, {"data": (32,)},
+                                  max_batch_size=4, warmup=True)
+        srv.predict({"data": np.zeros(32, np.float32)})
+        aot.save(aot.capture(site="executor"), args.coldstart_manifest)
+        srv.stop()
+        print(json.dumps({
+            "arm": arm,
+            "programs": len(aot.load(args.coldstart_manifest)["entries"]),
+        }))
+        return
+    manifest = args.coldstart_manifest or None
+    t0 = time.perf_counter()
+    srv = serving.ModelServer(sym, params, {}, {"data": (32,)},
+                              max_batch_size=4, warmup=(arm != "cold"),
+                              warmup_manifest=manifest)
+    startup_ms = (time.perf_counter() - t0) * 1e3
+    r0 = retraces()
+    t1 = time.perf_counter()
+    srv.predict({"data": np.zeros(32, np.float32)})
+    first_ms = (time.perf_counter() - t1) * 1e3
+    compiles = retraces() - r0
+    warmed = sum(1 for p in telemetry.programs(analyze=False)
+                 if p["warmed"])
+    st = aot.stats()
+    srv.stop()
+    print(json.dumps({
+        "arm": arm,
+        "coldstart_compiles": compiles,
+        "coldstart_first_step_ms": round(first_ms, 2),
+        "startup_ms": round(startup_ms, 1),
+        "warmed_programs": warmed,
+        "cache_hits": st["cache_hits"],
+        "cache_misses": st["cache_misses"],
+    }))
+
+
+def bench_coldstart(args):
+    """Cold-start latency across process restarts (docs/AOT.md): a seed
+    process populates the persistent compile cache and captures an AOT
+    manifest, then three fresh subprocesses restart the same server
+    cold, manifest-warmed, and manifest+cache.  Headline is the
+    manifest-warmed restart's first-request latency; the hard gates
+    (SystemExit) are the zero-compile contract: the cold arm must
+    compile on its first request while BOTH warmed restarts serve it
+    with ``coldstart_compiles == 0``, and the cache restart must
+    actually disk-load (``cache_hits > 0``)."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="mx-coldstart-")
+    manifest = os.path.join(tmp, "model.aot.json")
+    cache = os.path.join(tmp, "cache")
+
+    def run(arm, use_cache, use_manifest):
+        # every arm runs under the IDENTICAL jax config (same platform,
+        # same flags) — the persistent cache keys over compile options,
+        # so a config fork would turn hits into silent misses
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_AOT_MANIFEST", None)
+        env.pop("MXNET_COMPILE_CACHE_DIR", None)
+        if use_cache:
+            env["MXNET_COMPILE_CACHE_DIR"] = cache
+        cmd = [_sys.executable, os.path.join(root, "bench.py"),
+               "--mode", "coldstart-worker", "--coldstart-arm", arm]
+        if use_manifest:
+            cmd += ["--coldstart-manifest", manifest]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=600)
+        if proc.returncode != 0:
+            raise SystemExit("bench: coldstart %s arm failed:\n%s"
+                             % (arm, proc.stderr[-2000:]))
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("{") and '"arm"' in l][-1]
+        return json.loads(line)
+
+    try:
+        seed = run("seed", True, True)
+        cold = run("cold", False, False)
+        warm = run("warm", False, True)
+        cached = run("cache", True, True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if cold["coldstart_compiles"] <= 0:
+        raise SystemExit(
+            "bench: coldstart gate: the cold restart served its first "
+            "request without compiling (%r) — the witness lost its "
+            "baseline" % cold)
+    for name, arm in (("manifest-warmed", warm),
+                      ("persistent-cache", cached)):
+        if arm["coldstart_compiles"] != 0:
+            raise SystemExit(
+                "bench: coldstart gate: the %s restart compiled %d "
+                "program(s) on its first request (contract: 0; cold "
+                "arm compiled %d)" % (name, arm["coldstart_compiles"],
+                                      cold["coldstart_compiles"]))
+        if arm["warmed_programs"] <= 0:
+            raise SystemExit(
+                "bench: coldstart gate: the %s restart registered no "
+                "warmed programs in telemetry.programs() (%r)"
+                % (name, arm))
+    if cached["cache_hits"] <= 0:
+        raise SystemExit(
+            "bench: coldstart gate: the persistent-cache restart never "
+            "hit the cache (%r)" % cached)
+    return {
+        "metric": "coldstart_first_step_ms",
+        "value": warm["coldstart_first_step_ms"],
+        "unit": "ms",
+        "coldstart_compiles": {
+            "cold": cold["coldstart_compiles"],
+            "warm": warm["coldstart_compiles"],
+            "cache": cached["coldstart_compiles"],
+        },
+        "cold_first_step_ms": cold["coldstart_first_step_ms"],
+        "cache_first_step_ms": cached["coldstart_first_step_ms"],
+        "startup_ms": {
+            "cold": cold["startup_ms"],
+            "warm": warm["startup_ms"],
+            "cache": cached["startup_ms"],
+        },
+        "seed_programs": seed["programs"],
+        "warmed_programs": warm["warmed_programs"],
+        "cache_hits": cached["cache_hits"],
+    }
+
+
 def bench_decode(args):
     """mx.decode generative serving: continuous batching vs static
     (run-to-completion) batching over the paged-KV-cache decode engine
@@ -1927,7 +2107,8 @@ def main():
     ap.add_argument("--mode", type=str, default="train",
                     choices=["train", "inference", "serving", "checkpoint",
                              "kvstore", "kvstore-mh-worker",
-                             "fit", "decode", "dlrm", "transformer"])
+                             "fit", "decode", "dlrm", "transformer",
+                             "coldstart", "coldstart-worker"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
     ap.add_argument("--layout", type=str, default="NHWC",
@@ -1961,6 +2142,15 @@ def main():
     ap.add_argument("--serving-replicas", type=int, default=1)
     ap.add_argument("--serving-max-batch", type=int, default=8)
     ap.add_argument("--serving-latency-ms", type=float, default=5.0)
+    # coldstart bench (--mode coldstart; also folded into the default
+    # line as coldstart_compiles / coldstart_first_step_ms)
+    ap.add_argument("--coldstart-arm", type=str, default="cold",
+                    choices=["seed", "cold", "warm", "cache"],
+                    help="which --mode coldstart-worker arm this "
+                         "process runs (set by the parent)")
+    ap.add_argument("--coldstart-manifest", type=str, default="",
+                    help="AOT manifest path shared between the "
+                         "coldstart seed and restart arms")
     # kvstore bench (--mode kvstore; also folded into the default line)
     ap.add_argument("--kv-ndev", type=int, default=4,
                     help="simulated per-key device gradient streams for "
@@ -2047,6 +2237,12 @@ def main():
     if args.mode == "checkpoint":
         print(json.dumps(bench_checkpoint(args)))
         return
+    if args.mode == "coldstart":
+        print(json.dumps(bench_coldstart(args)))
+        return
+    if args.mode == "coldstart-worker":
+        bench_coldstart_worker(args)
+        return
     if args.mode == "inference":
         if args.quantized:
             print(json.dumps(bench_quantized_inference(args)))
@@ -2109,6 +2305,9 @@ def main():
     out["decode_spec_k"] = dc["decode_spec_k"]
     out["decode_accept_rate"] = dc["decode_accept_rate"]
     out["decode_tokens_per_launch"] = dc["decode_tokens_per_launch"]
+    cs = bench_coldstart(args)
+    out["coldstart_compiles"] = cs["coldstart_compiles"]
+    out["coldstart_first_step_ms"] = cs["value"]
     print(json.dumps(out))
 
 

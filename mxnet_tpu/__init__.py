@@ -118,6 +118,7 @@ from . import decode
 from . import profiler
 from . import telemetry
 from . import pallas
+from . import aot
 from . import checkpoint
 from . import embedding
 from . import kvstore_tpu

@@ -30,7 +30,25 @@ def _wrapped_params(func_def):
     return [arg.arg for arg in a.posonlyargs + a.args]
 
 
-def _jit_donations(mod, call):
+def _literal_argnums(node, assigns, depth=0):
+    """Int positions out of a donate_argnums expression, following the
+    ``safe_donate_argnums((...))`` guard wrapper (any single-positional-
+    arg call) and one local ``donate = ...`` assignment hop.  The guard
+    only ever SHRINKS the tuple at runtime, so the literal inside it is
+    the donation set this pass must check against."""
+    if depth > 3:
+        return []
+    if isinstance(node, ast.Name) and assigns and node.id in assigns:
+        return _literal_argnums(assigns[node.id], assigns, depth + 1)
+    if (isinstance(node, ast.Call) and len(node.args) == 1
+            and not node.keywords):
+        return _literal_argnums(node.args[0], assigns, depth + 1)
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [e.value for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+
+
+def _jit_donations(mod, call, assigns=None):
     """(wrapped_name, donated_positions) for a jax.jit call with
     donate_argnums, else None."""
     if not (isinstance(call, ast.Call)
@@ -42,14 +60,7 @@ def _jit_donations(mod, call):
             donate = kw.value
     if donate is None:
         return None
-    positions = []
-    if isinstance(donate, (ast.Tuple, ast.List)):
-        elts = donate.elts
-    else:
-        elts = [donate]
-    for e in elts:
-        if isinstance(e, ast.Constant) and isinstance(e.value, int):
-            positions.append(e.value)
+    positions = _literal_argnums(donate, assigns)
     target = call.args[0] if call.args else None
     name = target.id if isinstance(target, ast.Name) else None
     return name, tuple(positions)
@@ -75,10 +86,17 @@ def _collect_builders(mod):
                  if isinstance(n, ast.FunctionDef)):
         local_defs = {n.name: n for n in ast.walk(func)
                       if isinstance(n, ast.FunctionDef) and n is not func}
+        assigns = {n.targets[0].id: n.value for n in ast.walk(func)
+                   if isinstance(n, ast.Assign) and len(n.targets) == 1
+                   and isinstance(n.targets[0], ast.Name)}
         sigs = []
         for node in ast.walk(func):
             if isinstance(node, ast.Return) and node.value is not None:
-                d = _jit_donations(mod, node.value)
+                val = node.value
+                # `fn = jax.jit(...); return fn` builders count too
+                if isinstance(val, ast.Name) and val.id in assigns:
+                    val = assigns[val.id]
+                d = _jit_donations(mod, val, assigns)
                 if d and d[0] and d[0] in local_defs:
                     sigs.append((_wrapped_params(local_defs[d[0]]),
                                  d[1]))

@@ -1,0 +1,237 @@
+"""Persistent compiled-program store (docs/AOT.md).
+
+Wraps jax's persistent compilation cache
+(``jax.experimental.compilation_cache``) so every RetraceSite dispatch
+— executor fwd/fwd_bwd, the fused fit step, the bucketed kvstore
+programs, and the Pallas kernels they embed — serializes its compiled
+executable to ``MXNET_COMPILE_CACHE_DIR``.  A restarted process pays
+trace + disk-load instead of trace + XLA compile for every program it
+has compiled before (``jit_compile_ms`` collapses to trace time; the
+``aot_cache_hits`` counter is the witness).
+
+On top of jax's content-addressed files this module keeps its OWN
+index (``mx_cache_index.json``): the framework's (site, signature,
+mesh-fingerprint) program keys with fn_name / compile_ms / versions,
+written by ``mx.aot.capture()``/``warm()``.  The index is pure
+bookkeeping — `jax` owns the executables — so corruption or a
+version mismatch NEVER breaks a deploy: the index is discarded and
+rebuilt, and a corrupt/stale cache entry simply misses (jax validates
+its own entries) and falls back to a fresh compile.
+
+Key stability: jax's cache key covers the computation, compile
+options, XLA flags and versions.  Processes that should share a cache
+must therefore run the same configuration — this module applies the
+SAME three cache settings every time, so the framework itself never
+forks the key.
+"""
+import json
+import logging
+import os
+import threading
+
+from .. import telemetry as _telemetry
+
+log = logging.getLogger(__name__)
+
+# bump when the index schema changes: mismatched indexes are discarded
+# (never trusted), matching the corruption fallback
+FORMAT_VERSION = 1
+INDEX_NAME = "mx_cache_index.json"
+
+AOT_CACHE_HITS = _telemetry.REGISTRY.counter(
+    "aot_cache_hits", "compiled executables served from the "
+    "persistent compilation cache instead of XLA-compiled "
+    "(docs/AOT.md)")
+AOT_CACHE_MISSES = _telemetry.REGISTRY.counter(
+    "aot_cache_misses", "persistent-cache lookups that fell back to a "
+    "fresh XLA compile (first compile of a key, or a stale/corrupt "
+    "entry)")
+AOT_INDEX_ERRORS = _telemetry.REGISTRY.counter(
+    "aot_index_errors", "persistent-cache index files discarded as "
+    "corrupt or version-mismatched (rebuilt; never fatal)")
+
+_lock = threading.Lock()
+_STATE = {"dir": None, "listener": False}
+
+
+def _jax_version():
+    import jax
+    return str(jax.__version__)
+
+
+def cache_dir():
+    """The active persistent-cache directory (None = disabled)."""
+    return _STATE["dir"]
+
+
+def donation_safe():
+    """False while the persistent cache is enabled: buffer donation and
+    disk-loaded executables must not mix.
+
+    jax 0.4.37's DESERIALIZED executables mishandle input/output
+    aliasing — a donated program served from a persistent-cache entry
+    corrupts its buffers (wrong results, NaN, or a crash, typically
+    from the second chained step) on both the CPU and TPU backends.
+    Reproducible in pure jax with no framework code involved.  Freshly
+    compiled donated programs are correct, and NON-donated programs
+    disk-load correctly, so the framework-level guard is: while the
+    cache is active, program builders drop donation
+    (``safe_donate_argnums``).  Donation changes the program's aliasing
+    and therefore its cache key, so donated and non-donated variants
+    can never collide in the cache — a guarded process neither writes
+    donated entries nor loads one written by an unguarded process.
+    """
+    return _STATE["dir"] is None
+
+
+def safe_donate_argnums(argnums):
+    """``donate_argnums`` for program builders: the requested positions
+    when donation is safe, ``()`` while the persistent cache is active
+    (see ``donation_safe``).  Builders run lazily at first use, after
+    the import-time env enable, so the decision is current."""
+    return tuple(argnums) if donation_safe() else ()
+
+
+def _on_event(event, **kw):
+    # jax monitoring events are the exact hit/miss witnesses: one
+    # cache_hits/cache_misses event per persistent-cache lookup
+    if event == "/jax/compilation_cache/cache_hits":
+        AOT_CACHE_HITS.inc()
+    elif event == "/jax/compilation_cache/cache_misses":
+        AOT_CACHE_MISSES.inc()
+
+
+def _install_listener():
+    if _STATE["listener"]:
+        return
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_event)
+        _STATE["listener"] = True
+    except Exception as e:                     # pragma: no cover
+        log.warning("aot: cache hit/miss telemetry unavailable: %s", e)
+
+
+def _index_path(d):
+    return os.path.join(d, INDEX_NAME)
+
+
+def _fresh_index():
+    return {"format": FORMAT_VERSION, "jax": _jax_version(),
+            "programs": {}}
+
+
+def load_index(d=None):
+    """The store's program index; a corrupt or version-mismatched file
+    is counted, discarded, and replaced by a fresh index (the
+    fall-back-to-fresh-compile contract — never raises)."""
+    d = d or _STATE["dir"]
+    if not d:
+        return _fresh_index()
+    path = _index_path(d)
+    if not os.path.exists(path):
+        return _fresh_index()
+    try:
+        with open(path) as f:
+            idx = json.load(f)
+        if (not isinstance(idx, dict)
+                or idx.get("format") != FORMAT_VERSION
+                or idx.get("jax") != _jax_version()
+                or not isinstance(idx.get("programs"), dict)):
+            raise ValueError("index version/schema mismatch")
+        return idx
+    except Exception as e:
+        AOT_INDEX_ERRORS.inc()
+        log.warning("aot: discarding cache index %s (%s); programs "
+                    "recompile fresh", path, e)
+        return _fresh_index()
+
+
+def _write_index(d, idx):
+    tmp = _index_path(d) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(idx, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, _index_path(d))
+
+
+def index_update(entries, mesh_fingerprint=None, d=None):
+    """Merge program entries (export_signatures rows) into the on-disk
+    index under their (site, fn_name, signature, mesh) keys.  Best
+    effort — an unwritable cache dir degrades to jax-only caching."""
+    d = d or _STATE["dir"]
+    if not d:
+        return None
+    with _lock:
+        idx = load_index(d)
+        for e in entries:
+            key = "|".join([
+                e["site"], e["fn_name"],
+                str(mesh_fingerprint),
+                e.get("treedef", ""),
+                ";".join("%s%s" % (s[0], s[1]) if s else "None"
+                         for s in e.get("arg_specs", ())),
+            ])
+            idx["programs"][key] = {
+                "site": e["site"], "fn_name": e["fn_name"],
+                "compile_ms": e.get("compile_ms"),
+                "donated": e.get("donated"),
+            }
+        try:
+            _write_index(d, idx)
+        except OSError as e:
+            log.warning("aot: cache index not written (%s)", e)
+        return idx
+
+
+def enable(path=None):
+    """Turn on the persistent compilation cache.  ``path`` overrides
+    the ``MXNET_COMPILE_CACHE_DIR`` knob; with neither set this is a
+    no-op returning None (how the package import auto-enables).  Safe
+    to call repeatedly; every process that should share the cache
+    applies these exact settings so the cache keys agree."""
+    d = path or os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    if not d:
+        return None
+    d = os.path.abspath(d)
+    os.makedirs(d, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", d)
+    # cache every program: the default min-compile-time/entry-size
+    # gates would skip exactly the small steady-state programs whose
+    # compile storms make cold starts slow
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _install_listener()
+    with _lock:
+        _STATE["dir"] = d
+    # programs jitted before this point kept their donation (safe: they
+    # compile in-process, and their aliasing gives them distinct cache
+    # keys) — but a process that builds donated programs BEFORE
+    # enabling and runs again with the same dir could disk-load them,
+    # which jax 0.4.37 corrupts (see donation_safe).  Warn so deploys
+    # enable the cache first (the env-var path always does).
+    if getattr(_telemetry.programs, "_donated", None):
+        log.warning(
+            "aot: %d donated program(s) were built before the "
+            "persistent cache was enabled; enable the cache before "
+            "constructing modules/engines (MXNET_COMPILE_CACHE_DIR "
+            "does this at import) so donation is dropped from cached "
+            "programs", len(_telemetry.programs._donated))
+    # validate (and heal) the index up front so a corrupt file is
+    # reported at enable time, not mid-deploy
+    idx = load_index(d)
+    try:
+        _write_index(d, idx)
+    except OSError as e:
+        log.warning("aot: cache index not written (%s)", e)
+    return d
+
+
+def disable():
+    """Tests/teardown: detach the persistent cache."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    with _lock:
+        _STATE["dir"] = None

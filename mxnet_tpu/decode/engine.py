@@ -244,10 +244,17 @@ class DecodeEngine:
         # no whole-cache copy in and out per token (docs/DECODE.md).
         # Block tables/positions are NOT donated: they are rebuilt
         # host-side and fed by copy each iteration.
-        self._donate = _config.env_bool("MXNET_DECODE_DONATE",
-                                        default=True)
+        # ... unless the persistent compilation cache is active: disk-
+        # loaded donated executables corrupt their buffers on this jax
+        # version, so the guard drops donation (even against an explicit
+        # MXNET_DECODE_DONATE=1) and stats() reports the truth
+        # (aot.store.donation_safe, docs/AOT.md).
+        from ..aot import store as _aot_store
+        self._donate = (_config.env_bool("MXNET_DECODE_DONATE",
+                                         default=True)
+                        and _aot_store.donation_safe())
         if self._donate:
-            self._exe.donate_args(self._cache_names)
+            self._donate = bool(self._exe.donate_args(self._cache_names))
         self._inputs = ("data", "positions", "block_table", "chunk_data",
                         "chunk_positions", "chunk_start", "chunk_len",
                         "chunk_table", "span_start", "span_len")
@@ -372,8 +379,12 @@ class DecodeEngine:
     def warmup(self):
         """Compile the ONE mixed step up front (vs the retired pow2
         ladder's one compile per bucket): a single all-slots-inactive,
-        empty-chunk dispatch."""
-        with self._step_lock:
+        empty-chunk dispatch.  Runs inside an AOT-warming phase so the
+        step program is flagged ``warmed`` in telemetry.programs() and,
+        with MXNET_COMPILE_CACHE_DIR set, disk-loads on a restart
+        (docs/AOT.md)."""
+        from ..telemetry import programs as _programs
+        with self._step_lock, _programs.warming():
             outs = self._exe.forward(is_train=False, **self._idle_feeds())
             # block until compiled+run; warmup exists to absorb this
             # cost before serving
@@ -384,6 +395,17 @@ class DecodeEngine:
             # bookkeeping — every write holds _step_lock
             self._commit_caches(outs, base=4)
             self._warm.add("spec" if self._spec_k > 0 else "mixed")
+
+    def aot_warm(self, manifest=None):
+        """mx.aot.warm hook: the engine's step signature is fixed by its
+        construction knobs, so warming is the same single dispatch
+        whatever the manifest says; already-warm engines no-op.
+        Returns the number of programs dispatched."""
+        with self._step_lock:
+            if self._warm:
+                return 0
+        self.warmup()
+        return 1
 
     # ------------------------------------------------------------------
     # client API

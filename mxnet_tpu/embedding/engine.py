@@ -356,7 +356,9 @@ class SparseApplyEngine:
 
 
 def _build_local(sig, vocab, threshold, has_state):
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    from ..aot.store import safe_donate_argnums as _donate
+
+    @partial(jax.jit, donate_argnums=_donate((0, 1, 2)))
     def step(w, state, residual, idxs, rowss, lr, wd, rescale):
         _SITE.note()
         idx = jnp.concatenate(idxs) if len(idxs) > 1 else idxs[0]
@@ -379,7 +381,9 @@ def _build_local(sig, vocab, threshold, has_state):
 def _build_pre(vocab, threshold):
     """Local half of the host transport: coalesce (+ quantize against
     the host-local residual) before anything crosses the wire."""
-    @partial(jax.jit, donate_argnums=(0,))
+    from ..aot.store import safe_donate_argnums as _donate
+
+    @partial(jax.jit, donate_argnums=_donate((0,)))
     def pre(residual, idxs, rowss):
         _SITE.note()
         idx = jnp.concatenate(idxs) if len(idxs) > 1 else idxs[0]
@@ -399,7 +403,9 @@ def _build_pre(vocab, threshold):
 def _build_apply_only(sig, vocab, has_state):
     """Global half of the host transport: coalesce the rank-ordered
     union (already quantized per host) and apply."""
-    @partial(jax.jit, donate_argnums=(0, 1))
+    from ..aot.store import safe_donate_argnums as _donate
+
+    @partial(jax.jit, donate_argnums=_donate((0, 1)))
     def apply_(w, state, idx, rows, lr, wd, rescale):
         _SITE.note()
         uidx, g = _coalesce(idx, rows, vocab)

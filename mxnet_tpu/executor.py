@@ -256,7 +256,9 @@ def _make_fwd_eval_donated(graph_fn):
         _note_retrace()
         outs, _ = graph_fn(dict(args, **donated), auxs, seed, False)
         return outs
-    return jax.jit(_fwd_eval_donated, donate_argnums=0)
+    fn = jax.jit(_fwd_eval_donated, donate_argnums=0)
+    _telemetry.programs.note_donation(fn, (0,))
+    return fn
 
 
 class _StreamTarget:
@@ -600,7 +602,12 @@ class Executor:
         corresponding outputs (engine._commit_caches) before anything
         reads them.  Stream-monitored debug forwards fall back to the
         copy-based program.  Pass an empty sequence to turn donation
-        back off."""
+        back off.
+
+        With the persistent compilation cache enabled the request is
+        REFUSED (copy path kept, returns False): disk-loaded donated
+        executables corrupt their buffers on this jax version
+        (``mxnet_tpu.aot.store.donation_safe``, docs/AOT.md)."""
         names = tuple(names)
         for n in names:
             if n not in self.arg_dict:
@@ -608,7 +615,18 @@ class Executor:
         if not names:
             self._donated_names = ()
             self._jit_fwd_eval_donated = None
-            return
+            return True
+        from .aot import store as _aot_store
+        if not _aot_store.donation_safe():
+            import logging
+            logging.getLogger(__name__).warning(
+                "donate_args: refused — the persistent compilation "
+                "cache is active and disk-loaded donated executables "
+                "corrupt memory on this jax version; keeping the "
+                "copy-based forward (docs/AOT.md)")
+            self._donated_names = ()
+            self._jit_fwd_eval_donated = None
+            return False
         if self._group_devices is not None:
             raise MXNetError("donate_args: model-parallel (group2ctx) "
                              "binds are not supported")
@@ -618,6 +636,7 @@ class Executor:
                 cache["graph_fn"])
         self._donated_names = names
         self._jit_fwd_eval_donated = cache["fwd_eval_donated"]
+        return True
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():

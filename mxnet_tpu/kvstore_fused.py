@@ -217,12 +217,14 @@ def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
                    for off, size, shape in layout]
         return reduced, tuple(new_res)
 
+    from .aot.store import safe_donate_argnums as _donate
+
     if mode is None:
         def step(residuals, grads):
             _note_retrace()
             reduced, new_res = _reduce(residuals, grads)
             return tuple(reduced), new_res
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=_donate((0,)))
 
     upd = _fused.build(mode)
 
@@ -240,7 +242,7 @@ def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
             new_ws.append(new_w)
             new_ss.append(tuple(_fused.flatten_state(new_s)[0]))
         return tuple(new_ws), tuple(new_ss), new_res
-    return jax.jit(step, donate_argnums=(1, 2))
+    return jax.jit(step, donate_argnums=_donate((1, 2)))
 
 
 class _Pending:

@@ -239,7 +239,16 @@ def _build_fit_program(graph_fn, param_order, threshold, mode, tpls,
             macc = (macc[0] + bsum, macc[1] + bnum)
         return new_ps, new_ss, new_res, macc, new_scaler, new_auxs, outs
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 6))
+    # params/states/residuals/macc/scaler/auxs donate in place — except
+    # under the persistent cache, where disk-loaded donated executables
+    # corrupt memory (aot.store.donation_safe): the guard trades the
+    # in-place update for correct zero-compile restarts.
+    from ..aot.store import safe_donate_argnums as _donate
+    donate = _donate((0, 1, 2, 3, 4, 6))
+    fn = jax.jit(step, donate_argnums=donate)
+    if donate:
+        _telemetry.programs.note_donation(fn, donate)
+    return fn
 
 
 class FusedFitStep:

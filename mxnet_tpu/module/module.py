@@ -434,6 +434,35 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)
 
+    def aot_warm(self, manifest=None):
+        """mx.aot.warm hook (docs/AOT.md): dispatch the bound forward
+        (+ backward when bound for training) once on zeros, so a
+        restarted trainer pays trace + persistent-cache disk-load
+        before its first real batch rather than during it.  Touches
+        gradients only — parameters and optimizer state are untouched
+        (no ``update``).  The fused fit step keys on live optimizer
+        state and compiles lazily on the first ``fit_step``; with
+        ``MXNET_COMPILE_CACHE_DIR`` set that compile is also a
+        disk-load.  Returns the number of programs dispatched."""
+        assert self.binded and self.params_initialized
+        from ..io import DataBatch
+        from ..ndarray import zeros as _nd_zeros
+        from ..telemetry import programs as _programs
+        group = self._exec_group
+
+        def dummy(descs):
+            return [_nd_zeros(tuple(d.shape if hasattr(d, "shape")
+                                    else d[1])) for d in descs]
+
+        batch = DataBatch(data=dummy(group.data_shapes),
+                          label=(dummy(group.label_shapes)
+                                 if group.label_shapes else None))
+        with _programs.warming():
+            self.forward(batch, is_train=group.for_training)
+            if group.for_training:
+                self.backward()
+        return 1
+
     def fit_step(self, data_batch, eval_metric=None):
         """One training step. Eligible configurations (docs/TRAINING.md)
         run forward+backward+compress+reduce+update — plus device-side

@@ -271,11 +271,35 @@ def batch_norm(data, gamma, beta, moving_mean=None, moving_var=None, *,
             lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))
 
 
+def _use_layernorm_kernel(axis_last):
+    """Select the fused Pallas LayerNorm kernel.  MXNET_LN_IMPL:
+    ``auto`` (default) = the fused kernel on TPU when normalizing the
+    last axis, ``xla`` = the reference chain, ``pallas`` = require the
+    kernel (interpret mode off-TPU — the tier-1 parity convention).
+    Semantics shared with the other kernel knobs via
+    ``pallas.dispatch.choose_impl`` (docs/KERNELS.md)."""
+    from ..pallas.dispatch import use_layernorm_pallas
+    return use_layernorm_pallas(axis_last)
+
+
 @register("LayerNorm", aliases=("layer_norm",), num_outputs=3,
           num_visible_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1)
 def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
-    """Layer normalization (ref src/operator/nn/layer_norm.cc)."""
+    """Layer normalization (ref src/operator/nn/layer_norm.cc).
+
+    The transformer symbol path (axis=-1, stats outputs hidden) routes
+    through the fused Pallas forward/backward kernel when selected by
+    ``MXNET_LN_IMPL`` — one VMEM pass instead of XLA's separate
+    mean/var/normalize/scale chains; the kernel's custom VJP does not
+    propagate mean/inv_std cotangents, so routing requires
+    ``output_mean_var=False`` (where they are structurally unused)."""
     ax = int(axis) % data.ndim
+    if (not output_mean_var and data.ndim >= 2
+            and _use_layernorm_kernel(ax == data.ndim - 1)):
+        from ..pallas import layernorm_fused
+        out, mean, inv_std = layernorm_fused(
+            data, gamma.reshape(-1), beta.reshape(-1), eps=eps)
+        return (out, mean, inv_std)
     xf = data.astype(jnp.float32)
     mean = jnp.mean(xf, axis=ax, keepdims=True)
     var = jnp.var(xf, axis=ax, keepdims=True)

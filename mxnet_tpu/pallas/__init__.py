@@ -10,10 +10,14 @@ this package holds the custom TPU kernels behind the framework's
   the same kernel.
 * :mod:`quant` — fused 2-bit quantize (error-feedback residual) for
   the kvstore bucket path.
+* :mod:`layernorm` — fused LayerNorm (+ optional residual add)
+  forward/backward for the transformer symbol path (``MXNET_LN_IMPL``;
+  the ISSUE 17 registry-ranked kernel).
 * :mod:`dispatch` — the one ``auto|<kernel>|xla`` selection contract
   shared by every kernel knob (``MXNET_ATTN_IMPL``,
-  ``MXNET_PAGED_ATTN_IMPL``, ``MXNET_Q2BIT_IMPL``), plus the
-  ``pallas_kernel_launches`` / ``pallas_fallbacks`` witnesses.
+  ``MXNET_PAGED_ATTN_IMPL``, ``MXNET_Q2BIT_IMPL``, ``MXNET_LN_IMPL``),
+  plus the ``pallas_kernel_launches`` / ``pallas_fallbacks``
+  witnesses.
 
 Every kernel runs under ``interpret=True`` off-TPU, so the CPU
 container and tier-1 exercise the exact kernel code paths against the
@@ -24,18 +28,22 @@ TPU.
 """
 from . import dispatch
 from .dispatch import (PALLAS_FALLBACKS, PALLAS_LAUNCHES, choose_impl,
-                       paged_attn_impl, use_paged_pallas,
-                       use_q2bit_pallas)
+                       paged_attn_impl, use_layernorm_pallas,
+                       use_paged_pallas, use_q2bit_pallas)
 from . import attention
 from .attention import (paged_chunk_prefill_attend, paged_decode_attend,
                         paged_prefill_attend)
 from . import quant
 from .quant import two_bit_quantize_fused
+from . import layernorm
+from .layernorm import layernorm_fused
 
 __all__ = [
-    "attention", "dispatch", "quant",
+    "attention", "dispatch", "quant", "layernorm",
     "choose_impl", "paged_attn_impl", "use_paged_pallas",
-    "use_q2bit_pallas", "paged_chunk_prefill_attend",
+    "use_q2bit_pallas", "use_layernorm_pallas",
+    "paged_chunk_prefill_attend",
     "paged_decode_attend", "paged_prefill_attend",
-    "two_bit_quantize_fused", "PALLAS_FALLBACKS", "PALLAS_LAUNCHES",
+    "two_bit_quantize_fused", "layernorm_fused",
+    "PALLAS_FALLBACKS", "PALLAS_LAUNCHES",
 ]

@@ -102,6 +102,25 @@ def paged_attn_impl():
     return "pallas" if use_paged_pallas(count=False) else "xla"
 
 
+def use_layernorm_pallas(axis_last=True):
+    """Impl decision for the fused LayerNorm (+residual) kernel
+    (``MXNET_LN_IMPL``): auto = kernel on TPU when normalizing the
+    LAST axis (the transformer symbol path), forceable anywhere via
+    interpret mode — forcing with a non-last axis still raises, since
+    the kernel's row-tile layout only covers ``axis=-1``."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    return choose_impl(
+        "MXNET_LN_IMPL",
+        os.environ.get("MXNET_LN_IMPL", "auto"), "pallas",
+        axis_last and on_tpu,
+        why="backend=%s, axis_last=%s; auto uses the compiled kernel "
+            "only on TPU with axis=-1 — force 'pallas' to run it in "
+            "interpret mode anywhere (axis=-1 still required)"
+            % (jax.default_backend(), axis_last),
+        force_supported=axis_last, fallback_reason="backend")
+
+
 def use_q2bit_pallas():
     """Impl decision for the fused 2-bit quantize kernel on the
     kvstore bucket path (``MXNET_Q2BIT_IMPL``): same semantics as the

@@ -15,13 +15,78 @@ waits. Per-replica in-flight/served counters feed ``ModelServer.stats()``.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as _np
 
 from .batcher import DeadlineExceededError, settle_exception
 
-__all__ = ["Replica", "ReplicaPool"]
+__all__ = ["Replica", "ReplicaPool", "manifest_buckets"]
+
+
+def _warmup_threads(n_jobs):
+    """Warmup pool width: ``MXNET_AOT_WARMUP_THREADS`` caps it, 0/unset
+    means one thread per (replica, bucket) job up to 8.  Bucket shapes
+    are distinct jit cache keys, so concurrent warmup compiles each
+    exactly once — never the same entry twice."""
+    try:
+        n = int(os.environ.get("MXNET_AOT_WARMUP_THREADS", "0") or 0)
+    except ValueError:
+        n = 0
+    if n <= 0:
+        n = min(n_jobs, 8)
+    return max(1, min(n, n_jobs))
+
+
+def _run_warmup(jobs):
+    """Drive (replica, bucket) warmup jobs through a thread pool (the
+    pre-PR serial loop paid sum-of-compile-times at startup).  The
+    AOT-warming flag is thread-local, so each worker re-enters the
+    submitting thread's ``warming()`` phase."""
+    if not jobs:
+        return 0
+    from ..telemetry import programs as _programs
+    warmed = _programs.is_warming()
+
+    def one(rep, bucket):
+        if warmed:
+            with _programs.warming():
+                rep.warm_bucket(bucket)
+        else:
+            rep.warm_bucket(bucket)
+
+    if len(jobs) == 1:
+        one(*jobs[0])
+        return 1
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=_warmup_threads(len(jobs)),
+                            thread_name_prefix="mx-warmup") as pool:
+        futures = [pool.submit(one, rep, b) for rep, b in jobs]
+        for f in futures:
+            f.result()       # propagate the first compile failure
+    return len(jobs)
+
+
+def manifest_buckets(entries, input_shapes, buckets):
+    """Buckets an AOT manifest actually compiled for this model: bucket
+    ``b`` matches when an executor-site program took an argument shaped
+    ``(b,) + input_trailing_dims``.  Empty result means the manifest
+    covers a different model — callers fall back to warming the full
+    ladder rather than serving cold buckets."""
+    buckets = set(buckets)
+    trailing = {tuple(shape)[1:] for shape in input_shapes.values()}
+    found = set()
+    for e in entries:
+        if e.get("site") != "executor":
+            continue
+        for spec in e.get("arg_specs") or ():
+            if not spec:
+                continue
+            shape = tuple(spec[1])
+            if shape and shape[0] in buckets and shape[1:] in trailing:
+                found.add(shape[0])
+    return sorted(found)
 
 
 class Replica:
@@ -62,15 +127,26 @@ class Replica:
                 self._preds[bucket] = pred
         return pred
 
-    def warmup(self):
+    def warm_bucket(self, bucket):
+        """Bind + compile ONE bucket shape (one warmup-pool job)."""
+        pred = self._pred_for(bucket)
+        dummy = {name: _np.zeros((bucket,) + tuple(shape[1:]),
+                                 dtype=_np.float32)
+                 for name, shape in self._base.input_shapes.items()}
+        pred.forward(**dummy)
+
+    def warmup(self, buckets=None):
         """Compile every bucket shape before serving (cold-start cost paid
-        up front, not by the first unlucky requests)."""
-        for bucket in self.buckets:
-            pred = self._pred_for(bucket)
-            dummy = {name: _np.zeros((bucket,) + tuple(shape[1:]),
-                                     dtype=_np.float32)
-                     for name, shape in self._base.input_shapes.items()}
-            pred.forward(**dummy)
+        up front, not by the first unlucky requests); buckets compile
+        concurrently (MXNET_AOT_WARMUP_THREADS).  ``buckets`` restricts
+        the ladder — the manifest-driven path (mx.aot) warms only the
+        shapes a previous process actually served."""
+        if buckets is None:
+            picked = self.buckets
+        else:
+            allowed = set(buckets)
+            picked = [b for b in self.buckets if b in allowed]
+        return _run_warmup([(self, b) for b in picked])
 
     # ------------------------------------------------------------------
     @property
@@ -186,14 +262,49 @@ class ReplicaPool:
 
     def __init__(self, contexts, make_predictor, buckets, batcher,
                  stats=None, warmup=True):
+        self._make_predictor = make_predictor
+        self._buckets = sorted(buckets)
+        self._batcher = batcher
+        self._stats = stats
         self.replicas = []
         for i, ctx in enumerate(contexts):
             pred = make_predictor(ctx)
             self.replicas.append(
                 Replica(i, ctx, pred, buckets, batcher, stats))
         if warmup:
-            for rep in self.replicas:
-                rep.warmup()
+            self.warmup()
+
+    def warmup(self, manifest=None, replicas=None):
+        """Warm every (replica, bucket) pair through ONE thread pool
+        (the pool width spans replicas too, not just buckets).  With an
+        AOT manifest, only manifest-compiled buckets warm; a manifest
+        for a different model matches nothing and the full ladder warms
+        instead.  Returns the number of programs dispatched."""
+        reps = self.replicas if replicas is None else replicas
+        jobs = []
+        for rep in reps:
+            picked = rep.buckets
+            if manifest is not None:
+                sel = manifest_buckets(manifest.get("entries", []),
+                                       rep._base.input_shapes,
+                                       rep.buckets)
+                if sel:
+                    picked = sel
+            jobs += [(rep, b) for b in picked]
+        return _run_warmup(jobs)
+
+    def add_replica(self, ctx, warmup=True, manifest=None, start=True):
+        """Scale up: bind a new replica and (by default) warm its whole
+        bucket ladder BEFORE it starts pulling from the batcher, so a
+        scale-up never routes traffic onto a compiling replica."""
+        rep = Replica(len(self.replicas), ctx, self._make_predictor(ctx),
+                      self._buckets, self._batcher, self._stats)
+        if warmup:
+            self.warmup(manifest=manifest, replicas=[rep])
+        self.replicas.append(rep)
+        if start:
+            rep.start()
+        return rep
 
     def start(self):
         for rep in self.replicas:

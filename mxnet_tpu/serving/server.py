@@ -24,6 +24,7 @@ serving control plane alongside the device timeline.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import deque
@@ -39,6 +40,8 @@ from .batcher import (DeadlineExceededError, DynamicBatcher, QueueFullError,
 from .replica import ReplicaPool
 
 __all__ = ["ModelServer", "ServerStats"]
+
+log = logging.getLogger(__name__)
 
 
 class ServerStats:
@@ -266,14 +269,20 @@ class ModelServer:
     queue_capacity : admission bound; a full queue rejects immediately
     timeout_ms : default per-request deadline (None = no deadline)
     buckets : batch-size ladder (default 1, 2, 4, ..., max_batch_size)
-    warmup : pre-compile every bucket shape at construction
+    warmup : pre-compile every bucket shape at construction (threaded
+        across (replica, bucket) pairs; MXNET_AOT_WARMUP_THREADS)
+    warmup_manifest : AOT manifest path or dict (mx.aot.capture) — warm
+        only the buckets a previous process actually served, marking
+        their programs ``warmed`` in telemetry.programs(); with
+        MXNET_COMPILE_CACHE_DIR set the warmup disk-loads instead of
+        compiling (docs/AOT.md).  Default: the MXNET_AOT_MANIFEST knob.
     """
 
     def __init__(self, symbol, arg_params, aux_params, input_shapes,
                  num_replicas=1, contexts=None, max_batch_size=8,
                  max_latency_ms=5.0, queue_capacity=None, timeout_ms=None,
                  dtype="float32", buckets=None, warmup=True,
-                 decode_engine=None):
+                 warmup_manifest=None, decode_engine=None):
         from ..predictor import Predictor
 
         for name, shape in input_shapes.items():
@@ -311,8 +320,18 @@ class ModelServer:
                 {n: (top,) + s for n, s in self._example_shapes.items()},
                 ctx=ctx, dtype=dtype)
 
+        # warmup runs through aot_warm below so construction and the
+        # explicit mx.aot.warm path share one (threaded) code path
         self._pool = ReplicaPool(contexts, make_predictor, self._buckets,
-                                 self._batcher, self._stats, warmup=warmup)
+                                 self._batcher, self._stats, warmup=False)
+        if warmup_manifest is None:
+            from .. import aot as _aot
+            warmup_manifest = _aot.default_path()
+        self._warmup_manifest = warmup_manifest
+        if warmup_manifest is not None:
+            self.aot_warm(warmup_manifest)
+        elif warmup:
+            self._pool.warmup()
         self._closed = False
         self._http = None
         self._http_thread = None
@@ -531,6 +550,58 @@ class ModelServer:
             self._reloads += 1
             self._r_reloads.inc()
             return version
+
+    # ------------------------------------------------------------------
+    def _resolve_manifest(self, manifest):
+        """Load + compatibility-gate an AOT manifest.  Incompatible or
+        mismatched manifests resolve to None (full cold warmup) — a
+        stale manifest must never fail a deploy (docs/AOT.md)."""
+        from .. import aot as _aot
+        m = manifest if manifest is not None else self._warmup_manifest
+        if isinstance(m, str):
+            try:
+                m = _aot.load(m)
+            except MXNetError as e:
+                log.warning("serving: ignoring AOT manifest (%s)", e)
+                return None
+        if m is not None:
+            ok, reason = _aot.compatible(m)
+            if not ok:
+                log.warning("serving: AOT manifest incompatible (%s); "
+                            "warming the full bucket ladder instead",
+                            reason)
+                return None
+        return m
+
+    def aot_warm(self, manifest=None):
+        """Compile (or, with MXNET_COMPILE_CACHE_DIR, disk-load) every
+        (replica, bucket) program BEFORE the server accepts traffic —
+        the mx.aot warmup hook (docs/AOT.md).  ``manifest`` defaults to
+        the server's ``warmup_manifest``; programs dispatched here are
+        flagged ``warmed`` in telemetry.programs().  Returns the number
+        of programs dispatched."""
+        from ..telemetry import programs as _programs
+        m = self._resolve_manifest(manifest)
+        with _programs.warming():
+            return self._pool.warmup(manifest=m)
+
+    def add_replica(self, ctx=None):
+        """Scale up by one replica.  The new replica binds, AOT-warms
+        its bucket ladder (through the server's manifest and the
+        persistent cache, like startup) and only THEN starts pulling
+        from the shared batcher — scale-up traffic never lands on a
+        compiling replica.  Returns the new replica's index."""
+        from ..telemetry import programs as _programs
+        with self._reload_lock:
+            if self._closed:
+                raise MXNetError("cannot add a replica to a stopped server")
+            if ctx is None:
+                n = len(self._pool.replicas)
+                ctx = self._default_contexts(n + 1)[n]
+            m = self._resolve_manifest(None)
+            with _programs.warming():
+                rep = self._pool.add_replica(ctx, manifest=m)
+            return rep.index
 
     # ------------------------------------------------------------------
     def stats(self):

@@ -33,17 +33,23 @@ shared with bench.py.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from .registry import REGISTRY
 
 __all__ = ["record", "register_compiled", "programs", "top_programs",
            "analyze", "clear", "peak_tflops", "mfu_measured",
-           "MFU_MEASURED"]
+           "export_signatures", "warming", "is_warming",
+           "note_donation", "MFU_MEASURED"]
 
 PROGRAMS_REGISTERED = REGISTRY.gauge(
     "trace_programs", "distinct compiled programs currently in the "
     "program registry", unit="programs")
+PROGRAMS_WARMED = REGISTRY.gauge(
+    "trace_programs_warmed", "registered programs compiled (or loaded "
+    "from the persistent cache) during an explicit AOT warmup phase "
+    "(mx.aot) rather than by live traffic", unit="programs")
 MFU_MEASURED = REGISTRY.gauge(
     "mfu_measured", "model FLOP utilization from compiler-reported "
     "FLOPs (cost_analysis) over the chip's peak bf16 throughput — the "
@@ -63,6 +69,53 @@ PEAK_TFLOPS_TABLE = (
 _lock = threading.Lock()
 _programs = {}          # key -> entry dict
 _order = []             # insertion order of keys
+# (site, fn_name, fingerprint) -> key: the double-registration guard —
+# an AOT-warmed program and its later live-traffic dispatch (a fresh
+# fn id, or register_compiled followed by record) merge into ONE entry
+# instead of inflating programs() counts (ISSUE 17)
+_by_sig = {}
+# id(jitted fn) -> donate_argnums, noted by program builders (executor
+# donated step, fused fit step) so manifests can carry donation.  Keyed
+# by id on purpose: the fns live in per-symbol compile caches for the
+# process lifetime, so the table is bounded by the program count.
+_donated = {}
+
+# thread-local AOT-warmup flag (mx.aot re-exports `warming`): programs
+# recorded while set carry warmed=True and count in PROGRAMS_WARMED
+_warm_tls = threading.local()
+
+
+@contextlib.contextmanager
+def warming():
+    """Mark programs recorded on this thread as AOT-warmed."""
+    prev = getattr(_warm_tls, "on", False)
+    _warm_tls.on = True
+    try:
+        yield
+    finally:
+        _warm_tls.on = prev
+
+
+def _warming_now():
+    return bool(getattr(_warm_tls, "on", False))
+
+
+def is_warming():
+    """Whether this thread is inside a ``warming()`` phase — warmup
+    thread pools capture it in the submitting thread and re-enter
+    ``warming()`` in each worker (the flag is thread-local)."""
+    return _warming_now()
+
+
+def note_donation(fn, argnums):
+    """Builders of donated programs record their donate_argnums here
+    (jit objects accept attributes, but a side table survives wrapper
+    layers); manifests export it per program entry."""
+    try:
+        with _lock:
+            _donated[id(fn)] = tuple(int(a) for a in argnums)
+    except Exception:
+        pass
 
 
 def peak_tflops(device_kind=None):
@@ -116,27 +169,46 @@ def record(site, fn, args, compile_ms=None):
     able to fail a training step."""
     try:
         abstract = _abstractify(args)
-        key = (site, id(fn)) + _fingerprint(abstract)
+        fp = _fingerprint(abstract)
+        key = (site, id(fn)) + fp
+        fn_name = getattr(fn, "__name__",
+                          None) or str(type(fn).__name__)
+        sig_key = (site, fn_name, fp)
     except Exception:
         return None
     with _lock:
         entry = _programs.get(key)
+        if entry is None and sig_key in _by_sig:
+            # same (site, signature) already registered under another
+            # id — an AOT-warmed program now dispatched by traffic, or
+            # a rebind of the same symbol: merge, don't inflate counts
+            key = _by_sig[sig_key]
+            entry = _programs.get(key)
+            if entry is not None and entry["fn"] is None:
+                entry["fn"] = fn          # give AOT stubs a live fn
+                entry["abstract"] = abstract
+                entry["arg_shapes"] = _shape_summary(abstract)
         if entry is None:
             entry = {
                 "site": site,
-                "fn_name": getattr(fn, "__name__",
-                                   None) or str(type(fn).__name__),
+                "fn_name": fn_name,
                 "fn": fn,
                 "abstract": abstract,
                 "arg_shapes": _shape_summary(abstract),
                 "retraces": 0,
                 "compile_ms": None,
+                "warmed": _warming_now(),
+                "donated": _donated.get(id(fn)),
                 "analysis": None,       # filled lazily by analyze()
                 "analysis_error": None,
             }
             _programs[key] = entry
+            _by_sig[sig_key] = key
             _order.append(key)
             PROGRAMS_REGISTERED.set(len(_order))
+            if entry["warmed"]:
+                PROGRAMS_WARMED.set(sum(
+                    1 for e in _programs.values() if e.get("warmed")))
         entry["retraces"] += 1
         if compile_ms is not None:
             # keep the FIRST trace's wall time (trace+compile+first run);
@@ -146,30 +218,64 @@ def record(site, fn, args, compile_ms=None):
     return key
 
 
-def register_compiled(site, compiled, fn_name=None, compile_ms=None):
+def register_compiled(site, compiled, fn_name=None, compile_ms=None,
+                      signature=None, warmed=None):
     """Register an ALREADY-compiled executable (``jitted.lower(...)
-    .compile()``) — the AOT path tools/roofline.py and bench.py use, so
-    their measurement programs appear in ``telemetry.programs()`` and
-    their analyses never recompile.  Returns the entry dict."""
+    .compile()``) — the AOT path tools/roofline.py, bench.py, and
+    mx.aot warmup use, so their programs appear in
+    ``telemetry.programs()`` and their analyses never recompile.
+
+    ``signature`` (an argument pytree or ShapeDtypeStruct skeleton)
+    enables the (site, signature) double-registration guard: if the
+    same program was already recorded — or is later recorded by live
+    traffic — both registrations share ONE entry.  ``warmed`` defaults
+    to the thread's AOT-warming state.  Returns the entry dict."""
     key = (site, id(compiled), "aot")
+    abstract = fp = sig_key = None
+    if signature is not None:
+        try:
+            abstract = _abstractify(signature)
+            fp = _fingerprint(abstract)
+            sig_key = (site, fn_name or "compiled", fp)
+        except Exception:
+            abstract = sig_key = None
+    if warmed is None:
+        warmed = _warming_now()
     with _lock:
         entry = _programs.get(key)
+        if entry is None and sig_key is not None and sig_key in _by_sig:
+            entry = _programs.get(_by_sig[sig_key])
+        if entry is not None:
+            if warmed and not entry.get("warmed"):
+                entry["warmed"] = True
+                PROGRAMS_WARMED.set(sum(
+                    1 for e in _programs.values() if e.get("warmed")))
+            if compile_ms is not None and entry["compile_ms"] is None:
+                entry["compile_ms"] = round(float(compile_ms), 3)
         if entry is None:
             entry = {
                 "site": site,
                 "fn_name": fn_name or "compiled",
                 "fn": None,
-                "abstract": None,
-                "arg_shapes": None,
+                "abstract": abstract,
+                "arg_shapes": (_shape_summary(abstract)
+                               if abstract is not None else None),
                 "retraces": 1,
                 "compile_ms": (round(float(compile_ms), 3)
                                if compile_ms is not None else None),
+                "warmed": bool(warmed),
+                "donated": None,
                 "analysis": None,
                 "analysis_error": None,
             }
             _programs[key] = entry
+            if sig_key is not None:
+                _by_sig[sig_key] = key
             _order.append(key)
             PROGRAMS_REGISTERED.set(len(_order))
+            if entry["warmed"]:
+                PROGRAMS_WARMED.set(sum(
+                    1 for e in _programs.values() if e.get("warmed")))
     _analyze_entry(entry, compiled=compiled)
     return _public(entry)
 
@@ -256,9 +362,43 @@ def analyze(entry_or_index):
     return _analyze_entry(entry)
 
 
+def export_signatures(site=None):
+    """FULL (untruncated) program signatures for AOT manifests
+    (mx.aot.capture): per entry the site, fn_name, every argument
+    leaf's dtype/shape with the pytree structure string, donation, the
+    first-trace compile_ms and the warmed flag.  Entries registered
+    without a signature (bare register_compiled) are skipped — they
+    cannot be re-warmed from shapes alone."""
+    import jax
+    with _lock:
+        entries = [_programs[k] for k in _order]
+    out = []
+    for entry in entries:
+        if site is not None and entry["site"] != site:
+            continue
+        abstract = entry.get("abstract")
+        if abstract is None:
+            continue
+        leaves, treedef = jax.tree.flatten(
+            abstract, is_leaf=lambda x: x is None)
+        out.append({
+            "site": entry["site"],
+            "fn_name": entry["fn_name"],
+            "treedef": str(treedef),
+            "arg_specs": [[str(l.dtype), list(l.shape)]
+                          if l is not None else None for l in leaves],
+            "donated": (list(entry["donated"])
+                        if entry.get("donated") else None),
+            "compile_ms": entry["compile_ms"],
+            "warmed": bool(entry.get("warmed")),
+        })
+    return out
+
+
 def _public(entry, index=None):
     out = {k: entry[k] for k in ("site", "fn_name", "arg_shapes",
                                  "retraces", "compile_ms")}
+    out["warmed"] = bool(entry.get("warmed"))
     if index is not None:
         out["index"] = index
     a = entry["analysis"]
@@ -313,5 +453,8 @@ def clear():
     """Tests/teardown only."""
     with _lock:
         _programs.clear()
+        _by_sig.clear()
+        _donated.clear()
         del _order[:]
         PROGRAMS_REGISTERED.set(0)
+        PROGRAMS_WARMED.set(0)

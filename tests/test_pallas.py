@@ -540,3 +540,130 @@ def test_preemption_equivalence_under_pallas(model, monkeypatch):
         assert outs == ref
     finally:
         eng.stop()
+
+
+# ----------------------------------------------------------------------
+# fused LayerNorm (+residual) — registry-ranked kernel (docs/KERNELS.md)
+# ----------------------------------------------------------------------
+def _ln_jnp(x, g, b, res=None, eps=1e-5):
+    """Pure-jnp reference (the ops/nn.py fallback math)."""
+    xx = x + res if res is not None else x
+    mean = jnp.mean(xx, axis=-1, keepdims=True)
+    var = jnp.mean((xx - mean) ** 2, axis=-1, keepdims=True)
+    return (xx - mean) * jax.lax.rsqrt(var + eps) * g + b
+
+
+@pytest.mark.parametrize("with_res", [False, True])
+@pytest.mark.parametrize("shape", [(4, 33), (3, 5, 48)])
+def test_layernorm_kernel_parity_fwd_bwd(with_res, shape):
+    """layernorm_fused (interpret mode) vs the jnp reference: forward
+    plus every input gradient, with non-lane-aligned feature dims (33)
+    and rows that don't fill the 8-row tile — the masked-padding paths
+    of _ln_forward/_ln_backward."""
+    from mxnet_tpu.pallas import layernorm_fused
+    rng = np.random.RandomState(21)
+    cols = shape[-1]
+    x = _rand(rng, *shape)
+    res = _rand(rng, *shape) if with_res else None
+    g, b = _rand(rng, cols), _rand(rng, cols)
+    dy = _rand(rng, *shape)
+
+    out, mean, rstd = layernorm_fused(x, g, b, residual=res,
+                                      interpret=True)
+    ref = _ln_jnp(x, g, b, res)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=1e-6)
+    xx = x + res if with_res else x
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(jnp.mean(xx, axis=-1)),
+                               rtol=RTOL, atol=1e-6)
+    assert out.shape == x.shape and mean.shape == x.shape[:-1]
+
+    def loss_kernel(*args):
+        o, _, _ = layernorm_fused(args[0], args[1], args[2],
+                                  residual=args[3] if with_res else None,
+                                  interpret=True)
+        return jnp.sum(o * dy)
+
+    def loss_ref(*args):
+        return jnp.sum(_ln_jnp(args[0], args[1], args[2],
+                               args[3] if with_res else None) * dy)
+
+    argnums = (0, 1, 2, 3) if with_res else (0, 1, 2)
+    args = (x, g, b, res) if with_res else (x, g, b)
+    gk = jax.grad(loss_kernel, argnums=argnums)(*args)
+    gr = jax.grad(loss_ref, argnums=argnums)(*args)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_layernorm_op_parity(monkeypatch):
+    """pallas vs xla through the registered LayerNorm op: outputs and
+    every gradient agree, under jit, including the backward routed
+    through the fused _ln_backward kernel."""
+    from mxnet_tpu.ops.nn import layer_norm
+    rng = np.random.RandomState(22)
+    x = _rand(rng, 6, 33)
+    g, b = _rand(rng, 33), _rand(rng, 33)
+    dy = _rand(rng, 6, 33)
+
+    def run():
+        def loss(x, g, b):
+            out, _, _ = layer_norm(x, g, b)
+            return jnp.sum(out * dy)
+        out, _, _ = jax.jit(lambda *a: layer_norm(*a))(x, g, b)
+        grads = jax.grad(loss, argnums=(0, 1, 2))(x, g, b)
+        return out, grads
+
+    monkeypatch.setenv("MXNET_LN_IMPL", "xla")
+    ox, gx = run()
+    monkeypatch.setenv("MXNET_LN_IMPL", "pallas")
+    op_, gp = run()
+    np.testing.assert_allclose(np.asarray(ox), np.asarray(op_),
+                               rtol=RTOL, atol=1e-6)
+    for a, r in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_layernorm_knob_contract(monkeypatch):
+    """MXNET_LN_IMPL rides the same choose_impl contract as every other
+    kernel knob: xla always wins, auto falls back off-TPU, forcing
+    pallas runs interpret mode but still requires axis=-1."""
+    from mxnet_tpu.pallas import use_layernorm_pallas
+    monkeypatch.setenv("MXNET_LN_IMPL", "xla")
+    assert use_layernorm_pallas(True) is False
+    monkeypatch.setenv("MXNET_LN_IMPL", "auto")
+    assert use_layernorm_pallas(True) is False      # CPU container
+    monkeypatch.setenv("MXNET_LN_IMPL", "pallas")
+    assert use_layernorm_pallas(True) is True       # interpret mode
+    with pytest.raises(ValueError, match="cannot run here"):
+        use_layernorm_pallas(False)                 # axis != -1
+    monkeypatch.setenv("MXNET_LN_IMPL", "bogus")
+    with pytest.raises(ValueError, match=r"use auto\|pallas\|xla"):
+        use_layernorm_pallas(True)
+
+
+def test_layernorm_transformer_witness(monkeypatch):
+    """Forced on, the kernel serves the transformer symbol path: the
+    bound forward books pallas_kernel_launches{kernel=layernorm_fused}
+    and the containing executor program lands in telemetry.programs()."""
+    monkeypatch.setenv("MXNET_LN_IMPL", "pallas")
+    telemetry.programs.clear()
+    lc = PALLAS_LAUNCHES.labels(kernel="layernorm_fused")
+    before = lc.value
+    sym_lm = transformer.get_symbol(**CFG)
+    mod = mx.mod.Module(sym_lm, data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, SEQ))],
+             label_shapes=[("softmax_label", (2, SEQ))],
+             for_training=False)
+    mod.init_params(mx.init.Normal(0.02))
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.ones((2, SEQ), np.float32))], label=None)
+    mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].asnumpy()
+    assert lc.value > before          # kernel actually launched
+    progs = telemetry.programs(analyze=False, site="executor")
+    assert progs, "bound forward must register an executor program"
