@@ -1024,26 +1024,60 @@ def bench_kvstore_multihost(args):
     ``crosshost_bytes_per_step`` — wall time on a 1-core host measures
     process contention, not the collective. On this backend the engine
     uses the host transport (2 launches + 1 coordination-service
-    allgather per bucket); a real pod rides GSPMD at 1 launch."""
+    allgather per bucket); a real pod rides GSPMD at 1 launch.
+
+    Runs the world TWICE — backward-overlapped (default) vs
+    ``MXNET_KVSTORE_OVERLAP=0`` serial — under a bucket cap small
+    enough that the streaming flush engages, and GATES the A/B
+    (docs/KVSTORE.md "Overlapped push"): the overlapped arm must
+    dispatch no more programs per step than serial (overlap reorders
+    work, it never adds any) and its overlap witness must actually
+    fire; either failure is a SystemExit, not a report field."""
     import os
     import subprocess
     import sys as _sys
     root = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
-    proc = subprocess.run(
-        [_sys.executable, os.path.join(root, "tools", "run_multihost.py"),
-         "-n", str(args.kv_hosts), "--",
-         _sys.executable, os.path.join(root, "bench.py"),
-         "--mode", "kvstore-mh-worker", "--iters", str(args.iters),
-         "--batch", str(args.batch)],
-        env=env, capture_output=True, text=True, timeout=600)
-    if proc.returncode != 0:
-        raise SystemExit("bench: multi-host kvstore arm failed:\n%s"
-                         % proc.stderr[-2000:])
-    line = next(l for l in proc.stdout.splitlines()
-                if l.startswith("{") and "kvstore_hosts" in l)
-    return json.loads(line)
+
+    def arm(overlap):
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(root, "tools", "run_multihost.py"),
+             "-n", str(args.kv_hosts),
+             # cap = the largest key (256 KiB): full buckets stream out
+             # mid-push, the partial tail rides the sync point
+             "--env", "MXNET_KVSTORE_BIGARRAY_BOUND=262144",
+             "--env", "MXNET_KVSTORE_OVERLAP=%d" % overlap, "--",
+             _sys.executable, os.path.join(root, "bench.py"),
+             "--mode", "kvstore-mh-worker", "--iters", str(args.iters),
+             "--batch", str(args.batch)],
+            env=env, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise SystemExit("bench: multi-host kvstore arm failed:\n%s"
+                             % proc.stderr[-2000:])
+        line = next(l for l in proc.stdout.splitlines()
+                    if l.startswith("{") and "kvstore_hosts" in l)
+        return json.loads(line)
+
+    ov, ser = arm(1), arm(0)
+    if ov["kvstore_overlap_dispatches_per_step"] <= 0:
+        raise SystemExit(
+            "bench: overlap witness never fired — no bucket collective "
+            "was dispatched before the final backward bucket landed")
+    if ser["kvstore_overlap_dispatches_per_step"] != 0:
+        raise SystemExit("bench: MXNET_KVSTORE_OVERLAP=0 arm still "
+                         "ticked the overlap witness")
+    if ov["kvstore_mh_dispatches_per_step"] > \
+            ser["kvstore_mh_dispatches_per_step"]:
+        raise SystemExit(
+            "bench: overlapped push dispatched MORE programs per step "
+            "than serial (%.2f > %.2f) — overlap must reorder work, "
+            "not add any" % (ov["kvstore_mh_dispatches_per_step"],
+                             ser["kvstore_mh_dispatches_per_step"]))
+    ov["kvstore_mh_serial_dispatches_per_step"] = \
+        ser["kvstore_mh_dispatches_per_step"]
+    return ov
 
 
 def bench_kvstore_mh_worker(args):
@@ -1069,11 +1103,15 @@ def bench_kvstore_mh_worker(args):
         kv.push(keys, [[nd.array(grng.normal(0, 0.01, s)
                                  .astype(np.float32))] for s in shapes])
     step()                                  # warmup: trace + compile
-    steps = max(4, min(args.iters, 16))
+    kv._sync_engine()     # land the warmup's pipelined applies before
+    steps = max(4, min(args.iters, 16))     # snapshotting the counters
     xb = telemetry.REGISTRY.get("kvstore_tpu_crosshost_bytes")
-    d0, x0 = profiler.DEVICE_DISPATCHES.value, xb.value
+    wit = telemetry.REGISTRY.get("kvstore_overlap_dispatches")
+    d0, x0, w0 = (profiler.DEVICE_DISPATCHES.value, xb.value,
+                  wit.value)
     for _ in range(steps):
         step()
+    kv._sync_engine()
     kv.barrier()
     if kv.rank == 0:
         print(json.dumps({
@@ -1082,10 +1120,108 @@ def bench_kvstore_mh_worker(args):
                 int((xb.value - x0) / steps),
             "kvstore_mh_dispatches_per_step":
                 round((profiler.DEVICE_DISPATCHES.value - d0) / steps, 2),
+            "kvstore_overlap_dispatches_per_step":
+                round((wit.value - w0) / steps, 2),
             "kvstore_mh_transport":
                 "gspmd" if kv._gspmd_ok else "host",
             "kvstore_mh_keys": len(keys),
             "kvstore_mh_steps": steps,
+        }))
+
+
+def bench_dlrm_partition(args):
+    """Multi-host arm of ``--mode dlrm``: spawn a ``--dlrm-hosts``-
+    process kvstore='tpu' world where the stacked table row-partitions
+    ACROSS hosts (docs/EMBEDDING.md "Multi-host partitioning") and GATE
+    the pod-partitioning acceptance criteria: resident table bytes per
+    host must scale as 1/W and the cross-host row_sparse apply must
+    stay at ONE sparse dispatch per step (the replicated host transport
+    needs two). Either failure is a SystemExit, not a report field."""
+    import os
+    import subprocess
+    import sys as _sys
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "run_multihost.py"),
+         "-n", str(args.dlrm_hosts), "--",
+         _sys.executable, os.path.join(root, "bench.py"),
+         "--mode", "dlrm-part-worker", "--iters", str(args.iters)],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise SystemExit("bench: multi-host dlrm arm failed:\n%s"
+                         % proc.stderr[-2000:])
+    line = next(l for l in proc.stdout.splitlines()
+                if l.startswith("{") and "dlrm_hosts" in l)
+    out = json.loads(line)
+    W = out["dlrm_hosts"]
+    if not out["dlrm_partitioned"]:
+        raise SystemExit("bench: table did not partition in a %d-host "
+                         "world" % W)
+    if out["table_bytes_per_host_ratio"] > 1.0 / W + 1e-6:
+        raise SystemExit(
+            "bench: table_bytes_per_host_ratio %.3f > 1/%d — the slab "
+            "did not replace the replicated table"
+            % (out["table_bytes_per_host_ratio"], W))
+    if out["crosshost_sparse_dispatches_per_step"] != 1:
+        raise SystemExit(
+            "bench: partitioned sparse apply took %.2f dispatches/step "
+            "(want exactly 1 — the single cross-host launch)"
+            % out["crosshost_sparse_dispatches_per_step"])
+    return out
+
+
+def bench_dlrm_part_worker(args):
+    """One rank of the pod-partitioned DLRM arm (spawned by
+    bench_dlrm_partition under the MXTPU_* env contract). Rank 0
+    prints the JSON line the parent parses and gates on."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, telemetry
+    from mxnet_tpu.embedding import ShardedEmbedding
+    from mxnet_tpu.embedding.engine import SPARSE_DISPATCHES
+    from mxnet_tpu.embedding.lookup import LOOKUPS
+
+    V, D, F, B = 64, 8, 4, 8
+    kv = mx.kv.create("tpu")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05,
+                                      lazy_update=True,
+                                      rescale_grad=1.0 / B))
+    blk = ShardedEmbedding(F * V, D)
+    blk.initialize()
+    tbl = telemetry.REGISTRY.get("embedding_table_bytes_per_host")
+    a2a = telemetry.REGISTRY.get("embedding_alltoall_bytes")
+    key = blk.attach_to_kvstore(kv)
+    part = kv._partitioned.get(key)
+    rng = np.random.RandomState(11 + kv.rank)   # per-rank index stream
+    offs = (np.arange(F) * V)[None, :]
+
+    def step():
+        idx = np.minimum(rng.zipf(1.2, size=(B, F)) - 1, V - 1) + offs
+        with autograd.record():
+            out = blk(nd.array(idx))
+        out._grad = nd.array(rng.normal(0, 1, out.shape)
+                             .astype(np.float32))
+        blk.sparse_push(kv, key=key)
+
+    step()                                  # warmup: trace + compile
+    steps = max(4, min(args.iters, 12))
+    s0, l0, a0 = SPARSE_DISPATCHES.value, LOOKUPS.value, a2a.value
+    for _ in range(steps):
+        step()
+    kv.barrier()
+    if kv.rank == 0:
+        print(json.dumps({
+            "dlrm_hosts": kv.num_workers,
+            "dlrm_partitioned": part is not None,
+            "table_bytes_per_host_ratio":
+                round(tbl.value / (F * V * D * 4), 3),
+            "crosshost_sparse_dispatches_per_step":
+                round((SPARSE_DISPATCHES.value - s0) / steps, 2),
+            "crosshost_lookup_dispatches_per_step":
+                round((LOOKUPS.value - l0) / steps, 2),
+            "embedding_alltoall_bytes_per_step":
+                int((a2a.value - a0) / steps),
         }))
 
 
@@ -1194,6 +1330,9 @@ def bench_dlrm(args):
 
     hbm = telemetry.REGISTRY.get("embedding_hbm_bytes")
     dev = jax.devices()[0]
+    mh = bench_dlrm_partition(args) if args.dlrm_hosts > 1 else {
+        "dlrm_hosts": 1, "table_bytes_per_host_ratio": 1.0,
+        "crosshost_sparse_dispatches_per_step": 0}
     return {
         "metric": "dlrm_lookups_per_sec",
         "value": round(B * F * steps / dt, 1),
@@ -1211,6 +1350,7 @@ def bench_dlrm(args):
         "embedding_hbm_bytes": int(hbm.value),
         "dlrm_parity_rel_err": float(err),
         **_latency_fields(hist, compile_ms),
+        **mh,
     }
 
 
@@ -2107,7 +2247,8 @@ def main():
     ap.add_argument("--mode", type=str, default="train",
                     choices=["train", "inference", "serving", "checkpoint",
                              "kvstore", "kvstore-mh-worker",
-                             "fit", "decode", "dlrm", "transformer",
+                             "fit", "decode", "dlrm", "dlrm-part-worker",
+                             "transformer",
                              "coldstart", "coldstart-worker"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
@@ -2208,6 +2349,10 @@ def main():
     ap.add_argument("--dlrm-batch", type=int, default=128,
                     help="batch * features must be a power of two "
                          "(single-dispatch lookup)")
+    ap.add_argument("--dlrm-hosts", type=int, default=2,
+                    help="process count of the pod-partitioned "
+                         "embedding arm (spawned via "
+                         "tools/run_multihost.py; 1 skips the arm)")
     args = ap.parse_args()
 
     if args.pipeline_scaling:
@@ -2224,6 +2369,9 @@ def main():
         return
     if args.mode == "dlrm":
         print(json.dumps(bench_dlrm(args)))
+        return
+    if args.mode == "dlrm-part-worker":
+        bench_dlrm_part_worker(args)
         return
     if args.mode == "fit":
         print(json.dumps(bench_fit(args)))

@@ -2,7 +2,7 @@
 
 On a pod, the coordination-service collectives
 (``kvstore_tpu.dist.barrier/allgather_bytes/broadcast_bytes/
-allreduce_sum_np``) are SPMD: every rank must issue the same
+allreduce_sum_np/alltoall_bytes``) are SPMD: every rank must issue the same
 collectives, with the same tags, in the same program order — a
 rank-divergent collective is a silent pod hang, the exact class PR 8's
 watchdog only catches at runtime (and only after the fact).  Three
@@ -34,7 +34,7 @@ import ast
 from .core import Pass, parents
 
 COLLECTIVES = {"barrier", "allgather_bytes", "broadcast_bytes",
-               "allreduce_sum_np"}
+               "allreduce_sum_np", "alltoall_bytes"}
 DIST_MODULE = "mxnet_tpu.kvstore_tpu.dist"
 RANK_ATTRS = {"process_index", "process_id", "rank", "_rank"}
 RANK_NAMES = {"rank", "_rank", "pid", "process_id", "process_index"}

@@ -58,6 +58,7 @@ THREADED_MODULES = (
     "mxnet_tpu/embedding/sharding.py",
     "mxnet_tpu/embedding/lookup.py",
     "mxnet_tpu/embedding/engine.py",
+    "mxnet_tpu/kvstore_tpu/engine.py",
     "mxnet_tpu/profiler.py",
     "mxnet_tpu/io/io.py",
     "mxnet_tpu/image/record_iter.py",

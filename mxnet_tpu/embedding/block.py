@@ -53,6 +53,7 @@ class ShardedEmbedding(Block):
         self._kv = None
         self._kv_key = None
         self._placed = False
+        self._partition = None  # (lo, hi) once pod-partitioned
 
     # -- forward --------------------------------------------------------
     def forward(self, x):
@@ -63,7 +64,14 @@ class ShardedEmbedding(Block):
             self._placed = True
         idx = _np.asarray(x._data if isinstance(x, NDArray) else x)
         with autograd.pause():
-            out = NDArray(_lookup.lookup(w._data, idx), w.context)
+            if self._partition is not None:
+                lo, hi = self._partition
+                out = NDArray(
+                    _lookup.lookup_partitioned(w._data, idx, lo, hi,
+                                               self._input_dim),
+                    w.context)
+            else:
+                out = NDArray(_lookup.lookup(w._data, idx), w.context)
         if autograd.is_recording():
             # leaf-mark the lookup output: backward stops here and the
             # dense dy lands in out._grad, batch-sized — the huge table
@@ -100,18 +108,44 @@ class ShardedEmbedding(Block):
     def attach_to_kvstore(self, kv, key=None):
         """Register the table with ``kv`` and alias the parameter to the
         stored value so in-place engine updates are immediately visible
-        to the next forward."""
+        to the next forward.
+
+        In a multi-process world (or under ``MXNET_EMBED_PARTITION=1``)
+        an eligible table is row-partitioned ACROSS hosts
+        (docs/EMBEDDING.md "Multi-host partitioning"): the store keeps
+        only this rank's ``sharding.row_range`` slab, table bytes per
+        host scale as 1/W, and lookups/pushes route through the
+        all-to-all transport. Ineligible tables (vocab not divisible by
+        the world, non-f32) stay replicated under a narrow
+        ``kvstore_fallbacks`` slug."""
         if self.weight._data is None:
             raise MXNetError(
                 "initialize() the block before attach_to_kvstore")
         key = key if key is not None else "embedding:%s" % self.weight.name
         kv.init(key, self.weight.data())
         stored = kv._store[key]
-        stored._set_data(_sharding.place_table(stored._data))
+        dec, arg = _sharding.partition_decision(self._input_dim,
+                                                stored.dtype)
+        if dec == "partition":
+            from ..kvstore_tpu import dist
+            lo, hi = _sharding.row_range(self._input_dim, dist.rank(),
+                                         arg)
+            slab = NDArray(stored._data[lo:hi], stored.context)
+            kv._store[key] = slab
+            kv._partitioned[key] = (lo, hi, self._input_dim)
+            self._partition = (lo, hi)
+            stored = slab
+        else:
+            if arg is not None:
+                from ..kvstore import _note_fallback
+                _note_fallback(
+                    arg, detail="embedding table stays replicated")
+            stored._set_data(_sharding.place_table(stored._data))
         self.weight._data = stored
         self._placed = True
         self._kv, self._kv_key = kv, key
         _sharding.account_bytes(key, stored._data.nbytes)
+        _sharding.account_table_bytes(key, stored._data.nbytes)
         return key
 
     def sparse_push(self, kv=None, key=None, priority=0):

@@ -54,31 +54,57 @@ def _as_np(arr):
     return _np.asarray(arr._data if hasattr(arr, "_data") else arr)
 
 
-def save_tables(prefix, tag, tables, states=None, residuals=None):
+def save_tables(prefix, tag, tables, states=None, residuals=None,
+                partitioned=None):
     """Checkpoint ``tables`` ({name: NDArray-or-jax (vocab, dim)}), with
     optional parallel dicts of optimizer states and error-feedback
     residuals. Collective in a multi-process world: every rank must
     call with the same names and tag. Returns the manifest path (every
-    rank; only rank 0 wrote it)."""
+    rank; only rank 0 wrote it).
+
+    ``partitioned`` ({name: (lo, hi, vocab)}, e.g. ``kv._partitioned``)
+    marks entries whose value is THIS RANK'S OWNED ROW SLAB of a
+    pod-partitioned table (docs/EMBEDDING.md): the slab persists as
+    rows [lo, hi) of the full (vocab, dim) table, and the matching
+    state/residual entries are slab-shaped and persist whole instead of
+    being sliced. The shard format is identical either way — because
+    bounds are absolute, a W=2 partitioned checkpoint restores into a
+    W=1 (or replicated) job through the same ``load_tables``."""
     rank, world = _world()
     states = states or {}
     residuals = residuals or {}
+    partitioned = partitioned or {}
     shard = {}
     for name, table in tables.items():
         host = _as_np(table)
-        rows, lo, hi = _sharding.owned_slice(host, rank, world)
         st = _as_np(states.get(name))
         res = _as_np(residuals.get(name))
+        part = partitioned.get(name)
+        if part is not None:
+            lo, hi, vocab = int(part[0]), int(part[1]), int(part[2])
+            rows = host                       # already the owned slab
+            full_shape = (vocab,) + tuple(host.shape[1:])
+            st_rows = [_np.ascontiguousarray(s) for s in st] \
+                if isinstance(st, list) \
+                else (_np.ascontiguousarray(st) if st is not None
+                      else None)
+            res_rows = _np.ascontiguousarray(res) \
+                if res is not None else None
+        else:
+            rows, lo, hi = _sharding.owned_slice(host, rank, world)
+            full_shape = tuple(host.shape)
+            st_rows = [_np.ascontiguousarray(s[lo:hi]) for s in st] \
+                if isinstance(st, list) \
+                else (_np.ascontiguousarray(st[lo:hi])
+                      if st is not None else None)
+            res_rows = _np.ascontiguousarray(res[lo:hi]) \
+                if res is not None else None
         shard[name] = {
             "lo": lo, "hi": hi,
-            "shape": tuple(host.shape), "dtype": str(host.dtype),
+            "shape": full_shape, "dtype": str(host.dtype),
             "rows": _np.ascontiguousarray(rows),
-            "state": [ _np.ascontiguousarray(s[lo:hi]) for s in st ]
-                     if isinstance(st, list)
-                     else (_np.ascontiguousarray(st[lo:hi])
-                           if st is not None else None),
-            "residual": _np.ascontiguousarray(res[lo:hi])
-                        if res is not None else None,
+            "state": st_rows,
+            "residual": res_rows,
         }
     shard_path = _SHARD_FMT % (prefix, tag, rank)
     _manifest.atomic_write(shard_path, pickle.dumps(shard, protocol=4))

@@ -114,6 +114,11 @@ class KVStore:
         # docs/EMBEDDING.md); shares the bucketing toggle — both are
         # "the compiled hot path" from the operator's point of view
         self._sparse_engine = None
+        # key -> (lo, hi, vocab) for embedding tables whose stored value
+        # is THIS RANK'S row slab of a pod-partitioned (vocab, dim)
+        # table (ShardedEmbedding.attach_to_kvstore, docs/EMBEDDING.md);
+        # such keys have no full local copy to pull or eager-update
+        self._partitioned = {}
 
     @property
     def type(self):
@@ -174,6 +179,17 @@ class KVStore:
                     if seng is not None and sreason is None:
                         seng.push(k, vlist, prio)
                     else:
+                        if k in self._partitioned:
+                            # a row slab cannot take the eager per-key
+                            # path — there is no full local table to
+                            # reduce into
+                            raise MXNetError(
+                                "push: key %r is row-partitioned across "
+                                "hosts and must take the compiled sparse "
+                                "path (blocked: %s); use an optimizer "
+                                "with a fused sparse signature or set "
+                                "MXNET_EMBED_PARTITION=0"
+                                % (k, sreason or "bucketing disabled"))
                         if seng is not None:
                             _note_fallback(sreason, detail="key %r" % (k,))
                         self._push_one(k, vlist)
@@ -240,6 +256,10 @@ class KVStore:
     def _flush_pending(self):
         if self._engine is not None:
             self._engine.flush()
+            # the tpu engine's overlapped host transport applies buckets
+            # on a pipeline thread; every sync point must see them land
+            # before reading weights/state (docs/KVSTORE.md)
+            self._engine.synchronize()
 
     def _sync_engine(self):
         """Flush pending buckets under the CURRENT mode, then spill flat
@@ -276,6 +296,13 @@ class KVStore:
             for k, olist in zip(keys, outs):
                 if k not in self._store:
                     raise MXNetError("key %s not initialized" % k)
+                if k in self._partitioned:
+                    raise MXNetError(
+                        "pull: key %r is row-partitioned across hosts — "
+                        "no rank holds the full table; read rows through "
+                        "the partitioned lookup (ShardedEmbedding "
+                        "forward) or checkpoint via "
+                        "embedding.checkpoint.save_tables" % (k,))
                 src = self._store[k]
                 for o in olist:
                     o._set_data(src._data)
@@ -312,6 +339,11 @@ class KVStore:
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
+            if k in self._partitioned:
+                raise MXNetError(
+                    "row_sparse_pull: key %r is row-partitioned across "
+                    "hosts; read rows through the partitioned lookup "
+                    "(ShardedEmbedding forward) instead" % (k,))
             src = self._store[k]
             for o in olist:
                 rids = rid_list[i]

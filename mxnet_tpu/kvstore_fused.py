@@ -50,7 +50,8 @@ from . import telemetry as _telemetry
 from . import fused_update as _fused
 
 __all__ = ["FusedBucketEngine", "bucket_byte_cap", "TRACE_COUNT",
-           "two_bit_quantize", "fused_sgd_apply"]
+           "two_bit_quantize", "fused_sgd_apply", "overlap_enabled",
+           "OVERLAP_DISPATCHES", "OVERLAP_WINDOW_MS"]
 
 
 def two_bit_quantize(residual, grad, threshold):
@@ -102,6 +103,30 @@ DISPATCH_MS = _telemetry.REGISTRY.histogram(
     "kvstore_dispatch_ms",
     "host wall time to dispatch one bucket program (async enqueue)",
     unit="ms")
+# backward-overlap witness (docs/KVSTORE.md "Overlapped push"): a bucket
+# dispatched by the STREAMING flush leaves the host before the final
+# backward bucket's grads have even been enqueued — comms provably
+# overlap the remaining backward walk. Ticked only there (never by the
+# end-of-push flush), so a positive delta IS the overlap proof the
+# bench/tests gate on.
+OVERLAP_DISPATCHES = _telemetry.REGISTRY.counter(
+    "kvstore_overlap_dispatches",
+    "bucket programs dispatched by the streaming flush BEFORE the final "
+    "backward bucket landed (the comm/compute overlap witness)",
+    vital=True)
+OVERLAP_WINDOW_MS = _telemetry.REGISTRY.histogram(
+    "kvstore_overlap_window_ms",
+    "host wall time from the first overlapped bucket dispatch of a push "
+    "walk to the walk's final flush (the window comms had to hide in "
+    "backward)", unit="ms")
+
+
+def overlap_enabled():
+    """Backward-overlapped bucket dispatch (``MXNET_KVSTORE_OVERLAP``,
+    default on). 0 restores the serial shape: streaming-flushed buckets
+    still dispatch in availability order, but the cross-host wire (tpu
+    host transport) runs inline and the overlap witness stays silent."""
+    return os.environ.get("MXNET_KVSTORE_OVERLAP", "1") != "0"
 # shared RetraceSite semantics with executor / fused_fit: step bodies
 # call _note_retrace() at trace time; _dispatch times through it.
 # _dispatch wraps a non-jitted inner, so bucket programs register with
@@ -285,6 +310,15 @@ class FusedBucketEngine:
         self.last_flush_buckets = []   # [[keys]] in dispatch order
         self.stats = {"flushes": 0, "buckets": 0, "keys": 0,
                       "bytes_pushed": 0}
+        # comm/compute overlap (docs/KVSTORE.md "Overlapped push"):
+        # _streaming marks dispatches issued by the mid-push streaming
+        # flush (they overlap the rest of the backward walk by
+        # construction); _overlap_t0 opens the per-walk overlap window
+        # at the first such dispatch and the next end-of-push flush
+        # closes it into kvstore_overlap_window_ms
+        self._overlap = overlap_enabled()
+        self._streaming = False
+        self._overlap_t0 = None
 
     # -- eligibility ----------------------------------------------------
     def _updater_mode(self):
@@ -397,6 +431,13 @@ class FusedBucketEngine:
         trailing bucket still below the byte cap stays pending so
         steady-state bucket shapes don't depend on where mid-push
         flushes landed."""
+        if not keep_partial and self._overlap_t0 is not None:
+            # the walk that opened an overlap window is landing its
+            # final bucket: close the window (time comms had to hide)
+            import time
+            OVERLAP_WINDOW_MS.observe(
+                (time.perf_counter() - self._overlap_t0) * 1e3)
+            self._overlap_t0 = None
         if not self._pending:
             return
         items = sorted(self._pending, key=lambda it: (-it.priority, it.seq))
@@ -418,8 +459,15 @@ class FusedBucketEngine:
         self.last_flush_buckets = [[it.key for it in b] for b in buckets]
         items = [it for b in buckets for it in b]
         mode = self._updater_mode()
-        for bucket in buckets:
-            self._dispatch(bucket, mode)
+        if keep_partial and self._overlap and self._overlap_t0 is None:
+            import time
+            self._overlap_t0 = time.perf_counter()
+        self._streaming = keep_partial
+        try:
+            for bucket in buckets:
+                self._dispatch(bucket, mode)
+        finally:
+            self._streaming = False
         comp = self._kv._compression
         nbytes = sum(it.size * it.itemsize * it.n_dev for it in items)
         self.stats["flushes"] += 1
@@ -437,8 +485,20 @@ class FusedBucketEngine:
     def _dispatch(self, bucket, mode):
         from .executor import _count_dispatch
         _count_dispatch()       # one compiled bucket program per call
+        if self._streaming and self._overlap:
+            # dispatched before the final backward bucket landed: the
+            # program (XLA-async; the tpu host transport's wire rides
+            # the pipeline thread) overlaps the rest of the walk
+            OVERLAP_DISPATCHES.inc()
         return _SITE.timed(self._dispatch_inner, bucket, mode,
                            dispatch_hist=DISPATCH_MS)
+
+    def synchronize(self):
+        """Block until every dispatched bucket's side effects are
+        visible on this host. The base engine's dispatches are XLA-async
+        only (jax arrays synchronize at first read), so this is a no-op;
+        the tpu engine overrides it to drain its pipelined wire thread.
+        Called by the kvstore's sync points (pull/barrier/state save)."""
 
     def _updater_inputs(self, bucket):
         """Collect the live optimizer-apply inputs for one bucket (and

@@ -38,7 +38,7 @@ from ..base import MXNetError
 
 __all__ = ["initialize_from_env", "ensure_initialized", "world_size",
            "rank", "process_mesh", "barrier", "allgather_bytes",
-           "broadcast_bytes", "allreduce_sum_np"]
+           "broadcast_bytes", "allreduce_sum_np", "alltoall_bytes"]
 
 _lock = threading.Lock()
 _state = {"checked": False, "seq": {}}
@@ -279,6 +279,41 @@ def broadcast_bytes(tag, payload, root=0, timeout_ms=None):
     c.wait_at_barrier(key + "/done", t)
     if jax.process_index() == root:
         _cleanup(c, key)
+    return out
+
+
+def alltoall_bytes(tag, payloads, timeout_ms=None):
+    """All-to-all exchange of one bytes payload per destination rank:
+    ``payloads[j]`` goes to rank j, and rank i's return value is the
+    rank-ordered list whose j-th element is what rank j addressed to i
+    (single-process: ``[payloads[0]]``). The partitioned-embedding
+    transport (docs/EMBEDDING.md): indices route to their owner ranks,
+    gathered rows route back."""
+    import jax
+    n = jax.process_count()
+    if len(payloads) != n:
+        raise MXNetError(
+            "kvstore='tpu': alltoall_bytes needs exactly one payload per "
+            "process (%d != %d)" % (len(payloads), n))
+    if n == 1:
+        return [bytes(payloads[0])]
+    c = _client()
+    r = jax.process_index()
+    t = timeout_ms or _DEFAULT_TIMEOUT_MS
+    base = "mxtpu/a2a/%s/%d" % (tag, _next_seq("a2a" + tag))
+    # frame every lane: an all-to-all lane is legitimately EMPTY (no
+    # indices owned by that rank this step), and the coordination
+    # service's bytes get SEGFAULTS on values shorter than 2 bytes —
+    # a fixed 4-byte prefix keeps every stored value comfortably long
+    for j, p in enumerate(payloads):
+        c.key_value_set_bytes("%s/%d/%d" % (base, r, j),
+                              b"MXA2" + bytes(p))
+    out = [c.blocking_key_value_get_bytes("%s/%d/%d" % (base, i, r),
+                                          t)[4:]
+           for i in range(n)]
+    c.wait_at_barrier(base + "/done", t)
+    for j in range(n):
+        _cleanup(c, "%s/%d/%d" % (base, r, j))
     return out
 
 

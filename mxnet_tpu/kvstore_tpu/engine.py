@@ -37,6 +37,8 @@ residuals stay host-local per (process, device-stream).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as _np
 import jax
 import jax.numpy as jnp
@@ -63,6 +65,83 @@ ALLGATHER_MS = _telemetry.REGISTRY.histogram(
     "kvstore_tpu_allgather_ms",
     "host wall time of one coordination-service allgather (the CPU-"
     "backend transport; unused when reduction rides GSPMD)", unit="ms")
+
+
+class _OverlapPipeline:
+    """FIFO worker thread carrying the host transport's wire+apply
+    stages so bucket N's coordination-service transfer overlaps the
+    quantize of bucket N+1 on the main thread (docs/KVSTORE.md
+    "Overlapped push").
+
+    Ordering is the correctness load-bearing property: every rank
+    submits buckets in the same program order (SPMD push semantics) and
+    the single worker executes them FIFO, so the ``kvpush`` collective
+    sequence numbers pair across ranks exactly as the serial transport
+    paired them. When the pipeline is active, the MAIN thread never
+    issues a ``kvpush`` collective itself — mixed-thread issue orders of
+    one tag would pair different ranks' epochs against each other.
+
+    A job failure parks the exception and poisons the queue; the next
+    ``submit``/``drain`` (every kvstore sync point drains) re-raises on
+    the main thread.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._jobs = []
+        self._active = 0           # queued + in-flight jobs
+        self._exc = None
+        self._thread = None
+
+    def _ensure_thread(self):
+        # caller holds _cv
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="mx-kvstore-overlap")
+            self._thread.start()
+
+    def _raise_pending(self):
+        # caller holds _cv
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, job):
+        with self._cv:
+            self._raise_pending()
+            self._ensure_thread()
+            self._jobs.append(job)
+            self._active += 1
+            self._cv.notify_all()
+
+    def drain(self):
+        """Block until every submitted job has completed (or one of
+        them failed, in which case its exception surfaces here)."""
+        with self._cv:
+            while self._active and self._exc is None:
+                self._cv.wait()
+            self._raise_pending()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._jobs:
+                    self._cv.wait()
+                job = self._jobs.pop(0)
+            try:
+                job()
+            except BaseException as e:      # park for the main thread
+                with self._cv:
+                    self._exc = e
+                    self._jobs.clear()
+                    self._active = 0
+                    self._cv.notify_all()
+                continue
+            with self._cv:
+                self._active -= 1
+                if not self._active:
+                    self._cv.notify_all()
 
 
 def _build_tpu_step(layout, n_dev, nproc, threshold, mode, tpls, mp_flags,
@@ -204,7 +283,19 @@ class TPUBucketEngine(FusedBucketEngine):
         self._gspmd = dist.gspmd_supported()
         self._mesh = dist.process_mesh() if self._gspmd else None
         self._local_dev = jax.local_devices()[0]
+        # host-transport overlap: the wire+apply of each bucket rides a
+        # FIFO pipeline thread so transfers overlap the next bucket's
+        # quantize (GSPMD buckets are XLA-async already and need none)
+        self._pipeline = _OverlapPipeline() \
+            if (self._overlap and not self._gspmd) else None
         HOSTS.set(self._nproc)
+
+    def synchronize(self):
+        """Land every pipelined wire+apply before the caller reads
+        weights or optimizer state (kvstore sync points call this right
+        after ``flush``)."""
+        if self._pipeline is not None:
+            self._pipeline.drain()
 
     # -- global-array lifting (metadata-only, no device launches) ------
     def _shard_spec(self):
@@ -326,8 +417,20 @@ class TPUBucketEngine(FusedBucketEngine):
                                                  for r in new_res]
 
     def _dispatch_host(self, bucket, mode):
-        """CPU-backend multi-process transport: local program -> host
-        allgather (rank-order sum) -> local apply program."""
+        """CPU-backend multi-process transport: local quantize program
+        -> host allgather (rank-order sum) -> local apply program.
+
+        With overlap on (the default), the wire+apply stages run as ONE
+        FIFO pipeline job so bucket N's coordination-service transfer
+        overlaps bucket N+1's quantize on the main thread; the payload
+        fetch (the device sync on the quantize output) moves onto the
+        pipeline thread too. Everything ORDER-SENSITIVE on the host —
+        program-cache fills, residual record updates, the updater's
+        update-count/lr/wd side effects — stays on the main thread in
+        push order, so overlapped and serial runs are bit-identical;
+        the job only reads weight/state ``._data`` AFTER the previous
+        bucket's apply wrote them (FIFO), exactly like the serial
+        interleaving."""
         import time
         from ..executor import _count_dispatch
         kv = self._kv
@@ -355,36 +458,53 @@ class TPUBucketEngine(FusedBucketEngine):
         if keys_tuple is not None:
             self._flat_res[keys_tuple]["res"] = list(new_res)
 
-        # analyze: ok(hostsync) the host transport crosses the wire by design (CPU-backend multiprocess); priced in kvstore_tpu_allgather_ms
-        payload = _np.ascontiguousarray(_np.asarray(flat_q))
-        self._wire_bytes(payload.nbytes)
-        t0 = time.perf_counter()
-        red_np = dist.allreduce_sum_np("kvpush", payload)
-        ALLGATHER_MS.observe((time.perf_counter() - t0) * 1e3)
-
         ctx0 = bucket[0].likes[0].context
         if mode is None:
-            for it, (off, size, shape) in zip(bucket, layout):
-                kv._store[it.key] = NDArray(
-                    jnp.asarray(red_np[off:off + size].reshape(shape)),
-                    ctx0)
-            return
-        (weights_nd, state_leaves, tpls, mp_flags, lr_vec, wd_vec,
-         extra, use_wd, rescale) = self._updater_inputs(bucket)
-        sig = ("tpu-host-apply", mode, layout, tpls, mp_flags, use_wd)
-        fn = self._steps.get(sig)
-        if fn is None:
-            fn = self._steps[sig] = _build_local_apply(
-                layout, tpls, mp_flags, use_wd, mode)
-        _count_dispatch()       # the apply is a second device launch
-        weights = tuple(w._data for w in weights_nd)
-        states = tuple(tuple(l._data for l in leaves)
-                       for leaves in state_leaves)
-        new_ws, new_ss = _SITE.timed(
-            fn, weights, states, jnp.asarray(red_np), lr_vec, wd_vec,
-            rescale, extra, dispatch_hist=DISPATCH_MS)
-        for w, leaves, nw, ns in zip(weights_nd, state_leaves,
-                                     new_ws, new_ss):
-            w._set_data(nw)
-            for l, nl in zip(leaves, ns):
-                l._set_data(nl)
+            apply_inputs = None
+        else:
+            apply_inputs = self._updater_inputs(bucket)
+            tpls, mp_flags, use_wd = (apply_inputs[2], apply_inputs[3],
+                                      apply_inputs[7])
+            sig = ("tpu-host-apply", mode, layout, tpls, mp_flags,
+                   use_wd)
+            fn_apply = self._steps.get(sig)
+            if fn_apply is None:
+                fn_apply = self._steps[sig] = _build_local_apply(
+                    layout, tpls, mp_flags, use_wd, mode)
+
+        def wire_and_apply():
+            # analyze: ok(hostsync) the host transport crosses the wire by design (CPU-backend multiprocess); priced in kvstore_tpu_allgather_ms
+            payload = _np.ascontiguousarray(_np.asarray(flat_q))
+            self._wire_bytes(payload.nbytes)
+            t0 = time.perf_counter()
+            red_np = dist.allreduce_sum_np("kvpush", payload)
+            ALLGATHER_MS.observe((time.perf_counter() - t0) * 1e3)
+            if apply_inputs is None:
+                for it, (off, size, shape) in zip(bucket, layout):
+                    kv._store[it.key] = NDArray(
+                        jnp.asarray(red_np[off:off + size]
+                                    .reshape(shape)), ctx0)
+                return
+            (weights_nd, state_leaves, _tpls, _mp, lr_vec, wd_vec,
+             extra, _use_wd, rescale) = apply_inputs
+            _count_dispatch()   # the apply is a second device launch
+            weights = tuple(w._data for w in weights_nd)
+            states = tuple(tuple(l._data for l in leaves)
+                           for leaves in state_leaves)
+            new_ws, new_ss = _SITE.timed(
+                fn_apply, weights, states, jnp.asarray(red_np), lr_vec,
+                wd_vec, rescale, extra, dispatch_hist=DISPATCH_MS)
+            for w, leaves, nw, ns in zip(weights_nd, state_leaves,
+                                         new_ws, new_ss):
+                w._set_data(nw)
+                for l, nl in zip(leaves, ns):
+                    l._set_data(nl)
+
+        if self._pipeline is not None:
+            # ALL kvpush wire traffic rides the pipeline when overlap is
+            # on (not just streaming-flushed buckets): one FIFO issue
+            # order per rank keeps the collective sequence numbers
+            # paired across ranks
+            self._pipeline.submit(wire_and_apply)
+        else:
+            wire_and_apply()

@@ -541,6 +541,12 @@ class FusedFitStep:
             pos = {n: i for i, n in enumerate(group.param_names)}
             self._ukeys = [self._ukey(pos[n], n) for n in self._order]
         order, ukeys = self._order, self._ukeys
+        if self._kv is not None:
+            # a preceding eager batch may still have overlapped pushes
+            # applying weights on the kvstore pipeline thread
+            # (kvstore_tpu.engine._OverlapPipeline); land them before
+            # snapshotting weights/state into the donated program
+            self._kv._flush_pending()
         params = {n: exe.arg_dict[n]._data for n in order}
         for n in exe._arg_names:
             if n not in inputs and n not in params:

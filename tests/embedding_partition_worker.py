@@ -1,0 +1,126 @@
+"""Worker for the pod-partitioned embedding 2-process smoke test
+(tests/test_embedding.py::test_two_process_partitioned_embedding).
+
+Each process: attaches a ShardedEmbedding to kvstore='tpu' in a W=2
+world so the table row-partitions ACROSS hosts (this rank keeps only
+its V/2 slab), then pins against an analytic replicated oracle:
+
+* partitioned lookup parity at exactly ONE counted lookup per forward;
+* partitioned row_sparse apply parity at exactly ONE cross-host sparse
+  dispatch per push (the replicated host transport needs TWO);
+* ``embedding_table_bytes_per_host`` = half the replicated footprint;
+* vocab-indivisible tables fall back to replication under the narrow
+  ``embed_partition_vocab_indivisible`` slug;
+* a W=2 partitioned checkpoint (``save_tables`` with
+  ``partitioned=kv._partitioned``) reassembles the full table — the
+  parent pytest process re-loads it single-process (the W=2 -> W=1
+  restore).
+
+Run via:
+  python tools/run_multihost.py -n 2 --env MXTPU_EMB_PREFIX=... \
+      python tests/embedding_partition_worker.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.embedding import ShardedEmbedding, save_tables, load_tables
+from mxnet_tpu.embedding.engine import SPARSE_DISPATCHES
+from mxnet_tpu.embedding.lookup import LOOKUPS
+from mxnet_tpu.embedding.sharding import EMBED_TBL_PER_HOST, ALLTOALL_BYTES
+from mxnet_tpu.kvstore import FALLBACKS
+from mxnet_tpu.kvstore_tpu import dist
+
+V, D = 16, 4
+
+
+def main():
+    prefix = os.environ["MXTPU_EMB_PREFIX"]
+    kv = mx.kv.create("tpu")
+    n, rank = kv.num_workers, kv.rank
+    assert n == 2, n
+
+    # --- attach: W=2 auto-partitions an eligible table ----------------
+    w0 = np.arange(V * D, dtype=np.float32).reshape(V, D) * 0.01
+    emb = ShardedEmbedding(V, D)
+    emb.initialize()
+    emb.weight.set_data(nd.array(w0 if rank == 0 else np.zeros_like(w0)))
+    key = emb.attach_to_kvstore(kv)
+    lo, hi = rank * (V // 2), (rank + 1) * (V // 2)
+    assert kv._partitioned[key] == (lo, hi, V), kv._partitioned[key]
+    assert kv._store[key].shape == (V // 2, D)
+    # only the owned slab is resident: half the replicated footprint
+    assert EMBED_TBL_PER_HOST.value == V // 2 * D * 4
+
+    # --- partitioned lookup: parity + ONE counted lookup per forward --
+    idx = np.array([1, 9, 9, 15], np.int64) if rank == 0 \
+        else np.array([0, 2, 14], np.int64)   # rank-distinct, cross-slab
+    l0, a0 = LOOKUPS.value, ALLTOALL_BYTES.value
+    out = emb(nd.array(idx))
+    assert LOOKUPS.value - l0 == 1, LOOKUPS.value - l0
+    assert ALLTOALL_BYTES.value > a0, "all-to-all traffic went uncounted"
+    np.testing.assert_array_equal(out.asnumpy(), w0[idx])
+
+    # --- partitioned apply: parity + ONE cross-host sparse dispatch ---
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                      lazy_update=True))
+    rows = np.array([rank, 3], np.int64)       # row 3 pushed by BOTH
+    g = nd.sparse.row_sparse_array(
+        (np.ones((2, D), np.float32), rows), shape=(V, D))
+    d0 = SPARSE_DISPATCHES.value
+    kv.push(key, g)
+    disp = SPARSE_DISPATCHES.value - d0
+    assert disp == 1, \
+        "partitioned push should be ONE dispatch, got %d" % disp
+    exp = w0.copy()
+    exp[0] -= 1.0                              # rank 0's private row
+    exp[1] -= 1.0                              # rank 1's private row
+    exp[3] -= 2.0                              # reduced across hosts
+    np.testing.assert_allclose(np.asarray(kv._store[key]._data),
+                               exp[lo:hi], rtol=1e-6)
+
+    # the block aliases the slab: the next forward sees the update
+    idx2 = np.array([0, 3], np.int64) if rank == 0 \
+        else np.array([1, 3], np.int64)
+    out2 = emb(nd.array(idx2))
+    np.testing.assert_allclose(out2.asnumpy(), exp[idx2], rtol=1e-6)
+
+    # no rank holds the full table: dense pull must refuse
+    try:
+        kv.pull(key, out=nd.zeros((V, D)))
+    except MXNetError:
+        pass
+    else:
+        raise AssertionError("pull on a partitioned key should raise")
+
+    # --- ineligible vocab (15 % 2 != 0): replicated + narrow slug -----
+    f0 = FALLBACKS.labels(
+        reason="embed_partition_vocab_indivisible").value
+    odd = ShardedEmbedding(15, D)
+    odd.initialize()
+    odd.attach_to_kvstore(kv, key="emb:odd")
+    assert "emb:odd" not in kv._partitioned
+    assert kv._store["emb:odd"].shape == (15, D)
+    assert FALLBACKS.labels(
+        reason="embed_partition_vocab_indivisible").value == f0 + 1
+
+    # --- W=2 partitioned checkpoint: slab shards, absolute bounds -----
+    save_tables(prefix, "0001",
+                {key: np.asarray(kv._store[key]._data)},
+                partitioned={key: kv._partitioned[key]})
+    got = load_tables(prefix, "0001")
+    np.testing.assert_allclose(got[key]["weight"], exp, rtol=1e-6)
+    if rank == 0:
+        np.save(prefix + "-expected.npy", exp)
+    dist.barrier("embpart-done")
+    print("all partition checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -344,3 +344,135 @@ def test_two_process_embedding_smoke(tmp_path):
     sys.stderr.write(proc.stderr)
     assert proc.returncode == 0
     assert proc.stdout.count("all embedding checks passed") == 2
+
+
+# ----------------------------------------------------------------------
+# pod-partitioned tables
+# ----------------------------------------------------------------------
+def _run_partition_config(partition, monkeypatch):
+    """Train a ShardedEmbedding 5 steps on kvstore='tpu' (2-bit
+    compression + momentum) and return (forwards, final table,
+    per-step dispatch counts, retrace growth over the steady state)."""
+    import jax.numpy as jnp
+    from mxnet_tpu import profiler
+    if partition:
+        monkeypatch.setenv("MXNET_EMBED_PARTITION", "1")
+    else:
+        monkeypatch.delenv("MXNET_EMBED_PARTITION", raising=False)
+    Vp, Dp = 64, 8
+    emb = ShardedEmbedding(Vp, Dp)
+    emb.initialize()
+    rng = np.random.RandomState(0)
+    w0 = rng.normal(0, 0.1, (Vp, Dp)).astype(np.float32)
+    emb.weight.data()._set_data(jnp.asarray(w0))
+    kv = mx.kv.create("tpu")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    ws = telemetry.REGISTRY.get("embedding_table_bytes_per_host")
+    ws0 = ws.value
+    key = emb.attach_to_kvstore(kv)
+    if partition:
+        assert kv._partitioned[key] == (0, Vp, Vp), kv._partitioned[key]
+        # the W=1 "slab" is the whole table; per-host bytes pin 1/W
+        assert ws.value - ws0 == Vp * Dp * 4
+    else:
+        assert key not in kv._partitioned
+    outs = []
+    l0 = s0 = rt0 = None
+    lookups = telemetry.REGISTRY.get("embedding_lookups")
+    sdisp = telemetry.REGISTRY.get("embedding_sparse_dispatches")
+    for step in range(5):
+        idx = rng.randint(0, Vp, (3, 5))
+        with autograd.record():
+            out = emb(idx)
+        out._grad = nd.array(rng.normal(0, 1, out.shape)
+                             .astype(np.float32))
+        outs.append(out.asnumpy().copy())
+        emb.sparse_push()
+        if step == 1:     # steady state starts after the warmup traces
+            l0, s0 = lookups.value, sdisp.value
+            rt0 = (LOOKUP_RETRACES.value, SPARSE_RETRACES.value)
+    steady = 3
+    rt1 = (LOOKUP_RETRACES.value, SPARSE_RETRACES.value)
+    return (np.concatenate([o.reshape(-1) for o in outs]),
+            np.asarray(emb.weight.data()._data),
+            (lookups.value - l0) / steady, (sdisp.value - s0) / steady,
+            (rt1[0] - rt0[0], rt1[1] - rt0[1]))
+
+
+def test_forced_partition_trains_identically_at_one_dispatch(monkeypatch):
+    """MXNET_EMBED_PARTITION=1 in a single-process world runs the EXACT
+    GSPMD partition programs (metadata-only slab lift + the in-program
+    all-to-all gather) that accelerator pods run, so tier-1 pins them:
+    bit-identical forwards and final table vs the replicated path at
+    ONE lookup + ONE sparse dispatch per step, zero steady-state
+    retraces."""
+    fw_r, tbl_r, _, _, _ = _run_partition_config(False, monkeypatch)
+    fw_p, tbl_p, lk, sd, rt = _run_partition_config(True, monkeypatch)
+    np.testing.assert_array_equal(fw_p, fw_r)
+    np.testing.assert_array_equal(tbl_p, tbl_r)
+    assert lk == 1.0, lk
+    assert sd == 1.0, sd
+    assert rt == (0, 0), rt
+
+
+def test_partition_ineligible_dtype_slug(monkeypatch):
+    monkeypatch.setenv("MXNET_EMBED_PARTITION", "1")
+    blk = ShardedEmbedding(V, D, dtype="float16")
+    blk.initialize()
+    kv = mx.kv.create("tpu")
+    c = _fallback("embed_partition_dtype")
+    before = c.value
+    key = blk.attach_to_kvstore(kv)
+    assert key not in kv._partitioned       # replicated, not refused
+    assert kv._store[key].shape == (V, D)
+    assert c.value == before + 1
+
+
+def test_partitioned_key_guards(monkeypatch):
+    """No rank holds the full table: dense pulls and pushes that would
+    need one must refuse instead of silently truncating to the slab."""
+    monkeypatch.setenv("MXNET_EMBED_PARTITION", "1")
+    blk = ShardedEmbedding(V, D)
+    blk.initialize()
+    kv = mx.kv.create("tpu")
+    # Adam has no fused sparse signature, so a partitioned push cannot
+    # take the eager per-key fallback (it only sees the slab)
+    kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+    key = blk.attach_to_kvstore(kv)
+    assert key in kv._partitioned
+    with pytest.raises(MXNetError):
+        kv.pull(key, out=nd.zeros((V, D)))
+    with pytest.raises(MXNetError):
+        kv.row_sparse_pull(key, out=nd.zeros((V, D)),
+                           row_ids=nd.array(np.array([1, 2])))
+    with pytest.raises(MXNetError):
+        kv.push(key, nd.sparse.row_sparse_array(
+            (np.ones((1, D), np.float32), np.array([2])), shape=(V, D)))
+
+
+@pytest.mark.slow
+def test_two_process_partitioned_embedding(tmp_path):
+    """Spawn a real 2-process world where the table row-partitions
+    across hosts (tests/embedding_partition_worker.py), then restore
+    its W=2 partitioned checkpoint HERE, single-process — the shards
+    carry absolute row bounds, so the restore is world-size
+    independent."""
+    prefix = str(tmp_path / "mh" / "part")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "run_multihost.py"),
+         "-n", "2", "--env", "MXTPU_EMB_PREFIX=%s" % prefix,
+         sys.executable, os.path.join(ROOT, "tests",
+                                      "embedding_partition_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("all partition checks passed") == 2
+    got = load_tables(prefix)
+    (name, rec), = got.items()
+    exp = np.load(prefix + "-expected.npy")
+    np.testing.assert_allclose(rec["weight"], exp, rtol=1e-6)

@@ -353,3 +353,52 @@ def test_custom_updater_and_sparse_fall_back_eager():
     kv.pull("w", out=out)
     np.testing.assert_allclose(out.asnumpy(), 1.0)
     assert kv._engine is None or not kv._engine.stats["flushes"]
+
+
+# ----------------------------------------------------------------------
+# backward-overlapped collectives (docs/KVSTORE.md "Overlapped push")
+# ----------------------------------------------------------------------
+def test_overlap_witness_ticks_on_streaming_flush(monkeypatch):
+    """A bucket dispatched by the mid-push streaming flush happened
+    strictly before the final backward bucket landed — that is the
+    overlap witness (kvstore_overlap_dispatches), and the closing sync
+    point records the dispatch window histogram."""
+    from mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "256")
+    kv = mx.kv.create("tpu")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    keys = ["k%d" % i for i in range(5)]
+    for k in keys:
+        kv.init(k, nd.zeros((4, 4)))           # 64 B each, cap = 4 keys
+    wit = telemetry.REGISTRY.get("kvstore_overlap_dispatches")
+    hist = telemetry.REGISTRY.get("kvstore_overlap_window_ms")
+    w0, h0 = wit.value, hist.count
+    kv.set_async_push(True)
+    kv.push(keys, [[nd.ones((4, 4))]] * 5, priority=[0] * 5)
+    assert wit.value > w0, "no overlapped dispatch on streaming flush"
+    assert kv._engine.has_pending              # k4 still pending: the
+    # witness fired BEFORE the final bucket
+    out = nd.zeros((4, 4))
+    kv.pull("k4", out=out)                     # sync point
+    assert hist.count == h0 + 1, "window histogram missed the step"
+
+
+def test_overlap_escape_hatch(monkeypatch):
+    """MXNET_KVSTORE_OVERLAP=0 restores strictly serial dispatch: the
+    streaming flush still runs (bucket planning is orthogonal) but the
+    overlap witness never ticks."""
+    from mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "256")
+    monkeypatch.setenv("MXNET_KVSTORE_OVERLAP", "0")
+    kv = mx.kv.create("tpu")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    keys = ["k%d" % i for i in range(5)]
+    for k in keys:
+        kv.init(k, nd.zeros((4, 4)))
+    wit = telemetry.REGISTRY.get("kvstore_overlap_dispatches")
+    w0 = wit.value
+    kv.set_async_push(True)
+    kv.push(keys, [[nd.ones((4, 4))]] * 5, priority=[0] * 5)
+    out = nd.zeros((4, 4))
+    kv.pull("k0", out=out)
+    assert wit.value == w0, "escape hatch leaked the overlap witness"

@@ -416,3 +416,33 @@ def test_barrier_ms_handle_registration_race_safe():
     assert len(got) == 6
     assert all(h is got[0] for h in got)
     assert got[0] is telemetry.REGISTRY.get("kvstore_tpu_barrier_ms")
+
+
+# ----------------------------------------------------------------------
+# all-to-all transport + the overlapped 2-process world
+# ----------------------------------------------------------------------
+def test_alltoall_bytes_single_process_identity():
+    from mxnet_tpu.kvstore_tpu import dist
+    assert dist.alltoall_bytes("t", [b"payload"]) == [b"payload"]
+    with pytest.raises(mx.base.MXNetError):
+        dist.alltoall_bytes("t", [b"a", b"b"])   # one lane per process
+
+
+@pytest.mark.slow
+def test_two_process_overlap_parity():
+    """Spawn a real 2-process world (tests/tpu_overlap_worker.py): the
+    overlapped pipeline must train bit-for-bit identically to serial
+    dispatch — params AND 2-bit error-feedback residuals — while the
+    overlap witness fires before the last backward bucket lands."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "run_multihost.py"),
+         "-n", "2", "--env", "MXNET_KVSTORE_BIGARRAY_BOUND=256",
+         sys.executable, os.path.join(ROOT, "tests",
+                                      "tpu_overlap_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0
+    assert proc.stdout.count("all overlap checks passed") == 2

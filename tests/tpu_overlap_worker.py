@@ -1,0 +1,94 @@
+"""Worker for the kvstore='tpu' backward-overlap 2-process smoke test
+(tests/test_kvstore_tpu.py::test_two_process_overlap_parity).
+
+Each process drives the HOST transport (multi-process CPU world) twice
+through the same deterministic training sequence — once with the
+overlapped pipeline (default) and once with ``MXNET_KVSTORE_OVERLAP=0``
+— and pins:
+
+* params AND error-feedback residuals bit-for-bit identical between the
+  two runs (the overlap pipeline only reorders host wall time, never
+  the collective or apply order);
+* the ``kvstore_overlap_dispatches`` witness fires DURING the push walk
+  (buckets still pending => the final backward bucket had not landed);
+* the serial run never ticks the witness.
+
+Run via:
+  python tools/run_multihost.py -n 2 --env MXNET_KVSTORE_BIGARRAY_BOUND=256 \
+      python tests/tpu_overlap_worker.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+
+KEYS = ["k%d" % i for i in range(6)]
+SHAPE = (4, 4)            # 64 B each; cap 256 B => streaming mid-push
+STEPS = 4
+
+
+def _run(rank):
+    kv = mx.kv.create("tpu")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                      wd=1e-4, rescale_grad=0.5))
+    rng = np.random.RandomState(7)            # same params on all ranks
+    for k in KEYS:
+        kv.init(k, nd.array(rng.normal(0, 0.1, SHAPE).astype(np.float32)))
+    grng = np.random.RandomState(100 + rank)  # rank-distinct gradients
+    kv.set_async_push(True)
+    witness = telemetry.REGISTRY.get("kvstore_overlap_dispatches")
+    mid_push_ticks = 0
+    for _ in range(STEPS):
+        grads = [[nd.array(grng.normal(0, 0.1, SHAPE).astype(np.float32))]
+                 for _ in KEYS]
+        w0 = witness.value
+        kv.push(KEYS, grads, priority=[0] * len(KEYS))
+        if kv._engine.has_pending and witness.value > w0:
+            # dispatched while buckets were still pending: strictly
+            # before the final backward bucket landed
+            mid_push_ticks += 1
+        outs = [nd.zeros(SHAPE) for _ in KEYS]
+        kv.pull(KEYS, out=outs)
+    kv._sync_engine()
+    params = {k: o.asnumpy() for k, o in zip(KEYS, outs)}
+    res = {k: v.asnumpy() for k, v in kv._compression_residuals.items()}
+    return params, res, mid_push_ticks
+
+
+def main():
+    kv_probe = mx.kv.create("tpu")
+    rank, n = kv_probe.rank, kv_probe.num_workers
+    assert n == 2, n
+    assert os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND") == "256"
+
+    params_ov, res_ov, ticks_ov = _run(rank)
+    assert ticks_ov > 0, \
+        "overlap witness never fired before the final bucket landed"
+    window = telemetry.REGISTRY.get("kvstore_overlap_window_ms")
+    assert window.count > 0, "overlap window histogram stayed empty"
+
+    os.environ["MXNET_KVSTORE_OVERLAP"] = "0"
+    w_before = telemetry.REGISTRY.get("kvstore_overlap_dispatches").value
+    params_ser, res_ser, _ = _run(rank)
+    assert telemetry.REGISTRY.get("kvstore_overlap_dispatches").value \
+        == w_before, "serial escape hatch still ticked the witness"
+
+    assert set(params_ov) == set(params_ser)
+    for k in params_ov:
+        assert np.array_equal(params_ov[k], params_ser[k]), \
+            "param %s not bit-for-bit between overlapped and serial" % k
+    assert set(res_ov) == set(res_ser) and res_ov
+    for k in res_ov:
+        assert np.array_equal(res_ov[k], res_ser[k]), \
+            "residual %s not bit-for-bit" % (k,)
+    print("all overlap checks passed")
+
+
+if __name__ == "__main__":
+    main()
