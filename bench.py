@@ -19,6 +19,7 @@ Use ``--model resnet|transformer|all`` to select.
 """
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -1402,17 +1403,25 @@ def bench_fit(args):
     # fewer bytes than the f32 one (telemetry.programs cost analysis).
     sgd_params = {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}
     adam_params = {"learning_rate": 1e-3, "wd": 1e-4}
+    # "fused" runs with the in-launch numerics sentinels ON (the
+    # default); "fused_nosent" is the identical config with
+    # MXNET_SENTINEL_NUMERICS=0 — the pair yields sentinel_overhead_pct
+    # and the hard gate that the witnesses add ZERO dispatches/syncs
     arm_cfg = {
-        "eager": (False, "sgd", sgd_params, "float32"),
-        "fused": (True, "sgd", sgd_params, "float32"),
-        "fused_adam": (True, "adam", adam_params, "float32"),
+        "eager": (False, "sgd", sgd_params, "float32", True),
+        "fused": (True, "sgd", sgd_params, "float32", True),
+        "fused_nosent": (True, "sgd", sgd_params, "float32", False),
+        "fused_adam": (True, "adam", adam_params, "float32", True),
         "fused_bf16": (True, "adam",
                        dict(adam_params, multi_precision=True),
-                       "bfloat16"),
+                       "bfloat16", True),
     }
 
     arms = {}
-    for arm, (fused, opt, opt_params, train_dtype) in arm_cfg.items():
+    for arm, (fused, opt, opt_params, train_dtype,
+              sentinels) in arm_cfg.items():
+        prev_sent = os.environ.get("MXNET_SENTINEL_NUMERICS")
+        os.environ["MXNET_SENTINEL_NUMERICS"] = "1" if sentinels else "0"
         n_programs = len(telemetry.programs(analyze=False))
         mod = mx.Module(syms[train_dtype])
         mod._fused_fit_enabled = fused
@@ -1482,12 +1491,44 @@ def bench_fit(args):
                 arms[arm]["loss_scale_skips"] = scaler.skips
             else:
                 arms[arm]["loss_scale_skips"] = None
+        if prev_sent is None:
+            os.environ.pop("MXNET_SENTINEL_NUMERICS", None)
+        else:
+            os.environ["MXNET_SENTINEL_NUMERICS"] = prev_sent
     # acceptance: the fused Adam arms are SINGLE-launch, f32 and bf16+MP
     for arm in ("fused_adam", "fused_bf16"):
         if arms[arm]["dispatches_per_step"] != 1:
             raise SystemExit(
                 "bench: %s arm train_dispatches_per_step = %s (want 1)"
                 % (arm, arms[arm]["dispatches_per_step"]))
+    # acceptance: the in-launch sentinels ride the SAME program — with
+    # them on the fused arm must stay single-launch and sync-free, and
+    # the on/off dispatch counts must be IDENTICAL (the deterministic
+    # overhead convention; wall clock is reported, not gated, because
+    # the 1-core CPU container's p50 jitter exceeds any real delta)
+    if arms["fused"]["dispatches_per_step"] != 1:
+        raise SystemExit(
+            "bench: sentinels-on fused arm train_dispatches_per_step = "
+            "%s (want 1)" % arms["fused"]["dispatches_per_step"])
+    if arms["fused"]["host_syncs_per_step"] != 0:
+        raise SystemExit(
+            "bench: sentinels-on fused arm host_syncs_per_step = %s "
+            "(want 0)" % arms["fused"]["host_syncs_per_step"])
+    if arms["fused"]["dispatches_per_step"] \
+            != arms["fused_nosent"]["dispatches_per_step"]:
+        raise SystemExit(
+            "bench: sentinel witnesses changed the dispatch count "
+            "(%s on vs %s off)"
+            % (arms["fused"]["dispatches_per_step"],
+               arms["fused_nosent"]["dispatches_per_step"]))
+    p50_off = arms["fused_nosent"]["step_ms_p50"]
+    sentinel_overhead_pct = (
+        round((arms["fused"]["step_ms_p50"] - p50_off) / p50_off * 100, 2)
+        if p50_off else None)
+    from mxnet_tpu.telemetry import sentinel as _sentinel
+    sentinel_alerts = int(
+        _sentinel.SENTINEL_ALERTS.value
+        + sum(c.value for c in _sentinel.SENTINEL_ALERTS.children()))
     dev = jax.devices()[0]
     # XLA CPU upcasts bf16 compute to f32 (a bf16 matmul *reports more*
     # bytes accessed than the f32 one), so the fewer-bytes acceptance
@@ -1522,6 +1563,8 @@ def bench_fit(args):
                                  for a in arms},
         **({"train_bytes_note": bytes_note} if bytes_note else {}),
         "loss_scale_skips": arms["fused_bf16"]["loss_scale_skips"],
+        "sentinel_overhead_pct": sentinel_overhead_pct,
+        "sentinel_alerts": sentinel_alerts,
         "step_ms_p50": arms["fused"]["step_ms_p50"],
         "step_ms_p99": arms["fused"]["step_ms_p99"],
         "compile_ms": arms["fused"]["compile_ms"],
@@ -2429,6 +2472,8 @@ def main():
     out["train_dispatches_per_step"] = fit["train_dispatches_per_step"]
     out["host_syncs_per_step"] = fit["host_syncs_per_step"]
     out["fit_step_ms"] = fit["fit_step_ms"]
+    out["sentinel_overhead_pct"] = fit["sentinel_overhead_pct"]
+    out["sentinel_alerts"] = fit["sentinel_alerts"]
     tmp = bench_transformer_mp(args)
     out["transformer_mp"] = tmp.get("transformer_mp")
     out["param_bytes_per_device"] = tmp.get("param_bytes_per_device")

@@ -23,6 +23,13 @@ statically-checkable rules per call site:
   around an unconditional collective is fine (the multihost
   checkpoint commit does exactly that); the collective itself under
   the branch hangs every other rank.
+* ``unbounded-telemetry-collective`` — a collective issued from
+  ``mxnet_tpu/telemetry/`` (the metrics-aggregation path) must pass an
+  explicit ``timeout_ms=`` keyword.  Observability rides the same
+  transport as training but must NEVER hang the job it observes: a
+  dead rank degrades the aggregator to its local view (the
+  aggregate.py degradation contract), and that contract only holds
+  when the wait is visibly bounded at the call site.
 
 ``dist.py`` itself (the transport implementation, where rank branches
 are the mechanism) is exempt.
@@ -122,6 +129,18 @@ class CollectivePass(Pass):
                         tag.value, kind, first),
                     fix_hint="give this call site its own literal tag",
                     detail="%s:%s" % (kind, tag.value)))
+        if mod.path.startswith("mxnet_tpu/telemetry/") \
+                and not any(kw.arg == "timeout_ms"
+                            for kw in node.keywords):
+            out.append(self.finding(
+                mod, node, "unbounded-telemetry-collective",
+                "telemetry-path collective %s has no explicit "
+                "timeout_ms — aggregation must degrade to the local "
+                "view on a dead rank, never hang the job it observes"
+                % kind,
+                fix_hint="pass timeout_ms= (None means the bounded "
+                         "dist-layer default, but say so at the site)",
+                detail=kind))
         for p in parents(node):
             test = None
             if isinstance(p, (ast.If, ast.While)):

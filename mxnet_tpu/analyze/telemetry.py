@@ -15,6 +15,13 @@ checks (history in docs/OBSERVABILITY.md):
    registration site (``ALLOWED_DOC_ONLY`` for derived rows).
 4. **Label coverage** — every ``.labels(key=...)`` key must be
    documented as a backticked ``\\`key\\``` in the glossary.
+5. **Sentinel rule resolution** — every literal SLO rule expression
+   (``sentinel.rule("metric_p99 < 700")`` and the docstring examples
+   that double as documentation) must reference a glossary series:
+   after stripping the ``delta(...)`` wrapper and any histogram-stat
+   suffix (``_p50/_p95/_p99/_count/_sum/_min/_max``), the metric name
+   must be a glossary row.  A rule against a phantom series silently
+   never fires — the worst possible alerting bug.
 
 These are text/regex checks (names cross module boundaries as
 strings), run over the shared module list so ``--changed`` and the
@@ -47,6 +54,13 @@ _PROF_COUNTER = re.compile(
     r"""new_counter\(\s*\n?\s*["']([A-Za-z0-9_.:]+)["']""")
 _LABEL_USE = re.compile(r"""\.labels\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*=""")
 _GLOSSARY_ROW = re.compile(r"^\|\s*`([A-Za-z0-9_:]+)`\s*\|")
+# literal SLO rule expressions: sentinel.rule("..."), SENTINEL.rule("...")
+_SENTINEL_RULE = re.compile(
+    r"""(?:sentinel|SENTINEL)\.rule\(\s*\n?\s*["']([^"']+)["']""")
+_RULE_METRIC = re.compile(
+    r"""^\s*(?:delta\(\s*)?([A-Za-z_:][A-Za-z0-9_:]*)""")
+_HIST_STAT_SUFFIXES = ("_p50", "_p95", "_p99", "_count", "_sum",
+                       "_min", "_max")
 
 
 def sanitize(name):
@@ -72,6 +86,7 @@ class TelemetryPass(Pass):
         # line so its counts can never drift from what was checked
         self.registered = {}     # sanitized name -> (path, line)
         self.labels_used = {}    # label key -> (path, line)
+        self.rule_metrics = []   # (metric, expr, path, line)
         self.glossary_names = set()
 
     def run(self, ctx):
@@ -104,6 +119,13 @@ class TelemetryPass(Pass):
             for m in _LABEL_USE.finditer(mod.text):
                 line = mod.text.count("\n", 0, m.start()) + 1
                 labels_used.setdefault(m.group(1), (mod.path, line))
+            for m in _SENTINEL_RULE.finditer(mod.text):
+                expr = m.group(1)
+                mm = _RULE_METRIC.match(expr)
+                if mm:
+                    line = mod.text.count("\n", 0, m.start()) + 1
+                    self.rule_metrics.append(
+                        (mm.group(1), expr, mod.path, line))
 
         gpath = os.path.join(ctx.root, self.GLOSSARY)
         if not os.path.exists(gpath):
@@ -136,6 +158,23 @@ class TelemetryPass(Pass):
                     fix_hint="remove the row, restore the series, or "
                              "allowlist in ALLOWED_DOC_ONLY with a "
                              "reason", detail=name))
+        for metric, expr, path, line in self.rule_metrics:
+            base = metric
+            for suffix in _HIST_STAT_SUFFIXES:
+                if metric.endswith(suffix) and len(metric) > len(suffix):
+                    base = metric[: -len(suffix)]
+                    break
+            if metric not in known and base not in known:
+                findings.append(Finding(
+                    self.name, path, line, "unresolved-rule-metric",
+                    "sentinel rule %r references %r, which is not a "
+                    "glossary series (a rule against a phantom series "
+                    "never fires)" % (expr, metric),
+                    fix_hint="use a docs/OBSERVABILITY.md glossary "
+                             "name, optionally with a _p50/_p95/_p99/"
+                             "_count/_sum/_min/_max stat suffix or a "
+                             "delta(...) wrapper",
+                    detail=metric))
         for key in sorted(labels_used):
             if "`%s`" % key not in glossary_text:
                 path, line = labels_used[key]

@@ -191,6 +191,11 @@ def capture(module, epoch=None, step=None, include_optimizer=True):
         # init scale would re-run the warmup backoffs (capture is a
         # sync boundary, so state_dict's publish() readback is free)
         extra["loss_scaler"] = scaler.state_dict()
+    fused = getattr(module, "_fused_fit", None)
+    if fused is not None:
+        # capture is a sync boundary: publish the in-launch numerics
+        # sentinels so the checkpoint tick doubles as a sentinel read
+        fused.publish_sentinels()
     state["extra"] = extra
     return state
 

@@ -96,6 +96,17 @@ RETRACES = REGISTRY.counter(
     "program's first compile — pinned at zero by tests", vital=True)
 RELOADS = REGISTRY.counter(
     "decode_reloads", "successful hot weight reloads into a live engine")
+TTFT_STEPS = REGISTRY.histogram(
+    "decode_ttft_steps", "steps to first token (submit -> first emit, "
+    "in mixed-step iterations) — the dispatch-count TTFT witness "
+    "sentinel SLO rules watch (wall-clock is bandwidth noise in CPU "
+    "containers)", unit="steps",
+    bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+ACCEPT_WINDOW = REGISTRY.gauge(
+    "decode_accept_rate_window", "accepted/proposed draft-token ratio "
+    "over the last MXNET_DECODE_ACCEPT_WINDOW slot-spans (default 256) "
+    "— the sentinel's drift witness; decode_accept_rate is cumulative "
+    "and cannot recover after a bad stretch", unit="ratio")
 
 
 def _chunk_budget(chunk_tokens, max_context):
@@ -285,6 +296,13 @@ class DecodeEngine:
         self._n_slot_tokens = 0
         self._n_spec_proposed = 0
         self._n_spec_accepted = 0
+        # sliding acceptance window: (proposed, accepted) per slot-span,
+        # feeding the decode_accept_rate_window sentinel gauge — the
+        # cumulative ACCEPT_RATE can never recover after a bad stretch
+        import os as _os
+        self._spec_window = _collections.deque(
+            maxlen=max(16, int(_os.environ.get(
+                "MXNET_DECODE_ACCEPT_WINDOW", "256") or 256)))
         self._n_completed = 0
         self._n_failed = 0
         self._n_expired = 0
@@ -979,9 +997,15 @@ class DecodeEngine:
             if accepted:
                 self._n_spec_accepted += accepted
                 SPEC_ACCEPTED.inc(accepted)
+            if draft:
+                self._spec_window.append((len(draft), accepted))
         if self._n_spec_proposed:
             ACCEPT_RATE.set(self._n_spec_accepted
                             / float(self._n_spec_proposed))
+            wp = sum(p for p, _ in self._spec_window)
+            if wp:
+                ACCEPT_WINDOW.set(
+                    sum(a for _, a in self._spec_window) / float(wp))
         if self._n_slot_iters:
             TOKENS_PER_LAUNCH.set(self._n_slot_tokens
                                   / float(self._n_slot_iters))
@@ -1041,8 +1065,9 @@ class DecodeEngine:
             with self._cv:
                 self._ttfts.append(ttft)
                 if seq.submit_step is not None:
-                    self._ttft_steps.append(self._n_steps
-                                            - seq.submit_step)
+                    steps = self._n_steps - seq.submit_step
+                    self._ttft_steps.append(steps)
+                    TTFT_STEPS.observe(steps)
         seq.handle._emit(tok)
         self._n_tokens += 1
         TOKENS.inc()
@@ -1225,6 +1250,10 @@ class DecodeEngine:
             "accept_rate": (self._n_spec_accepted
                             / self._n_spec_proposed
                             if self._n_spec_proposed else None),
+            "accept_rate_window": (
+                sum(a for _, a in self._spec_window)
+                / float(sum(p for p, _ in self._spec_window))
+                if sum(p for p, _ in self._spec_window) else None),
             "tokens_per_launch": (self._n_slot_tokens
                                   / self._n_slot_iters
                                   if self._n_slot_iters else None),

@@ -178,7 +178,8 @@ def _on_device(x, dev):
     return jax.device_put(x, dev)
 
 
-def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
+def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd,
+                sentinel=False):
     """Compile-once bucket program: the whole bucket — 2-bit compress with
     error feedback, cross-device reduce, and the optimizer apply for every
     key — is ONE jitted computation.
@@ -242,9 +243,29 @@ def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
                    for off, size, shape in layout]
         return reduced, tuple(new_res)
 
+    def _nonfinite(grads):
+        """Per-bucket isfinite witness (docs/OBSERVABILITY.md): count of
+        non-finite gradient elements across every device stream, folded
+        into the SAME bucket program as a single scalar — no extra
+        dispatch, read only at sync boundaries via a donated
+        accumulator."""
+        nf = jnp.float32(0.0)
+        for d in range(n_dev):
+            for i in range(n_keys):
+                nf = nf + jnp.sum(
+                    (~jnp.isfinite(grads[d][i])).astype(jnp.float32))
+        return nf
+
     from .aot.store import safe_donate_argnums as _donate
 
     if mode is None:
+        if sentinel:
+            def step(residuals, grads, nf_acc):
+                _note_retrace()
+                reduced, new_res = _reduce(residuals, grads)
+                return tuple(reduced), new_res, nf_acc + _nonfinite(grads)
+            return jax.jit(step, donate_argnums=_donate((0, 2)))
+
         def step(residuals, grads):
             _note_retrace()
             reduced, new_res = _reduce(residuals, grads)
@@ -253,9 +274,8 @@ def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
 
     upd = _fused.build(mode)
 
-    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale,
-             extra):
-        _note_retrace()
+    def _apply(weights, states, residuals, grads, lr_vec, wd_vec,
+               rescale, extra):
         reduced, new_res = _reduce(residuals, grads)
         new_ws, new_ss = [], []
         for i in range(n_keys):
@@ -267,6 +287,22 @@ def _build_step(layout, n_dev, threshold, mode, tpls, mp_flags, use_wd):
             new_ws.append(new_w)
             new_ss.append(tuple(_fused.flatten_state(new_s)[0]))
         return tuple(new_ws), tuple(new_ss), new_res
+
+    if sentinel:
+        def step(weights, states, residuals, grads, lr_vec, wd_vec,
+                 rescale, extra, nf_acc):
+            _note_retrace()
+            new_ws, new_ss, new_res = _apply(
+                weights, states, residuals, grads, lr_vec, wd_vec,
+                rescale, extra)
+            return new_ws, new_ss, new_res, nf_acc + _nonfinite(grads)
+        return jax.jit(step, donate_argnums=_donate((1, 2, 8)))
+
+    def step(weights, states, residuals, grads, lr_vec, wd_vec, rescale,
+             extra):
+        _note_retrace()
+        return _apply(weights, states, residuals, grads, lr_vec, wd_vec,
+                      rescale, extra)
     return jax.jit(step, donate_argnums=_donate((1, 2)))
 
 
@@ -319,6 +355,11 @@ class FusedBucketEngine:
         self._overlap = overlap_enabled()
         self._streaming = False
         self._overlap_t0 = None
+        # in-launch numerics witness: donated f32 scalar accumulating
+        # non-finite gradient elements across bucket programs; read only
+        # at sync boundaries by publish_sentinels()
+        self._nf_acc = None
+        self._published_nf = 0.0
 
     # -- eligibility ----------------------------------------------------
     def _updater_mode(self):
@@ -500,6 +541,28 @@ class FusedBucketEngine:
         the tpu engine overrides it to drain its pipelined wire thread.
         Called by the kvstore's sync points (pull/barrier/state save)."""
 
+    def publish_sentinels(self):
+        """Fold the donated non-finite witness scalar into the shared
+        ``nonfinite_grads`` counter. Reading the scalar is a HOST SYNC —
+        this runs only from existing sync boundaries (Module._fit_sync,
+        kvstore pull/barrier), never the per-step dispatch path.
+        Returns the cumulative count, or None when no witness rode a
+        program yet (sentinels off, or nothing dispatched)."""
+        acc = self._nf_acc
+        if acc is None:
+            return None
+        # analyze: ok(hostsync) sentinel publish rides an existing sync boundary (_fit_sync / kvstore pull), never the per-dispatch path
+        cum = float(_np.asarray(acc))
+        delta = int(round(cum - self._published_nf))
+        if delta > 0:
+            self._published_nf = cum
+            from .telemetry import sentinel as _sentinel
+            _sentinel.NONFINITE_GRADS.inc(delta)
+            from .telemetry.flight import RECORDER
+            RECORDER.note("sentinel_trip", source="kvstore_bucket",
+                          nonfinite=delta)
+        return cum
+
     def _updater_inputs(self, bucket):
         """Collect the live optimizer-apply inputs for one bucket (and
         perform the per-key update-count side effects) — shared by the
@@ -559,28 +622,41 @@ class FusedBucketEngine:
                                              bucket)
 
         ctx0 = bucket[0].likes[0].context
+        sent = _telemetry.sentinel.numerics_enabled()
+        nf = None
+        if sent:
+            nf = self._nf_acc
+            if nf is None:
+                nf = jnp.zeros((), jnp.float32)
+            nf = _on_device(nf, dev0)
         if mode is None:
-            sig = (None, threshold, n_dev, layout)
+            sig = (None, threshold, n_dev, layout, sent)
             fn = self._steps.get(sig)
             if fn is None:
                 fn = self._steps[sig] = _build_step(
-                    layout, n_dev, threshold, None, None, None, False)
-                _telemetry.programs.record("kvstore_bucket", fn,
-                                           (residuals, grads))
-            outs, new_res = fn(residuals, grads)
+                    layout, n_dev, threshold, None, None, None, False,
+                    sentinel=sent)
+                _telemetry.programs.record(
+                    "kvstore_bucket", fn,
+                    (residuals, grads, nf) if sent
+                    else (residuals, grads))
+            if sent:
+                outs, new_res, self._nf_acc = fn(residuals, grads, nf)
+            else:
+                outs, new_res = fn(residuals, grads)
             for it, out in zip(bucket, outs):
                 kv._store[it.key] = NDArray(out, ctx0)
         else:
             (weights_nd, state_leaves, tpls, mp_flags, lr_vec, wd_vec,
              extra, use_wd, rescale) = self._updater_inputs(bucket)
             sig = (mode, threshold, n_dev, layout, tpls, mp_flags,
-                   use_wd)
+                   use_wd, sent)
             fn = self._steps.get(sig)
             fresh = fn is None
             if fresh:
                 fn = self._steps[sig] = _build_step(
                     layout, n_dev, threshold, mode, tpls, mp_flags,
-                    use_wd)
+                    use_wd, sentinel=sent)
             weights = tuple(w._data for w in weights_nd)
             states = tuple(tuple(l._data for l in leaves)
                            for leaves in state_leaves)
@@ -588,10 +664,17 @@ class FusedBucketEngine:
                 _telemetry.programs.record(
                     "kvstore_bucket", fn,
                     (weights, states, residuals, grads, lr_vec, wd_vec,
-                     rescale, extra))
-            new_ws, new_ss, new_res = fn(weights, states, residuals,
-                                         grads, lr_vec, wd_vec, rescale,
-                                         extra)
+                     rescale, extra, nf) if sent
+                    else (weights, states, residuals, grads, lr_vec,
+                          wd_vec, rescale, extra))
+            if sent:
+                new_ws, new_ss, new_res, self._nf_acc = fn(
+                    weights, states, residuals, grads, lr_vec, wd_vec,
+                    rescale, extra, nf)
+            else:
+                new_ws, new_ss, new_res = fn(
+                    weights, states, residuals, grads, lr_vec, wd_vec,
+                    rescale, extra)
             for w, leaves, nw, ns in zip(weights_nd, state_leaves,
                                          new_ws, new_ss):
                 w._set_data(nw)

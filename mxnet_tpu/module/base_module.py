@@ -195,6 +195,11 @@ class BaseModule:
         # pod health (straggler exchange) + hang watchdog — both no-ops
         # unless armed (multi-process world / env; docs/OBSERVABILITY.md)
         health = _telemetry.PodHealthMonitor.maybe_create(self.logger)
+        # pod metrics aggregation + SLO rule evaluation on the merged
+        # view (multi-process world, MXNET_SENTINEL_EVERY, or installed
+        # sentinel rules — docs/OBSERVABILITY.md)
+        sentinel = _telemetry.PodMetricsAggregator.maybe_create(
+            self.logger)
         watchdog = None
         if float(os.environ.get("MXNET_WATCHDOG_FACTOR", "0") or 0) > 0:
             watchdog = _telemetry.Watchdog("fit")
@@ -202,7 +207,7 @@ class BaseModule:
             for epoch in range(begin_epoch, num_epoch):
                 preempted = self._run_train_epoch(
                     epoch, train_data, train_metric, monitor, on_batch,
-                    sparse_row_id_fn, ckpt, health, watchdog)
+                    sparse_row_id_fn, ckpt, health, watchdog, sentinel)
                 if preempted:
                     self.logger.warning(
                         "Epoch[%d] preempted — emergency checkpoint "
@@ -252,7 +257,7 @@ class BaseModule:
 
     def _run_train_epoch(self, epoch, train_data, train_metric, monitor,
                          on_batch, sparse_row_id_fn, ckpt=None,
-                         health=None, watchdog=None):
+                         health=None, watchdog=None, sentinel=None):
         """One epoch: keep the device queue full, read metrics back only
         at callback boundaries. With the fused fit step active, the loop
         body performs ZERO blocking host syncs — metrics accumulate on
@@ -304,6 +309,15 @@ class BaseModule:
             FIT_STEP_MS.observe(step_ms)
             if health is not None:
                 health.step(step_ms)
+            if sentinel is not None:
+                # an exchange step first drains the pipeline through the
+                # EXISTING sync boundary (_fit_sync publishes the
+                # in-launch sentinel scalars), so the shipped snapshot
+                # carries fresh numerics; off-cadence steps pay one
+                # attribute check
+                if sentinel.due():
+                    self._fit_sync()
+                sentinel.step()
             _telemetry.RECORDER.tick()
             _telemetry.mark_step(nbatch)
             if monitor is not None:

@@ -125,6 +125,23 @@ def __getattr__(name):
 _MEM_EVERY = int(os.environ.get("MXNET_TELEMETRY_MEMORY_EVERY", "0") or 0)
 
 
+def _sentinel_enabled():
+    """In-launch numerics sentinels (docs/OBSERVABILITY.md): a handful
+    of scalars — global grad norm, non-finite element count, metric
+    EMA z-score, residual-norm drift — folded into the SAME donated
+    program and read only at sync boundaries. On by default (the
+    overhead contract is zero extra dispatches/syncs and <2% step
+    time, gated by bench.py); ``MXNET_SENTINEL_NUMERICS=0`` disables."""
+    from ..telemetry.sentinel import numerics_enabled
+    return numerics_enabled()
+
+
+# EMA decay for the sentinel metric/residual baselines, and how many
+# steps the z-score stays muted while the baseline converges
+_SENT_DECAY = 0.98
+_SENT_WARMUP = 8.0
+
+
 def _metric_closure(metric, label_names, output_names):
     """(metric_fn, cache_sig) folding ``metric``'s device accumulation
     into the step program with ``update_dict``'s output/label selection
@@ -151,7 +168,8 @@ def _metric_closure(metric, label_names, output_names):
 
 
 def _build_fit_program(graph_fn, param_order, threshold, mode, tpls,
-                       mp_flags, use_wd, metric_fn, mirror, scaler):
+                       mp_flags, use_wd, metric_fn, mirror, scaler,
+                       sentinel=False):
     """ONE jitted program: fwd+bwd+compress+reduce+update(+metric).
 
     The compress and optimizer math are the SAME functions the bucketed
@@ -175,8 +193,8 @@ def _build_fit_program(graph_fn, param_order, threshold, mode, tpls,
     upd = _fused.build(mode)
 
     # analyze: ok(retrace) upd is a pure memoized function of `mode`, which is a builder parameter and part of the fit-program cache key
-    def step(params, states, residuals, macc, scaler_state, inputs, auxs,
-             lr_vec, wd_vec, rescale, extra, seed):
+    def step(params, states, residuals, macc, scaler_state, sent_state,
+             inputs, auxs, lr_vec, wd_vec, rescale, extra, seed):
         _note_retrace()   # trace-time host side effect only
 
         def f(p):
@@ -234,17 +252,70 @@ def _build_fit_program(graph_fn, param_order, threshold, mode, tpls,
             new_ps, new_ss, new_res = apply_updates(None)
             new_scaler = scaler_state
 
+        bsum = bnum = None
         if metric_fn is not None:
             bsum, bnum = metric_fn(inputs, outs)
             macc = (macc[0] + bsum, macc[1] + bnum)
-        return new_ps, new_ss, new_res, macc, new_scaler, new_auxs, outs
+
+        new_sent = sent_state
+        if sentinel:
+            # in-launch numerics witnesses: a few reductions over
+            # arrays this program already holds, carried in one donated
+            # f32[8] vector — [metric_ema, metric_var, n_steps,
+            # cum_nonfinite, grad_norm, zscore, residual_ema,
+            # residual_drift]. Same launch, zero host syncs; the host
+            # reads it only at sync boundaries (publish_sentinels).
+            gnsq = jnp.float32(0.0)
+            nonfin = jnp.float32(0.0)
+            for name in param_order:
+                g = g32[name]
+                gnsq = gnsq + jnp.sum(jnp.square(g))
+                nonfin = nonfin + jnp.sum(
+                    (~jnp.isfinite(g)).astype(jnp.float32))
+            gnorm = jnp.sqrt(gnsq)
+            if bsum is not None:
+                mval = (bsum / jnp.maximum(bnum, 1)).astype(jnp.float32)
+            else:
+                mval = gnorm    # no device metric: track the grad norm
+            ema, emvar, n, cnf, rema = (sent_state[0], sent_state[1],
+                                        sent_state[2], sent_state[3],
+                                        sent_state[6])
+            d = mval - ema
+            z = jnp.where(n >= _SENT_WARMUP,
+                          d * jax.lax.rsqrt(emvar + jnp.float32(1e-12)),
+                          jnp.float32(0.0))
+            # a non-finite sample must trip the z-score/counter, not
+            # poison the running baseline forever
+            ok = jnp.isfinite(mval)
+            new_ema = jnp.where(ok, ema + (1.0 - _SENT_DECAY) * d, ema)
+            new_var = jnp.where(
+                ok, _SENT_DECAY * (emvar + (1.0 - _SENT_DECAY) * d * d),
+                emvar)
+            if threshold is not None:
+                rnsq = jnp.float32(0.0)
+                for name in param_order:
+                    rnsq = rnsq + jnp.sum(jnp.square(new_res[name]))
+                rnorm = jnp.sqrt(rnsq)
+                drift = jnp.where(rema > 0.0,
+                                  rnorm / (rema + jnp.float32(1e-30)),
+                                  jnp.float32(1.0))
+                new_rema = _SENT_DECAY * rema \
+                    + (1.0 - _SENT_DECAY) * rnorm
+            else:
+                drift = jnp.float32(0.0)
+                new_rema = rema
+            new_sent = jnp.stack(
+                [new_ema, new_var, n + 1.0, cnf + nonfin, gnorm, z,
+                 new_rema, drift]).astype(jnp.float32)
+        return (new_ps, new_ss, new_res, macc, new_scaler, new_sent,
+                new_auxs, outs)
 
     # params/states/residuals/macc/scaler/auxs donate in place — except
     # under the persistent cache, where disk-loaded donated executables
     # corrupt memory (aot.store.donation_safe): the guard trades the
     # in-place update for correct zero-compile restarts.
     from ..aot.store import safe_donate_argnums as _donate
-    donate = _donate((0, 1, 2, 3, 4, 6))
+    donate = _donate((0, 1, 2, 3, 4, 5, 7))
     fn = jax.jit(step, donate_argnums=donate)
     if donate:
         _telemetry.programs.note_donation(fn, donate)
@@ -277,6 +348,10 @@ class FusedFitStep:
         self._metric_ref = FusedFitStep._METRIC_UNSET
         self._metric_fn = None
         self._msig = None
+        # donated sentinel vector (f32[8], see _build_fit_program) and
+        # the cumulative non-finite count already pushed to the registry
+        self._sent_state = None
+        self._published_nonfinite = 0.0
         self.launches = 0
         self._mem_tracker = _telemetry.StepMemoryTracker() \
             if _MEM_EVERY else None
@@ -483,6 +558,34 @@ class FusedFitStep:
                 self._kv._compression_residuals[(n, 0)] = NDArray(r)
         self._residuals = None
 
+    # -- sentinel publish (sync boundaries only) ------------------------
+    def publish_sentinels(self):
+        """Read the donated sentinel vector and push it into the
+        registry — the DynamicLossScaler.publish pattern: called ONLY
+        at existing sync boundaries (Module._fit_sync, checkpoint
+        capture), never per step, so sentinels cost zero host syncs."""
+        st = self._sent_state
+        if st is None:
+            return None
+        # analyze: ok(hostsync) sentinel publish rides an existing sync boundary (_fit_sync / checkpoint capture), never the per-step path
+        vals = _np.asarray(st)
+        from ..telemetry import sentinel as _sentinel
+        gnorm = float(vals[4])
+        zscore = float(vals[5])
+        _sentinel.GRAD_NORM.set(gnorm)
+        _sentinel.LOSS_ZSCORE.set(zscore)
+        if self._threshold is not None:
+            _sentinel.RESIDUAL_DRIFT.set(float(vals[7]))
+        cum = float(vals[3])
+        delta = int(round(cum - self._published_nonfinite))
+        if delta > 0:
+            self._published_nonfinite = cum
+            _sentinel.NONFINITE_GRADS.inc(delta)
+            from ..telemetry.flight import RECORDER
+            RECORDER.note("sentinel_trip", nonfinite=delta,
+                          grad_norm=gnorm, loss_zscore=zscore)
+        return vals
+
     # -- the step -------------------------------------------------------
     def step(self, data_batch, eval_metric=None):
         """Run one single-launch training step. Returns False when this
@@ -609,17 +712,18 @@ class FusedFitStep:
             scaler = getattr(mod, "_loss_scaler", None) or scaler
             self._scaler = scaler
         scaler_sig = scaler.trace_sig() if scaler is not None else None
+        sent_on = _sentinel_enabled()
         cache = _compiled_cache(mod._symbol).setdefault("fit_step", {})
         # `mode` re-read above: mutating optimizer hyperparams mid-
         # training switches programs (one retrace), like the eager path
         key = (tuple(order), self._threshold, mode, tpls, mp_flags,
-               use_wd, msig, mirror, scaler_sig)
+               use_wd, msig, mirror, scaler_sig, sent_on)
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = _build_fit_program(
                 _compiled_cache(mod._symbol)["graph_fn"], tuple(order),
                 self._threshold, mode, tpls, mp_flags, use_wd,
-                metric_fn, mirror, scaler)
+                metric_fn, mirror, scaler, sentinel=sent_on)
 
         macc = ()
         if metric_fn is not None:
@@ -629,6 +733,11 @@ class FusedFitStep:
                     if eval_metric._dev_num is not None else jnp.float32(0.0))
 
         scaler_state = scaler.device_state() if scaler is not None else ()
+        sent_state = ()
+        if sent_on:
+            sent_state = self._sent_state
+            if sent_state is None:
+                sent_state = jnp.zeros(8, jnp.float32)
         auxs = exe._auxs_values()
         if self._pmesh is not None:
             # lift every program input onto the cross-host mesh (no-op
@@ -645,6 +754,8 @@ class FusedFitStep:
                       for n, v in inputs.items()}
             macc = tuple(self._lift_repl(m) for m in macc)
             scaler_state = tuple(self._lift_repl(s) for s in scaler_state)
+            if sent_on:
+                sent_state = self._lift_repl(sent_state)
 
         seed = exe._next_seed()
         rescale = _np.float32(optimizer.rescale_grad)
@@ -656,16 +767,18 @@ class FusedFitStep:
         try:
             with exe._prof_scope("Module::fused_fit_step"), \
                     _telemetry.tracing.span("fit.fused_dispatch"):
-                (new_ps, new_ss, new_res, macc, new_scaler, new_auxs,
-                 outs) = _SITE.timed(
+                (new_ps, new_ss, new_res, macc, new_scaler, new_sent,
+                 new_auxs, outs) = _SITE.timed(
                     fn, params, states, residuals, macc, scaler_state,
-                    inputs, auxs, lr_vec, wd_vec, rescale, extra, seed)
+                    sent_state, inputs, auxs, lr_vec, wd_vec, rescale,
+                    extra, seed)
         except Exception:
             # a runtime failure after donation consumes the donated
             # buffers — drop our residual refs so a later spill doesn't
             # resurrect deleted arrays, then surface the error (the
             # module's device state is not recoverable at this point)
             self._residuals = None
+            self._sent_state = None
             raise
         if track_mem:
             self._mem_tracker.end()
@@ -684,6 +797,7 @@ class FusedFitStep:
             self._residuals = dict(new_res)
         if scaler is not None:
             scaler.set_device_state(new_scaler)
+        self._sent_state = new_sent if sent_on else None
         exe._write_auxs(new_auxs)
         exe._outputs = [NDArray(o, exe._ctx) for o in outs]
         exe._pending_train_fwd = False

@@ -501,6 +501,16 @@ class Module(BaseModule):
             # already a sync boundary: refresh the loss_scale gauge and
             # overflow-skip counter from the device triple
             scaler.publish()
+        if self._fused_fit is not None:
+            # same boundary: fold the in-launch numerics sentinels
+            # (grad norm, non-finite count, z-score, residual drift)
+            # into the registry
+            self._fused_fit.publish_sentinels()
+        kv = self._kvstore
+        if kv is not None and getattr(kv, "_engine", None) is not None:
+            # the bucketed kvstore engine carries its own non-finite
+            # witness scalar; same boundary, same dedup semantics
+            kv._engine.publish_sentinels()
 
     def update(self):
         """Apply one optimizer step (kvstore push/pull or local updater)."""

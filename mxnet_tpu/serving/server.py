@@ -630,8 +630,11 @@ class ModelServer:
         """Serve ``POST /predict`` ({"inputs": {...}, "timeout_ms": n}),
         ``GET /stats``, ``GET /metrics`` (Prometheus text exposition of
         the whole mx.telemetry registry — serving, kvstore, fit-step and
-        HBM series; docs/OBSERVABILITY.md) and ``GET /health`` on a
-        daemon thread. Returns the bound (host, port)."""
+        HBM series; docs/OBSERVABILITY.md), ``GET /pod_metrics`` (the
+        aggregated fleet view — rank-labeled scalars, bucket-merged
+        histograms) and ``GET /health`` (which carries any open
+        sentinel SLO incidents) on a daemon thread. Returns the bound
+        (host, port)."""
         if self._http is not None:
             raise MXNetError("HTTP endpoint already running")
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -803,12 +806,25 @@ class ModelServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/pod_metrics":
+                    # the aggregated fleet view (rank-labeled gauges/
+                    # counters, bucket-merged histograms) — the local
+                    # view when no exchange has happened yet
+                    body = _tm.aggregate.pod_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     _tm.export.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/stats":
                     self._reply(200, server.stats())
                 elif self.path == "/health":
-                    self._reply(200 if not server._closed else 503,
-                                {"status": "ok" if not server._closed
-                                 else "stopped"})
+                    alerts = _tm.sentinel.SENTINEL.active()
+                    ok = not server._closed
+                    self._reply(200 if ok else 503,
+                                {"status": "ok" if ok else "stopped",
+                                 "sentinel_alerts": alerts})
                 else:
                     self._reply(404, {"error": "unknown path %s" % self.path})
 

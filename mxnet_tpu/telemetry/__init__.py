@@ -28,6 +28,15 @@ One place to read every operational witness the framework emits
 * :mod:`health` — pod-scale straggler detection over the coordination-
   service collectives and a hang watchdog (flight note + faulthandler
   stack dump).
+* :mod:`aggregate` — pod-wide metrics aggregation: every rank's
+  registry merged into one fleet view over the coordination-service
+  collectives (``GET /pod_metrics``; rank-labeled scalars,
+  bucket-merged histograms).
+* :mod:`sentinel` — declarative SLO rules evaluated on the aggregated
+  view (``sentinel.rule("decode_ttft_steps_p99 < 700")``), firing
+  once-per-incident alerts, plus the in-launch numerics witness series
+  (``grad_norm``/``nonfinite_grads``/``residual_drift``/
+  ``loss_zscore``).
 
 This package is stdlib-only at import (jax is touched lazily inside
 :mod:`memory`/:mod:`programs`), so the registry is safe to import from
@@ -50,6 +59,9 @@ from . import tracing
 from . import health
 from . import programs as _programs_mod
 from .health import PodHealthMonitor, Watchdog
+from . import aggregate
+from .aggregate import PodMetricsAggregator
+from . import sentinel
 
 
 class _ProgramsFacade:
@@ -68,7 +80,8 @@ programs = _ProgramsFacade()
 
 __all__ = [
     "registry", "export", "flight", "memory", "chrome", "tracing",
-    "health", "programs",
+    "health", "programs", "aggregate", "sentinel",
+    "PodMetricsAggregator",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "exponential_buckets", "hist_quantile", "sanitize_name",

@@ -1,0 +1,381 @@
+"""Pod-wide metrics aggregation over the coordination-service
+collectives (docs/OBSERVABILITY.md, "Pod aggregation & alerting").
+
+PR 4 gave every rank a private registry and PR 8 a single cross-host
+signal (the straggler p50 allgather); a pod still looked like N
+isolated scrape endpoints.  :class:`PodMetricsAggregator` turns them
+into ONE fleet view: every ``MXNET_SENTINEL_EVERY`` fit steps each
+rank serializes its registry (scalars + full histogram bucket vectors)
+and the ranks run one ``kvstore_tpu.dist.allgather_bytes`` exchange —
+single-process worlds included, where the exchange is an identity.
+The merged :class:`PodView`
+
+* rank-labels counters and gauges (``fit_step_retraces{rank="1"}``),
+* bucket-merges histograms (same bounds -> counts summed across ranks,
+  so pod-level p50/p95/p99 are computed from the TRUE merged
+  distribution, not an average of per-rank quantiles),
+
+and is served as Prometheus text from ``GET /pod_metrics`` on both
+``ModelServer`` and :func:`telemetry.start_http_exporter` — one scrape
+on rank 0 sees the whole pod.  Each fresh view is handed to the SLO
+rule engine (:mod:`telemetry.sentinel`) for evaluation.
+
+Degradation contract: the exchange rides a BOUNDED collective timeout
+(``MXNET_SENTINEL_TIMEOUT_MS``, default the dist-layer timeout) and
+any failure — a dead rank, a torn coordination service — degrades to
+the LOCAL view with a warning.  Aggregation is observability; it must
+never hang the job it observes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .registry import REGISTRY, Histogram, hist_quantile
+
+__all__ = ["PodMetricsAggregator", "PodView", "local_payload", "merge",
+           "pod_text", "default_aggregator"]
+
+AGG_EXCHANGES = REGISTRY.counter(
+    "sentinel_exchanges", "pod metrics-aggregation exchanges completed")
+POD_RANKS = REGISTRY.gauge(
+    "sentinel_pod_ranks", "ranks contributing to the last aggregated "
+    "pod view (0 = no exchange yet)", unit="ranks")
+
+# series that must NOT be re-exported rank-labeled: the aggregator's
+# own bookkeeping would otherwise grow one series per rank per scrape
+_SKIP = {"sentinel_pod_ranks"}
+
+
+def _sentinel_every():
+    try:
+        return max(0, int(os.environ.get("MXNET_SENTINEL_EVERY", "50")
+                          or 0))
+    except ValueError:
+        return 50
+
+
+def local_payload(registry=None):
+    """This rank's registry serialized for the exchange: one JSON blob
+    with scalars for counters/gauges and full ``bounds``/``counts``
+    vectors for histograms (quantiles cannot be merged — buckets
+    can)."""
+    reg = registry if registry is not None else REGISTRY
+    series = []
+    for m in reg.collect():
+        for s in [m] + m.children():
+            entry = {"name": s.name, "kind": s.kind, "help": m.help,
+                     "unit": m.unit,
+                     "labels": dict(zip(s.label_names, s.label_values))}
+            if isinstance(s, Histogram):
+                snap = s.snapshot()
+                entry.update(bounds=list(snap["bounds"]),
+                             counts=list(snap["counts"]),
+                             sum=snap["sum"], count=snap["count"],
+                             min=snap["min"], max=snap["max"])
+            else:
+                entry["value"] = s.value
+            series.append(entry)
+    return json.dumps({"series": series}).encode()
+
+
+def _merge_minmax(a, b, fn):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return fn(a, b)
+
+
+class PodView:
+    """The merged fleet view of one aggregation exchange.
+
+    ``scalars`` maps ``(name, labels_tuple)`` -> ``{"kind", "help",
+    "unit", "value"}`` where counters/gauges carry an extra ``rank``
+    label; ``hists`` maps ``(name, labels_tuple)`` (NO rank label) ->
+    a merged histogram snapshot dict.
+    """
+
+    def __init__(self, n_ranks, degraded=False):
+        self.n_ranks = n_ranks
+        self.degraded = degraded     # True = local fallback view
+        self.scalars = {}            # (name, labels) -> entry
+        self.hists = {}              # (name, labels) -> merged snapshot
+
+    # -- rule-engine lookup --------------------------------------------
+    def lookup(self, ref):
+        """Resolve a rule metric reference against this view.
+
+        ``name`` alone reduces the scalar series across ranks and label
+        sets (counters sum — they count events; gauges take the MAX —
+        the SLO-pessimistic rank).  A ``_p50/_p95/_p99/_count/_sum/
+        _min/_max`` suffix reads the bucket-MERGED histogram of the
+        base name.  Returns None when the series does not exist or has
+        no samples yet.
+        """
+        for suffix in ("_p50", "_p95", "_p99", "_count", "_sum",
+                       "_min", "_max"):
+            if ref.endswith(suffix) and len(ref) > len(suffix):
+                base, stat = ref[: -len(suffix)], suffix[1:]
+                vals = [s for (n, _), s in self.hists.items()
+                        if n == base]
+                if not vals:
+                    continue   # maybe a scalar literally named *_count
+                return self._hist_stat(vals, stat)
+        vals, kinds = [], set()
+        for (n, _), e in self.scalars.items():
+            if n == ref:
+                vals.append(e["value"])
+                kinds.add(e["kind"])
+        if not vals:
+            return None
+        if "counter" in kinds:
+            return float(sum(vals))
+        return float(max(vals))
+
+    @staticmethod
+    def _hist_stat(snaps, stat):
+        counts = None
+        merged = {"sum": 0.0, "count": 0, "min": None, "max": None}
+        bounds = None
+        for s in snaps:
+            if bounds is None:
+                bounds, counts = s["bounds"], list(s["counts"])
+            elif tuple(s["bounds"]) == tuple(bounds):
+                counts = [a + b for a, b in zip(counts, s["counts"])]
+            merged["sum"] += s["sum"]
+            merged["count"] += s["count"]
+            merged["min"] = _merge_minmax(merged["min"], s["min"], min)
+            merged["max"] = _merge_minmax(merged["max"], s["max"], max)
+        if stat in ("count", "sum", "min", "max"):
+            return merged[stat]
+        snap = {"bounds": tuple(bounds), "counts": tuple(counts),
+                "min": merged["min"], "max": merged["max"]}
+        return hist_quantile(snap, {"p50": 0.5, "p95": 0.95,
+                                    "p99": 0.99}[stat])
+
+    # -- flat snapshot (flight notes / tests) ---------------------------
+    def snapshot(self):
+        out = {}
+        for (name, labels), e in sorted(self.scalars.items()):
+            key = name
+            if labels:
+                key += "{%s}" % ",".join("%s=%s" % kv for kv in labels)
+            out[key] = e["value"]
+        for (name, labels), s in sorted(self.hists.items()):
+            key = name
+            if labels:
+                key += "{%s}" % ",".join("%s=%s" % kv for kv in labels)
+            out[key] = {"count": s["count"], "sum": s["sum"],
+                        "min": s["min"], "max": s["max"],
+                        "p50": hist_quantile(s, 0.5),
+                        "p95": hist_quantile(s, 0.95),
+                        "p99": hist_quantile(s, 0.99)}
+        return out
+
+    # -- Prometheus exposition -----------------------------------------
+    def generate_text(self):
+        from .export import _label_str, _fmt_value, _escape_help
+        lines = []
+        fams = {}
+        for (name, labels), e in self.scalars.items():
+            fams.setdefault(name, (e["kind"], e["help"], e["unit"],
+                                   []))[3].append((labels, e))
+        for (name, labels), s in self.hists.items():
+            fams.setdefault(name, ("histogram", s.get("help", ""),
+                                   s.get("unit", ""), []))[3] \
+                .append((labels, s))
+        for name in sorted(fams):
+            kind, help_text, unit, series = fams[name]
+            help_text = help_text or name
+            if unit:
+                help_text += " [%s]" % unit
+            lines.append("# HELP %s %s" % (name, _escape_help(help_text)))
+            lines.append("# TYPE %s %s" % (name, kind))
+            for labels, e in sorted(series, key=lambda kv: kv[0]):
+                names = tuple(k for k, _ in labels)
+                values = tuple(v for _, v in labels)
+                if kind == "histogram":
+                    cum = 0
+                    for bound, c in zip(e["bounds"], e["counts"]):
+                        cum += c
+                        lines.append("%s_bucket%s %s" % (
+                            name, _label_str(names, values,
+                                             'le="%s"' % _fmt_value(bound)),
+                            _fmt_value(cum)))
+                    cum += e["counts"][-1]
+                    lines.append("%s_bucket%s %s" % (
+                        name, _label_str(names, values, 'le="+Inf"'),
+                        _fmt_value(cum)))
+                    ls = _label_str(names, values)
+                    lines.append("%s_sum%s %s"
+                                 % (name, ls, _fmt_value(e["sum"])))
+                    lines.append("%s_count%s %s"
+                                 % (name, ls, _fmt_value(e["count"])))
+                else:
+                    lines.append("%s%s %s" % (
+                        name, _label_str(names, values),
+                        _fmt_value(e["value"])))
+        return "\n".join(lines) + "\n"
+
+
+def merge(parts, degraded=False):
+    """Merge per-rank payloads (``local_payload`` blobs or their parsed
+    dicts, rank = list position) into a :class:`PodView`."""
+    view = PodView(len(parts), degraded=degraded)
+    for rank, part in enumerate(parts):
+        doc = json.loads(part.decode()) if isinstance(part, (bytes,
+                                                             bytearray)) \
+            else part
+        for e in doc.get("series", ()):
+            name = e["name"]
+            if name in _SKIP:
+                continue
+            labels = tuple(sorted(e.get("labels", {}).items()))
+            if e["kind"] == "histogram":
+                key = (name, labels)
+                cur = view.hists.get(key)
+                if cur is None or tuple(cur["bounds"]) != \
+                        tuple(e["bounds"]):
+                    if cur is not None:
+                        # bounds drift across ranks (mixed versions):
+                        # last writer wins rather than corrupt a merge
+                        continue
+                    view.hists[key] = {
+                        "bounds": tuple(e["bounds"]),
+                        "counts": tuple(e["counts"]),
+                        "sum": e["sum"], "count": e["count"],
+                        "min": e["min"], "max": e["max"],
+                        "help": e.get("help", ""),
+                        "unit": e.get("unit", "")}
+                else:
+                    cur["counts"] = tuple(
+                        a + b for a, b in zip(cur["counts"], e["counts"]))
+                    cur["sum"] += e["sum"]
+                    cur["count"] += e["count"]
+                    cur["min"] = _merge_minmax(cur["min"], e["min"], min)
+                    cur["max"] = _merge_minmax(cur["max"], e["max"], max)
+            else:
+                rl = labels + (("rank", str(rank)),)
+                view.scalars[(name, tuple(sorted(rl)))] = {
+                    "kind": e["kind"], "help": e.get("help", ""),
+                    "unit": e.get("unit", ""), "value": e["value"]}
+    return view
+
+
+class PodMetricsAggregator:
+    """Periodic registry exchange + merged-view cache (module doc).
+
+    ``step()`` is the per-fit-step hook: on every ``every``-th call it
+    runs one :meth:`exchange`.  Collective discipline: every rank's fit
+    loop drives the same cadence, so every rank reaches the allgather
+    at the same step.
+    """
+
+    def __init__(self, every=None, logger=None, registry=None,
+                 timeout_ms=None):
+        self.every = _sentinel_every() if every is None \
+            else max(0, int(every))
+        self._logger = logger
+        self._registry = registry
+        if timeout_ms is None:
+            env = os.environ.get("MXNET_SENTINEL_TIMEOUT_MS", "")
+            timeout_ms = int(env) if env else None
+        self._timeout_ms = timeout_ms    # None = dist-layer default
+        self._steps = 0
+        self._view = None
+        self._lock = threading.Lock()
+        _set_default(self)
+
+    @classmethod
+    def maybe_create(cls, logger=None):
+        """The fit loop's constructor: an aggregator when the world is
+        multi-process, ``MXNET_SENTINEL_EVERY`` is set explicitly, or
+        SLO rules are installed (they evaluate on the aggregated view);
+        else None."""
+        env = os.environ.get("MXNET_SENTINEL_EVERY")
+        try:
+            import jax
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        from . import sentinel as _sentinel
+        if env is None and not multi and not _sentinel.SENTINEL.rules():
+            return None
+        agg = cls(logger=logger)
+        return agg if agg.every else None
+
+    def due(self):
+        """True when the NEXT ``step()`` call will run an exchange —
+        the fit loop drains its pipeline (``_fit_sync``) first so the
+        shipped snapshot carries fresh in-launch sentinel values."""
+        return bool(self.every) and (self._steps + 1) % self.every == 0
+
+    def step(self):
+        """Per-step hook; returns the fresh PodView on exchange steps,
+        None otherwise."""
+        self._steps += 1
+        if not self.every or self._steps % self.every:
+            return None
+        return self.exchange()
+
+    def exchange(self):
+        """One allgather of registry payloads -> merged view -> rule
+        evaluation. Any transport failure degrades to the local view
+        (a dead rank must not take pod observability down with it)."""
+        payload = local_payload(self._registry)
+        from ..kvstore_tpu import dist
+        try:
+            parts = dist.allgather_bytes("sentinel_agg", payload,
+                                         timeout_ms=self._timeout_ms)
+            view = merge(parts)
+            AGG_EXCHANGES.inc()
+            POD_RANKS.set(len(parts))
+        except Exception as e:                       # noqa: BLE001
+            if self._logger is not None:
+                self._logger.warning(
+                    "pod metrics aggregation failed (%s); serving the "
+                    "local view", e)
+            view = merge([payload], degraded=True)
+        with self._lock:
+            self._view = view
+        from . import sentinel as _sentinel
+        _sentinel.SENTINEL.evaluate(view, logger=self._logger)
+        return view
+
+    def view(self, refresh_local=True):
+        """The last merged view; with no exchange yet (or after
+        degradation on a single rank) a fresh LOCAL view."""
+        with self._lock:
+            v = self._view
+        if v is None and refresh_local:
+            v = merge([local_payload(self._registry)], degraded=True)
+        return v
+
+
+# the process-default aggregator: whoever constructed one last (the fit
+# loop, a server, a test) owns the /pod_metrics surfaces
+_DEFAULT = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def _set_default(agg):
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = agg
+
+
+def default_aggregator():
+    return _DEFAULT
+
+
+def pod_text(registry=None):
+    """Prometheus text for ``GET /pod_metrics``: the default
+    aggregator's last merged view, else a local single-rank view."""
+    agg = _DEFAULT
+    if agg is not None:
+        v = agg.view()
+        if v is not None:
+            return v.generate_text()
+    return merge([local_payload(registry)], degraded=True).generate_text()

@@ -20,7 +20,7 @@ import threading
 
 from .registry import REGISTRY, Histogram
 
-__all__ = ["CONTENT_TYPE", "generate_text", "parse_text",
+__all__ = ["CONTENT_TYPE", "generate_text", "parse_text", "parse_labels",
            "start_http_exporter", "Exporter"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -30,6 +30,8 @@ def _fmt_value(v):
     if v is None:
         return "NaN"
     f = float(v)
+    if math.isnan(f):
+        return "NaN"     # a NaN-poisoned gauge must not break the scrape
     if math.isinf(f):
         return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
@@ -96,9 +98,42 @@ def generate_text(registry=None):
     return "\n".join(lines) + "\n"
 
 
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(s):
+    """Inverse of ``_escape_label``: one left-to-right scan, so
+    ``\\\\n`` stays a literal backslash + n and ``\\n`` a newline."""
+    out, i = [], 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            rep = {"n": "\n", '"': '"', "\\": "\\"}.get(nxt)
+            if rep is not None:
+                out.append(rep)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def parse_labels(key):
+    """``(name, {label: value})`` from a sample key, label values
+    UN-escaped — the round-trip inverse of ``_label_str``."""
+    name, brace, rest = key.partition("{")
+    if not brace:
+        return key, {}
+    body = rest.rsplit("}", 1)[0]
+    return name, {m.group(1): _unescape_label(m.group(2))
+                  for m in _LABEL_RE.finditer(body)}
+
+
 def parse_text(text):
     """Minimal exposition parser: ``{name: {"type": kind, "samples":
-    {sample_name+labels: float}}}``.  Round-trip/validation use only."""
+    {sample_name+labels: float}, "labels": {key: {label: value}}}}``
+    with label values un-escaped.  Round-trip/validation use only."""
     out = {}
     types = {}
     for line in text.splitlines():
@@ -130,6 +165,8 @@ def parse_text(text):
                                     "samples": {}})
         v = float("nan") if value == "NaN" else float(value)
         fam["samples"][key] = v
+        if "{" in key:
+            fam.setdefault("labels", {})[key] = parse_labels(key)[1]
     return out
 
 
@@ -147,9 +184,13 @@ class Exporter:
 
 
 def start_http_exporter(port=0, host="127.0.0.1", registry=None):
-    """Serve ``GET /metrics`` (+``/healthz``) on a daemon thread —
-    the scrape endpoint for training jobs.  ``port=0`` binds an
-    ephemeral port; read it back from ``exporter.address``."""
+    """Serve ``GET /metrics`` (+``/pod_metrics``, ``/healthz``) on a
+    daemon thread — the scrape endpoint for training jobs.  ``port=0``
+    binds an ephemeral port; read it back from ``exporter.address``.
+    ``/pod_metrics`` is the fleet view: the last
+    :class:`~mxnet_tpu.telemetry.aggregate.PodMetricsAggregator`
+    exchange (rank-labeled scalars, bucket-merged histograms), falling
+    back to the local registry when no exchange has run."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -159,6 +200,11 @@ def start_http_exporter(port=0, host="127.0.0.1", registry=None):
         def do_GET(self):
             if self.path in ("/metrics", "/"):
                 body = generate_text(registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+            elif self.path == "/pod_metrics":
+                from . import aggregate as _aggregate
+                body = _aggregate.pod_text(registry).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", CONTENT_TYPE)
             elif self.path == "/healthz":

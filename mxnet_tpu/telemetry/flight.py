@@ -11,6 +11,12 @@ Wire-up: ``RECORDER.install(path, every=N)`` (or env
 ``MXNET_TELEMETRY_FLIGHT=<path>`` [+ ``MXNET_TELEMETRY_FLIGHT_EVERY``,
 default 50] at import).  The fit loop calls ``RECORDER.tick()`` once
 per step — a single attribute check when the recorder is idle.
+
+Dumps ROTATE instead of overwriting: before each write the existing
+``path`` shifts to ``path.1`` (… ``path.<keep-1>``), bounding total
+output to ``MXNET_TELEMETRY_FLIGHT_KEEP`` files (default 5; 1 =
+overwrite in place) — a crash-looping job keeps its last few
+post-mortems instead of only the newest.
 """
 from __future__ import annotations
 
@@ -25,8 +31,16 @@ from collections import deque
 __all__ = ["FlightRecorder", "RECORDER"]
 
 
+def _keep_default():
+    try:
+        return max(1, int(os.environ.get("MXNET_TELEMETRY_FLIGHT_KEEP",
+                                         "5") or 5))
+    except ValueError:
+        return 5
+
+
 class FlightRecorder:
-    def __init__(self, capacity=512, registry=None):
+    def __init__(self, capacity=512, registry=None, keep=None):
         self._registry = registry
         self._ring = deque(maxlen=capacity)
         self._lock = threading.Lock()
@@ -34,6 +48,7 @@ class FlightRecorder:
         self._path = None
         self._installed = False
         self._steps = 0
+        self.keep = keep if keep is not None else _keep_default()
 
     def _reg(self):
         if self._registry is not None:
@@ -123,6 +138,7 @@ class FlightRecorder:
         path = path or self._path
         if path is None:
             raise ValueError("no dump path: pass one or install() first")
+        self._rotate(path)
         extra = []
         try:
             from . import tracing as _tracing
@@ -144,6 +160,26 @@ class FlightRecorder:
             for rec in extra + records:
                 f.write(json.dumps(rec) + "\n")
         return path
+
+    def _rotate(self, path):
+        """Shift ``path`` -> ``path.1`` -> ... -> ``path.<keep-1>``
+        (oldest dropped) so repeated dumps keep the last ``keep``
+        files. ``keep <= 1`` keeps the overwrite-in-place behavior.
+        Best-effort: rotation failures must never lose the dump."""
+        keep = max(1, int(self.keep or 1))
+        if keep <= 1 or not os.path.exists(path):
+            return
+        try:
+            oldest = "%s.%d" % (path, keep - 1)
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(keep - 2, 0, -1):
+                src = "%s.%d" % (path, i)
+                if os.path.exists(src):
+                    os.replace(src, "%s.%d" % (path, i + 1))
+            os.replace(path, "%s.1" % path)
+        except OSError:
+            pass
 
 
 RECORDER = FlightRecorder()

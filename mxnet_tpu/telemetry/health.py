@@ -114,8 +114,11 @@ class PodHealthMonitor:
             return None
         from ..kvstore_tpu import dist
         try:
+            # timeout_ms=None is the bounded dist-layer default — made
+            # explicit per the collective pass's telemetry discipline
             parts = dist.allgather_bytes("health_step",
-                                         struct.pack("<d", p50))
+                                         struct.pack("<d", p50),
+                                         timeout_ms=None)
         except Exception as e:                      # noqa: BLE001
             if self._logger is not None:
                 self._logger.warning("pod health exchange failed: %s", e)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
@@ -164,6 +165,14 @@ def exponential_buckets(start, factor, count):
 # default ms-scale ladder: 0.05 ms .. ~7 min, factor 2
 DEFAULT_MS_BUCKETS = exponential_buckets(0.05, 2.0, 23)
 
+# per-metric labeled-series cap: a buggy label loop (request ids, raw
+# paths...) must not grow a long-running server's registry without
+# bound. Past the cap, ``labels()`` hands back a detached overflow
+# child (updates land nowhere visible) and bumps
+# ``telemetry_series_dropped``. Module attribute so tests can lower it.
+MAX_SERIES = int(os.environ.get("MXNET_TELEMETRY_MAX_SERIES", "1024")
+                 or 1024)
+
 
 def _fmt_label_key(kv):
     names = tuple(sorted(kv))
@@ -185,21 +194,39 @@ class _Metric:
         self.label_values = tuple(label_values)
         self._lock = threading.Lock()
         self._children = {}
+        self._overflow = None     # shared detached child past MAX_SERIES
 
     def _make_child(self, names, values):
         raise NotImplementedError
 
     def labels(self, **kv):
-        """Child instrument for one label set (created on first use)."""
+        """Child instrument for one label set (created on first use).
+
+        Past ``MAX_SERIES`` distinct label sets the call degrades to a
+        shared DETACHED child: updates still type-check and never
+        raise, but the series is not registered (not exported, not
+        snapshotted) and ``telemetry_series_dropped`` counts the
+        overflow — cardinality bugs surface as one counter, not an
+        OOM."""
         if not kv:
             return self
         names, values = _fmt_label_key(kv)
         with self._lock:
             child = self._children.get((names, values))
-            if child is None:
+            if child is not None:
+                return child
+            if MAX_SERIES and len(self._children) >= MAX_SERIES:
+                if self._overflow is None:
+                    self._overflow = self._make_child(names, values)
+                child = self._overflow
+            else:
                 child = self._make_child(names, values)
                 self._children[(names, values)] = child
-            return child
+                return child
+        # dropped: count outside this metric's lock (SERIES_DROPPED is
+        # itself a registry counter with its own lock)
+        SERIES_DROPPED.inc()
+        return child
 
     def children(self):
         with self._lock:
@@ -457,6 +484,13 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+# overflow witness for the MAX_SERIES cap (module doc above labels());
+# vital so a disabled registry still surfaces cardinality bugs
+SERIES_DROPPED = REGISTRY.counter(
+    "telemetry_series_dropped",
+    "label sets dropped by the per-metric MXNET_TELEMETRY_MAX_SERIES "
+    "cardinality cap", vital=True)
 
 
 def counter(name, help="", unit="", vital=False):

@@ -1,0 +1,259 @@
+"""mx.sentinel — declarative SLO rules over the aggregated pod view,
+plus the registry home of the in-launch numerics witnesses
+(docs/OBSERVABILITY.md, "Pod aggregation & alerting").
+
+Rules are INVARIANTS in Borgmon style::
+
+    from mxnet_tpu.telemetry import sentinel
+    sentinel.rule("decode_ttft_steps_p99 < 700", for_steps=3)
+    sentinel.rule("grad_norm < 1e3", action=lambda rule, value: ckpt())
+    sentinel.rule("delta(nonfinite_grads) == 0")
+
+or, file-driven, ``MXNET_SENTINEL_RULES=rules.json`` with a list of
+``{"expr": ..., "for_steps": ..., "name": ...}`` objects.  A metric
+reference is a glossary series name (enforced statically by
+``mx.analyze``'s telemetry pass), optionally with a ``_p50/_p95/_p99/
+_count/_sum/_min/_max`` suffix to read a bucket-merged histogram stat,
+or wrapped in ``delta(...)`` to evaluate the change since the previous
+evaluation (the usable form for cumulative counters).
+
+Evaluation happens on each :class:`~.aggregate.PodMetricsAggregator`
+exchange — every ``MXNET_SENTINEL_EVERY`` fit steps, on the MERGED
+fleet view (counters summed, gauges max-reduced across ranks,
+histograms bucket-merged) — so a rule watches the pod, not one rank.
+Incident lifecycle: an invariant must evaluate FALSE on ``for_steps``
+consecutive evaluations to open an incident; opening fires ONCE — a
+``sentinel_alerts{rule=...}`` increment, a flight-recorder note, the
+optional ``action(rule, value)`` callback — and the incident stays
+open (no re-fire) until an evaluation where the invariant holds again
+clears it (flight note ``sentinel_clear``).  Active incidents surface
+in ``ModelServer``'s ``GET /health``.
+
+The numerics witnesses the fused fit step publishes live here so the
+sentinel layer is their one home: ``grad_norm``, ``nonfinite_grads``,
+``residual_drift``, ``loss_zscore``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+from .registry import REGISTRY
+
+__all__ = ["Rule", "RuleEngine", "SENTINEL", "rule", "rules", "clear",
+           "evaluate_local", "numerics_enabled", "GRAD_NORM",
+           "NONFINITE_GRADS", "RESIDUAL_DRIFT", "LOSS_ZSCORE",
+           "SENTINEL_ALERTS"]
+
+# -- the in-launch numerics series (published by module/fused_fit.py
+#    and the bucketed kvstore engine at sync boundaries) ---------------
+GRAD_NORM = REGISTRY.gauge(
+    "grad_norm", "global L2 norm of the f32 master-gradient view at "
+    "the last sentinel publish (fused fit step)")
+NONFINITE_GRADS = REGISTRY.counter(
+    "nonfinite_grads", "non-finite gradient elements seen by the "
+    "in-launch numerics sentinels (fused fit step + bucketed kvstore)",
+    vital=True)
+RESIDUAL_DRIFT = REGISTRY.gauge(
+    "residual_drift", "2-bit error-feedback residual-norm drift: "
+    "last residual L2 norm over its EMA (~1 = stable)", unit="ratio")
+LOSS_ZSCORE = REGISTRY.gauge(
+    "loss_zscore", "z-score of the last step's device-folded training "
+    "metric (the loss when the metric is a loss; the grad norm when no "
+    "device metric rides the program) against its running EMA")
+SENTINEL_ALERTS = REGISTRY.counter(
+    "sentinel_alerts", "SLO rule incidents opened (once per incident), "
+    "labeled by `rule`")
+
+def numerics_enabled():
+    """The ``MXNET_SENTINEL_NUMERICS`` gate (default ON) shared by the
+    fused fit step and the bucketed kvstore engine — one source of
+    truth for whether the in-launch witnesses ride the programs."""
+    return os.environ.get("MXNET_SENTINEL_NUMERICS", "1") \
+        not in ("0", "false", "off")
+
+
+_EXPR_RE = re.compile(
+    r"^\s*(delta\()?\s*([A-Za-z_:][A-Za-z0-9_:]*)\s*(\))?\s*"
+    r"(<=|>=|==|!=|<|>)\s*([-+]?[0-9.]+(?:[eE][-+]?[0-9]+)?)\s*$")
+
+_OPS = {
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+class Rule:
+    """One parsed invariant + its incident state."""
+
+    def __init__(self, expr, for_steps=1, action=None, name=None):
+        m = _EXPR_RE.match(expr)
+        if m is None or bool(m.group(1)) != bool(m.group(3)):
+            raise ValueError(
+                "unparseable sentinel rule %r (want 'metric[_p99] OP "
+                "number' or 'delta(metric) OP number')" % (expr,))
+        self.expr = expr
+        self.delta = bool(m.group(1))
+        self.metric = m.group(2)
+        self.op = m.group(4)
+        self.threshold = float(m.group(5))
+        self.for_steps = max(1, int(for_steps))
+        self.action = action
+        self.name = name or self.metric
+        # incident state
+        self._breached = 0         # consecutive failing evaluations
+        self.firing = False
+        self.last_value = None
+        self._prev = None          # previous raw value (delta rules)
+
+    def holds(self, value):
+        """Does the invariant hold at ``value``?"""
+        return _OPS[self.op](value, self.threshold)
+
+    def reset(self):
+        self._breached = 0
+        self.firing = False
+        self.last_value = None
+        self._prev = None
+
+
+class RuleEngine:
+    """Registry of :class:`Rule` + the evaluate/fire/clear lifecycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules = {}
+        self._env_loaded = False
+
+    # -- registration ---------------------------------------------------
+    def rule(self, expr, for_steps=1, action=None, name=None):
+        """Install (or replace, by name) one invariant; returns it."""
+        r = Rule(expr, for_steps=for_steps, action=action, name=name)
+        with self._lock:
+            self._rules[r.name] = r
+        return r
+
+    def rules(self):
+        self._load_env_rules()
+        with self._lock:
+            return [self._rules[k] for k in sorted(self._rules)]
+
+    def remove(self, name):
+        with self._lock:
+            self._rules.pop(name, None)
+
+    def clear(self):
+        """Drop every rule (tests / teardown)."""
+        with self._lock:
+            self._rules.clear()
+            self._env_loaded = True   # a cleared engine stays cleared
+
+    def _load_env_rules(self):
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        path = os.environ.get("MXNET_SENTINEL_RULES")
+        if not path:
+            return
+        try:
+            with open(path) as f:
+                specs = json.load(f)
+            for spec in specs:
+                self.rule(spec["expr"],
+                          for_steps=int(spec.get("for_steps", 1)),
+                          name=spec.get("name"))
+        except Exception as e:                       # noqa: BLE001
+            import logging
+            logging.getLogger("mxnet_tpu.sentinel").warning(
+                "failed to load MXNET_SENTINEL_RULES=%s: %s", path, e)
+
+    # -- evaluation -----------------------------------------------------
+    def evaluate(self, view, logger=None):
+        """Evaluate every rule against a PodView (or any object with
+        ``lookup(ref)``); returns the list of rules that FIRED on this
+        evaluation (not merely active)."""
+        fired = []
+        for r in self.rules():
+            raw = view.lookup(r.metric)
+            if raw is None:
+                continue           # series absent: no fire, no clear
+            raw = float(raw)
+            if r.delta:
+                prev, r._prev = r._prev, raw
+                if prev is None:
+                    continue       # first sample: no delta yet
+                value = raw - prev
+            else:
+                value = raw
+            r.last_value = value
+            if r.holds(value):
+                if r.firing:
+                    self._note("sentinel_clear", r, value)
+                    if logger is not None:
+                        logger.info("sentinel cleared: %s (value %g)",
+                                    r.expr, value)
+                r._breached = 0
+                r.firing = False
+                continue
+            r._breached += 1
+            if r.firing or r._breached < r.for_steps:
+                continue
+            r.firing = True
+            fired.append(r)
+            SENTINEL_ALERTS.labels(rule=r.name).inc()
+            self._note("sentinel_alert", r, value)
+            if logger is not None:
+                logger.warning("sentinel alert: %s (value %g, breached "
+                               "%d consecutive evals)", r.expr, value,
+                               r._breached)
+            if r.action is not None:
+                try:
+                    r.action(r, value)
+                except Exception as e:               # noqa: BLE001
+                    if logger is not None:
+                        logger.warning("sentinel action for %r failed: "
+                                       "%s", r.name, e)
+        return fired
+
+    @staticmethod
+    def _note(event, r, value):
+        from .flight import RECORDER
+        RECORDER.note(event, rule=r.name, expr=r.expr,
+                      value=round(value, 6))
+
+    def active(self):
+        """Open incidents, for ``GET /health``: ``[{"rule", "expr",
+        "value"}]``."""
+        return [{"rule": r.name, "expr": r.expr, "value": r.last_value}
+                for r in self.rules() if r.firing]
+
+
+SENTINEL = RuleEngine()
+
+
+def rule(expr, for_steps=1, action=None, name=None):
+    return SENTINEL.rule(expr, for_steps=for_steps, action=action,
+                         name=name)
+
+
+def rules():
+    return SENTINEL.rules()
+
+
+def clear():
+    SENTINEL.clear()
+
+
+def evaluate_local(logger=None, registry=None):
+    """Evaluate rules on a fresh LOCAL single-rank view — the
+    no-aggregator path (serving without a fit loop, tests)."""
+    from . import aggregate as _aggregate
+    view = _aggregate.merge([_aggregate.local_payload(registry)],
+                            degraded=True)
+    return SENTINEL.evaluate(view, logger=logger)

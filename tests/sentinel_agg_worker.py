@@ -1,0 +1,143 @@
+"""Worker for the mx.sentinel 2-process pod-aggregation smoke test
+(tests/test_sentinel.py::test_two_process_pod_aggregation).
+
+Each rank publishes distinct registry truth (a gauge, a counter, a
+histogram), drives :class:`telemetry.aggregate.PodMetricsAggregator`
+exchanges over the coordination-service collectives, and pins:
+
+* the merged view rank-labels counters/gauges with each rank's EXACT
+  values and bucket-merges the histogram (counts vectors summed
+  element-wise against a locally-built reference);
+* ``GET /pod_metrics`` on rank 0 serves BOTH ranks' series from one
+  scrape;
+* a breached SLO rule opens an incident that fires EXACTLY ONCE
+  (``sentinel_alerts{rule=...}``), stays open without re-firing, clears
+  on recovery, and re-fires as a second incident on a fresh breach;
+* a rank missing from an exchange degrades the caller to its LOCAL
+  view through the bounded collective timeout — never a hang.
+
+Run via:
+  python tools/run_multihost.py -n 2 python tests/sentinel_agg_worker.py
+"""
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.kvstore_tpu import dist
+from mxnet_tpu.telemetry import aggregate, sentinel
+
+BOUNDS = (1, 10, 100)
+
+
+def _expected_merged_counts():
+    """Both ranks' observations are deterministic, so each rank can
+    rebuild the exact merged bucket vector from scratch."""
+    ref = telemetry.Registry()
+    h0 = ref.histogram("h0", bounds=BOUNDS)
+    h0.observe(5)
+    h0.observe(5)
+    h1 = ref.histogram("h1", bounds=BOUNDS)
+    h1.observe(50)
+    h1.observe(50)
+    return tuple(a + b for a, b in zip(h0.snapshot()["counts"],
+                                       h1.snapshot()["counts"]))
+
+
+def main():
+    kv_probe = mx.kv.create("tpu")
+    rank, n = kv_probe.rank, kv_probe.num_workers
+    assert n == 2, n
+
+    gauge = telemetry.REGISTRY.gauge("sentinel_worker_gauge",
+                                     "per-rank truth (rank + 1)")
+    ctr = telemetry.REGISTRY.counter("sentinel_worker_events",
+                                     "per-rank truth (10 * (rank + 1))")
+    hist = telemetry.REGISTRY.histogram("sentinel_worker_ms",
+                                        "per-rank truth", bounds=BOUNDS)
+    gauge.set(float(rank + 1))
+    ctr.inc(10 * (rank + 1))
+    for _ in range(2):
+        hist.observe(5 if rank == 0 else 50)
+
+    engine = sentinel.SENTINEL
+    engine.clear()
+    # breached by rank 1's value (pod gauge reduction is MAX = 2)
+    engine.rule("sentinel_worker_gauge < 1.5", for_steps=2, name="wg")
+    alerts = sentinel.SENTINEL_ALERTS.labels(rule="wg")
+
+    agg = aggregate.PodMetricsAggregator(every=1)
+    view = agg.exchange()                 # eval 1: breach 1 of 2
+    assert not view.degraded and view.n_ranks == 2
+
+    # rank-labeled scalars carry each rank's exact values — on BOTH ranks
+    for rk in range(2):
+        labels = (("rank", str(rk)),)
+        assert view.scalars[("sentinel_worker_gauge", labels)]["value"] \
+            == float(rk + 1)
+        assert view.scalars[("sentinel_worker_events", labels)]["value"] \
+            == 10 * (rk + 1)
+    assert view.lookup("sentinel_worker_events") == 30.0   # counters sum
+    assert view.lookup("sentinel_worker_gauge") == 2.0     # gauges max
+
+    # bucket-merged histogram matches the per-rank truth exactly
+    merged = view.hists[("sentinel_worker_ms", ())]
+    assert merged["counts"] == _expected_merged_counts()
+    assert merged["count"] == 4 and merged["sum"] == 110.0
+    assert merged["min"] == 5.0 and merged["max"] == 50.0
+    assert view.lookup("sentinel_worker_ms_count") == 4
+    assert view.lookup("sentinel_worker_ms_p99") >= 10
+
+    # one scrape of rank 0 sees the whole pod
+    if rank == 0:
+        exp = telemetry.start_http_exporter(port=0)
+        try:
+            host, port = exp.address
+            text = urllib.request.urlopen(
+                "http://%s:%d/pod_metrics" % (host, port),
+                timeout=30).read().decode()
+            assert 'sentinel_worker_gauge{rank="0"} 1' in text
+            assert 'sentinel_worker_gauge{rank="1"} 2' in text
+            assert 'sentinel_worker_events{rank="1"} 20' in text
+            assert "sentinel_worker_ms_bucket" in text
+        finally:
+            exp.stop()
+
+    assert alerts.value == 0              # below for_steps: not yet open
+    agg.exchange()                        # eval 2: incident opens
+    assert alerts.value == 1
+    agg.exchange()                        # eval 3: open incident, no re-fire
+    assert alerts.value == 1
+    assert [a["rule"] for a in engine.active()] == ["wg"]
+
+    gauge.set(0.0)                        # recovery on every rank
+    agg.exchange()                        # eval 4: invariant holds -> clears
+    assert alerts.value == 1
+    assert engine.active() == []
+
+    gauge.set(float(rank + 1))            # fresh breach: SECOND incident
+    agg.exchange()                        # eval 5: breach 1 of 2
+    agg.exchange()                        # eval 6: second incident opens
+    assert alerts.value == 2
+
+    # rank death during aggregation: rank 1 sits the exchange out; rank
+    # 0's bounded timeout degrades to the local view instead of hanging
+    if rank == 0:
+        lone = aggregate.PodMetricsAggregator(every=1, timeout_ms=1500)
+        t0 = time.monotonic()
+        v = lone.exchange()
+        assert time.monotonic() - t0 < 60, "degradation took too long"
+        assert v.degraded and v.n_ranks == 1
+        assert ("sentinel_worker_gauge", (("rank", "0"),)) in v.scalars
+    else:
+        time.sleep(5.0)                   # outlive rank 0's timeout
+    dist.barrier("sentinel_worker_done", timeout_ms=60000)
+    print("all sentinel agg checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -352,6 +352,50 @@ def test_collective_rank_branch_and_tag_rules():
                                        "tag-reuse"]
 
 
+def test_collective_telemetry_timeout_discipline():
+    """A collective issued from telemetry/ must make its timeout bound
+    visible at the call site (explicit timeout_ms=); the same call
+    elsewhere in the tree is not subject to the rule."""
+    src = """
+        from ..kvstore_tpu import dist
+
+        def bounded(payload):
+            return dist.allgather_bytes("aggtag", payload,
+                                        timeout_ms=None)
+
+        def unbounded(payload):
+            return dist.allgather_bytes("aggtag2", payload)
+    """
+    m = make_module(src, "mxnet_tpu/telemetry/aggregate.py")
+    _, fs = run_pass(CollectivePass(), m)
+    hits = [f for f in fs if f.slug == "unbounded-telemetry-collective"]
+    assert len(hits) == 1 and hits[0].detail == "allgather_bytes"
+    assert hits[0].line == m.text[: m.text.index("aggtag2")] \
+        .count("\n") + 1
+    m2 = make_module(src, "mxnet_tpu/checkpoint/multihost.py")
+    _, fs2 = run_pass(CollectivePass(), m2)
+    assert "unbounded-telemetry-collective" not in slugs(fs2, "collective")
+
+
+def test_telemetry_unresolved_rule_metric():
+    """Literal sentinel.rule(...) expressions must reference a glossary
+    series — suffix-stripped and delta-unwrapped forms resolve, a
+    phantom series is flagged."""
+    from analyze.telemetry import TelemetryPass
+    m = make_module('''
+        from mxnet_tpu.telemetry import sentinel
+
+        def install():
+            sentinel.rule("grad_norm < 1e3")
+            sentinel.rule("decode_ttft_steps_p99 < 700", for_steps=3)
+            sentinel.rule("delta(nonfinite_grads) == 0")
+            sentinel.rule("phantom_series_p99 < 5")
+    ''', "mxnet_tpu/telemetry/bogus_rules.py")
+    _, fs = run_pass(TelemetryPass(), m)
+    unresolved = [f for f in fs if f.slug == "unresolved-rule-metric"]
+    assert [f.detail for f in unresolved] == ["phantom_series_p99"]
+
+
 def test_collective_dist_module_itself_exempt():
     src = ("def broadcast_bytes(tag, payload, root=0):\n"
            "    import jax\n"
