@@ -2283,6 +2283,211 @@ def bench_decode(args):
     return out
 
 
+def bench_fleet(args):
+    """mx.fleet disaggregated serving (docs/FLEET.md): three arms.
+
+    * **Routing A/B** — the SAME shared-prefix request mix (three
+      request families, each opening with its own system preamble,
+      interleaved round-robin the way a fleet actually sees traffic)
+      through a two-replica ``FleetRouter`` under ``affinity`` vs
+      ``least_loaded``.  Hard gate: the affinity arm's summed
+      ``prefix_hit_blocks`` must be STRICTLY higher — co-locating a
+      family on one replica converts every repeat preamble into trie
+      hits, while spreading makes each replica re-prefill it.
+    * **TP arm** — ``make_tp_engine(tensor_parallel=2)`` over the mp
+      mesh must keep the decode contract intact (1 dispatch/iteration,
+      0 steady-state retraces, greedy streams bit-identical to the
+      single-device baseline) while its per-device cache bytes drop to
+      <= 0.6x replicated — TP buys memory, never different math.
+    * **Scale-up arm** — a COLD replica (``warmup=False``) joins the
+      ring via ``add_replica`` (which AOT-warms BEFORE the replica is
+      routable) and serves its first routed request with ZERO
+      serve-time compiles (``steady_state_retraces == 0``).
+
+    Wall-clock is meaningless for routing on the 1-core container; the
+    headline is the hit-block ratio, the dispatch-count convention's
+    stand-in for the TTFT win prefix affinity buys on hardware."""
+    import os
+    import sys
+    if "jax" not in sys.modules \
+            and os.environ.get("JAX_PLATFORMS") == "cpu":
+        # standalone --mode fleet on the CPU container: the TP arm
+        # needs >= 2 visible devices (same knob tests/conftest.py pins)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    from mxnet_tpu import sharding
+    from mxnet_tpu.decode import DecodeEngine
+    from mxnet_tpu.fleet import (FleetRouter, make_tp_engine,
+                                 per_device_cache_bytes)
+    from mxnet_tpu.models import transformer
+
+    cfg = dict(num_classes=args.decode_vocab,
+               num_layers=args.decode_layers, d_model=16,
+               num_heads=2, seq_len=args.decode_seq)
+    ek = dict(capacity=4, block_size=args.decode_block_size,
+              num_blocks=args.decode_blocks, chunk_tokens=8,
+              warmup=True, prefix_cache=True)
+    tsym = transformer.get_symbol(**cfg)
+    shapes, _, _ = tsym.infer_shape(data=(1, args.decode_seq),
+                                    softmax_label=(args.decode_seq,))
+    rng = np.random.RandomState(0)
+    params = {n: rng.normal(0, 0.05, s).astype(np.float32)
+              for n, s in zip(tsym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+
+    # three request FAMILIES (distinct system preambles spanning > 3
+    # full cache blocks) interleaved round-robin: the shape
+    # prefix-affinity routing exists for — without stickiness or
+    # affinity, consecutive arrivals from one family land on different
+    # replicas and every one re-prefills the preamble
+    fam_rng = np.random.RandomState(11)
+    preambles = [list(fam_rng.randint(0, args.decode_vocab,
+                                      3 * args.decode_block_size + 1))
+                 for _ in range(3)]
+    requests = []
+    for turn in range(4):
+        for fam, pre in enumerate(preambles):
+            requests.append(pre + list(fam_rng.randint(
+                0, args.decode_vocab, 2 + fam + turn)))
+
+    def run_router_arm(policy):
+        engs = {"r0": DecodeEngine(params, cfg, **ek),
+                "r1": DecodeEngine(params, cfg, **ek)}
+        try:
+            router = FleetRouter(policy=policy, sticky=False,
+                                 trie_blocks=4096)
+            for name, eng in engs.items():
+                router.add_replica(name, eng)
+            placements = []
+            for toks in requests:
+                name, eng = router.route(toks)
+                placements.append(name)
+                eng.generate(toks, max_new_tokens=4, timeout=300)
+            hit_blocks = sum(
+                e.stats()["cache"]["prefix_hit_blocks"]
+                for e in engs.values())
+            return {"hit_blocks": int(hit_blocks),
+                    "spread": len(set(placements)),
+                    "router": router.stats()}
+        finally:
+            for eng in engs.values():
+                eng.stop()
+
+    affinity = run_router_arm("affinity")
+    least = run_router_arm("least_loaded")
+    if not affinity["hit_blocks"] > least["hit_blocks"]:
+        raise SystemExit(
+            "bench: affinity routing did not beat least_loaded on "
+            "prefix_hit_blocks (%d vs %d) under the shared-prefix "
+            "mix — cache-aware placement bought nothing"
+            % (affinity["hit_blocks"], least["hit_blocks"]))
+
+    # TP arm: same prompts single-device vs mp=2
+    tp_prompts = [list(fam_rng.randint(0, args.decode_vocab,
+                                       fam_rng.randint(4, 13)))
+                  for _ in range(4)]
+    base = DecodeEngine(params, cfg, **ek)
+    try:
+        base_streams = [base.generate(p, max_new_tokens=8, timeout=300)
+                        for p in tp_prompts]
+        base_bytes = per_device_cache_bytes(base)
+    finally:
+        base.stop()
+    n_dev = len(jax.devices())
+    if n_dev >= 2 and n_dev % 2 == 0:
+        try:
+            tp = make_tp_engine(params, cfg, tensor_parallel=2, **ek)
+            try:
+                tp_streams = [tp.generate(p, max_new_tokens=8,
+                                          timeout=300)
+                              for p in tp_prompts]
+                tp_stats = tp.stats()
+                tp_bytes = per_device_cache_bytes(tp)
+            finally:
+                tp.stop()
+        finally:
+            sharding.clear_mesh()
+        if tp_streams != base_streams:
+            raise SystemExit("bench: TP decode arm changed the greedy "
+                             "streams vs the single-device baseline")
+        if (tp_stats["dispatches_per_step"] != 1.0
+                or tp_stats["steady_state_retraces"] != 0):
+            raise SystemExit(
+                "bench: TP decode arm broke the dispatch contract: "
+                "dispatches_per_step=%r (want 1.0), "
+                "steady_state_retraces=%r (want 0)"
+                % (tp_stats["dispatches_per_step"],
+                   tp_stats["steady_state_retraces"]))
+        cache_ratio = round(tp_bytes / max(1, base_bytes), 3)
+        if cache_ratio > 0.6:
+            raise SystemExit(
+                "bench: TP per-device cache bytes %d = %.0f%% of "
+                "replicated %d (want <= 60%%) — the head shards "
+                "silently replicated" % (tp_bytes, 100 * cache_ratio,
+                                         base_bytes))
+        tp_fields = {
+            "fleet_tp_dispatches_per_step":
+                tp_stats["dispatches_per_step"],
+            "fleet_tp_retraces_steady_state":
+                tp_stats["steady_state_retraces"],
+            "fleet_tp_cache_bytes_ratio": cache_ratio,
+        }
+    else:
+        tp_fields = {"fleet_tp_note":
+                     "%d visible device(s): mp=2 needs an even "
+                     "count >= 2" % n_dev}
+
+    # scale-up arm: a cold replica joins and serves compile-free
+    cold = DecodeEngine(params, cfg, capacity=4,
+                        block_size=args.decode_block_size,
+                        num_blocks=args.decode_blocks, chunk_tokens=8,
+                        warmup=False, prefix_cache=True)
+    try:
+        router = FleetRouter(policy="affinity", sticky=False)
+        warmed = router.add_replica("join", cold)
+        name, eng = router.route(tp_prompts[0])
+        eng.generate(tp_prompts[0], max_new_tokens=6, timeout=300)
+        join_stats = eng.stats()
+    finally:
+        cold.stop()
+    if warmed <= 0 or join_stats["steady_state_retraces"] != 0:
+        raise SystemExit(
+            "bench: scale-up first request compiled at serve time "
+            "(warmed=%r, steady_state_retraces=%r — want > 0 / 0): "
+            "add_replica must AOT-warm before ring insertion"
+            % (warmed, join_stats["steady_state_retraces"]))
+
+    dev = jax.devices()[0]
+    out = {
+        "metric": "fleet_affinity_hit_ratio",
+        "value": round(affinity["hit_blocks"]
+                       / max(1, least["hit_blocks"]), 2),
+        "unit": "x",
+        "device_kind": dev.device_kind,
+        "config": {"replicas": 2, "requests": len(requests),
+                   "families": len(preambles),
+                   "block_size": args.decode_block_size,
+                   "num_blocks": args.decode_blocks,
+                   "vocab": args.decode_vocab,
+                   "seq": args.decode_seq},
+        "fleet_affinity_hit_blocks": affinity["hit_blocks"],
+        "fleet_least_loaded_hit_blocks": least["hit_blocks"],
+        "fleet_affinity_replicas_used": affinity["spread"],
+        "fleet_least_loaded_replicas_used": least["spread"],
+        "fleet_router_mirror_blocks": sum(
+            r["mirror_blocks"]
+            for r in affinity["router"]["replicas"].values()),
+        "fleet_scale_up_warmed_programs": warmed,
+        "fleet_scale_up_retraces_first_request":
+            join_stats["steady_state_retraces"],
+    }
+    out.update(tp_fields)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", type=str, default="all",
@@ -2291,7 +2496,7 @@ def main():
                     choices=["train", "inference", "serving", "checkpoint",
                              "kvstore", "kvstore-mh-worker",
                              "fit", "decode", "dlrm", "dlrm-part-worker",
-                             "transformer",
+                             "transformer", "fleet",
                              "coldstart", "coldstart-worker"])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--image-shape", type=str, default="3,224,224")
@@ -2424,6 +2629,9 @@ def main():
         return
     if args.mode == "decode":
         print(json.dumps(bench_decode(args)))
+        return
+    if args.mode == "fleet":
+        print(json.dumps(bench_fleet(args)))
         return
     if args.mode == "checkpoint":
         print(json.dumps(bench_checkpoint(args)))

@@ -115,6 +115,7 @@ from . import config
 from . import predictor
 from . import serving
 from . import decode
+from . import fleet
 from . import profiler
 from . import telemetry
 from . import pallas

@@ -43,6 +43,8 @@ HOT_MODULES = (
     "mxnet_tpu/decode/engine.py",
     "mxnet_tpu/decode/scheduler.py",
     "mxnet_tpu/decode/spec.py",
+    "mxnet_tpu/fleet/handoff.py",
+    "mxnet_tpu/fleet/router.py",
     "mxnet_tpu/kvstore_fused.py",
     "mxnet_tpu/kvstore_tpu/engine.py",
     "mxnet_tpu/serving/replica.py",

@@ -45,6 +45,8 @@ THREADED_MODULES = (
     "mxnet_tpu/decode/scheduler.py",
     "mxnet_tpu/decode/cache.py",
     "mxnet_tpu/decode/spec.py",
+    "mxnet_tpu/fleet/router.py",
+    "mxnet_tpu/fleet/handoff.py",
     "mxnet_tpu/telemetry/registry.py",
     "mxnet_tpu/telemetry/tracing.py",
     "mxnet_tpu/telemetry/flight.py",

@@ -58,6 +58,10 @@ PREFIX_HIT_BLOCKS = REGISTRY.gauge(
     "decode_prefix_hit_blocks", "cumulative KV-cache blocks served from "
     "the shared-prefix trie instead of being re-prefilled",
     unit="blocks")
+PREFIX_EVICTIONS = REGISTRY.counter(
+    "decode_prefix_evictions", "trie-only prefix blocks evicted "
+    "leaf-first under allocation pressure (fleet routing replays make "
+    "this routine — invisible eviction churn is a routing-policy bug)")
 
 # every live allocator contributes to the ONE set of process-wide
 # gauges / census group — a second engine in the same process must add
@@ -325,6 +329,7 @@ class PagedKVCache:
             del children[key]
             self._prefix_blocks -= 1
             self.free([block])
+            PREFIX_EVICTIONS.inc()
             freed += 1
         return freed
 

@@ -1139,7 +1139,10 @@ class DecodeEngine:
                 data = v._data
                 if data.dtype != dst._data.dtype:
                     data = data.astype(dst._data.dtype)
-                dst._set_data(jax.device_put(data, self._ctx.jax_device))
+                # re-shard onto the destination's bind-time placement:
+                # under a TP mesh (mx.fleet) params carry NamedShardings
+                # that a plain single-device put would clobber.
+                dst._set_data(jax.device_put(data, dst._data.sharding))
             if version is not None:
                 self._model_version = version
         if self._prefix_cache:

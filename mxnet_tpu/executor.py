@@ -644,7 +644,13 @@ class Executor:
                 raise MXNetError("forward: unknown argument '%s'" % k)
             dst = self.arg_dict[k]
             if isinstance(v, NDArray):
-                dst._set_data(self._to_ctx(v._data))
+                data = v._data
+                sh = dst._data.sharding
+                if getattr(data, "sharding", None) != sh:
+                    # move onto the bound buffer's placement (single
+                    # device normally; the mesh under GSPMD binds)
+                    data = jax.device_put(data, sh)
+                dst._set_data(data)
             else:
                 dst._sync_copyfrom(v)
         if is_train:
@@ -792,17 +798,25 @@ class Executor:
     # ------------------------------------------------------------------
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
+        # staging preserves each destination's placement: a param the
+        # bind installed with a NamedSharding (mx.sharding annotations
+        # resolved in _install_param_shardings) re-shards the incoming
+        # host values instead of collapsing back to the single bind
+        # device; unsharded params keep the exact old behavior (their
+        # current sharding IS the ctx device).
         for name, arr in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._set_data(
-                    jax.device_put(arr._data, self._ctx.jax_device))
+                dst = self.arg_dict[name]
+                dst._set_data(
+                    jax.device_put(arr._data, dst._data.sharding))
             elif not allow_extra_params:
                 raise MXNetError("unknown arg '%s'" % name)
         if aux_params:
             for name, arr in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._set_data(
-                        jax.device_put(arr._data, self._ctx.jax_device))
+                    dst = self.aux_dict[name]
+                    dst._set_data(
+                        jax.device_put(arr._data, dst._data.sharding))
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux '%s'" % name)
 
@@ -907,6 +921,7 @@ class Executor:
         specs = _sharding.collect_var_specs(symbol)
         if not specs:
             return
+        placed = set()
         for name, s in specs.items():
             for store in (arg_dict, aux_dict):
                 arr = store.get(name)
@@ -914,9 +929,26 @@ class Executor:
                     continue
                 ns = _sharding.resolve(s, arr.shape, mesh, what=name)
                 arr._set_data(jax.device_put(arr._data, ns))
+                placed.add(name)
                 g = grad_dict.get(name) if store is arg_dict else None
                 if g is not None:
                     g._set_data(jax.device_put(g._data, ns))
+        # every OTHER bound buffer goes replicated over the same mesh:
+        # jit refuses argument sets committed to different device sets,
+        # so once one param lives on the mesh, all of them (and the
+        # inputs) must.  Module binds immediately re-place data/label
+        # with P('dp') in executor_group._install_shardings; direct
+        # simple_bind users (mx.decode under an mp mesh) keep the
+        # replicated placement, which GSPMD treats as free.
+        repl = _sharding.NamedSharding(mesh, _sharding.P())
+        for store in (arg_dict, aux_dict):
+            for name, arr in store.items():
+                if name in placed:
+                    continue
+                arr._set_data(jax.device_put(arr._data, repl))
+                g = grad_dict.get(name) if store is arg_dict else None
+                if g is not None:
+                    g._set_data(jax.device_put(g._data, repl))
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states, group2ctx,

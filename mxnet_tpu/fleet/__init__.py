@@ -1,0 +1,51 @@
+"""mx.fleet — disaggregated, cache-aware serving at pod scale.
+
+Serving a pod is not one engine problem, it is three stacked placement
+problems, and this package owns all three (docs/FLEET.md):
+
+* **Tensor-parallel decode** (:mod:`.tp`) — one logical decode engine
+  whose weights and paged KV cache are sharded head-wise over an
+  ``mp`` mesh axis.  The engine itself does not change: the decode
+  step symbols accept ``tensor_parallel=<axis>`` and annotate the
+  attention/FFN weights and per-layer cache blocks with GSPMD
+  shardings, so the ONE compiled launch per iteration becomes a
+  multi-device program.  Greedy streams stay bit-identical to
+  single-device decoding, dispatch/retrace witnesses are unchanged,
+  and per-device cache bytes drop ~1/mp — which is the whole point:
+  TP buys cache headroom, not just FLOPs.
+* **Prefill/decode disaggregation** (:mod:`.handoff`) — prefill-heavy
+  workers stream finished KV-cache blocks to decode workers over
+  ``kvstore_tpu.dist.alltoall_bytes``, reusing the sharded-checkpoint
+  slice format as the wire format (same bounds + CRC discipline, so a
+  corrupt or mis-sliced payload is rejected, never silently decoded).
+  Every exchange carries a bounded timeout: a dead prefill worker
+  degrades the decode worker to LOCAL prefill (counter + flight note),
+  it never hangs the serving loop.
+* **Cache-aware routing** (:mod:`.router`) — a :class:`FleetRouter`
+  places each /generate request on the replica whose prefix trie
+  already holds the longest block-aligned prefix of the prompt,
+  discounted by cache occupancy (a full cache that would evict its own
+  trie to admit you is not an affinity win), with session stickiness
+  and drain-free scale-up/down: a joining replica is AOT-warmed
+  BEFORE it enters the ring (first request compiles nothing), a
+  leaving replica stops receiving traffic first and drains in-flight
+  work before removal.
+"""
+from __future__ import annotations
+
+from .handoff import (handoff_exchange, export_prefix, inject_prefix,
+                      pack_blocks, unpack_blocks)
+from .router import FleetRouter
+from .tp import make_tp_engine, per_device_cache_bytes, tp_mesh
+
+__all__ = [
+    "FleetRouter",
+    "make_tp_engine",
+    "tp_mesh",
+    "per_device_cache_bytes",
+    "pack_blocks",
+    "unpack_blocks",
+    "export_prefix",
+    "inject_prefix",
+    "handoff_exchange",
+]

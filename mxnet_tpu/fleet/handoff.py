@@ -1,0 +1,232 @@
+"""Prefill/decode disaggregation: KV-block handoff (docs/FLEET.md).
+
+Chunked prefill and token-by-token decode want opposite things from a
+device — prefill is compute-bound over long spans, decode is
+latency-bound over single rows — so a pod splits them: PREFILL workers
+run prompts to completion and stream the finished cache blocks to
+DECODE workers, which inject them into their own paged cache and serve
+the stream with a prefix that was computed elsewhere.
+
+Wire format: the sharded-checkpoint slice discipline
+(``checkpoint.sharded``) reused verbatim — each cache tensor is walked
+shard-by-shard into ``(bounds, slice)`` records with a chained CRC32,
+a JSON header carries the token prefix + geometry, and the slices ride
+one ``npz`` blob.  A decode worker therefore validates a payload the
+exact same way a restore validates a checkpoint: geometry mismatch or
+CRC failure REJECTS the payload (counter + flight note) and the
+request falls back to local prefill — wrong-weights cache rows can
+never be injected silently.
+
+Failure is a first-class outcome everywhere: the exchange collective
+carries a bounded timeout (``MXNET_FLEET_HANDOFF_TIMEOUT_MS``), and a
+dead prefill worker degrades its decode peers to local prefill — the
+serving loop never blocks on a corpse.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..checkpoint.sharded import _tensor_crc, _unique_slices
+from ..telemetry import REGISTRY
+from ..telemetry.flight import RECORDER
+
+__all__ = ["pack_blocks", "unpack_blocks", "export_prefix",
+           "inject_prefix", "handoff_exchange"]
+
+_MAGIC = b"MXFB1"     # MXnet Fleet Blocks v1
+
+BLOCKS_EXPORTED = REGISTRY.counter(
+    "fleet_blocks_exported", "finished KV-cache blocks packed for "
+    "prefill->decode handoff")
+BLOCKS_INJECTED = REGISTRY.counter(
+    "fleet_blocks_injected", "handed-off KV-cache blocks injected into "
+    "a decode worker's paged cache")
+HANDOFF_BYTES = REGISTRY.counter(
+    "fleet_handoff_bytes", "bytes of packed cache blocks moved over "
+    "the handoff collective", unit="bytes")
+PREFILL_FALLBACKS = REGISTRY.counter(
+    "fleet_prefill_fallbacks", "handoffs that degraded to local "
+    "prefill, labeled by `reason` (timeout/geometry/crc/oom)")
+
+
+def pack_blocks(tensors, tokens, n_rows, block_size):
+    """Serialize finished cache blocks for the wire.
+
+    ``tensors`` maps cache-array name -> gathered block rows (the
+    ``(n_blocks, block_size, H, D)`` slab for that layer); ``tokens``
+    is the token prefix those rows encode (``len(tokens) == n_rows``).
+    Slices + CRCs follow ``checkpoint.sharded`` exactly.
+    """
+    slices, index, n = {}, {}, 0
+    for key in sorted(tensors):
+        data = tensors[key]
+        data = getattr(data, "_data", data)
+        recs = []
+        for bounds, arr in _unique_slices(data):
+            skey = "s%d" % n
+            n += 1
+            slices[skey] = arr
+            recs.append({"key": skey,
+                         "lo": [int(b[0]) for b in bounds],
+                         "hi": [int(b[1]) for b in bounds]})
+        index[key] = {
+            "shape": [int(s) for s in getattr(data, "shape", ())],
+            "dtype": str(_np.dtype(getattr(data, "dtype", "float32"))),
+            "slices": recs,
+            "crc32": _tensor_crc(recs, slices),
+        }
+    blob = io.BytesIO()
+    _np.savez(blob, **slices)
+    header = json.dumps({
+        "tokens": [int(t) for t in tokens],
+        "n_rows": int(n_rows),
+        "block_size": int(block_size),
+        "tensors": index,
+    }).encode()
+    return (_MAGIC + struct.pack(">I", len(header)) + header
+            + blob.getvalue())
+
+
+def unpack_blocks(payload):
+    """Parse + validate a :func:`pack_blocks` payload.  Returns
+    ``(tensors, header)`` with every tensor reassembled from its slices
+    and CRC-verified; raises ``MXNetError`` on any mismatch."""
+    if not isinstance(payload, (bytes, bytearray)) \
+            or payload[:len(_MAGIC)] != _MAGIC:
+        raise MXNetError("handoff payload: bad magic (not a packed "
+                         "cache-block frame)")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack(">I", bytes(payload[off:off + 4]))
+    off += 4
+    try:
+        header = json.loads(bytes(payload[off:off + hlen]))
+    except ValueError as e:
+        raise MXNetError("handoff payload: unreadable header: %s" % e)
+    off += hlen
+    try:
+        with _np.load(io.BytesIO(bytes(payload[off:]))) as npz:
+            slices = {k: npz[k] for k in npz.files}
+    except Exception as e:
+        raise MXNetError("handoff payload: unreadable slice blob: %s"
+                         % e)
+    tensors = {}
+    for key, rec in header.get("tensors", {}).items():
+        if _tensor_crc(rec["slices"], slices) != rec["crc32"]:
+            raise MXNetError("handoff payload: tensor %r failed CRC "
+                             "validation" % key)
+        out = _np.zeros(tuple(rec["shape"]), dtype=rec["dtype"])
+        for r in rec["slices"]:
+            sel = tuple(slice(lo, hi) for lo, hi in zip(r["lo"],
+                                                        r["hi"]))
+            out[sel] = slices[r["key"]]
+        tensors[key] = out
+    return tensors, header
+
+
+def export_prefix(engine, tokens):
+    """Pack the cache blocks a prefill engine holds for ``tokens``.
+
+    Matches the prompt against the engine's published prefix trie
+    (``acquire_prefix`` pins the blocks against eviction while their
+    rows are read), gathers the per-layer rows, and returns the wire
+    payload — or ``None`` when no full block of the prompt is cached,
+    which the caller treats as nothing-to-hand-off.  The device read
+    holds the engine's step lock: cache buffers are DONATED to the
+    step program, so an unlocked read could touch an invalidated
+    buffer mid-iteration.
+    """
+    blocks, n_rows = engine.cache.acquire_prefix(
+        [int(t) for t in tokens])
+    if not blocks:
+        return None
+    try:
+        # analyze: ok(hostsync) host-side block-id list, never a device value
+        idx = _np.asarray(blocks, _np.int32)
+        tensors = {}
+        with engine._step_lock:
+            for name, nd in zip(engine._cache_names,
+                                engine._cache_arrs):
+                # analyze: ok(hostsync) the gather IS the handoff — exported rows must reach the host to go on the wire; off the step path, once per handoff
+                tensors[name] = _np.asarray(nd._data[idx])
+    finally:
+        engine.cache.free(blocks)     # undo acquire_prefix's pin
+    payload = pack_blocks(tensors, tokens[:n_rows], n_rows,
+                          engine.cache.block_size)
+    BLOCKS_EXPORTED.inc(len(blocks))
+    HANDOFF_BYTES.inc(len(payload))
+    return payload
+
+
+def inject_prefix(engine, payload):
+    """Install a handed-off payload into ``engine``'s paged cache and
+    publish it in the prefix trie.  Returns the rows injected, or 0
+    when the payload is rejected (geometry/CRC mismatch) or the cache
+    cannot spare the blocks — both degrade to local prefill, counted
+    under ``fleet_prefill_fallbacks``."""
+    from ..decode.cache import CacheOOMError
+
+    try:
+        tensors, header = unpack_blocks(payload)
+    except MXNetError as e:
+        PREFILL_FALLBACKS.labels(reason="crc").inc()
+        RECORDER.note("fleet_handoff_reject", error=str(e)[:200])
+        return 0
+    if header.get("block_size") != engine.cache.block_size \
+            or set(tensors) != set(engine._cache_names) \
+            or any(tuple(tensors[n].shape[1:])
+                   != tuple(nd._data.shape[1:])
+                   for n, nd in zip(engine._cache_names,
+                                    engine._cache_arrs)):
+        PREFILL_FALLBACKS.labels(reason="geometry").inc()
+        RECORDER.note("fleet_handoff_reject",
+                      error="cache geometry mismatch")
+        return 0
+    n_rows = int(header["n_rows"])
+    n_blocks = n_rows // engine.cache.block_size
+    with engine._step_lock:
+        try:
+            blocks = engine.cache.alloc(n_blocks)
+        except CacheOOMError:
+            PREFILL_FALLBACKS.labels(reason="oom").inc()
+            return 0
+        # analyze: ok(hostsync) host-side block-id list, never a device value
+        idx = _np.asarray(blocks, _np.int32)
+        for name, nd in zip(engine._cache_names, engine._cache_arrs):
+            rows = tensors[name].astype(nd._data.dtype, copy=False)
+            upd = nd._data.at[idx].set(rows)
+            nd._set_data(upd)
+        engine.cache.register_prefix(header["tokens"], n_rows, blocks)
+    engine.cache.free(blocks)         # the trie keeps its reference
+    BLOCKS_INJECTED.inc(n_blocks)
+    return n_rows
+
+
+def handoff_exchange(outbox, timeout_ms=None):
+    """One all-to-all round of cache-block payloads across the world.
+
+    ``outbox`` holds one payload (``bytes``, possibly empty) per rank;
+    returns the received list, or ``None`` when the collective fails —
+    most importantly on TIMEOUT, the shape a dead prefill worker takes.
+    Callers treat ``None`` as degrade-to-local-prefill; they must
+    never retry in a loop (the next request simply prefills locally
+    while the pod heals).
+    """
+    import os
+
+    from ..kvstore_tpu import dist as _dist
+
+    if timeout_ms is None:
+        timeout_ms = int(os.environ.get(
+            "MXNET_FLEET_HANDOFF_TIMEOUT_MS", "10000"))
+    try:
+        return _dist.alltoall_bytes("fleet/handoff", outbox,
+                                    timeout_ms=timeout_ms)
+    except Exception as e:   # noqa: BLE001 — jax runtime raises its own types on timeout
+        PREFILL_FALLBACKS.labels(reason="timeout").inc()
+        RECORDER.note("fleet_handoff_timeout", error=str(e)[:200])
+        return None

@@ -157,15 +157,34 @@ def get_symbol(num_classes=16384, num_layers=12, d_model=2048, num_heads=16,
 # block tables — enters as runtime ARRAY inputs so ragged generation
 # never retraces the compiled step.
 # ----------------------------------------------------------------------
-def _decode_trunk_vars(pre):
+def _tp_attrs(tensor_parallel):
+    """Megatron ``__sharding__`` attr dicts for the decode-graph
+    factories (mirrors get_symbol's training-side split): returns
+    ``(col_w, col_b, row_w, cache)`` — empty dicts when tensor
+    parallelism is off, so annotation-free symbols stay byte-identical.
+    ``cache`` head-shards the paged KV blocks (num_blocks, block_size,
+    H, D) over the axis, which is where the per-device cache-bytes
+    saving of TP decode (docs/FLEET.md) comes from."""
+    tp = "mp" if tensor_parallel is True else tensor_parallel
+    if not tp:
+        return {}, {}, {}, {}
+    from .. import sharding as _sharding
+    return ({_sharding.SHARDING_ATTR: _sharding.spec(tp, None)},
+            {_sharding.SHARDING_ATTR: _sharding.spec(tp)},
+            {_sharding.SHARDING_ATTR: _sharding.spec(None, tp)},
+            {_sharding.SHARDING_ATTR: _sharding.spec(None, None, tp, None)})
+
+
+def _decode_trunk_vars(pre, col_w={}, col_b={}, row_w={}):
     """The attention sublayer's weight variables, training-graph names."""
-    return (sym.Variable(pre + "qkv_weight"),
-            sym.Variable(pre + "qkv_bias", init=_init.Zero()),
-            sym.Variable(pre + "proj_weight"),
+    return (sym.Variable(pre + "qkv_weight", **col_w),
+            sym.Variable(pre + "qkv_bias", init=_init.Zero(), **col_b),
+            sym.Variable(pre + "proj_weight", **row_w),
             sym.Variable(pre + "proj_bias", init=_init.Zero()))
 
 
-def _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, layer_idx):
+def _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, layer_idx,
+                     col_w={}, col_b={}, row_w={}):
     """Explicit post-attention sublayer weight Variables (training-graph
     names) so the mixed-step symbol's two streams — decode slots and the
     prefill chunk — bind ONE copy of every parameter."""
@@ -189,10 +208,10 @@ def _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, layer_idx):
         })
     else:
         shared.update({
-            "up_weight": sym.Variable(pre + "ffn_up_weight"),
+            "up_weight": sym.Variable(pre + "ffn_up_weight", **col_w),
             "up_bias": sym.Variable(pre + "ffn_up_bias",
-                                    init=_init.Zero()),
-            "down_weight": sym.Variable(pre + "ffn_down_weight"),
+                                    init=_init.Zero(), **col_b),
+            "down_weight": sym.Variable(pre + "ffn_down_weight", **row_w),
             "down_bias": sym.Variable(pre + "ffn_down_bias",
                                       init=_init.Zero()),
         })
@@ -365,7 +384,8 @@ def get_prefill_symbol(num_classes=16384, num_layers=12, d_model=2048,
 def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
                           num_heads=16, ffn_dim=None, seq_len=1024,
                           dtype="float32", block_size=16, num_blocks=64,
-                          moe_experts=0, moe_every=2, **kwargs):
+                          moe_experts=0, moe_every=2, tensor_parallel=None,
+                          **kwargs):
     """ONE decode iteration with chunked prefill fused in (stall-free
     scheduling, docs/DECODE.md): up to K prefill-chunk tokens of one
     admitted prompt AND one decode token for every active slot run in
@@ -394,12 +414,20 @@ def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
     chunk last-token logits (1, vocab), chunk greedy token (1,),
     new caches...]`` — the chunk head's greedy token is the sequence's
     FIRST generated token once its final chunk lands.
+
+    ``tensor_parallel`` (docs/FLEET.md): a mesh-axis name (True means
+    "mp") Megatron-splitting every dense layer exactly as in
+    get_symbol(), PLUS head-sharding the paged KV caches over the axis
+    — each device holds 1/mp of every cache block, so TP decode scales
+    cache capacity with the mesh.  Annotations only; without a selected
+    mesh the symbol binds replicated, unchanged.
     """
     vocab = int(num_classes)
     d = int(d_model)
     ffn = int(ffn_dim) if ffn_dim else 4 * d
     H = int(num_heads)
     D = d // H
+    _col_w, _col_b, _row_w, _cache = _tp_attrs(tensor_parallel)
 
     data = sym.Variable("data")                      # (C, 1) token ids
     positions = sym.Variable("positions")            # (C, 1)
@@ -427,13 +455,15 @@ def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
     new_kv = []
     for i in range(int(num_layers)):
         pre = "layer%d_" % i
-        attn_vars = _decode_trunk_vars(pre)
+        attn_vars = _decode_trunk_vars(pre, _col_w, _col_b, _row_w)
         ln1_g = sym.Variable(pre + "ln1_gamma")
         ln1_b = sym.Variable(pre + "ln1_beta", init=_init.Zero())
         kc = sym.Variable(pre + "k_cache",
-                          shape=(int(num_blocks), int(block_size), H, D))
+                          shape=(int(num_blocks), int(block_size), H, D),
+                          **_cache)
         vc = sym.Variable(pre + "v_cache",
-                          shape=(int(num_blocks), int(block_size), H, D))
+                          shape=(int(num_blocks), int(block_size), H, D),
+                          **_cache)
 
         ln1 = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
                             name=pre + "ln1")
@@ -454,7 +484,8 @@ def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
         xc = xc + catt[0]
         new_kv += [catt[1], catt[2]]
 
-        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i)
+        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i,
+                                  _col_w, _col_b, _row_w)
         x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i,
                             shared=shared)
         xc = xc + _decode_ffn(xc, pre, d, ffn, moe_experts, moe_every, i,
@@ -489,7 +520,8 @@ def get_mixed_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
 def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
                          num_heads=16, ffn_dim=None, seq_len=1024,
                          dtype="float32", block_size=16, num_blocks=64,
-                         moe_experts=0, moe_every=2, **kwargs):
+                         moe_experts=0, moe_every=2, tensor_parallel=None,
+                         **kwargs):
     """The mixed step generalized to draft-verify spans (speculative
     decoding, docs/DECODE.md): instead of ONE token per slot, every
     iteration scores an S-token span per slot — the slot's last
@@ -529,12 +561,16 @@ def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
     engine's cache-commit and chunk-completion paths are shared.  Row
     ``r*S + j`` is slot r, span offset j; greedy token at offset j is
     the target model's choice for position ``span_start + j + 1``.
+
+    ``tensor_parallel``: same Megatron split + head-sharded caches as
+    get_mixed_step_symbol (docs/FLEET.md).
     """
     vocab = int(num_classes)
     d = int(d_model)
     ffn = int(ffn_dim) if ffn_dim else 4 * d
     H = int(num_heads)
     D = d // H
+    _col_w, _col_b, _row_w, _cache = _tp_attrs(tensor_parallel)
 
     data = sym.Variable("data")                      # (C, S) span ids
     positions = sym.Variable("positions")            # (C, S) absolute
@@ -564,13 +600,15 @@ def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
     new_kv = []
     for i in range(int(num_layers)):
         pre = "layer%d_" % i
-        attn_vars = _decode_trunk_vars(pre)
+        attn_vars = _decode_trunk_vars(pre, _col_w, _col_b, _row_w)
         ln1_g = sym.Variable(pre + "ln1_gamma")
         ln1_b = sym.Variable(pre + "ln1_beta", init=_init.Zero())
         kc = sym.Variable(pre + "k_cache",
-                          shape=(int(num_blocks), int(block_size), H, D))
+                          shape=(int(num_blocks), int(block_size), H, D),
+                          **_cache)
         vc = sym.Variable(pre + "v_cache",
-                          shape=(int(num_blocks), int(block_size), H, D))
+                          shape=(int(num_blocks), int(block_size), H, D),
+                          **_cache)
 
         ln1 = sym.LayerNorm(data=x, gamma=ln1_g, beta=ln1_b,
                             name=pre + "ln1")
@@ -591,7 +629,8 @@ def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
         xc = xc + catt[0]
         new_kv += [catt[1], catt[2]]
 
-        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i)
+        shared = _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every, i,
+                                  _col_w, _col_b, _row_w)
         x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every, i,
                             shared=shared)
         xc = xc + _decode_ffn(xc, pre, d, ffn, moe_experts, moe_every, i,
@@ -621,3 +660,104 @@ def get_spec_step_symbol(num_classes=16384, num_layers=12, d_model=2048,
                            name="c_cast_out")
     cnxt = sym.argmax(clogits, axis=1, name="c_greedy_token")
     return sym.Group([flat, nxt, clogits, cnxt] + new_kv)
+
+
+def get_draft_span_symbol(draft_k, num_classes=16384, num_layers=12,
+                          d_model=2048, num_heads=16, ffn_dim=None,
+                          seq_len=1024, dtype="float32", moe_experts=0,
+                          moe_every=2, **kwargs):
+    """ONE compiled program proposing ``draft_k`` greedy draft tokens
+    (mx.speculative, docs/DECODE.md): the autoregressive draft loop of
+    ``DraftModelDrafter`` — K full forwards, K argmax readbacks —
+    unrolled into a single graph, so a proposal costs exactly one
+    dispatch and one K-int readback whatever K is.
+
+    Every unrolled iteration shares ONE copy of every weight (explicit
+    Variables bound by all K trunk instances under per-iteration op-name
+    tags — the mixed-step symbol's sharing pattern), and the draft
+    checkpoint loads unchanged.  Inputs: ``data`` (1, seq_len) the
+    left-aligned, zero-padded token history; ``length`` (1,) its real
+    token count n; ``iota`` (1, seq_len) a runtime ``arange(seq_len)``
+    (fed once — symbols have no shape-dependent constants).  Iteration
+    j reads the hidden row at position ``n + j - 1``, takes the greedy
+    token, and writes it back into ``data`` at position ``n + j`` via
+    an iota-mask blend — token j+1 conditions on token j entirely
+    on-device.  Output: the (draft_k,) proposed token ids — the ONE
+    readback.  Rows past ``seq_len`` never arise: the drafter trims its
+    context to ``seq_len - draft_k`` tokens before feeding.
+
+    Causal masking keeps the padded tail invisible to every row that is
+    read, so the proposals equal the sequential drafter's exactly.
+    """
+    vocab = int(num_classes)
+    d = int(d_model)
+    ffn = int(ffn_dim) if ffn_dim else 4 * d
+    H = int(num_heads)
+    S = int(seq_len)
+    K = int(draft_k)
+    if K < 1:
+        raise ValueError("get_draft_span_symbol: draft_k must be >= 1")
+
+    data = sym.Variable("data")                      # (1, S) history ids
+    length = sym.Variable("length")                  # (1,) real count
+    iota = sym.Variable("iota")                      # (1, S) arange(S)
+
+    tokw = sym.Variable("tok_embed_weight")
+    pos_w = sym.Variable("pos_embed_weight", shape=(1, S, d))
+    lnf_g = sym.Variable("ln_f_gamma")
+    lnf_b = sym.Variable("ln_f_beta", init=_init.Zero())
+    lmw = sym.Variable("lm_head_weight")
+    lmb = sym.Variable("lm_head_bias", init=_init.Zero())
+    layers = []
+    for i in range(int(num_layers)):
+        pre = "layer%d_" % i
+        layers.append({
+            "pre": pre,
+            "ln1_g": sym.Variable(pre + "ln1_gamma"),
+            "ln1_b": sym.Variable(pre + "ln1_beta", init=_init.Zero()),
+            "attn": _decode_trunk_vars(pre),
+            "ffn": _ffn_shared_vars(pre, d, ffn, moe_experts, moe_every,
+                                    i),
+        })
+
+    toks = []
+    for j in range(K):
+        tag = "d%d_" % j
+        tok = sym.Embedding(data, tokw, input_dim=vocab, output_dim=d,
+                            name=tag + "tok_embed")
+        x = sym.broadcast_add(tok, pos_w, name=tag + "embed_add")
+        if dtype in ("float16", "bfloat16"):
+            x = sym.Cast(data=x, dtype=dtype, name=tag + "cast_embed")
+        for i, ly in enumerate(layers):
+            pre = ly["pre"]
+            ln1 = sym.LayerNorm(data=x, gamma=ly["ln1_g"],
+                                beta=ly["ln1_b"], name=pre + tag + "ln1")
+            proj = sym.contrib.FusedCausalSelfAttention(
+                ln1, *ly["attn"], num_heads=H, name=pre + tag + "attn")
+            x = x + proj
+            x = x + _decode_ffn(x, pre, d, ffn, moe_experts, moe_every,
+                                i, shared=ly["ffn"], tag=tag)
+        x = sym.LayerNorm(data=x, gamma=lnf_g, beta=lnf_b,
+                          name=tag + "ln_f")
+        # the greedy next token after n + j committed tokens lives in
+        # row n + j - 1 (causal: it saw exactly the real history plus
+        # drafts < j; the padded tail sits behind the mask)
+        last = sym.contrib.GatherTimestep(x, length + (float(j) - 1.0),
+                                          name=tag + "last_token")
+        logits = sym.FullyConnected(data=last, weight=lmw, bias=lmb,
+                                    num_hidden=vocab,
+                                    name=tag + "lm_head")  # (1, vocab)
+        if dtype in ("float16", "bfloat16"):
+            logits = sym.Cast(data=logits, dtype="float32",
+                              name=tag + "cast_out")
+        nxt = sym.argmax(logits, axis=1, name=tag + "greedy")   # (1,)
+        toks.append(nxt)
+        if j + 1 < K:
+            # scatter the token at position n + j: data += mask*(t - data)
+            posj = sym.Reshape(length + float(j), shape=(1, 1),
+                               name=tag + "pos2d")
+            onehot = sym.broadcast_equal(iota, posj, name=tag + "onehot")
+            tok2d = sym.Reshape(nxt, shape=(1, 1), name=tag + "tok2d")
+            data = data + onehot * (tok2d - data)
+
+    return sym.Concat(*toks, dim=0, name="draft_tokens")    # (K,)

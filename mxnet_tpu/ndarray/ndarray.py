@@ -194,7 +194,13 @@ class NDArray:
         arr = _np.asarray(source, dtype=self.dtype)
         if arr.shape != self.shape:
             raise MXNetError("shape mismatch in _sync_copyfrom")
-        self._data = jax.device_put(jnp.asarray(arr), self._ctx.jax_device)
+        # keep the buffer's CURRENT placement: an array a bind installed
+        # on a GSPMD mesh (replicated runtime inputs of a TP-sharded
+        # decode step, mx.fleet) must not collapse back to the single
+        # bind device — that would hand jit arguments committed to
+        # different device sets.  For ordinary single-device arrays the
+        # existing sharding IS the ctx device, so behavior is unchanged.
+        self._data = jax.device_put(jnp.asarray(arr), self._data.sharding)
 
     @staticmethod
     def _norm_key(key):

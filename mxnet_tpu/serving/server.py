@@ -282,7 +282,7 @@ class ModelServer:
                  num_replicas=1, contexts=None, max_batch_size=8,
                  max_latency_ms=5.0, queue_capacity=None, timeout_ms=None,
                  dtype="float32", buckets=None, warmup=True,
-                 warmup_manifest=None, decode_engine=None):
+                 warmup_manifest=None, decode_engine=None, fleet=None):
         from ..predictor import Predictor
 
         for name, shape in input_shapes.items():
@@ -340,6 +340,12 @@ class ModelServer:
         # in lockstep with the replicas (docs/DECODE.md). The caller
         # owns the engine's lifecycle; stop() does not stop it.
         self._decode_engine = decode_engine
+        # optional mx.fleet router: /generate requests are PLACED by
+        # prefix affinity across the router's decode replicas instead
+        # of going to the single attached engine; a `session` field in
+        # the request body rides the router's stickiness map
+        # (docs/FLEET.md). The caller owns replica lifecycles.
+        self._fleet = fleet
         # hot-reload bookkeeping (docs/CHECKPOINT.md): version of the
         # weights currently served (checkpoint tag / epoch), reload count
         self._model_version = None
@@ -616,6 +622,8 @@ class ModelServer:
         snap["reloads"] = self._reloads
         if self._decode_engine is not None:
             snap["decode"] = self._decode_engine.stats()
+        if self._fleet is not None:
+            snap["fleet"] = self._fleet.stats()
         return snap
 
     def reset_stats(self):
@@ -698,9 +706,10 @@ class ModelServer:
                 in-flight failure becomes a ``{"done": true, "error":
                 ...}`` tail instead of a broken connection)."""
                 eng = server._decode_engine
-                if eng is None:
+                if eng is None and server._fleet is None:
                     self._reply(404, {"error": "no decode engine attached "
-                                      "(ModelServer(decode_engine=...))",
+                                      "(ModelServer(decode_engine=...) or "
+                                      "ModelServer(fleet=...))",
                                       "type": "no_decode"})
                     return
                 tokens = doc.get("tokens")
@@ -708,6 +717,19 @@ class ModelServer:
                     self._reply(400, {"error": "generate needs a non-empty "
                                       "'tokens' list", "type": "bad_request"})
                     return
+                replica = None
+                if server._fleet is not None:
+                    # cache-aware placement: the router picks the
+                    # replica whose prefix trie best matches the
+                    # prompt; a `session` field pins a conversation to
+                    # the replica that holds its history (docs/FLEET.md)
+                    try:
+                        replica, eng = server._fleet.route(
+                            tokens, session=doc.get("session"))
+                    except MXNetError as e:
+                        self._reply(503, {"error": str(e),
+                                          "type": "no_replicas"})
+                        return
                 kwargs = {}
                 if "eos_id" in doc:
                     kwargs["eos_id"] = doc["eos_id"]
@@ -761,9 +783,12 @@ class ModelServer:
                         self._reply(500, {"error": str(e),
                                           "type": "internal"})
                         return
-                    self._reply(200, {"tokens": toks,
-                                      "finish_reason": handle.finish_reason,
-                                      "ttft_ms": handle.ttft_ms})
+                    body = {"tokens": toks,
+                            "finish_reason": handle.finish_reason,
+                            "ttft_ms": handle.ttft_ms}
+                    if replica is not None:
+                        body["replica"] = replica
+                    self._reply(200, body)
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
@@ -790,6 +815,8 @@ class ModelServer:
                             "finish_reason": handle.finish_reason,
                             "tokens": handle.tokens,
                             "ttft_ms": handle.ttft_ms}
+                if replica is not None:
+                    tail["replica"] = replica
                 try:
                     self._chunk((json.dumps(tail) + "\n").encode())
                     self.wfile.write(b"0\r\n\r\n")
@@ -819,6 +846,13 @@ class ModelServer:
                     self.wfile.write(body)
                 elif self.path == "/stats":
                     self._reply(200, server.stats())
+                elif self.path == "/fleet":
+                    if server._fleet is None:
+                        self._reply(404, {"error": "no fleet router "
+                                          "attached (ModelServer("
+                                          "fleet=...))", "type": "no_fleet"})
+                    else:
+                        self._reply(200, server._fleet.stats())
                 elif self.path == "/health":
                     alerts = _tm.sentinel.SENTINEL.active()
                     ok = not server._closed

@@ -1,0 +1,116 @@
+"""Worker for the mx.fleet 2-process prefill/decode handoff test
+(tests/test_fleet.py::test_two_process_prefill_decode_handoff).
+
+Rank 0 plays the PREFILL worker: it runs a prompt through its engine
+(publishing the finished blocks in its prefix trie), exports the
+blocks with :func:`fleet.export_prefix`, and streams them to rank 1
+over the handoff collective.  Rank 1 plays the DECODE worker: it
+injects the payload into its own paged cache and pins:
+
+* the injected blocks are BIT-IDENTICAL to what local prefill would
+  have produced (the wire payload from a local export matches the
+  remote one tensor-for-tensor);
+* generation over the injected prefix emits the same stream as a
+  cold local engine, with prefix hits > 0 (the replay was skipped);
+* a dead prefill worker degrades through the bounded collective
+  timeout to ``None`` — local-prefill fallback, never a hang.
+
+Run via:
+  python tools/run_multihost.py -n 2 python tests/fleet_handoff_worker.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.decode import DecodeEngine
+from mxnet_tpu.fleet import (export_prefix, handoff_exchange,
+                             inject_prefix, unpack_blocks)
+from mxnet_tpu.kvstore_tpu import dist
+from mxnet_tpu.models import transformer
+
+SEQ = 48
+CFG = dict(num_classes=50, num_layers=2, d_model=16, num_heads=2,
+           seq_len=SEQ)
+EK = dict(capacity=3, block_size=4, num_blocks=36, chunk_tokens=8,
+          warmup=True, prefix_cache=True)
+
+
+def _params():
+    tsym = transformer.get_symbol(**CFG)
+    shapes, _, _ = tsym.infer_shape(data=(1, SEQ), softmax_label=(SEQ,))
+    rng = np.random.RandomState(7)
+    return {n: rng.normal(0, 0.1, s).astype(np.float32)
+            for n, s in zip(tsym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def main():
+    kv = mx.kv.create("tpu")
+    rank, n = kv.rank, kv.num_workers
+    assert n == 2, n
+
+    params = _params()
+    prompt = [3, 14, 15, 9, 2, 6, 5, 35, 8, 9, 7, 9, 3, 2, 3, 8, 4]
+    eng = DecodeEngine(params, CFG, **EK)
+
+    if rank == 0:
+        # prefill worker: run the prompt, export its finished blocks
+        stream = eng.generate(prompt, max_new_tokens=4, timeout=120)
+        payload = export_prefix(eng, prompt)
+        assert payload is not None, "prefill left nothing in the trie"
+        got = handoff_exchange([b"", payload])
+        assert got is not None
+        assert got[1] == b""              # decode worker sends nothing
+    else:
+        got = handoff_exchange([b"", b""])
+        assert got is not None
+        payload = got[0]                  # rank 0's blocks
+        assert payload[:5] == b"MXFB1"
+
+        # bit-identical witness: a LOCAL prefill of the same prompt
+        # exports byte-for-byte the same block rows
+        local = DecodeEngine(params, CFG, **EK)
+        local_stream = local.generate(prompt, max_new_tokens=4,
+                                      timeout=120)
+        local_payload = export_prefix(local, prompt)
+        remote_t, remote_h = unpack_blocks(payload)
+        local_t, local_h = unpack_blocks(local_payload)
+        assert remote_h["n_rows"] == local_h["n_rows"] == 16
+        assert remote_h["tokens"] == local_h["tokens"]
+        for name in sorted(local_t):
+            assert np.array_equal(remote_t[name], local_t[name]), \
+                "handed-off %s differs from local prefill" % name
+
+        # inject + serve: same stream, prefix replay skipped
+        rows = inject_prefix(eng, payload)
+        assert rows == 16, rows
+        h0 = eng.cache.prefix_stats["hit_blocks"]
+        stream = eng.generate(prompt, max_new_tokens=4, timeout=120)
+        assert stream == local_stream, (stream, local_stream)
+        assert eng.cache.prefix_stats["hit_blocks"] - h0 > 0
+        local.stop()
+
+    dist.barrier("fleet_worker_mid", timeout_ms=60000)
+
+    # dead-prefill-worker degradation: rank 0 sits the exchange out,
+    # rank 1's bounded timeout returns None (local-prefill fallback)
+    if rank == 1:
+        t0 = time.monotonic()
+        dead = handoff_exchange([b"", b""], timeout_ms=2000)
+        assert dead is None, "timeout should degrade, not deliver"
+        assert time.monotonic() - t0 < 60, "degradation took too long"
+    else:
+        time.sleep(5.0)                   # outlive rank 1's timeout
+
+    dist.barrier("fleet_worker_done", timeout_ms=60000)
+    eng.stop()
+    print("all fleet handoff checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -307,6 +307,49 @@ def test_spec_draft_model_drafter(model, baseline):
         eng.stop()
 
 
+def test_draft_model_one_launch_per_span(model):
+    """The span drafter costs exactly ONE compiled dispatch per
+    proposal whatever K is, and its tokens match the K-sequential
+    reference bit-for-bit (the unrolled writeback feeds each step the
+    previous step's argmax exactly like re-running the forward)."""
+    from mxnet_tpu.decode.spec import DraftModelDrafter
+    from mxnet_tpu.executor import _DISPATCH_TALLY
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    tsym = transformer.get_symbol(**CFG)
+    exe = tsym.simple_bind(ctx=mx.cpu(), grad_req="null", data=(1, SEQ),
+                           softmax_label=(SEQ,))
+    exe.copy_params_from(
+        {k: NDArray(v) for k, v in model["params"].items()}, {},
+        allow_extra_params=True)
+
+    def seq_propose(tokens, k):
+        hist = [int(t) for t in tokens]
+        out = []
+        for _ in range(k):
+            n = len(hist[-SEQ:])
+            data = np.zeros((1, SEQ), np.float32)
+            data[0, :n] = hist[-SEQ:]
+            probs = exe.forward(is_train=False, data=data)[0]
+            nxt = int(np.argmax(probs.asnumpy()[n - 1]))
+            out.append(nxt)
+            hist.append(nxt)
+        return out
+
+    drafter = DraftModelDrafter(model["params"], CFG)
+    for k in (1, 3):
+        for p in PROMPTS:
+            assert drafter.propose(p, k) == seq_propose(p, k), (k, p)
+
+    drafter.propose(PROMPTS[0], 3)            # warm the K=3 program
+    before = _DISPATCH_TALLY.count
+    got = drafter.propose(PROMPTS[3], 3)
+    assert _DISPATCH_TALLY.count - before == 1, \
+        "a K=3 span must cost one draft launch, not K"
+    assert got == seq_propose(PROMPTS[3], 3)
+    assert drafter.propose([], 3) == []       # empty history: no span
+
+
 # ----------------------------------------------------------------------
 # drafters + impl selection
 # ----------------------------------------------------------------------
